@@ -1,0 +1,44 @@
+"""glom_tpu — a TPU-native GLOM framework (JAX / XLA / Pallas / pjit).
+
+A from-scratch, TPU-first implementation of the capabilities of the reference
+`glom-pytorch` (Hinton's GLOM, arXiv:2102.12627): patch columns of L level
+embeddings, iteratively updated by the mean of (previous value, bottom-up MLP,
+top-down MLP, same-level cross-column consensus attention).
+
+Layering (bottom to top):
+  ops/       pure tensor ops (grouped per-level MLP, consensus attention,
+             patchify) — the math contract, verified against a NumPy oracle
+  kernels/   Pallas TPU kernels (blockwise consensus, fused update)
+  models/    the functional GLOM core (lax.scan over iterations) and the
+             reference-compatible `Glom` API class
+  train/     self-supervised denoising trainer, temporal/video mode
+  parallel/  mesh / sharding / ring + halo + Ulysses sequence parallelism
+  utils/     config presets, checkpointing, metrics, profiling
+"""
+
+from glom_tpu.version import __version__
+
+__all__ = ["__version__"]
+
+
+def __getattr__(name):
+    # Lazy re-exports so `import glom_tpu` stays cheap and avoids importing
+    # jax until a symbol is actually used.
+    try:
+        if name in ("Glom", "GlomParams", "glom_forward", "init_glom"):
+            from glom_tpu.models import api, core
+
+            mapping = {
+                "Glom": api.Glom,
+                "GlomParams": core.GlomParams,
+                "glom_forward": core.glom_forward,
+                "init_glom": core.init_glom,
+            }
+            return mapping[name]
+        if name == "GlomConfig":
+            from glom_tpu.utils.config import GlomConfig
+
+            return GlomConfig
+    except ImportError as e:
+        raise AttributeError(f"module 'glom_tpu' has no attribute {name!r}") from e
+    raise AttributeError(f"module 'glom_tpu' has no attribute {name!r}")

@@ -1,0 +1,24 @@
+from glom_tpu.ops.consensus import build_local_mask, consensus_attention
+from glom_tpu.ops.ffw import GroupedFFWParams, grouped_ffw, init_grouped_ffw
+from glom_tpu.ops.patch import (
+    LinearParams,
+    image_to_tokens,
+    init_linear,
+    patchify,
+    tokens_to_image,
+    unpatchify,
+)
+
+__all__ = [
+    "build_local_mask",
+    "consensus_attention",
+    "GroupedFFWParams",
+    "grouped_ffw",
+    "init_grouped_ffw",
+    "LinearParams",
+    "image_to_tokens",
+    "init_linear",
+    "patchify",
+    "tokens_to_image",
+    "unpatchify",
+]

@@ -1,0 +1,85 @@
+"""Consensus attention: same-level attention across all columns (patches).
+
+Reference parity: ConsensusAttention (glom_pytorch/glom_pytorch.py:36-71).
+Behavioral contract (every item is a reference subtlety — see tests):
+
+  * No learned projections: attention is over the level embeddings themselves.
+    q = levels (raw), k = L2-normalized levels, v = levels (raw). The k-only
+    normalization makes the similarity cosine-like but asymmetric; the scale
+    is still d^-1/2.                              (reference :56-58)
+  * Per-level independence: sim[b, l, i, j] — each of the L levels runs its
+    own attention over the n patch positions.     (reference :58)
+  * Self mask (attend_self=False): the DIAGONAL similarity is REPLACED with
+    the soft value -5e-4 (not -inf) — columns attend weakly to themselves.
+                                                  (reference :9, :61-63)
+  * Local mask (local_consensus_radius > 0): positions farther than `radius`
+    in Euclidean patch-grid distance are hard-masked with -finfo.max.
+    Two different fill semantics live in one op.   (reference :42-52, :65-67)
+
+The dense form below materializes the [b, L, n, n] similarity — the simple,
+always-correct baseline. The O(n)-memory blockwise/Pallas and ring-sharded
+forms (glom_tpu.kernels / glom_tpu.parallel) are verified against this one.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from glom_tpu.utils.helpers import TOKEN_ATTEND_SELF_VALUE, l2norm, max_neg_value
+
+
+def build_local_mask(num_patches_side: int, radius: float) -> Optional[np.ndarray]:
+    """Static [n, n] boolean mask; True = NON-local pair (to be hard-masked).
+
+    Mirrors the reference's init-time meshgrid -> cdist -> (dist > radius)
+    buffer (reference :42-52). Built in numpy at trace time: it is a
+    compile-time constant, never a traced value.
+    """
+    if radius <= 0:
+        return None
+    side = num_patches_side
+    hs, ws = np.meshgrid(np.arange(side), np.arange(side), indexing="ij")
+    coords = np.stack([hs, ws], axis=-1).reshape(-1, 2).astype(np.float64)
+    dist = np.linalg.norm(coords[:, None, :] - coords[None, :, :], axis=-1)
+    return dist > radius
+
+
+def consensus_attention(
+    levels: jnp.ndarray,
+    *,
+    attend_self: bool = False,
+    local_mask: Optional[np.ndarray] = None,
+    compute_dtype=None,
+) -> jnp.ndarray:
+    """Dense consensus attention.
+
+    levels: [b, n, L, d]  ->  [b, n, L, d]
+    local_mask: optional [n, n] bool, True = masked out (non-local).
+    """
+    if compute_dtype is not None:
+        levels = levels.astype(compute_dtype)
+    b, n, L, d = levels.shape
+    q = levels
+    k = l2norm(levels, axis=-1)
+    v = levels
+
+    scale = d ** -0.5
+    sim = jnp.einsum("bild,bjld->blij", q, k, preferred_element_type=jnp.float32)
+    sim = sim * scale
+
+    if not attend_self:
+        eye = jnp.eye(n, dtype=bool)
+        sim = jnp.where(eye[None, None, :, :], TOKEN_ATTEND_SELF_VALUE, sim)
+
+    if local_mask is not None:
+        mask = jnp.asarray(local_mask)
+        sim = jnp.where(mask[None, None, :, :], max_neg_value(sim.dtype), sim)
+
+    attn = jax.nn.softmax(sim, axis=-1).astype(levels.dtype)
+
+    out = jnp.einsum("blij,bjld->bild", attn, v, preferred_element_type=jnp.float32)
+    return out.astype(levels.dtype)

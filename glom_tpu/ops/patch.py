@@ -1,0 +1,68 @@
+"""Patchify / unpatchify and the token embedding.
+
+Reference parity: the `image_to_tokens` Sequential in Glom.__init__
+(glom_pytorch/glom_pytorch.py:88-91):
+
+    Rearrange('b c (h p1) (w p2) -> b (h w) (p1 p2 c)') ; Linear(p*p*c -> dim)
+
+and the README's reconstruction head (`patches_to_images`): Linear(dim ->
+p*p*c) + the inverse Rearrange (README :30-75, the denoise recipe).
+
+Images are channel-first [b, c, H, W] to preserve the reference API surface.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from einops import rearrange
+
+
+class LinearParams(NamedTuple):
+    w: jnp.ndarray  # [in, out]
+    b: jnp.ndarray  # [out]
+
+
+def init_linear(key: jax.Array, d_in: int, d_out: int, dtype=jnp.float32) -> LinearParams:
+    k1, k2 = jax.random.split(key)
+    s = 1.0 / jnp.sqrt(d_in)
+    return LinearParams(
+        w=jax.random.uniform(k1, (d_in, d_out), dtype, -s, s),
+        b=jax.random.uniform(k2, (d_out,), dtype, -s, s),
+    )
+
+
+def patchify(img: jnp.ndarray, patch_size: int) -> jnp.ndarray:
+    """[b, c, H, W] -> [b, n, p*p*c] with n = (H/p)*(W/p).
+
+    Patch-flattening order matches the reference's einops pattern
+    'b c (h p1) (w p2) -> b (h w) (p1 p2 c)': within a patch, the channel
+    axis is innermost.
+    """
+    p = patch_size
+    return rearrange(img, "b c (h p1) (w p2) -> b (h w) (p1 p2 c)", p1=p, p2=p)
+
+
+def unpatchify(patches: jnp.ndarray, patch_size: int, image_size: int) -> jnp.ndarray:
+    """[b, n, p*p*c] -> [b, c, H, W]; exact inverse of `patchify`."""
+    p = patch_size
+    h = image_size // p
+    return rearrange(
+        patches, "b (h w) (p1 p2 c) -> b c (h p1) (w p2)", h=h, w=h, p1=p, p2=p
+    )
+
+
+def image_to_tokens(params: LinearParams, img: jnp.ndarray, patch_size: int) -> jnp.ndarray:
+    """[b, c, H, W] -> [b, n, dim] token embedding."""
+    x = patchify(img, patch_size)
+    return x @ params.w + params.b
+
+
+def tokens_to_image(
+    params: LinearParams, tokens: jnp.ndarray, patch_size: int, image_size: int
+) -> jnp.ndarray:
+    """[b, n, dim] -> [b, c, H, W] reconstruction head (README denoise recipe)."""
+    x = tokens @ params.w + params.b
+    return unpatchify(x, patch_size, image_size)

@@ -1,0 +1,15 @@
+from glom_tpu.utils.helpers import (
+    TOKEN_ATTEND_SELF_VALUE,
+    default,
+    exists,
+    l2norm,
+    max_neg_value,
+)
+
+__all__ = [
+    "TOKEN_ATTEND_SELF_VALUE",
+    "default",
+    "exists",
+    "l2norm",
+    "max_neg_value",
+]

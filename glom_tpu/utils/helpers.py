@@ -1,0 +1,41 @@
+"""Small functional helpers shared across the framework.
+
+Reference parity: `exists` / `default` mirror the null-coalescing helpers in the
+reference (glom_pytorch/glom_pytorch.py:13-17) used for the optional `iters` /
+`levels` forward arguments.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# The *soft* self-attention penalty used by consensus attention when
+# attend_self=False. Deliberately NOT -inf: columns attend weakly to
+# themselves. (reference: glom_pytorch/glom_pytorch.py:9)
+TOKEN_ATTEND_SELF_VALUE = -5e-4
+
+
+def exists(val):
+    return val is not None
+
+
+def default(val, d):
+    return val if exists(val) else d
+
+
+def l2norm(x: jnp.ndarray, axis: int = -1, eps: float = 1e-12) -> jnp.ndarray:
+    """L2-normalize along `axis`, matching torch.nn.functional.normalize:
+    x / max(||x||_2, eps).
+    """
+    norm = jnp.linalg.norm(x, ord=2, axis=axis, keepdims=True)
+    return x / jnp.maximum(norm, eps)
+
+
+def max_neg_value(dtype) -> float:
+    """The -finfo.max fill used for the *hard* (local-radius) attention mask.
+
+    Distinct from TOKEN_ATTEND_SELF_VALUE — the reference uses two different
+    mask semantics in one attention op (soft self-penalty vs hard locality
+    cutoff). (reference: glom_pytorch/glom_pytorch.py:63-67)
+    """
+    return -jnp.finfo(dtype).max

@@ -1,0 +1,31 @@
+"""Test harness: force an 8-device virtual CPU platform BEFORE jax imports.
+
+This is the JAX-native analog of a fake/mock distributed backend: every
+pjit/shard_map/ring-collective test runs multi-device on CPU without TPU
+hardware (SURVEY.md §4d). Must run before any test module imports jax.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Determinism and precision: CPU tests compare against a float64 numpy oracle.
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+# A sitecustomize hook in this image may have pre-registered a TPU backend and
+# overridden jax_platforms before conftest ran; force CPU at the config level.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,138 @@
+"""Pure-NumPy oracle of the GLOM forward contract (SURVEY.md §3.2).
+
+This is an INDEPENDENT implementation — written directly from the behavioral
+spec, sharing no code with glom_tpu — used to lock every contract subtlety:
+
+  1. iters default = 2 * levels
+  2. pos-emb added ONLY to the top-down net's input, every iteration
+  3. k-only L2 normalization in consensus attention, scale d^-1/2
+  4. self-mask value -5e-4 (soft replace); local-radius mask -finfo.max (hard)
+  5. per-level divisor: 4 everywhere, 3 at the TOP level (zero-padded top-down)
+  6. return_all yields T+1 states including the initial one
+  7. `levels` may be passed in (temporal carry)
+  8. the update is a plain unweighted mean — no gating/norm
+
+All math float64 by default for a tight tolerance against float32 JAX.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+TOKEN_ATTEND_SELF_VALUE = -5e-4
+
+
+def np_gelu(x):
+    """Exact (erf) GELU."""
+    from scipy.special import erf  # scipy available transitively; fallback below
+
+    return 0.5 * x * (1.0 + erf(x / np.sqrt(2.0)))
+
+
+try:  # pragma: no cover - environment probe
+    import scipy.special  # noqa: F401
+except ImportError:  # pragma: no cover
+    from math import erf as _erf
+
+    def np_gelu(x):  # type: ignore[no-redef]
+        return 0.5 * x * (1.0 + np.vectorize(_erf)(x / np.sqrt(2.0)))
+
+
+def np_l2norm(x, axis=-1, eps=1e-12):
+    n = np.linalg.norm(x, axis=axis, keepdims=True)
+    return x / np.maximum(n, eps)
+
+
+def np_grouped_ffw(params, x):
+    """x: [..., G, d]; params dict with w1 [G,d,f], b1 [G,f], w2 [G,f,d], b2 [G,d]."""
+    h = np.einsum("...gd,gdf->...gf", x, params["w1"]) + params["b1"]
+    h = np_gelu(h)
+    return np.einsum("...gf,gfd->...gd", h, params["w2"]) + params["b2"]
+
+
+def np_local_mask(side, radius):
+    if radius <= 0:
+        return None
+    hs, ws = np.meshgrid(np.arange(side), np.arange(side), indexing="ij")
+    coords = np.stack([hs, ws], -1).reshape(-1, 2).astype(np.float64)
+    dist = np.linalg.norm(coords[:, None] - coords[None, :], axis=-1)
+    return dist > radius
+
+
+def np_consensus(levels, attend_self=False, local_mask=None):
+    """levels: [b, n, L, d] -> [b, n, L, d]."""
+    b, n, L, d = levels.shape
+    q = levels
+    k = np_l2norm(levels)
+    sim = np.einsum("bild,bjld->blij", q, k) * (d ** -0.5)
+    if not attend_self:
+        eye = np.eye(n, dtype=bool)
+        sim = np.where(eye[None, None], TOKEN_ATTEND_SELF_VALUE, sim)
+    if local_mask is not None:
+        sim = np.where(local_mask[None, None], -np.finfo(sim.dtype).max, sim)
+    sim = sim - sim.max(axis=-1, keepdims=True)
+    attn = np.exp(sim)
+    attn = attn / attn.sum(axis=-1, keepdims=True)
+    return np.einsum("blij,bjld->bild", attn, levels)
+
+
+def np_patchify(img, p):
+    """[b, c, H, W] -> [b, n, p*p*c], channel innermost per patch."""
+    b, c, H, W = img.shape
+    h, w = H // p, W // p
+    x = img.reshape(b, c, h, p, w, p)
+    x = x.transpose(0, 2, 4, 3, 5, 1)  # b h w p1 p2 c
+    return x.reshape(b, h * w, p * p * c)
+
+
+def np_unpatchify(x, p, image_size, c=3):
+    b, n, _ = x.shape
+    h = image_size // p
+    x = x.reshape(b, h, h, p, p, c)
+    x = x.transpose(0, 5, 1, 3, 2, 4)  # b c h p1 w p2
+    return x.reshape(b, c, h * p, h * p)
+
+
+def np_forward(
+    params,
+    img,
+    *,
+    levels_cfg,
+    patch_size,
+    iters=None,
+    levels=None,
+    return_all=False,
+    attend_self=False,
+    local_mask=None,
+):
+    """Full GLOM forward. params: dict with keys
+    token_w [p*p*c, d], token_b [d], pos_emb [n, d], init_levels [L, d],
+    bottom_up {w1,b1,w2,b2} (G=L), top_down {...} (G=L-1).
+    """
+    L = levels_cfg
+    T = iters if iters is not None else 2 * L
+
+    tokens = np_patchify(img, patch_size) @ params["token_w"] + params["token_b"]
+    b, n, d = tokens.shape
+    pos = params["pos_emb"][None, :, None, :]  # [1, n, 1, d]
+    bottom = tokens[:, :, None, :]  # [b, n, 1, d]
+
+    if levels is None:
+        levels = np.broadcast_to(params["init_levels"][None, None], (b, n, L, d)).copy()
+
+    hiddens = [levels]
+    divisor = np.full((L, 1), 4.0)
+    divisor[-1] = 3.0  # top level has no top-down contribution
+
+    for _ in range(T):
+        with_input = np.concatenate([bottom, levels], axis=2)  # [b, n, L+1, d]
+        bu = np_grouped_ffw(params["bottom_up"], with_input[:, :, :-1, :])
+        td = np_grouped_ffw(params["top_down"], with_input[:, :, 2:, :] + pos)
+        td = np.concatenate([td, np.zeros_like(td[:, :, :1])], axis=2)
+        cons = np_consensus(levels, attend_self=attend_self, local_mask=local_mask)
+        levels = (levels + bu + td + cons) / divisor
+        hiddens.append(levels)
+
+    if return_all:
+        return np.stack(hiddens)  # [T+1, b, n, L, d]
+    return levels

@@ -1,0 +1,154 @@
+"""Unit tests for the ops layer against the NumPy oracle (SURVEY.md §4a)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from glom_tpu.ops import (
+    build_local_mask,
+    consensus_attention,
+    grouped_ffw,
+    init_grouped_ffw,
+    patchify,
+    unpatchify,
+)
+from glom_tpu.ops.ffw import GroupedFFWParams
+from oracle_np import (
+    np_consensus,
+    np_grouped_ffw,
+    np_local_mask,
+    np_patchify,
+    np_unpatchify,
+)
+
+
+def rand_ffw_params(rng, groups, dim, mult=4):
+    hidden = dim * mult
+    return {
+        "w1": rng.normal(size=(groups, dim, hidden)) * 0.1,
+        "b1": rng.normal(size=(groups, hidden)) * 0.1,
+        "w2": rng.normal(size=(groups, hidden, dim)) * 0.1,
+        "b2": rng.normal(size=(groups, dim)) * 0.1,
+    }
+
+
+def to_jax_ffw(p):
+    return GroupedFFWParams(
+        *(jnp.asarray(p[k], jnp.float32) for k in ("w1", "b1", "w2", "b2"))
+    )
+
+
+class TestGroupedFFW:
+    def test_matches_oracle(self, rng):
+        G, d = 5, 32
+        p = rand_ffw_params(rng, G, d)
+        x = rng.normal(size=(2, 7, G, d))
+        got = grouped_ffw(to_jax_ffw(p), jnp.asarray(x, jnp.float32))
+        want = np_grouped_ffw(p, x)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+    def test_no_cross_group_mixing(self, rng):
+        """Perturbing group g's input must not change any other group's output
+        (the defining property of the reference's Conv1d-groups trick)."""
+        G, d = 4, 16
+        p = to_jax_ffw(rand_ffw_params(rng, G, d))
+        x = jnp.asarray(rng.normal(size=(1, 3, G, d)), jnp.float32)
+        base = grouped_ffw(p, x)
+        x2 = x.at[:, :, 1, :].add(1.0)
+        out2 = grouped_ffw(p, x2)
+        others = [g for g in range(G) if g != 1]
+        np.testing.assert_allclose(
+            np.asarray(out2[:, :, others]), np.asarray(base[:, :, others]), atol=1e-6
+        )
+        assert not np.allclose(np.asarray(out2[:, :, 1]), np.asarray(base[:, :, 1]))
+
+    def test_init_shapes(self):
+        p = init_grouped_ffw(jax.random.PRNGKey(0), groups=6, dim=64, mult=4)
+        assert p.w1.shape == (6, 64, 256)
+        assert p.b1.shape == (6, 256)
+        assert p.w2.shape == (6, 256, 64)
+        assert p.b2.shape == (6, 64)
+
+
+class TestConsensusAttention:
+    @pytest.mark.parametrize("attend_self", [False, True])
+    def test_matches_oracle(self, rng, attend_self):
+        b, n, L, d = 2, 9, 3, 16
+        x = rng.normal(size=(b, n, L, d))
+        got = consensus_attention(
+            jnp.asarray(x, jnp.float32), attend_self=attend_self
+        )
+        want = np_consensus(x, attend_self=attend_self)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+    def test_local_mask_matches_oracle(self, rng):
+        side, L, d = 4, 2, 8
+        n = side * side
+        mask = build_local_mask(side, radius=1.5)
+        want_mask = np_local_mask(side, 1.5)
+        np.testing.assert_array_equal(mask, want_mask)
+        x = rng.normal(size=(1, n, L, d))
+        got = consensus_attention(jnp.asarray(x, jnp.float32), local_mask=mask)
+        want = np_consensus(x, local_mask=want_mask)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+    def test_local_mask_zeroes_nonlocal_attention(self, rng):
+        """Hard-masked (non-local) pairs must receive exactly zero attention
+        weight, while the soft self mask must NOT zero the diagonal."""
+        side, L, d = 3, 1, 4
+        n = side * side
+        mask = build_local_mask(side, radius=1.0)
+        x = jnp.asarray(rng.normal(size=(1, n, L, d)), jnp.float32)
+        # recompute attention weights the oracle way to probe them
+        from glom_tpu.utils.helpers import TOKEN_ATTEND_SELF_VALUE, l2norm
+
+        sim = jnp.einsum("bild,bjld->blij", x, l2norm(x)) * (d ** -0.5)
+        sim = jnp.where(jnp.eye(n, dtype=bool)[None, None], TOKEN_ATTEND_SELF_VALUE, sim)
+        sim = jnp.where(jnp.asarray(mask)[None, None], -jnp.finfo(jnp.float32).max, sim)
+        attn = jax.nn.softmax(sim, axis=-1)
+        attn = np.asarray(attn)[0, 0]
+        assert np.all(attn[np.asarray(mask)] == 0.0)
+        assert np.all(attn.diagonal() > 0.0)  # soft self penalty, not -inf
+
+    def test_self_mask_is_soft_not_hard(self, rng):
+        """-5e-4 vs -inf distinction: diagonal attention stays near-uniform
+        magnitude, far from zero."""
+        n, L, d = 6, 1, 8
+        x = jnp.asarray(rng.normal(size=(1, n, L, d)) * 0.01, jnp.float32)
+        out_masked = consensus_attention(x, attend_self=False)
+        out_self = consensus_attention(x, attend_self=True)
+        # With tiny inputs sims ~0, so -5e-4 barely changes the result.
+        np.testing.assert_allclose(
+            np.asarray(out_masked), np.asarray(out_self), atol=1e-3
+        )
+
+    def test_per_level_independence(self, rng):
+        """Attention at level l must only read level l across columns."""
+        b, n, L, d = 1, 5, 3, 8
+        x = rng.normal(size=(b, n, L, d))
+        base = np.asarray(consensus_attention(jnp.asarray(x, jnp.float32)))
+        x2 = x.copy()
+        x2[:, :, 2, :] += 1.0
+        out2 = np.asarray(consensus_attention(jnp.asarray(x2, jnp.float32)))
+        np.testing.assert_allclose(out2[:, :, :2], base[:, :, :2], atol=1e-6)
+
+
+class TestPatchify:
+    def test_roundtrip(self, rng):
+        img = rng.normal(size=(2, 3, 16, 16))
+        p = patchify(jnp.asarray(img, jnp.float32), 4)
+        back = unpatchify(p, 4, 16)
+        np.testing.assert_allclose(np.asarray(back), img, rtol=1e-6)
+
+    def test_matches_oracle_ordering(self, rng):
+        img = rng.normal(size=(1, 3, 8, 8))
+        got = patchify(jnp.asarray(img, jnp.float32), 2)
+        want = np_patchify(img, 2)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+        back = np_unpatchify(want, 2, 8)
+        np.testing.assert_allclose(back, img, rtol=1e-12)
+
+
+def test_virtual_device_count():
+    assert jax.device_count() == 8, "conftest must provide 8 virtual CPU devices"
