@@ -1,0 +1,17 @@
+from glom_tpu.models.api import Glom
+from glom_tpu.models.core import (
+    GlomParams,
+    contribution_divisor,
+    glom_forward,
+    init_glom,
+    update_step,
+)
+
+__all__ = [
+    "Glom",
+    "GlomParams",
+    "contribution_divisor",
+    "glom_forward",
+    "init_glom",
+    "update_step",
+]

@@ -1,0 +1,102 @@
+"""The user-facing `Glom` class — the reference's public API, preserved.
+
+Reference parity: `Glom(dim=512, levels=6, image_size=224, patch_size=14,
+consensus_self=False, local_consensus_radius=0)` and
+`forward(img, iters=None, levels=None, return_all=False)`
+(glom_pytorch/glom_pytorch.py:76-83, :103). A reference user switches by
+changing the import; the constructor accepts the same kwargs (plus a
+`backend` flag per the project north star, and JAX-specific extras: `key`,
+`param_dtype`, `compute_dtype`, `remat`).
+
+This is a thin object-oriented shell over the functional core: it owns a
+params pytree and memoizes jitted forwards per static signature. All real
+logic lives in glom_tpu.models.core, which composes with jit/grad/pjit.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from glom_tpu.models.core import GlomParams, glom_forward, init_glom
+from glom_tpu.utils.config import GlomConfig
+
+
+class Glom:
+    def __init__(
+        self,
+        *,
+        dim: int = 512,
+        levels: int = 6,
+        image_size: int = 224,
+        patch_size: int = 14,
+        consensus_self: bool = False,
+        local_consensus_radius: int = 0,
+        backend: str = "tpu",
+        key: Optional[jax.Array] = None,
+        params: Optional[GlomParams] = None,
+        param_dtype=jnp.float32,
+        compute_dtype=None,
+        remat: bool = False,
+    ):
+        if backend not in ("tpu", "cpu", "xla"):
+            raise ValueError(
+                f"backend={backend!r}: this framework is the native XLA backend; "
+                "valid values are 'tpu', 'cpu', 'xla' (all compile via XLA to "
+                "whatever jax.devices() exposes)"
+            )
+        self.config = GlomConfig(
+            dim=dim,
+            levels=levels,
+            image_size=image_size,
+            patch_size=patch_size,
+            consensus_self=consensus_self,
+            local_consensus_radius=local_consensus_radius,
+        )
+        self.compute_dtype = compute_dtype
+        self.remat = remat
+        if params is None:
+            key = key if key is not None else jax.random.PRNGKey(0)
+            params = init_glom(key, self.config, param_dtype)
+        self.params = params
+        self._jitted = {}
+
+    def _forward(self, iters, return_all):
+        # Normalize before keying so iters=None and the explicit default share
+        # one compiled program; levels-presence is already distinguished by
+        # jax.jit's own pytree-structure cache.
+        iters = iters if iters is not None else self.config.default_iters
+        sig = (iters, return_all)
+        if sig not in self._jitted:
+            def fn(params, img, levels):
+                return glom_forward(
+                    params,
+                    img,
+                    self.config,
+                    iters=iters,
+                    levels=levels,
+                    return_all=return_all,
+                    remat=self.remat,
+                    compute_dtype=self.compute_dtype,
+                )
+
+            self._jitted[sig] = jax.jit(fn)
+        return self._jitted[sig]
+
+    def __call__(
+        self,
+        img: jnp.ndarray,
+        iters: Optional[int] = None,
+        levels: Optional[jnp.ndarray] = None,
+        return_all: bool = False,
+    ) -> jnp.ndarray:
+        """forward(img, iters=None, levels=None, return_all=False) — the
+        reference signature, jit-compiled and memoized per static config."""
+        fn = self._forward(iters, return_all)
+        return fn(self.params, img, levels)
+
+    # torch-familiar alias
+    forward = __call__

@@ -1,0 +1,167 @@
+"""The functional GLOM core: parameter init, one column-update step, and the
+scanned T-iteration forward.
+
+Reference parity: Glom.__init__ / Glom.forward (glom_pytorch/glom_pytorch.py:
+75-152); the full behavioral contract is SURVEY.md §3.2 and is locked by
+tests/test_model.py against the NumPy oracle. Where the reference runs T
+eager iterations (one CUDA kernel launch per op), this core is a single
+`lax.scan` body compiled once by XLA — the loop is fused, weights stay
+resident, and the T iterations pipeline on-chip.
+
+Design notes (TPU-first, not a port):
+  * Pure functions over a `GlomParams` pytree — jit/grad/vmap/pjit compose.
+  * `iters` is a static scan length (no data-dependent control flow).
+  * `consensus_fn` is injectable so the dense op can be swapped for the
+    Pallas blockwise kernel or the ring/Ulysses sharded forms without
+    touching the core update equation.
+  * `remat=True` wraps the scan body in jax.checkpoint — BASELINE config 5's
+    "ckpt over iters" — trading recompute for O(1) activation memory in T.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from einops import rearrange
+
+from glom_tpu.ops.consensus import build_local_mask, consensus_attention
+from glom_tpu.ops.ffw import GroupedFFWParams, grouped_ffw, init_grouped_ffw
+from glom_tpu.ops.patch import LinearParams, image_to_tokens, init_linear
+from glom_tpu.utils.config import GlomConfig
+from glom_tpu.utils.helpers import default, exists
+
+ConsensusFn = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+class GlomParams(NamedTuple):
+    """Learnable state. Mirrors the reference module tree (SURVEY.md §3.1)."""
+
+    token_embed: LinearParams  # Linear(p*p*c -> d)        (reference :88-91)
+    pos_emb: jnp.ndarray  # [n, d] learned position table   (reference :92)
+    init_levels: jnp.ndarray  # [L, d] learned column init  (reference :95)
+    bottom_up: GroupedFFWParams  # groups = L               (reference :98)
+    top_down: GroupedFFWParams  # groups = L - 1            (reference :99)
+
+
+def init_glom(key: jax.Array, cfg: GlomConfig, dtype=jnp.float32) -> GlomParams:
+    k_tok, k_pos, k_lvl, k_bu, k_td = jax.random.split(key, 5)
+    return GlomParams(
+        token_embed=init_linear(k_tok, cfg.patch_dim, cfg.dim, dtype),
+        pos_emb=jax.random.normal(k_pos, (cfg.num_patches, cfg.dim), dtype),
+        init_levels=jax.random.normal(k_lvl, (cfg.levels, cfg.dim), dtype),
+        bottom_up=init_grouped_ffw(k_bu, cfg.levels, cfg.dim, cfg.mult, dtype),
+        top_down=init_grouped_ffw(k_td, cfg.levels - 1, cfg.dim, cfg.mult, dtype),
+    )
+
+
+def contribution_divisor(levels: int, dtype=jnp.float32) -> jnp.ndarray:
+    """[L, 1] per-level mean divisor: 4 contributions everywhere except the
+    top level, which has no top-down input and divides by 3 (reference
+    :121-122 — a naive mean-of-stack is wrong at the top)."""
+    div = np.full((levels, 1), 4.0, dtype=np.float64)
+    div[-1] = 3.0
+    return jnp.asarray(div, dtype)
+
+
+def update_step(
+    params: GlomParams,
+    levels: jnp.ndarray,
+    bottom: jnp.ndarray,
+    pos: jnp.ndarray,
+    divisor: jnp.ndarray,
+    *,
+    consensus_fn: ConsensusFn,
+) -> jnp.ndarray:
+    """One column update: the mean of (previous value, bottom-up, top-down,
+    consensus). The §3.2 loop body (reference :124-140).
+
+    levels: [b, n, L, d]   bottom: [b, n, 1, d]   pos: [1, n, 1, d]
+    """
+    with_input = jnp.concatenate([bottom, levels], axis=-2)  # [b, n, L+1, d]
+    # Bottom-up sees (image tokens, levels 1..L-1) -> update for levels 1..L:
+    # level 1 re-reads the RAW tokens every iteration (reference :127).
+    with jax.named_scope("bottom_up"):
+        bottom_up_out = grouped_ffw(params.bottom_up, with_input[..., :-1, :])
+    # Top-down sees levels 2..L with the positional embedding injected HERE
+    # and only here (reference :129); produces updates for levels 1..L-1,
+    # zero-padded at the top (reference :130).
+    with jax.named_scope("top_down"):
+        top_down_out = grouped_ffw(params.top_down, with_input[..., 2:, :] + pos)
+        top_down_out = jnp.pad(top_down_out, ((0, 0), (0, 0), (0, 1), (0, 0)))
+    with jax.named_scope("consensus"):
+        consensus = consensus_fn(levels)
+    with jax.named_scope("mean_update"):
+        new_levels = (levels + bottom_up_out + top_down_out + consensus) / divisor
+    return new_levels.astype(levels.dtype)
+
+
+def glom_forward(
+    params: GlomParams,
+    img: jnp.ndarray,
+    cfg: GlomConfig,
+    *,
+    iters: Optional[int] = None,
+    levels: Optional[jnp.ndarray] = None,
+    return_all: bool = False,
+    remat: bool = False,
+    compute_dtype=None,
+    consensus_fn: Optional[ConsensusFn] = None,
+) -> jnp.ndarray:
+    """The T-iteration GLOM forward (reference :103-152).
+
+    img: [b, c, H, W] -> [b, n, L, d], or [T+1, b, n, L, d] with return_all
+    (T+1 includes the INITIAL state, reference :119/:140/:143).
+
+    `levels` may be passed in to continue from a previous call (the README
+    temporal/video recipe — detach between frames with lax.stop_gradient).
+    `iters`/`return_all`/`remat` are static under jit.
+    """
+    T = default(iters, cfg.default_iters)
+
+    if consensus_fn is None:
+        local_mask = build_local_mask(cfg.num_patches_side, cfg.local_consensus_radius)
+        consensus_fn = partial(
+            consensus_attention,
+            attend_self=cfg.consensus_self,
+            local_mask=local_mask,
+        )
+
+    # Cast params and inputs ONCE, outside the scan — casting inside the body
+    # would re-run (and re-run again under remat) every iteration.
+    if compute_dtype is not None:
+        params = jax.tree_util.tree_map(lambda t: t.astype(compute_dtype), params)
+        img = img.astype(compute_dtype)
+        if exists(levels):
+            levels = levels.astype(compute_dtype)
+
+    with jax.named_scope("image_to_tokens"):
+        tokens = image_to_tokens(params.token_embed, img, cfg.patch_size)  # [b,n,d]
+    b, n, d = tokens.shape
+    pos = rearrange(params.pos_emb, "n d -> 1 n 1 d")
+    bottom = rearrange(tokens, "b n d -> b n 1 d")
+
+    if not exists(levels):
+        levels = jnp.broadcast_to(
+            params.init_levels[None, None], (b, n, cfg.levels, d)
+        ).astype(tokens.dtype)
+
+    divisor = contribution_divisor(cfg.levels, jnp.float32)
+
+    def body(carry, _):
+        new = update_step(
+            params, carry, bottom, pos, divisor, consensus_fn=consensus_fn
+        )
+        return new, (new if return_all else None)
+
+    if remat:
+        body = jax.checkpoint(body)
+
+    final, stacked = jax.lax.scan(body, levels, None, length=T)
+
+    if return_all:
+        return jnp.concatenate([levels[None], stacked], axis=0)  # [T+1, b, n, L, d]
+    return final

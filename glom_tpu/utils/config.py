@@ -1,0 +1,96 @@
+"""Configuration dataclasses.
+
+The reference's entire "config system" is the six `Glom.__init__` kwargs
+(glom_pytorch/glom_pytorch.py:76-83) plus two forward kwargs. Those six are
+preserved verbatim in `GlomConfig`; everything else (training, mesh, backend)
+layers around them without touching the model contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class GlomConfig:
+    """Model hyperparameters — field-for-field the reference constructor."""
+
+    dim: int = 512
+    levels: int = 6
+    image_size: int = 224
+    patch_size: int = 14
+    consensus_self: bool = False
+    local_consensus_radius: int = 0
+    # Extensions beyond the reference kwargs (defaults match its hardcoded values):
+    mult: int = 4  # FFW expansion, reference hardcodes 4
+    channels: int = 3  # reference hardcodes RGB
+
+    def __post_init__(self):
+        if self.image_size % self.patch_size != 0:
+            raise ValueError(
+                f"image_size {self.image_size} not divisible by patch_size {self.patch_size}"
+            )
+        if self.levels < 2:
+            raise ValueError("levels must be >= 2 (top-down net needs levels-1 groups)")
+
+    @property
+    def num_patches_side(self) -> int:
+        return self.image_size // self.patch_size
+
+    @property
+    def num_patches(self) -> int:
+        return self.num_patches_side ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * self.channels
+
+    @property
+    def default_iters(self) -> int:
+        # "twice the levels, for information to propagate up and back down"
+        # (reference :105)
+        return 2 * self.levels
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Parallelism layout. Axis sizes of 1 disable an axis.
+
+    data:  batch sharding (DP) — gradient allreduce over ICI
+    seq:   patch-axis sharding (SP) — ring / halo consensus
+    model: dim sharding (TP) of the FFW weights
+    """
+
+    data: int = 1
+    seq: int = 1
+    model: int = 1
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return ("data", "seq", "model")
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (self.data, self.seq, self.model)
+
+    @property
+    def num_devices(self) -> int:
+        return self.data * self.seq * self.model
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Self-supervised denoising trainer (the reference's README recipe)."""
+
+    batch_size: int = 8
+    learning_rate: float = 1e-4
+    weight_decay: float = 0.0
+    noise_std: float = 1.0
+    # Which stacked iteration's top level feeds the reconstruction head.
+    # Reference README uses index 7 for L=6/T=12 (mid-iteration top level).
+    recon_iter_index: Optional[int] = None  # None -> (T + 1) // 2 + 1
+    iters: Optional[int] = None  # None -> model default (2L)
+    remat: bool = False  # jax.checkpoint over the scan body ("ckpt over iters")
+    compute_dtype: str = "float32"  # "bfloat16" for MXU-optimal training
+    seed: int = 0
