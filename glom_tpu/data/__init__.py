@@ -1,0 +1,3 @@
+from glom_tpu.data.synthetic import gaussian_dataset, shapes_dataset
+
+__all__ = ["gaussian_dataset", "shapes_dataset"]

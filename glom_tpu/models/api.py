@@ -15,7 +15,6 @@ logic lives in glom_tpu.models.core, which composes with jit/grad/pjit.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax

@@ -1,0 +1,29 @@
+from glom_tpu.train.objectives import (
+    DenoiseParams,
+    default_recon_index,
+    denoise_loss,
+    init_denoise,
+    reconstruct,
+)
+from glom_tpu.train.temporal import temporal_rollout
+from glom_tpu.train.trainer import (
+    Trainer,
+    TrainState,
+    create_train_state,
+    default_optimizer,
+    make_train_step,
+)
+
+__all__ = [
+    "DenoiseParams",
+    "default_recon_index",
+    "denoise_loss",
+    "init_denoise",
+    "reconstruct",
+    "temporal_rollout",
+    "Trainer",
+    "TrainState",
+    "create_train_state",
+    "default_optimizer",
+    "make_train_step",
+]

@@ -1,0 +1,122 @@
+"""Training objectives.
+
+The reference ships no trainer — its only "training loop" is the README's
+self-supervised denoising recipe (README :30-75, SURVEY.md §3.3):
+
+    noised     = img + randn_like(img)
+    all_levels = model(noised, return_all=True)     # [T+1, b, n, L, d]
+    top        = all_levels[k, :, :, -1]            # mid-iteration top level
+    recon      = patches_to_images(top)             # Linear(d -> p*p*c) + unpatchify
+    loss       = F.mse_loss(img, recon)
+
+This module provides that objective as a pure, jit/grad/pjit-composable
+function. One deliberate optimization over the reference: the loss depends
+only on iterations 1..k, so we scan exactly k iterations and take the final
+top level instead of materializing the full [T+1, ...] stack — identical
+math and gradients (iterations k+1..T are dead code for this loss; torch
+autograd also never touches them), but O(1) rather than O(T) activation
+memory before remat even enters the picture.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from glom_tpu.models.core import ConsensusFn, GlomParams, glom_forward, init_glom
+from glom_tpu.ops.patch import LinearParams, init_linear, tokens_to_image
+from glom_tpu.utils.config import GlomConfig
+
+
+class DenoiseParams(NamedTuple):
+    """GLOM params + the reconstruction head from the README recipe."""
+
+    glom: GlomParams
+    to_pixels: LinearParams  # Linear(d -> p*p*c)
+
+
+def init_denoise(key: jax.Array, cfg: GlomConfig, dtype=jnp.float32) -> DenoiseParams:
+    k_glom, k_pix = jax.random.split(key)
+    return DenoiseParams(
+        glom=init_glom(k_glom, cfg, dtype),
+        to_pixels=init_linear(k_pix, cfg.dim, cfg.patch_dim, dtype),
+    )
+
+
+def default_recon_index(iters: int) -> int:
+    """Which stacked state feeds the reconstruction head.
+
+    The reference README hardcodes index 7 for L=6 (T=2L=12): the
+    mid-iteration top level, after information has gone up and come back
+    down once. Generalized as T//2 + 1, which reproduces 7 at T=12.
+    """
+    return iters // 2 + 1
+
+
+def denoise_loss(
+    params: DenoiseParams,
+    img: jnp.ndarray,
+    noise: jnp.ndarray,
+    cfg: GlomConfig,
+    *,
+    recon_index: Optional[int] = None,
+    iters: Optional[int] = None,
+    remat: bool = False,
+    compute_dtype=None,
+    consensus_fn: Optional[ConsensusFn] = None,
+) -> jnp.ndarray:
+    """MSE between the clean image and the reconstruction from the noised
+    image's top level at iteration `recon_index`."""
+    T = iters if iters is not None else cfg.default_iters
+    k = recon_index if recon_index is not None else default_recon_index(T)
+    if not 1 <= k <= T:
+        raise ValueError(f"recon_index {k} outside 1..{T}")
+
+    noised = img + noise
+    final = glom_forward(
+        params.glom,
+        noised,
+        cfg,
+        iters=k,  # iterations k+1..T are dead for this loss; don't run them
+        remat=remat,
+        compute_dtype=compute_dtype,
+        consensus_fn=consensus_fn,
+    )
+    top = final[:, :, -1]  # [b, n, d] — the top level
+    with jax.named_scope("reconstruction"):
+        recon = tokens_to_image(
+            params.to_pixels, top.astype(img.dtype), cfg.patch_size, cfg.image_size
+        )
+    return jnp.mean((img - recon) ** 2)
+
+
+def reconstruct(
+    params: DenoiseParams,
+    img: jnp.ndarray,
+    cfg: GlomConfig,
+    *,
+    recon_index: Optional[int] = None,
+    iters: Optional[int] = None,
+    compute_dtype=None,
+    consensus_fn: Optional[ConsensusFn] = None,
+) -> jnp.ndarray:
+    """Inference-side reconstruction (for eval / visual inspection).
+
+    Pass the SAME consensus_fn the model was trained with — evaluating a
+    custom-consensus model with the default dense op is a silent mismatch.
+    """
+    T = iters if iters is not None else cfg.default_iters
+    k = recon_index if recon_index is not None else default_recon_index(T)
+    final = glom_forward(
+        params.glom,
+        img,
+        cfg,
+        iters=k,
+        compute_dtype=compute_dtype,
+        consensus_fn=consensus_fn,
+    )
+    return tokens_to_image(
+        params.to_pixels, final[:, :, -1].astype(img.dtype), cfg.patch_size, cfg.image_size
+    )

@@ -1,0 +1,71 @@
+"""Temporal / video mode.
+
+Reference parity: the README video recipe (README :80-100, SURVEY.md §3.4):
+
+    levels = None
+    for frame in frames:
+        if levels is not None: levels = levels.detach()
+        levels = model(frame, iters=12, levels=levels)
+
+i.e. columns persist across frames, with backprop-through-time truncated at
+frame boundaries. TPU-native form: the frame loop is itself a `lax.scan`
+(compiled once for any number of frames), and `.detach()` becomes
+`lax.stop_gradient` on the carry.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from glom_tpu.models.core import ConsensusFn, GlomParams, glom_forward
+from glom_tpu.utils.config import GlomConfig
+
+
+def temporal_rollout(
+    params: GlomParams,
+    frames: jnp.ndarray,
+    cfg: GlomConfig,
+    *,
+    iters: Optional[int] = None,
+    detach_between_frames: bool = True,
+    init_levels: Optional[jnp.ndarray] = None,
+    remat: bool = False,
+    compute_dtype=None,
+    consensus_fn: Optional[ConsensusFn] = None,
+) -> jnp.ndarray:
+    """Run GLOM over a frame sequence, carrying column state.
+
+    frames: [t, b, c, H, W]  ->  per-frame final levels [t, b, n, L, d].
+    """
+    t, b = frames.shape[:2]
+
+    def run_frame(levels, frame):
+        return glom_forward(
+            params,
+            frame,
+            cfg,
+            iters=iters,
+            levels=levels,
+            remat=remat,
+            compute_dtype=compute_dtype,
+            consensus_fn=consensus_fn,
+        )
+
+    # Frame 0 outside the scan: the reference calls it with levels=None, so
+    # init_levels DOES get gradients through the first frame — only the
+    # frame-to-frame carry is detached.
+    first = run_frame(init_levels, frames[0])
+    if t == 1:
+        return first[None]
+
+    def frame_step(levels, frame):
+        if detach_between_frames:
+            levels = jax.lax.stop_gradient(levels)
+        new = run_frame(levels, frame)
+        return new, new
+
+    _, rest = jax.lax.scan(frame_step, first, frames[1:])
+    return jnp.concatenate([first[None], rest], axis=0)

@@ -1,0 +1,147 @@
+"""The training loop the reference never had (SURVEY.md §5: trainer = absent
+in reference; README recipe only). TPU-native design:
+
+  * `train_step` is a pure function (state, batch, rng) -> (state, metrics),
+    jitted once; under a mesh it is pjit-sharded by glom_tpu.parallel.
+  * optimizer = any optax GradientTransformation (Adam by default).
+  * donate_argnums on the state so XLA updates parameters in place —
+    essential at pod scale where two copies of the optimizer state would
+    blow HBM.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Any, Callable, Iterator, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from glom_tpu.models.core import ConsensusFn
+from glom_tpu.train.objectives import DenoiseParams, denoise_loss, init_denoise
+from glom_tpu.utils.config import GlomConfig, TrainConfig
+
+
+class TrainState(NamedTuple):
+    params: DenoiseParams
+    opt_state: Any
+    step: jnp.ndarray  # scalar int32
+
+
+def create_train_state(
+    key: jax.Array,
+    cfg: GlomConfig,
+    tcfg: TrainConfig,
+    optimizer: Optional[optax.GradientTransformation] = None,
+) -> Tuple[TrainState, optax.GradientTransformation]:
+    optimizer = optimizer if optimizer is not None else default_optimizer(tcfg)
+    params = init_denoise(key, cfg)
+    return (
+        TrainState(
+            params=params,
+            opt_state=optimizer.init(params),
+            step=jnp.zeros((), jnp.int32),
+        ),
+        optimizer,
+    )
+
+
+def default_optimizer(tcfg: TrainConfig) -> optax.GradientTransformation:
+    if tcfg.weight_decay > 0:
+        return optax.adamw(tcfg.learning_rate, weight_decay=tcfg.weight_decay)
+    return optax.adam(tcfg.learning_rate)
+
+
+def make_train_step(
+    cfg: GlomConfig,
+    tcfg: TrainConfig,
+    optimizer: optax.GradientTransformation,
+    *,
+    consensus_fn: Optional[ConsensusFn] = None,
+) -> Callable[[TrainState, jnp.ndarray, jax.Array], Tuple[TrainState, dict]]:
+    """Build the pure train step. Noise is generated ON DEVICE from the rng
+    (no host->device transfer of noise tensors)."""
+    if tcfg.compute_dtype not in ("float32", "bfloat16"):
+        raise ValueError(
+            f"compute_dtype={tcfg.compute_dtype!r}: must be 'float32' or 'bfloat16'"
+        )
+    compute_dtype = jnp.bfloat16 if tcfg.compute_dtype == "bfloat16" else None
+
+    def train_step(state: TrainState, img: jnp.ndarray, rng: jax.Array):
+        noise_rng = jax.random.fold_in(rng, state.step)
+        noise = tcfg.noise_std * jax.random.normal(noise_rng, img.shape, img.dtype)
+
+        def loss_fn(params):
+            return denoise_loss(
+                params,
+                img,
+                noise,
+                cfg,
+                recon_index=tcfg.recon_iter_index,
+                iters=tcfg.iters,
+                remat=tcfg.remat,
+                compute_dtype=compute_dtype,
+                consensus_fn=consensus_fn,
+            )
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics = {
+            "loss": loss,
+            "grad_norm": optax.global_norm(grads),
+            "step": state.step,
+        }
+        return TrainState(params, opt_state, state.step + 1), metrics
+
+    return train_step
+
+
+class Trainer:
+    """Single-host convenience wrapper: jit, data iteration, metric logging.
+
+    The distributed path (glom_tpu.parallel.runtime.DistributedTrainer)
+    reuses make_train_step under pjit — this class is the 1-device base.
+    """
+
+    def __init__(
+        self,
+        cfg: GlomConfig,
+        tcfg: TrainConfig,
+        *,
+        optimizer: Optional[optax.GradientTransformation] = None,
+        consensus_fn: Optional[ConsensusFn] = None,
+        metrics_writer=None,
+    ):
+        self.cfg = cfg
+        self.tcfg = tcfg
+        key = jax.random.PRNGKey(tcfg.seed)
+        self.rng, init_key = jax.random.split(key)
+        self.state, self.optimizer = create_train_state(init_key, cfg, tcfg, optimizer)
+        step_fn = make_train_step(cfg, tcfg, self.optimizer, consensus_fn=consensus_fn)
+        self._step = jax.jit(step_fn, donate_argnums=(0,))
+        self.metrics_writer = metrics_writer
+
+    def fit(
+        self,
+        data: Iterator[jnp.ndarray],
+        num_steps: int,
+        *,
+        log_every: int = 10,
+    ) -> list[dict]:
+        """Run `num_steps` updates pulling [b, c, H, W] batches from `data`."""
+        history = []
+        t0 = time.perf_counter()
+        for i in range(num_steps):
+            batch = next(data)
+            self.rng, step_rng = jax.random.split(self.rng)
+            self.state, metrics = self._step(self.state, batch, step_rng)
+            if (i + 1) % log_every == 0 or i == num_steps - 1:
+                metrics = {k: float(v) for k, v in metrics.items()}
+                metrics["steps_per_sec"] = (i + 1) / (time.perf_counter() - t0)
+                history.append(metrics)
+                if self.metrics_writer is not None:
+                    self.metrics_writer.write(metrics)
+        return history

@@ -89,7 +89,7 @@ class TrainConfig:
     noise_std: float = 1.0
     # Which stacked iteration's top level feeds the reconstruction head.
     # Reference README uses index 7 for L=6/T=12 (mid-iteration top level).
-    recon_iter_index: Optional[int] = None  # None -> (T + 1) // 2 + 1
+    recon_iter_index: Optional[int] = None  # None -> T // 2 + 1 (7 at T=12)
     iters: Optional[int] = None  # None -> model default (2L)
     remat: bool = False  # jax.checkpoint over the scan body ("ckpt over iters")
     compute_dtype: str = "float32"  # "bfloat16" for MXU-optimal training

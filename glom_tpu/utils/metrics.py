@@ -1,0 +1,87 @@
+"""Metrics / observability (SURVEY.md §5: absent in reference — built here).
+
+JSONL metrics writer + the analytic FLOP model used for MFU. The FLOP model
+follows SURVEY.md §3.2's hot-loop profile:
+
+  per column-update iteration, per image:
+    bottom-up MLP : 2 matmuls over L groups   = 2 * n * L * d * (d*mult) * 2
+    top-down  MLP : same over L-1 groups
+    consensus     : 2 einsums, O(L * n^2 * d) = 2 * L * n * n * d * 2
+
+A "column-iter" (the north-star unit) = one t-step update of all n*L level
+vectors of one image.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Optional
+
+from glom_tpu.utils.config import GlomConfig
+
+
+def flops_per_column_iter(cfg: GlomConfig) -> float:
+    """FLOPs for one column-update iteration of ONE image (forward only)."""
+    n, L, d, m = cfg.num_patches, cfg.levels, cfg.dim, cfg.mult
+    ffw = lambda groups: 2 * 2 * n * groups * d * (d * m)  # two matmuls, MACs*2
+    bottom_up = ffw(L)
+    top_down = ffw(L - 1)
+    consensus = 2 * 2 * L * n * n * d  # qk^T and attn@v
+    return float(bottom_up + top_down + consensus)
+
+
+def tokens_flops(cfg: GlomConfig) -> float:
+    """Patch embedding FLOPs per image (outside the loop)."""
+    return float(2 * cfg.num_patches * cfg.patch_dim * cfg.dim)
+
+
+# Peak bf16 TFLOP/s per chip. v5e ("TPU v5 lite"): 197 bf16 TFLOP/s.
+PEAK_FLOPS = {
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "cpu": 1e12,  # nominal, so MFU math never divides by zero off-TPU
+}
+
+
+def mfu(
+    cfg: GlomConfig,
+    column_iters_per_sec: float,
+    *,
+    chip: str = "v5e",
+    backward: bool = False,
+) -> float:
+    """Model FLOP utilization from measured column-iters/sec/chip."""
+    f = flops_per_column_iter(cfg)
+    if backward:
+        f *= 3.0  # fwd + ~2x bwd
+    return column_iters_per_sec * f / PEAK_FLOPS[chip]
+
+
+class MetricsWriter:
+    """Append-only JSONL metrics log, one dict per line, with wall time."""
+
+    def __init__(self, path: Optional[str] = None, echo: bool = True):
+        self.path = Path(path) if path else None
+        self.echo = echo
+        self._t0 = time.time()
+        if self.path:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            self._fh = self.path.open("a")
+        else:
+            self._fh = None
+
+    def write(self, metrics: dict):
+        rec = {"wall_time": round(time.time() - self._t0, 3), **metrics}
+        line = json.dumps(rec)
+        if self._fh:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+        if self.echo:
+            print(line)
+
+    def close(self):
+        if self._fh:
+            self._fh.close()
