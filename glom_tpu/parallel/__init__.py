@@ -1,0 +1,54 @@
+"""Parallel runtime: mesh, shardings, and sequence-parallel consensus.
+
+Strategy map (SURVEY.md §2.2 — everything here is absent in the reference):
+
+  DP      sharding.batch_spec + GSPMD grad allreduce      (runtime.py)
+  TP      sharding.ffw_specs('hidden') — Megatron-style    (sharding.py)
+  EP-like sharding.ffw_specs('levels') — per-level groups  (sharding.py)
+  SP      ring.py (exact ring attention over 'seq'),
+          ulysses.py (all-to-all, L as heads),
+          halo.py (local-radius neighbor exchange)
+  PP      deliberately not provided: GLOM's L levels update
+          SIMULTANEOUSLY each iteration (one scan step reads all levels and
+          writes all levels), so there is no layer-sequential dependency to
+          pipeline — a stage-over-levels pipeline would serialize what the
+          hardware runs as one batched einsum. The EP-like 'levels' sharding
+          above is the profitable way to split the L axis.
+"""
+
+from glom_tpu.parallel.halo import make_halo_consensus
+from glom_tpu.parallel.mesh import initialize_multihost, make_mesh
+from glom_tpu.parallel.ring import make_ring_consensus
+from glom_tpu.parallel.runtime import (
+    SP_STRATEGIES,
+    DistributedTrainer,
+    make_consensus_fn,
+)
+from glom_tpu.parallel.sharding import (
+    batch_spec,
+    denoise_param_specs,
+    ffw_specs,
+    glom_param_specs,
+    levels_spec,
+    opt_state_specs,
+    to_named,
+)
+from glom_tpu.parallel.ulysses import make_ulysses_consensus
+
+__all__ = [
+    "make_halo_consensus",
+    "initialize_multihost",
+    "make_mesh",
+    "make_ring_consensus",
+    "SP_STRATEGIES",
+    "DistributedTrainer",
+    "make_consensus_fn",
+    "batch_spec",
+    "denoise_param_specs",
+    "ffw_specs",
+    "glom_param_specs",
+    "levels_spec",
+    "opt_state_specs",
+    "to_named",
+    "make_ulysses_consensus",
+]

@@ -1,0 +1,81 @@
+"""Device mesh construction and multi-host initialization.
+
+The reference has NO distributed support (SURVEY.md §2.2: no
+torch.distributed / NCCL anywhere). This module is the TPU-native
+communication backend: a named `Mesh` over the chip topology, with XLA
+emitting collectives over ICI from sharding annotations (pjit/GSPMD) or from
+explicit shard_map collectives (ring / halo / all-to-all in this package).
+
+Axis convention (see utils.config.MeshConfig):
+  data  — batch sharding (DP); gradient allreduce rides ICI (multi-slice
+          setups put the outermost data axis on DCN)
+  seq   — patch-axis sharding (SP): ring consensus / halo exchange
+  model — dim sharding (TP) of the grouped-FFW weights
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+from glom_tpu.utils.config import MeshConfig
+
+
+def make_mesh(cfg: MeshConfig, devices: Optional[list] = None) -> Mesh:
+    """Build a Mesh of shape (data, seq, model) over the available devices.
+
+    Uses mesh_utils.create_device_mesh on real TPU slices so mesh axes map
+    contiguously onto the ICI torus (nearest-neighbor collectives stay on
+    ICI links); falls back to a simple reshape for CPU/virtual devices.
+    """
+    devices = devices if devices is not None else jax.devices()
+    n = cfg.num_devices
+    if n > len(devices):
+        raise ValueError(
+            f"mesh {cfg.shape} needs {n} devices, only {len(devices)} available"
+        )
+    devices = devices[:n]
+    if devices[0].platform == "tpu":
+        try:
+            dev_array = mesh_utils.create_device_mesh(cfg.shape, devices=devices)
+        except (ValueError, AssertionError):
+            dev_array = np.asarray(devices).reshape(cfg.shape)
+    else:
+        dev_array = np.asarray(devices).reshape(cfg.shape)
+    return Mesh(dev_array, cfg.axis_names)
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host bring-up: the analog of torch's init_process_group, but via
+    the JAX distributed runtime (coordinator + heartbeat failure detection).
+
+    No-op on single-process. Args fall back to the standard env vars
+    (JAX_COORDINATOR_ADDRESS, JAX_NUM_PROCESSES, JAX_PROCESS_ID) so launch
+    scripts can stay declarative.
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if coordinator_address is None:
+        return  # single host
+    kwargs = {"coordinator_address": coordinator_address}
+    if num_processes is not None or "JAX_NUM_PROCESSES" in os.environ:
+        kwargs["num_processes"] = int(
+            num_processes
+            if num_processes is not None
+            else os.environ["JAX_NUM_PROCESSES"]
+        )
+    if process_id is not None or "JAX_PROCESS_ID" in os.environ:
+        kwargs["process_id"] = int(
+            process_id if process_id is not None else os.environ["JAX_PROCESS_ID"]
+        )
+    jax.distributed.initialize(**kwargs)

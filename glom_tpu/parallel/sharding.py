@@ -1,0 +1,103 @@
+"""Partition specs: how params, optimizer state, and batches lay out on the
+mesh. GSPMD does the rest — annotate, and XLA inserts the collectives
+(allreduce for DP grads, psum for the TP contraction) over ICI.
+
+Tensor parallelism (TP) shards the grouped-FFW HIDDEN axis (Megatron-style
+column-then-row): w1 [G, d, f] and b1 [G, f] shard f across 'model'; w2
+[G, f, d] shards its f contraction axis, so XLA emits one psum per FFW on
+the second matmul's output. Embeddings and init_levels stay replicated —
+`d` appears inside consensus attention, and sharding it there would trade
+one cheap psum for many.
+
+Expert-parallel analog (SURVEY.md §2.2: EP n/a — no MoE in GLOM): the
+closest structure is the per-level grouped FFW, whose G axis is expert-like
+and shardable. `tp_axis="levels"` shards G instead of the hidden axis —
+levels are fully independent in the FFWs, so this needs NO collective in
+the FFW at all (the analog of expert dispatch is a static slice).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from glom_tpu.ops.ffw import GroupedFFWParams
+from glom_tpu.ops.patch import LinearParams
+from glom_tpu.models.core import GlomParams
+from glom_tpu.train.objectives import DenoiseParams
+
+
+def ffw_specs(tp_axis: str = "hidden") -> GroupedFFWParams:
+    if tp_axis == "hidden":
+        return GroupedFFWParams(
+            w1=P(None, None, "model"),
+            b1=P(None, "model"),
+            w2=P(None, "model", None),
+            b2=P(None, None),
+        )
+    if tp_axis == "levels":  # EP-style: shard the independent level groups
+        return GroupedFFWParams(
+            w1=P("model", None, None),
+            b1=P("model", None),
+            w2=P("model", None, None),
+            b2=P("model", None),
+        )
+    raise ValueError(f"tp_axis must be 'hidden' or 'levels', got {tp_axis!r}")
+
+
+def glom_param_specs(tp_axis: str = "hidden") -> GlomParams:
+    # In 'levels' (EP-style) mode only bottom_up (G = L) shards its group
+    # axis; top_down has G = L - 1, coprime with L, so no mesh size divides
+    # both — it shards its hidden axis instead.
+    td_axis = "hidden" if tp_axis == "levels" else tp_axis
+    return GlomParams(
+        token_embed=LinearParams(w=P(None, None), b=P(None)),
+        pos_emb=P(None, None),
+        init_levels=P(None, None),
+        bottom_up=ffw_specs(tp_axis),
+        top_down=ffw_specs(td_axis),
+    )
+
+
+def denoise_param_specs(tp_axis: str = "hidden") -> DenoiseParams:
+    return DenoiseParams(
+        glom=glom_param_specs(tp_axis),
+        to_pixels=LinearParams(w=P(None, None), b=P(None)),
+    )
+
+
+def batch_spec() -> P:
+    """[b, c, H, W] image batches shard on the data axis."""
+    return P("data", None, None, None)
+
+
+def levels_spec() -> P:
+    """[b, n, L, d] column state: batch on 'data', patch axis on 'seq'."""
+    return P("data", "seq", None, None)
+
+
+def opt_state_specs(abstract_opt_state: Any, param_specs: DenoiseParams) -> Any:
+    """Optimizer-state spec tree: moment buffers (DenoiseParams-shaped
+    subtrees, e.g. Adam's mu/nu) follow the param layout; scalars (count)
+    replicate."""
+
+    def match(node):
+        if isinstance(node, DenoiseParams):
+            return param_specs
+        return P()
+
+    return jax.tree_util.tree_map(
+        match, abstract_opt_state, is_leaf=lambda x: isinstance(x, DenoiseParams)
+    )
+
+
+def to_named(mesh: Mesh, spec_tree: Any) -> Any:
+    """PartitionSpec tree -> NamedSharding tree."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        spec_tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )

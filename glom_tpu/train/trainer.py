@@ -99,6 +99,29 @@ def make_train_step(
     return train_step
 
 
+def fit_loop(
+    step: Callable[[Any], dict],
+    data: Iterator,
+    num_steps: int,
+    *,
+    log_every: int = 10,
+    metrics_writer=None,
+) -> list[dict]:
+    """Shared training loop: pull batches, step, log every `log_every`.
+    Used by both the single-device Trainer and the DistributedTrainer."""
+    history = []
+    t0 = time.perf_counter()
+    for i in range(num_steps):
+        metrics = step(next(data))
+        if (i + 1) % log_every == 0 or i == num_steps - 1:
+            metrics = {k: float(v) for k, v in metrics.items()}
+            metrics["steps_per_sec"] = (i + 1) / (time.perf_counter() - t0)
+            history.append(metrics)
+            if metrics_writer is not None:
+                metrics_writer.write(metrics)
+    return history
+
+
 class Trainer:
     """Single-host convenience wrapper: jit, data iteration, metric logging.
 
@@ -124,6 +147,11 @@ class Trainer:
         self._step = jax.jit(step_fn, donate_argnums=(0,))
         self.metrics_writer = metrics_writer
 
+    def step(self, batch) -> dict:
+        self.rng, step_rng = jax.random.split(self.rng)
+        self.state, metrics = self._step(self.state, batch, step_rng)
+        return metrics
+
     def fit(
         self,
         data: Iterator[jnp.ndarray],
@@ -132,16 +160,10 @@ class Trainer:
         log_every: int = 10,
     ) -> list[dict]:
         """Run `num_steps` updates pulling [b, c, H, W] batches from `data`."""
-        history = []
-        t0 = time.perf_counter()
-        for i in range(num_steps):
-            batch = next(data)
-            self.rng, step_rng = jax.random.split(self.rng)
-            self.state, metrics = self._step(self.state, batch, step_rng)
-            if (i + 1) % log_every == 0 or i == num_steps - 1:
-                metrics = {k: float(v) for k, v in metrics.items()}
-                metrics["steps_per_sec"] = (i + 1) / (time.perf_counter() - t0)
-                history.append(metrics)
-                if self.metrics_writer is not None:
-                    self.metrics_writer.write(metrics)
-        return history
+        return fit_loop(
+            self.step,
+            data,
+            num_steps,
+            log_every=log_every,
+            metrics_writer=self.metrics_writer,
+        )

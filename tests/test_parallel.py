@@ -1,0 +1,212 @@
+"""Multi-device tests on the 8-device virtual CPU mesh (SURVEY.md §4d-e):
+sharded-vs-replicated parity for every parallelism strategy."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from glom_tpu.data import shapes_dataset
+from glom_tpu.models.core import glom_forward, init_glom
+from glom_tpu.ops.consensus import build_local_mask, consensus_attention
+from glom_tpu.parallel import (
+    DistributedTrainer,
+    make_halo_consensus,
+    make_mesh,
+    make_ring_consensus,
+    make_ulysses_consensus,
+)
+from glom_tpu.train import Trainer
+from glom_tpu.utils.config import GlomConfig, MeshConfig, TrainConfig
+
+
+def seq_mesh(seq=8):
+    return make_mesh(MeshConfig(data=1, seq=seq, model=1))
+
+
+@pytest.fixture(scope="module")
+def levels_16():
+    """[b, n=16, L=4, d=32] random levels on a 4x4 patch grid."""
+    rng = np.random.default_rng(7)
+    return jnp.asarray(rng.normal(size=(2, 16, 4, 32)), jnp.float32)
+
+
+class TestRingConsensus:
+    @pytest.mark.parametrize("attend_self", [False, True])
+    def test_matches_dense(self, levels_16, attend_self):
+        mesh = seq_mesh(8)
+        ring = make_ring_consensus(mesh, attend_self=attend_self, side=4)
+        got = jax.jit(ring)(levels_16)
+        want = consensus_attention(levels_16, attend_self=attend_self)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6
+        )
+
+    def test_matches_dense_with_radius(self, levels_16):
+        mesh = seq_mesh(8)
+        ring = make_ring_consensus(mesh, attend_self=False, side=4, radius=1.5)
+        got = jax.jit(ring)(levels_16)
+        want = consensus_attention(
+            levels_16, attend_self=False, local_mask=build_local_mask(4, 1.5)
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6
+        )
+
+    def test_seq_2_shards(self, levels_16):
+        mesh = seq_mesh(2)
+        ring = make_ring_consensus(mesh, attend_self=False, side=4)
+        got = jax.jit(ring)(levels_16)
+        want = consensus_attention(levels_16)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6
+        )
+
+
+class TestUlyssesConsensus:
+    def test_matches_dense(self, levels_16):
+        mesh = seq_mesh(4)  # L=4 divisible by 4
+        uly = make_ulysses_consensus(mesh, attend_self=False)
+        got = jax.jit(uly)(levels_16)
+        want = consensus_attention(levels_16)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6
+        )
+
+    def test_matches_dense_with_mask(self, levels_16):
+        mesh = seq_mesh(2)
+        mask = build_local_mask(4, 1.0)
+        uly = make_ulysses_consensus(mesh, attend_self=True, local_mask=mask)
+        got = jax.jit(uly)(levels_16)
+        want = consensus_attention(levels_16, attend_self=True, local_mask=mask)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6
+        )
+
+    def test_indivisible_levels_raises(self, levels_16):
+        mesh = seq_mesh(8)  # L=4 not divisible by 8
+        uly = make_ulysses_consensus(mesh, attend_self=False)
+        with pytest.raises(ValueError, match="divisible"):
+            jax.jit(uly)(levels_16)
+
+
+class TestHaloConsensus:
+    def test_matches_dense_local(self):
+        """8x8 grid (n=64), 4 shards of 2 rows, radius 1.5 -> 2 halo rows."""
+        rng = np.random.default_rng(8)
+        x = jnp.asarray(rng.normal(size=(1, 64, 3, 16)), jnp.float32)
+        mesh = seq_mesh(4)
+        halo = make_halo_consensus(mesh, attend_self=False, side=8, radius=1.5)
+        got = jax.jit(halo)(x)
+        want = consensus_attention(
+            x, attend_self=False, local_mask=build_local_mask(8, 1.5)
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6
+        )
+
+    def test_radius_too_large_raises(self):
+        mesh = seq_mesh(8)
+        with pytest.raises(ValueError, match="halo"):
+            make_halo_consensus(mesh, attend_self=False, side=8, radius=3.0)
+
+    def test_zero_radius_raises(self):
+        mesh = seq_mesh(2)
+        with pytest.raises(ValueError, match="radius"):
+            make_halo_consensus(mesh, attend_self=False, side=8, radius=0.0)
+
+
+CFG = GlomConfig(dim=16, levels=4, image_size=8, patch_size=2)  # n=16
+
+
+class TestShardedForward:
+    """glom_forward with an SP consensus_fn == single-device forward."""
+
+    @pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+    def test_forward_parity(self, strategy):
+        params = init_glom(jax.random.PRNGKey(0), CFG)
+        img = jnp.asarray(
+            np.random.default_rng(1).normal(size=(2, 3, 8, 8)), jnp.float32
+        )
+        mesh = seq_mesh(4)
+        from glom_tpu.parallel import make_consensus_fn
+
+        fn = make_consensus_fn(mesh, CFG, strategy)
+        dense = glom_forward(params, img, CFG, iters=3)
+        sharded = jax.jit(
+            lambda p, im: glom_forward(p, im, CFG, iters=3, consensus_fn=fn)
+        )(params, img)
+        np.testing.assert_allclose(
+            np.asarray(sharded), np.asarray(dense), rtol=1e-4, atol=1e-5
+        )
+
+
+class TestDistributedTrainer:
+    def test_dp_matches_single_device(self):
+        """Same seed: 8-way DP training == single-device training (the
+        gradient allreduce must average exactly, not approximately)."""
+        tcfg = TrainConfig(batch_size=8, learning_rate=1e-3, noise_std=0.3, seed=5)
+        single = Trainer(CFG, tcfg)
+        dist = DistributedTrainer(CFG, tcfg, MeshConfig(data=8, seq=1, model=1))
+        data1 = shapes_dataset(8, CFG.image_size, seed=3)
+        data2 = shapes_dataset(8, CFG.image_size, seed=3)
+        h1 = single.fit(data1, num_steps=3, log_every=1)
+        h2 = dist.fit(data2, num_steps=3, log_every=1)
+        for a, b in zip(h1, h2):
+            np.testing.assert_allclose(a["loss"], b["loss"], rtol=1e-4)
+        p1 = jax.tree_util.tree_leaves(single.state.params)
+        p2 = jax.tree_util.tree_leaves(dist.state.params)
+        for x, y in zip(p1, p2):
+            np.testing.assert_allclose(
+                np.asarray(x), np.asarray(y), rtol=1e-4, atol=1e-5
+            )
+
+    @pytest.mark.parametrize("tp_axis", ["hidden", "levels"])
+    def test_tp_matches_single_device(self, tp_axis):
+        tcfg = TrainConfig(batch_size=4, learning_rate=1e-3, noise_std=0.3, seed=5)
+        single = Trainer(CFG, tcfg)
+        dist = DistributedTrainer(
+            CFG, tcfg, MeshConfig(data=1, seq=1, model=2), tp_axis=tp_axis
+        )
+        data1 = shapes_dataset(4, CFG.image_size, seed=3)
+        data2 = shapes_dataset(4, CFG.image_size, seed=3)
+        h1 = single.fit(data1, num_steps=2, log_every=1)
+        h2 = dist.fit(data2, num_steps=2, log_every=1)
+        for a, b in zip(h1, h2):
+            np.testing.assert_allclose(a["loss"], b["loss"], rtol=1e-4)
+
+    def test_dp_sp_combined(self):
+        """2 data x 4 seq mesh with ring consensus trains and loss is finite."""
+        tcfg = TrainConfig(batch_size=4, learning_rate=1e-3, noise_std=0.3, seed=5)
+        dist = DistributedTrainer(
+            CFG,
+            tcfg,
+            MeshConfig(data=2, seq=4, model=1),
+            sp_strategy="ring",
+        )
+        data = shapes_dataset(4, CFG.image_size, seed=3)
+        h = dist.fit(data, num_steps=3, log_every=1)
+        assert all(np.isfinite(m["loss"]) for m in h)
+
+    def test_dp_sp_matches_single_device(self):
+        tcfg = TrainConfig(batch_size=4, learning_rate=1e-3, noise_std=0.3, seed=5)
+        single = Trainer(CFG, tcfg)
+        dist = DistributedTrainer(
+            CFG,
+            tcfg,
+            MeshConfig(data=2, seq=2, model=1),
+            sp_strategy="ring",
+        )
+        data1 = shapes_dataset(4, CFG.image_size, seed=3)
+        data2 = shapes_dataset(4, CFG.image_size, seed=3)
+        h1 = single.fit(data1, num_steps=2, log_every=1)
+        h2 = dist.fit(data2, num_steps=2, log_every=1)
+        for a, b in zip(h1, h2):
+            np.testing.assert_allclose(a["loss"], b["loss"], rtol=1e-3)
+
+    def test_bad_batch_divisibility_raises(self):
+        tcfg = TrainConfig(batch_size=3)
+        with pytest.raises(ValueError, match="divisible"):
+            DistributedTrainer(CFG, tcfg, MeshConfig(data=2))
