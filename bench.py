@@ -29,7 +29,7 @@ def main():
     on_tpu = platform == "tpu"
     if on_tpu:
         cfg = GlomConfig(dim=512, levels=6, image_size=224, patch_size=14)
-        batch, iters, repeats, chain = 16, 12, 3, 4
+        batch, iters, repeats, chain = 16, 12, 4, 8
         chip = "v5e"
     else:  # CPU fallback so the harness stays runnable anywhere
         cfg = GlomConfig(dim=128, levels=4, image_size=32, patch_size=4)
@@ -39,33 +39,38 @@ def main():
     params = init_glom(jax.random.PRNGKey(0), cfg)
     img = jax.random.normal(jax.random.PRNGKey(1), (batch, 3, cfg.image_size, cfg.image_size), jnp.float32)
 
-    # Forward returning a device-side scalar: timing syncs by fetching ONE
-    # float. (block_until_ready is unreliable on tunneled platforms — it can
-    # return before execution completes; a host fetch cannot.)
-    fwd = jax.jit(
-        lambda p, x: jnp.sum(
-            glom_forward(p, x, cfg, iters=iters, compute_dtype=jnp.bfloat16)
-        )
-    )
-    float(fwd(params, img))  # compile + warm
+    # Timing methodology for a noisy, tunneled device:
+    #   * ONE dispatch per measurement — K whole forwards run inside a
+    #     single compiled fori_loop, so per-call dispatch overhead and host
+    #     round-trip are amortized over K*T column updates;
+    #   * the loop carry (a scalar folded into the next input) serializes
+    #     iterations, preventing any dedup/overlap from faking speedups;
+    #   * sync by fetching the device-side-reduced scalar (block_until_ready
+    #     can return before execution completes on tunneled platforms);
+    #   * min over repeats: jitter and throttling only ever slow things down.
+    def multi(p, x):
+        def body(_, acc):
+            out = glom_forward(
+                p, x + acc * 0.0, cfg, iters=iters, compute_dtype=jnp.bfloat16
+            )
+            return jnp.sum(out).astype(jnp.float32) * 1e-9
+        return jax.lax.fori_loop(0, chain, body, jnp.float32(0.0))
 
-    # Round-trip latency floor: time fetching an already-computed scalar.
-    tiny = jax.jit(lambda x: jnp.sum(x))(img)
-    t0 = time.perf_counter()
-    for _ in range(3):
-        float(tiny)
-    rtt = (time.perf_counter() - t0) / 3
+    bench_fn = jax.jit(multi)
+    warm = float(bench_fn(params, img))  # compile + warm
+    if not jnp.isfinite(warm):
+        raise RuntimeError(f"non-finite benchmark output: {warm}")
 
     times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
-        outs = [fwd(params, img) for _ in range(chain)]  # async dispatch
-        acc = sum(float(o) for o in outs)  # fetches overlap later computes
-        assert jnp.isfinite(acc)
-        times.append((time.perf_counter() - t0 - rtt) / chain)
-    dt = max(min(times), 1e-9)
+        out = float(bench_fn(params, img))
+        times.append(time.perf_counter() - t0)
+        if not jnp.isfinite(out):
+            raise RuntimeError(f"non-finite benchmark output: {out}")
+    dt = min(times)
 
-    column_iters_per_sec = batch * iters / dt
+    column_iters_per_sec = batch * chain * iters / dt
     measured_mfu = mfu(cfg, column_iters_per_sec, chip=chip)
     print(
         json.dumps(

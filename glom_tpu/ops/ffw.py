@@ -69,6 +69,10 @@ def grouped_ffw(
     if compute_dtype is not None:
         x = x.astype(compute_dtype)
         w1, b1, w2, b2 = (t.astype(compute_dtype) for t in (w1, b1, w2, b2))
+    # Always accumulate in float32 (2048-term contractions in bf16 lose
+    # digits, and off-TPU backends honor the accumulation dtype literally).
+    # The bf16-traffic win comes from the astype below, which XLA fuses into
+    # the matmul epilogue — the [..., G, 4d] hidden tensor hits HBM in bf16.
     acc = jnp.float32
     h = jnp.einsum("...gd,gdf->...gf", x, w1, preferred_element_type=acc)
     h = h + b1
