@@ -1,0 +1,3 @@
+from glom_tpu.kernels.grouped_mlp import fused_grouped_ffw
+
+__all__ = ["fused_grouped_ffw"]

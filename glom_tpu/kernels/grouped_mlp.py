@@ -1,0 +1,193 @@
+"""Pallas TPU kernel: fused per-group MLP (the grouped feed-forward hot op).
+
+Profiling (see bench.py methodology) shows the per-iteration cost of the
+scanned GLOM update is dominated by the two grouped FFWs; XLA materializes
+the [.., G, 4d] hidden activations in HBM between the two matmuls. This
+kernel computes  out = gelu(x @ w1 + b1) @ w2 + b2  per group with the
+hidden tile resident in VMEM — HBM sees only x, the weights, and out.
+
+Grid layout: (G, M_tiles) with the m axis innermost, so each group's weight
+pair stays resident in VMEM across all of its row tiles (revisits cost
+nothing; the next group triggers one weight DMA).
+
+Backward: custom_vjp. Only x and params are saved; the backward pass
+recomputes the hidden pre-activation with one extra matmul and runs as
+plain XLA einsums (matmul-heavy, nothing to fuse by hand).
+
+Falls back to the XLA einsum path (ops/ffw.py) off-TPU, under interpret
+testing, and for shapes that don't tile cleanly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from glom_tpu.ops.ffw import GroupedFFWParams, grouped_ffw
+
+
+
+def _erf(x):
+    """Abramowitz & Stegun 7.1.26 rational approximation (max err 1.5e-7).
+    The Pallas TPU lowering has no erf/erfc primitive; this uses only
+    mul/add/exp, all VPU-native. 1.5e-7 is far below bf16 resolution and
+    inside the f32 test tolerances."""
+    sign = jnp.sign(x)
+    x = jnp.abs(x)
+    t = 1.0 / (1.0 + 0.3275911 * x)
+    poly = t * (
+        0.254829592
+        + t * (-0.284496736 + t * (1.421413741 + t * (-1.453152027 + t * 1.061405429)))
+    )
+    return sign * (1.0 - poly * jnp.exp(-x * x))
+
+
+def _gelu_exact(x):
+    """Exact (erf-based) GELU, matching jax.nn.gelu(approximate=False)."""
+    return 0.5 * x * (1.0 + _erf(x * 0.7071067811865476))
+
+
+def _mlp_kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, out_ref):
+    """One (group, row-tile) program: [TM, d] -> [TM, d] through the f-wide
+    hidden layer entirely in VMEM."""
+    x = x_ref[0]  # [TM, d]
+    h = jnp.dot(x, w1_ref[0], preferred_element_type=jnp.float32)
+    h = h + b1_ref[0].astype(jnp.float32)  # b1_ref[0]: [1, f], broadcasts
+    h = _gelu_exact(h)
+    h = h.astype(x.dtype)
+    out = jnp.dot(h, w2_ref[0], preferred_element_type=jnp.float32)
+    out = out + b2_ref[0].astype(jnp.float32)
+    out_ref[0] = out.astype(out_ref.dtype)
+
+
+def _fused_forward(
+    params: GroupedFFWParams, x: jnp.ndarray, *, tile_m: int, interpret: bool
+) -> jnp.ndarray:
+    """x: [G, M, d] -> [G, M, d] (group-major so every block keeps the
+    tile-aligned [TM, d] trailing dims the TPU lowering requires)."""
+    G, M, d = x.shape
+    f = params.w1.shape[-1]
+    # m innermost: each group's weight pair stays VMEM-resident across all
+    # of its row tiles.
+    grid = (G, M // tile_m)
+    return pl.pallas_call(
+        _mlp_kernel,
+        out_shape=jax.ShapeDtypeStruct((G, M, d), x.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, tile_m, d), lambda g, m: (g, m, 0)),  # x
+            pl.BlockSpec((1, d, f), lambda g, m: (g, 0, 0)),  # w1
+            # biases as [G, 1, f]: block dims equal to array dims satisfy the
+            # TPU (8, 128)-tiling rule without padding
+            pl.BlockSpec((1, 1, f), lambda g, m: (g, 0, 0)),  # b1
+            pl.BlockSpec((1, f, d), lambda g, m: (g, 0, 0)),  # w2
+            pl.BlockSpec((1, 1, d), lambda g, m: (g, 0, 0)),  # b2
+        ],
+        out_specs=pl.BlockSpec((1, tile_m, d), lambda g, m: (g, m, 0)),
+        interpret=interpret,
+    )(x, params.w1, params.b1[:, None, :], params.w2, params.b2[:, None, :])
+
+
+TILE_CANDIDATES = (512, 256, 128)  # 1024 overflows the 16MB VMEM budget in-scan
+
+
+def _pick_tile(M: int) -> int | None:
+    """Largest MXU-friendly row tile dividing M (None -> no clean tiling)."""
+    for t in TILE_CANDIDATES:
+        if M % t == 0:
+            return t
+    return None
+
+
+def _supported(params: GroupedFFWParams, x: jnp.ndarray, tile_m: int | None) -> bool:
+    if x.ndim < 3 or tile_m is None:
+        return False
+    f = params.w1.shape[-1]
+    d = x.shape[-1]
+    # Clean MXU tiling: row tiles divide M (via _pick_tile); d/f on 128-lane
+    # boundaries.
+    return d % 128 == 0 and f % 128 == 0
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _fused_grouped_ffw(params, x, tile_m, interpret):
+    *lead, G, d = x.shape
+    x2 = jnp.moveaxis(x.reshape(-1, G, d), 1, 0)  # [G, M, d]
+    out = _fused_forward(params, x2, tile_m=tile_m, interpret=interpret)
+    return jnp.moveaxis(out, 0, 1).reshape(*lead, G, d)
+
+
+def _fwd(params, x, tile_m, interpret):
+    return _fused_grouped_ffw(params, x, tile_m, interpret), (params, x)
+
+
+def _bwd(tile_m, interpret, res, g):
+    params, x = res
+    w1, b1, w2, b2 = params
+    f32 = jnp.float32
+    # Recompute the hidden pre-activation (one extra matmul) rather than
+    # saving the [.., G, f] tensor — same memory/recompute trade as flash
+    # attention's backward. EVERY contraction and reduction below pins
+    # float32 accumulation (preferred_element_type / f32 dpre), matching the
+    # forward paths' invariant — bf16 accumulation over f=4d or M=b*n terms
+    # loses digits.
+    pre = jnp.einsum("...gd,gdf->...gf", x, w1, preferred_element_type=f32)
+    pre = pre + b1.astype(f32)
+    h = jax.nn.gelu(pre, approximate=False).astype(x.dtype)
+    g32 = g.astype(f32)
+
+    dh = jnp.einsum("...gd,gfd->...gf", g, w2, preferred_element_type=f32)
+    # exact-GELU derivative: Phi(z) + z phi(z)
+    z = pre
+    phi = jnp.exp(-0.5 * z * z) * (1.0 / jnp.sqrt(2.0 * jnp.pi))
+    Phi = 0.5 * (1.0 + jax.lax.erf(z / jnp.sqrt(2.0)))
+    dpre = (dh * (Phi + z * phi)).astype(x.dtype)
+
+    red = tuple(range(x.ndim - 2))  # reduce the leading (batch-ish) dims
+    dx = jnp.einsum("...gf,gdf->...gd", dpre, w1, preferred_element_type=f32)
+    dw1 = jnp.einsum("...gd,...gf->gdf", x, dpre, preferred_element_type=f32)
+    db1 = jnp.sum(dpre.astype(f32), axis=red)
+    dw2 = jnp.einsum("...gf,...gd->gfd", h, g, preferred_element_type=f32)
+    db2 = jnp.sum(g32, axis=red)
+    return (
+        GroupedFFWParams(
+            dw1.astype(w1.dtype),
+            db1.astype(b1.dtype),
+            dw2.astype(w2.dtype),
+            db2.astype(b2.dtype),
+        ),
+        dx.astype(x.dtype),
+    )
+
+
+_fused_grouped_ffw.defvjp(_fwd, _bwd)
+
+
+def fused_grouped_ffw(
+    params: GroupedFFWParams,
+    x: jnp.ndarray,
+    *,
+    tile_m: int | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Drop-in replacement for ops.ffw.grouped_ffw ([..., G, d] -> [..., G, d]).
+
+    Uses the Pallas kernel on TPU (or anywhere under interpret=True); falls
+    back to the XLA einsum path otherwise. tile_m=None picks the largest
+    clean row tile automatically (e.g. 256 at batch=1/n=256), capped at
+    1024 by VMEM.
+    """
+    M = 1
+    for s in x.shape[:-2]:
+        M *= s
+    if tile_m is None:
+        tile_m = _pick_tile(M)
+    elif M % tile_m != 0:
+        tile_m = None
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not _supported(params, x, tile_m) or not (on_tpu or interpret):
+        return grouped_ffw(params, x)
+    return _fused_grouped_ffw(params, x, tile_m, interpret)

@@ -35,6 +35,7 @@ from glom_tpu.utils.config import GlomConfig
 from glom_tpu.utils.helpers import default, exists
 
 ConsensusFn = Callable[[jnp.ndarray], jnp.ndarray]
+FFWFn = Callable[[GroupedFFWParams, jnp.ndarray], jnp.ndarray]
 
 
 class GlomParams(NamedTuple):
@@ -75,6 +76,7 @@ def update_step(
     divisor: jnp.ndarray,
     *,
     consensus_fn: ConsensusFn,
+    ffw_fn: FFWFn = grouped_ffw,
 ) -> jnp.ndarray:
     """One column update: the mean of (previous value, bottom-up, top-down,
     consensus). The §3.2 loop body (reference :124-140).
@@ -85,12 +87,12 @@ def update_step(
     # Bottom-up sees (image tokens, levels 1..L-1) -> update for levels 1..L:
     # level 1 re-reads the RAW tokens every iteration (reference :127).
     with jax.named_scope("bottom_up"):
-        bottom_up_out = grouped_ffw(params.bottom_up, with_input[..., :-1, :])
+        bottom_up_out = ffw_fn(params.bottom_up, with_input[..., :-1, :])
     # Top-down sees levels 2..L with the positional embedding injected HERE
     # and only here (reference :129); produces updates for levels 1..L-1,
     # zero-padded at the top (reference :130).
     with jax.named_scope("top_down"):
-        top_down_out = grouped_ffw(params.top_down, with_input[..., 2:, :] + pos)
+        top_down_out = ffw_fn(params.top_down, with_input[..., 2:, :] + pos)
         top_down_out = jnp.pad(top_down_out, ((0, 0), (0, 0), (0, 1), (0, 0)))
     with jax.named_scope("consensus"):
         consensus = consensus_fn(levels)
@@ -110,6 +112,7 @@ def glom_forward(
     remat: bool = False,
     compute_dtype=None,
     consensus_fn: Optional[ConsensusFn] = None,
+    use_pallas: bool = False,
 ) -> jnp.ndarray:
     """The T-iteration GLOM forward (reference :103-152).
 
@@ -119,8 +122,20 @@ def glom_forward(
     `levels` may be passed in to continue from a previous call (the README
     temporal/video recipe — detach between frames with lax.stop_gradient).
     `iters`/`return_all`/`remat` are static under jit.
+
+    use_pallas=True routes the grouped FFWs through the fused Pallas kernel
+    (auto-falls back off-TPU / unsupported shapes). Leave False inside
+    GSPMD-sharded model-parallel regions — the custom call has no
+    partitioning rule for sharded weights.
     """
     T = default(iters, cfg.default_iters)
+
+    if use_pallas:
+        from glom_tpu.kernels import fused_grouped_ffw
+
+        ffw_fn: FFWFn = fused_grouped_ffw
+    else:
+        ffw_fn = grouped_ffw
 
     if consensus_fn is None:
         local_mask = build_local_mask(cfg.num_patches_side, cfg.local_consensus_radius)
@@ -153,7 +168,8 @@ def glom_forward(
 
     def body(carry, _):
         new = update_step(
-            params, carry, bottom, pos, divisor, consensus_fn=consensus_fn
+            params, carry, bottom, pos, divisor,
+            consensus_fn=consensus_fn, ffw_fn=ffw_fn,
         )
         return new, (new if return_all else None)
 

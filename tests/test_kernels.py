@@ -1,0 +1,99 @@
+"""Pallas kernel tests (interpret mode on CPU) vs the XLA/oracle path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from glom_tpu.kernels import fused_grouped_ffw
+from glom_tpu.ops.ffw import GroupedFFWParams, grouped_ffw, init_grouped_ffw
+
+
+@pytest.fixture(scope="module")
+def setup():
+    G, d = 4, 128
+    params = init_grouped_ffw(jax.random.PRNGKey(0), G, d, mult=4)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 256, G, d), jnp.float32)
+    return params, x
+
+
+class TestFusedGroupedFFW:
+    def test_forward_matches_xla(self, setup):
+        params, x = setup
+        got = fused_grouped_ffw(params, x, tile_m=128, interpret=True)
+        want = grouped_ffw(params, x)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5
+        )
+
+    def test_grad_matches_xla(self, setup):
+        params, x = setup
+
+        def loss_fused(p, x_):
+            return jnp.mean(fused_grouped_ffw(p, x_, tile_m=128, interpret=True) ** 2)
+
+        def loss_xla(p, x_):
+            return jnp.mean(grouped_ffw(p, x_) ** 2)
+
+        g1 = jax.grad(loss_fused, argnums=(0, 1))(params, x)
+        g2 = jax.grad(loss_xla, argnums=(0, 1))(params, x)
+        for a, b in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-5
+            )
+
+    def test_fallback_on_unsupported_shape(self, setup):
+        params, _ = setup
+        # M=6 not divisible by tile -> must silently fall back, still correct
+        x = jax.random.normal(jax.random.PRNGKey(2), (1, 6, 4, 128), jnp.float32)
+        got = fused_grouped_ffw(params, x, tile_m=128)
+        want = grouped_ffw(params, x)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6
+        )
+
+    def test_bf16(self, setup):
+        if jax.devices()[0].platform == "cpu":
+            pytest.skip("CPU XLA lacks bf16xbf16->f32 dot; covered on TPU")
+        params, x = setup
+        pb = jax.tree_util.tree_map(lambda t: t.astype(jnp.bfloat16), params)
+        xb = x.astype(jnp.bfloat16)
+        got = fused_grouped_ffw(pb, xb, tile_m=128, interpret=True)
+        want = grouped_ffw(pb, xb)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32), rtol=5e-2, atol=5e-2
+        )
+
+    def test_auto_tile_small_batch(self, setup):
+        """batch=1, n=256 -> M=256 must auto-pick tile 256 and use the kernel
+        (not silently fall back)."""
+        from glom_tpu.kernels.grouped_mlp import _pick_tile
+
+        assert _pick_tile(256) == 256
+        assert _pick_tile(4096) == 512
+        assert _pick_tile(6) is None
+        params, _ = setup
+        x = jax.random.normal(jax.random.PRNGKey(3), (1, 256, 4, 128), jnp.float32)
+        got = fused_grouped_ffw(params, x, interpret=True)
+        want = grouped_ffw(params, x)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5
+        )
+
+    def test_bwd_accumulates_f32(self, setup):
+        """The custom-VJP backward must pin f32 accumulation on every
+        contraction regardless of input dtype (checked via the jaxpr, since
+        CPU cannot execute bf16 dots)."""
+        from glom_tpu.kernels.grouped_mlp import _bwd
+
+        params, _ = setup
+        pb = jax.tree_util.tree_map(lambda t: t.astype(jnp.bfloat16), params)
+        x = jnp.zeros((2, 128, 4, 128), jnp.bfloat16)
+        g = jnp.zeros_like(x)
+        jaxpr = jax.make_jaxpr(lambda p, x_, g_: _bwd(128, False, (p, x_), g_))(
+            pb, x, g
+        )
+        dots = [e for e in jaxpr.jaxpr.eqns if e.primitive.name == "dot_general"]
+        assert dots, "backward lost its contractions?"
+        for e in dots:
+            assert e.params["preferred_element_type"] == jnp.float32
