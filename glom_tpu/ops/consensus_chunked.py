@@ -1,0 +1,105 @@
+"""Chunked (online-softmax) consensus attention — single-device long-context.
+
+The dense op (ops/consensus.py) materializes [b, L, n, n]; at n = 4096
+(e.g. 448px images with 7px patches) that is 1.6 GB per image-level in f32.
+This variant scans over key/value chunks with a running (max, sumexp, out)
+accumulator — flash-attention's recurrence — so memory is O(n * chunk)
+while staying bitwise-faithful to the §3.2 contract:
+
+  * k-only L2 normalization, d^-1/2 scale;
+  * soft -5e-4 self mask (diagonal REPLACED, computed per chunk from global
+    column indices);
+  * hard -finfo.max local-radius mask (integer-exact squared distances).
+
+Pure lax.scan: differentiable out of the box (autodiff of the scan
+recomputes per-chunk under remat), portable to CPU/GPU, and XLA fuses each
+chunk body. The ring form (parallel/ring.py) is the multi-chip analog of
+the same recurrence; this one is the single-chip memory-scaling path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from glom_tpu.utils.helpers import TOKEN_ATTEND_SELF_VALUE, l2norm
+
+NEG_MAX = -jnp.finfo(jnp.float32).max
+
+
+def chunked_consensus_attention(
+    levels: jnp.ndarray,
+    *,
+    attend_self: bool = False,
+    num_patches_side: Optional[int] = None,
+    local_radius: float = 0.0,
+    chunk_size: int = 512,
+) -> jnp.ndarray:
+    """[b, n, L, d] -> [b, n, L, d] without materializing the n x n matrix.
+
+    `num_patches_side` is required when local_radius > 0 (grid geometry).
+    n must be divisible by chunk_size (callers pick a divisor; n is a square
+    of the patch grid side so powers of two are typically available).
+    """
+    b, n, L, d = levels.shape
+    chunk = min(chunk_size, n)
+    if n % chunk != 0:
+        # Fall back to the dense op via its caller; keeping this function
+        # total avoids silent wrong-shape behavior.
+        raise ValueError(f"n={n} not divisible by chunk_size={chunk}")
+    if local_radius > 0 and num_patches_side is None:
+        raise ValueError("num_patches_side required when local_radius > 0")
+
+    x32 = levels.astype(jnp.float32)
+    q = x32  # [b, n, L, d]
+    k = l2norm(x32, axis=-1)
+    v = x32
+    scale = d ** -0.5
+
+    kc = k.reshape(b, n // chunk, chunk, L, d)
+    vc = v.reshape(b, n // chunk, chunk, L, d)
+    # scan over chunks: carry (m, s, o)
+    idx_i = lax.iota(jnp.int32, n)[:, None]  # [n, 1] global query index
+
+    def chunk_body(carry, inputs):
+        m, s, o = carry
+        c_idx, k_blk, v_blk = inputs  # k_blk: [b, chunk, L, d]
+        sim = (
+            jnp.einsum("bild,bjld->blij", q, k_blk, preferred_element_type=jnp.float32)
+            * scale
+        )  # [b, L, n, chunk]
+        idx_j = c_idx * chunk + lax.iota(jnp.int32, chunk)[None, :]  # [1, chunk]
+        if not attend_self:
+            sim = jnp.where((idx_i == idx_j)[None, None], TOKEN_ATTEND_SELF_VALUE, sim)
+        if local_radius > 0:
+            side = num_patches_side
+            ri, ci = idx_i // side, idx_i % side
+            rj, cj = idx_j // side, idx_j % side
+            dist2 = ((ri - rj) ** 2 + (ci - cj) ** 2).astype(jnp.float32)
+            sim = jnp.where(
+                (dist2 > local_radius * local_radius)[None, None], NEG_MAX, sim
+            )
+        blk_max = jnp.max(sim, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, blk_max)
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(sim - m_new)
+        s_new = s * corr + jnp.sum(p, axis=-1, keepdims=True)
+        o_new = o * corr + jnp.einsum(
+            "blij,bjld->blid", p, v_blk, preferred_element_type=jnp.float32
+        )
+        return (m_new, s_new, o_new), None
+
+    m0 = jnp.full((b, L, n, 1), NEG_MAX, jnp.float32)
+    s0 = jnp.zeros((b, L, n, 1), jnp.float32)
+    o0 = jnp.zeros((b, L, n, d), jnp.float32)
+    chunk_ids = jnp.arange(n // chunk, dtype=jnp.int32)
+    (m, s, o), _ = lax.scan(
+        chunk_body,
+        (m0, s0, o0),
+        (chunk_ids, jnp.moveaxis(kc, 1, 0), jnp.moveaxis(vc, 1, 0)),
+    )
+    out = o / s  # [b, L, n, d]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(levels.dtype)

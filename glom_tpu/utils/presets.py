@@ -1,0 +1,124 @@
+"""The five driver benchmark configurations (BASELINE.md) as named presets.
+
+Each preset bundles the model config, a training config, and the mesh /
+SP strategy the config was designed to exercise. Mesh sizes here describe
+the TARGET topology; `scaled_to(num_devices)` shrinks the mesh to whatever
+is actually available (e.g. the 8-device CPU test harness or one chip).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+from glom_tpu.utils.config import GlomConfig, MeshConfig, TrainConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class Preset:
+    name: str
+    description: str
+    model: GlomConfig
+    train: TrainConfig
+    mesh: MeshConfig
+    sp_strategy: str = "none"  # none | ring | ulysses | halo
+
+    def scaled_to(self, num_devices: int) -> "Preset":
+        """Shrink the mesh to fit `num_devices` (keeps axis priorities:
+        data first, then seq, then model)."""
+        data, seq, model = self.mesh.data, self.mesh.seq, self.mesh.model
+        while data * seq * model > num_devices and model > 1:
+            model //= 2
+        while data * seq * model > num_devices and seq > 1:
+            seq //= 2
+        while data * seq * model > num_devices and data > 1:
+            data //= 2
+        mesh = MeshConfig(data=data, seq=seq, model=model)
+        sp = self.sp_strategy if mesh.seq > 1 else "none"
+        return dataclasses.replace(self, mesh=mesh, sp_strategy=sp)
+
+
+PRESETS: Dict[str, Preset] = {}
+
+
+def _register(p: Preset) -> Preset:
+    PRESETS[p.name] = p
+    return p
+
+
+# 1. MNIST 28x28, patch=7, levels=4, dim=128 — forward denoise (CPU ref)
+_register(
+    Preset(
+        name="mnist",
+        description="MNIST 28x28 p7 L4 d128 — correctness reference",
+        model=GlomConfig(dim=128, levels=4, image_size=28, patch_size=7),
+        train=TrainConfig(batch_size=32, learning_rate=3e-4, noise_std=0.5),
+        mesh=MeshConfig(),
+    )
+)
+
+# 2. CIFAR-10 32x32, patch=4, levels=5, dim=256 — denoise training
+_register(
+    Preset(
+        name="cifar10",
+        description="CIFAR-10 32x32 p4 L5 d256 — self-supervised denoise train",
+        model=GlomConfig(dim=256, levels=5, image_size=32, patch_size=4),
+        train=TrainConfig(
+            batch_size=64, learning_rate=3e-4, noise_std=0.5, compute_dtype="bfloat16"
+        ),
+        mesh=MeshConfig(),
+    )
+)
+
+# 3. ImageNet-64, patch=8, levels=6, dim=512, local consensus window=7
+_register(
+    Preset(
+        name="imagenet64-local",
+        description="ImageNet-64 p8 L6 d512 radius7 — local-mask / halo path",
+        model=GlomConfig(
+            dim=512, levels=6, image_size=64, patch_size=8, local_consensus_radius=7
+        ),
+        train=TrainConfig(
+            batch_size=64, learning_rate=3e-4, noise_std=0.5, compute_dtype="bfloat16"
+        ),
+        mesh=MeshConfig(data=4, seq=2),
+        sp_strategy="halo",
+    )
+)
+
+# 4. ImageNet-224, patch=14, levels=6, dim=512 — data-parallel v5e-8
+_register(
+    Preset(
+        name="imagenet224-dp8",
+        description="ImageNet-224 p14 L6 d512 — DP over a v5e-8 slice",
+        model=GlomConfig(dim=512, levels=6, image_size=224, patch_size=14),
+        train=TrainConfig(
+            batch_size=64, learning_rate=3e-4, noise_std=0.5, compute_dtype="bfloat16"
+        ),
+        mesh=MeshConfig(data=8),
+    )
+)
+
+# 5. ImageNet-224, patch=14, levels=12, dim=1024 — pod-scale v5e-256, remat
+_register(
+    Preset(
+        name="imagenet224-pod",
+        description="ImageNet-224 p14 L12 d1024 — v5e-256 pod, remat over iters",
+        model=GlomConfig(dim=1024, levels=12, image_size=224, patch_size=14),
+        train=TrainConfig(
+            batch_size=256,
+            learning_rate=3e-4,
+            noise_std=0.5,
+            compute_dtype="bfloat16",
+            remat=True,
+        ),
+        mesh=MeshConfig(data=64, seq=2, model=2),
+        sp_strategy="ring",
+    )
+)
+
+
+def get_preset(name: str) -> Preset:
+    if name not in PRESETS:
+        raise KeyError(f"unknown preset {name!r}; available: {sorted(PRESETS)}")
+    return PRESETS[name]
