@@ -39,11 +39,31 @@ def tokens_flops(cfg: GlomConfig) -> float:
 
 # Peak bf16 TFLOP/s per chip. v5e ("TPU v5 lite"): 197 bf16 TFLOP/s.
 PEAK_FLOPS = {
+    "v6e": 918e12,
     "v5e": 197e12,
     "v5p": 459e12,
     "v4": 275e12,
     "cpu": 1e12,  # nominal, so MFU math never divides by zero off-TPU
 }
+
+
+def detect_chip(device=None) -> str:
+    """Map jax device_kind to a PEAK_FLOPS key ('v5e' fallback with the
+    benefit of the doubt going to the lowest-peak TPU)."""
+    import jax
+
+    device = device or jax.devices()[0]
+    if device.platform != "tpu":
+        return "cpu"
+    kind = device.device_kind.lower()
+    if "v6" in kind:
+        return "v6e"
+    if "v5" in kind:
+        # "TPU v5 lite" = v5e; "TPU v5p"/"TPU v5" = v5p
+        return "v5e" if "lite" in kind or "v5e" in kind else "v5p"
+    if "v4" in kind:
+        return "v4"
+    return "v5e"
 
 
 def mfu(
