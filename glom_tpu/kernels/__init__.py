@@ -1,3 +1,4 @@
 from glom_tpu.kernels.grouped_mlp import fused_grouped_ffw
+from glom_tpu.kernels.consensus_update import fused_consensus_update
 
-__all__ = ["fused_grouped_ffw"]
+__all__ = ["fused_grouped_ffw", "fused_consensus_update"]

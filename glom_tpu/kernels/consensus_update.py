@@ -1,0 +1,307 @@
+"""Pallas TPU kernel: blockwise consensus attention fused with the 4-way
+mean column update.
+
+Reference parity: ConsensusAttention.forward + the update mean
+(glom_pytorch/glom_pytorch.py:54-71 and :124-140). One kernel program
+computes, for one (level g, image b, row-tile i):
+
+    cons = softmax_j( q_i . normalize(k)_j * d^-1/2  [dual masks] ) @ v
+    out  = (levels_i + bottom_up_i + top_down_i + cons) / div_g
+
+with a flash-style ONLINE softmax over j-tiles — the [n, n] similarity is
+never materialized (O(n) memory in the patch axis), which is the
+long-context path SURVEY.md §2.2 calls for. Both reference mask semantics
+live in the inner loop:
+
+  * attend_self=False: the DIAGONAL similarity is REPLACED by the soft
+    -5e-4 penalty (reference TOKEN_ATTEND_SELF_VALUE, :9/:61-63);
+  * local radius > 0: pairs farther than `radius` in Euclidean patch-grid
+    distance are hard-masked to -3e38 (reference cdist buffer, :42-52).
+    The mask is computed in-register from iota (no [n, n] HBM buffer at
+    all — the reference's O(n^2) init-time cost disappears), and j-tiles
+    that are ENTIRELY outside the radius band are skipped (block
+    sparsity): rows i and j can only interact if their grid rows differ
+    by <= radius, so the live j-window per i-tile is static arithmetic.
+
+The epilogue folds in the per-level mean (4 contributions, 3 at the top
+level — reference :121-122) and the zero top-down of the top level
+(reference :130 F.pad) by masking the g = L-1 top-down tile, so XLA's
+separate pad + add + divide HBM sweeps disappear.
+
+Layout: level-major [L, B, n, d] ("lm") — the batched-matmul-natural
+layout; glom_tpu.models.core keeps the scan carry in this layout so no
+transposes appear between kernels.
+
+Backward: custom_vjp that recomputes the forward in plain XLA (dense
+consensus from ops/consensus.py) and differentiates that — exactly
+correct (same math contract, locked by tests), matmul-heavy, and saves
+nothing but levels/bu/td, the flash-attention residual trade.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from glom_tpu.utils.helpers import TOKEN_ATTEND_SELF_VALUE
+
+_NEG_MAX = float(jnp.finfo(jnp.float32).min)
+
+
+def _row_col(idx, side):
+    """Patch-grid (row, col) coordinates of flat patch indices."""
+    return idx // side, idx % side
+
+
+def _consensus_update_kernel(
+    x_ref,      # [1, TB, TI, d] levels q/self tile
+    kv_ref,     # [1, TB, n, d]  full rows of levels for (g, b-tile): k and v
+    bu_ref,     # [1, TB, TI, d] bottom-up contribution tile
+    td_ref,     # [1, TB, TI, d] top-down tile (index-clamped at the top level)
+    out_ref,    # [1, TB, TI, d]
+    *,
+    levels_count: int,
+    side: int,
+    radius: float,
+    attend_self: bool,
+    tile_i: int,
+    tile_j: int,
+    n: int,
+):
+    """One program: a (level g, image-tile, row-tile i) block. The TB images
+    ride the batch dimension of a single batched dot_general per j-step, so
+    small-n configs still feed the MXU one large op instead of TB tiny ones.
+    """
+    g = pl.program_id(0)
+    i = pl.program_id(2)
+    tb = x_ref.shape[1]
+    d = x_ref.shape[-1]
+    scale = d ** -0.5
+
+    x = x_ref[0]  # [TB, TI, d]
+    q32 = x.astype(jnp.float32)
+
+    row_ids = i * tile_i + jax.lax.broadcasted_iota(jnp.int32, (tile_i, tile_j), 0)
+    ri, ci = _row_col(row_ids, side)
+
+    n_j = n // tile_j
+
+    # Block sparsity for the local mask: patches interact only when their
+    # grid rows differ by <= radius, i.e. flat indices differ by less than
+    # (radius + 1) * side. The live j-window for this i-tile (i is traced,
+    # so the window is int32 arithmetic; fori_loop takes dynamic bounds):
+    if radius > 0:
+        reach = int(radius + 1) * side
+        lo = i * tile_i - reach
+        hi = i * tile_i + tile_i + reach
+        j_lo = jnp.maximum(lo // tile_j, 0)
+        j_hi = jnp.minimum(-(-hi // tile_j), n_j)
+    else:
+        j_lo, j_hi = 0, n_j
+
+    m0 = jnp.full((tb, tile_i, 1), _NEG_MAX, jnp.float32)
+    l0 = jnp.zeros((tb, tile_i, 1), jnp.float32)
+    acc0 = jnp.zeros((tb, tile_i, d), jnp.float32)
+
+    def j_body(j, carry):
+        m, l, acc = carry
+        kv = kv_ref[0, :, pl.ds(j * tile_j, tile_j), :]  # [TB, TJ, d]
+        kv32 = kv.astype(jnp.float32)
+        # k-only L2 normalization (reference :56): v stays raw. Matches
+        # helpers.l2norm: x / max(||x||, 1e-12).
+        norm = jnp.sqrt(jnp.sum(kv32 * kv32, axis=-1, keepdims=True))
+        k = (kv32 / jnp.maximum(norm, 1e-12)).astype(x.dtype)
+        s = (
+            jax.lax.dot_general(
+                x, k, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [TB, TI, TJ]
+
+        col_ids = j * tile_j + jax.lax.broadcasted_iota(
+            jnp.int32, (tile_i, tile_j), 1
+        )
+        if not attend_self:
+            s = jnp.where((row_ids == col_ids)[None], TOKEN_ATTEND_SELF_VALUE, s)
+        if radius > 0:
+            rj, cj = _row_col(col_ids, side)
+            dist2 = (ri - rj) ** 2 + (ci - cj) ** 2
+            s = jnp.where(
+                (dist2.astype(jnp.float32) > radius * radius)[None], _NEG_MAX, s
+            )
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        # Downcast the probabilities for the MXU, matching the dense op's
+        # softmax(...).astype(levels.dtype) before attn @ v.
+        pv = jax.lax.dot_general(
+            p.astype(x.dtype), kv, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        acc_new = acc * corr + pv
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(j_lo, j_hi, j_body, (m0, l0, acc0))
+    cons = acc / l
+
+    bu = bu_ref[0].astype(jnp.float32)
+    td = td_ref[0].astype(jnp.float32)
+    # Top level: no top-down contribution (its tile is index-clamped junk)
+    # and a 3-way divisor (reference :121-122, :130).
+    is_top = g == levels_count - 1
+    td = jnp.where(is_top, 0.0, td)
+    div = jnp.where(is_top, 3.0, 4.0)
+    new = (q32 + bu + td + cons) / div
+    out_ref[0] = new.astype(out_ref.dtype)
+
+
+def _pick_tile(n: int, cap: int = 256) -> int:
+    for t in (cap, 128, 64, 32, 16, 8):
+        if n % t == 0 and t <= n:
+            return t
+    return n
+
+
+def _pick_tile_b(B: int, n: int, d: int, tile_i: int, tile_j: int, itemsize: int) -> int:
+    """Largest batch tile dividing B that keeps the working set well under
+    VMEM: ~2x-buffered in/out blocks + f32 accumulators + the sim tile."""
+    budget = 12 * 1024 * 1024
+    for tb in (8, 4, 2, 1):
+        if B % tb != 0:
+            continue
+        blocks = 5 * tb * tile_i * d * itemsize * 2  # x/bu/td/out/kv, 2x buffered
+        kv_extra = tb * (n - tile_i) * d * itemsize * 2 if n > tile_i else 0
+        scratch = tb * tile_i * (d + 1) * 4 * 2 + tb * tile_i * tile_j * 4
+        if blocks + kv_extra + scratch <= budget:
+            return tb
+    return 1
+
+
+def _forward(
+    levels_lm: jnp.ndarray,
+    bu_lm: jnp.ndarray,
+    td_lm: jnp.ndarray,
+    *,
+    side: int,
+    radius: float,
+    attend_self: bool,
+    interpret: bool,
+) -> jnp.ndarray:
+    L, B, n, d = levels_lm.shape
+    tile_i = _pick_tile(n)
+    tile_j = _pick_tile(n)
+    tile_b = _pick_tile_b(B, n, d, tile_i, tile_j, levels_lm.dtype.itemsize)
+    grid = (L, B // tile_b, n // tile_i)
+
+    kernel = partial(
+        _consensus_update_kernel,
+        levels_count=L,
+        side=side,
+        radius=float(radius),
+        attend_self=attend_self,
+        tile_i=tile_i,
+        tile_j=tile_j,
+        n=n,
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((L, B, n, d), levels_lm.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, tile_b, tile_i, d), lambda g, b, i: (g, b, i, 0)),  # x
+            pl.BlockSpec((1, tile_b, n, d), lambda g, b, i: (g, b, 0, 0)),  # kv
+            pl.BlockSpec((1, tile_b, tile_i, d), lambda g, b, i: (g, b, i, 0)),  # bu
+            # td has L-1 groups; clamp the top level's index (masked in-kernel)
+            pl.BlockSpec(
+                (1, tile_b, tile_i, d),
+                lambda g, b, i, _L=L: (jnp.minimum(g, _L - 2), b, i, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec((1, tile_b, tile_i, d), lambda g, b, i: (g, b, i, 0)),
+        interpret=interpret,
+    )(levels_lm, levels_lm, bu_lm, td_lm)
+
+
+def _xla_reference(levels_lm, bu_lm, td_lm, *, side, radius, attend_self):
+    """Plain-XLA recomputation of the fused op (used for the backward pass).
+    Must match the kernel's math contract bit-for-bit at the op level."""
+    from glom_tpu.ops.consensus import build_local_mask, consensus_attention
+
+    L, B, n, d = levels_lm.shape
+    levels = jnp.transpose(levels_lm, (1, 2, 0, 3))  # [B, n, L, d]
+    mask = build_local_mask(side, radius)
+    cons = consensus_attention(levels, attend_self=attend_self, local_mask=mask)
+    cons_lm = jnp.transpose(cons, (2, 0, 1, 3))  # [L, B, n, d]
+    td_full = jnp.concatenate(
+        [td_lm[: L - 1], jnp.zeros_like(td_lm[:1])], axis=0
+    )
+    div = jnp.concatenate(
+        [jnp.full((L - 1, 1, 1, 1), 4.0), jnp.full((1, 1, 1, 1), 3.0)]
+    ).astype(jnp.float32)
+    new = (
+        levels_lm.astype(jnp.float32)
+        + bu_lm.astype(jnp.float32)
+        + td_full.astype(jnp.float32)
+        + cons_lm.astype(jnp.float32)
+    ) / div
+    return new.astype(levels_lm.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _fused(levels_lm, bu_lm, td_lm, side, radius, attend_self, interpret):
+    return _forward(
+        levels_lm, bu_lm, td_lm,
+        side=side, radius=radius, attend_self=attend_self, interpret=interpret,
+    )
+
+
+def _fused_fwd(levels_lm, bu_lm, td_lm, side, radius, attend_self, interpret):
+    out = _fused(levels_lm, bu_lm, td_lm, side, radius, attend_self, interpret)
+    return out, (levels_lm, bu_lm, td_lm)
+
+
+def _fused_bwd(side, radius, attend_self, interpret, res, g):
+    levels_lm, bu_lm, td_lm = res
+    _, vjp = jax.vjp(
+        lambda lv, bu, td: _xla_reference(
+            lv, bu, td, side=side, radius=radius, attend_self=attend_self
+        ),
+        levels_lm, bu_lm, td_lm,
+    )
+    return vjp(g)
+
+
+_fused.defvjp(_fused_fwd, _fused_bwd)
+
+
+def fused_consensus_update(
+    levels_lm: jnp.ndarray,
+    bu_lm: jnp.ndarray,
+    td_lm: jnp.ndarray,
+    *,
+    side: int,
+    radius: float = 0.0,
+    attend_self: bool = False,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """new_levels = (levels + bu + pad(td) + consensus(levels)) / div, fused.
+
+    levels_lm: [L, B, n, d] level-major; bu_lm: [L, B, n, d];
+    td_lm: [L-1, B, n, d] (top level's zero contribution is implicit).
+    Returns [L, B, n, d]. Falls back to the XLA composition off-TPU.
+    """
+    L, B, n, d = levels_lm.shape
+    on_tpu = jax.devices()[0].platform == "tpu"
+    supported = d % 128 == 0 and n % 8 == 0 and L >= 2
+    if not supported or not (on_tpu or interpret):
+        return _xla_reference(
+            levels_lm, bu_lm, td_lm,
+            side=side, radius=radius, attend_self=attend_self,
+        )
+    return _fused(levels_lm, bu_lm, td_lm, side, radius, attend_self, interpret)

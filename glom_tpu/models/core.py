@@ -123,14 +123,32 @@ def glom_forward(
     temporal/video recipe — detach between frames with lax.stop_gradient).
     `iters`/`return_all`/`remat` are static under jit.
 
-    use_pallas=True routes the grouped FFWs through the fused Pallas kernel
-    (auto-falls back off-TPU / unsupported shapes). Leave False inside
-    GSPMD-sharded model-parallel regions — the custom call has no
-    partitioning rule for sharded weights.
+    use_pallas=True selects the fully-fused TPU path: a LEVEL-MAJOR
+    [L, b, n, d] scan carry (zero layout transposes between ops), the
+    Pallas fused grouped-MLP for both FFWs, and the Pallas blockwise
+    consensus+mean kernel (kernels/consensus_update.py) for the rest of
+    the update. Auto-falls back to XLA ops off-TPU / unsupported shapes.
+    Leave False inside GSPMD-sharded model-parallel regions — the custom
+    calls have no partitioning rule for sharded weights.
     """
     T = default(iters, cfg.default_iters)
 
+    if use_pallas and consensus_fn is None:
+        if compute_dtype is not None:
+            params = jax.tree_util.tree_map(
+                lambda t: t.astype(compute_dtype), params
+            )
+            img = img.astype(compute_dtype)
+            if exists(levels):
+                levels = levels.astype(compute_dtype)
+        return _glom_forward_fused(
+            params, img, cfg, iters=T, levels_in=levels,
+            return_all=return_all, remat=remat,
+        )
+
     if use_pallas:
+        # Custom consensus_fn + Pallas FFWs: reference-layout path with the
+        # fused MLP swapped in (used by sharded per-shard bodies).
         from glom_tpu.kernels import fused_grouped_ffw
 
         ffw_fn: FFWFn = fused_grouped_ffw
@@ -181,3 +199,79 @@ def glom_forward(
     if return_all:
         return jnp.concatenate([levels[None], stacked], axis=0)  # [T+1, b, n, L, d]
     return final
+
+
+def _glom_forward_fused(
+    params: GlomParams,
+    img: jnp.ndarray,
+    cfg: GlomConfig,
+    *,
+    iters: int,
+    levels_in: Optional[jnp.ndarray],
+    return_all: bool,
+    remat: bool,
+) -> jnp.ndarray:
+    """The fused TPU forward: level-major carry + Pallas kernels.
+
+    Same behavioral contract as the reference path (locked by
+    tests/test_model.py::TestPallasParity); the differences are purely
+    physical: the scan carry is [L, b, n, d] so the grouped-FFW batched
+    matmuls and the per-(level, image) consensus tiles are layout-native,
+    and the whole 4-way mean update runs inside the consensus kernel's
+    epilogue instead of as separate XLA HBM sweeps.
+    """
+    from glom_tpu.kernels import fused_consensus_update
+    from glom_tpu.kernels.grouped_mlp import fused_grouped_ffw_lm
+
+    with jax.named_scope("image_to_tokens"):
+        tokens = image_to_tokens(params.token_embed, img, cfg.patch_size)
+    b, n, d = tokens.shape
+    L = cfg.levels
+    tokens_lm = tokens[None]  # [1, b, n, d]
+    pos_lm = params.pos_emb[None, None]  # [1, 1, n, d]
+
+    if exists(levels_in):
+        # Keep the caller's carry dtype (the reference path's scan carry is
+        # new.astype(levels.dtype) — the temporal recipe must see identical
+        # dtype behavior under both flags).
+        levels_lm = jnp.transpose(levels_in, (2, 0, 1, 3))
+    else:
+        levels_lm = jnp.broadcast_to(
+            params.init_levels[:, None, None], (L, b, n, d)
+        ).astype(tokens.dtype)
+
+    def body(carry, _):
+        lv = carry
+        # Bottom-up input: (image tokens, levels 1..L-1) — level 1 re-reads
+        # the RAW tokens every iteration (reference :127).
+        with jax.named_scope("bottom_up"):
+            bu_in = jnp.concatenate([tokens_lm, lv[:-1]], axis=0)
+            bu_out = fused_grouped_ffw_lm(
+                params.bottom_up, bu_in.reshape(L, b * n, d)
+            ).reshape(L, b, n, d)
+        # Top-down input: levels 2..L with pos-emb injected HERE only
+        # (reference :129); the top level's zero pad + the 4-vs-3 divisor
+        # live in the consensus kernel's epilogue.
+        with jax.named_scope("top_down"):
+            td_in = lv[1:] + pos_lm
+            td_out = fused_grouped_ffw_lm(
+                params.top_down, td_in.reshape(L - 1, b * n, d)
+            ).reshape(L - 1, b, n, d)
+        with jax.named_scope("consensus_update"):
+            new = fused_consensus_update(
+                lv, bu_out, td_out,
+                side=cfg.num_patches_side,
+                radius=float(cfg.local_consensus_radius),
+                attend_self=cfg.consensus_self,
+            )
+        return new, (new if return_all else None)
+
+    if remat:
+        body = jax.checkpoint(body)
+
+    final, stacked = jax.lax.scan(body, levels_lm, None, length=iters)
+
+    if return_all:
+        all_lm = jnp.concatenate([levels_lm[None], stacked], axis=0)
+        return jnp.transpose(all_lm, (0, 2, 3, 1, 4))  # [T+1, b, n, L, d]
+    return jnp.transpose(final, (1, 2, 0, 3))  # [b, n, L, d]

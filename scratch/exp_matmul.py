@@ -1,0 +1,137 @@
+"""Scratch: what matmul TF/s can this chip actually reach, and which grouped
+formulation is fastest?"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+sys.path.insert(0, "/root/repo")
+
+
+def timed(fn, *args, repeats=3):
+    f = jax.jit(fn)
+    warm = float(f(*args))
+    assert warm == warm
+    ts = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        float(f(*args))
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+K_ITERS = 32
+
+
+def report(name, dt, flops_per_app):
+    per = dt / K_ITERS
+    print(f"{name:28s}: {per*1e6:9.1f} us/app  {flops_per_app/per/1e12:6.1f} TF/s")
+
+
+# 1) big square 2D matmul — achievable peak
+for S in (4096, 8192):
+    a = jax.random.normal(jax.random.PRNGKey(0), (S, S), jnp.bfloat16)
+    b = jax.random.normal(jax.random.PRNGKey(1), (S, S), jnp.bfloat16)
+
+    def sq(a0, b0):
+        def body(_, c):
+            c = jnp.dot(c, b0, preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+            return c * 1e-2
+        out = jax.lax.fori_loop(0, K_ITERS, body, a0)
+        return jnp.sum(out).astype(jnp.float32)
+
+    dt = timed(sq, a, b)
+    report(f"square {S}", dt, 2 * S**3)
+
+# 2) grouped-FFW shaped: G=6, M=4096, K=512, N=2048, output fed back via :512
+G, M, D, F = 6, 4096, 512, 2048
+flops = 2 * G * M * D * F
+x = jax.random.normal(jax.random.PRNGKey(2), (G, M, D), jnp.bfloat16)
+w = jax.random.normal(jax.random.PRNGKey(3), (G, D, F), jnp.bfloat16)
+w2 = jax.random.normal(jax.random.PRNGKey(4), (G, F, D), jnp.bfloat16)
+
+
+def chain(step):
+    def f(x0, w0, w20):
+        def body(_, c):
+            return step(c, w0, w20)
+        out = jax.lax.fori_loop(0, K_ITERS, body, x0)
+        return jnp.sum(out).astype(jnp.float32)
+    return f
+
+
+def einsum_pair(c, w0, w20):
+    # round trip d->f->d so carry keeps shape and ALL flops count
+    h = jnp.einsum("gmd,gdf->gmf", c, w0, preferred_element_type=jnp.float32)
+    h = h.astype(jnp.bfloat16)
+    o = jnp.einsum("gmf,gfd->gmd", h, w20, preferred_element_type=jnp.float32)
+    return (o * 1e-3).astype(jnp.bfloat16)
+
+
+def vmap_pair(c, w0, w20):
+    def one(cg, wg, w2g):
+        h = jnp.dot(cg, wg, preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+        return jnp.dot(h, w2g, preferred_element_type=jnp.float32)
+    o = jax.vmap(one)(c, w0, w20)
+    return (o * 1e-3).astype(jnp.bfloat16)
+
+
+def unrolled_pair(c, w0, w20):
+    outs = []
+    for g in range(G):
+        h = jnp.dot(c[g], w0[g], preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+        outs.append(jnp.dot(h, w20[g], preferred_element_type=jnp.float32))
+    o = jnp.stack(outs)
+    return (o * 1e-3).astype(jnp.bfloat16)
+
+
+report("einsum grouped pair", timed(chain(einsum_pair), x, w, w2), 2 * flops)
+report("vmap grouped pair", timed(chain(vmap_pair), x, w, w2), 2 * flops)
+report("unrolled grouped pair", timed(chain(unrolled_pair), x, w, w2), 2 * flops)
+
+# 3) pallas fused pair (existing kernel)
+from glom_tpu.kernels.grouped_mlp import _fused_forward
+from glom_tpu.ops.ffw import GroupedFFWParams
+
+params = GroupedFFWParams(
+    w1=w, b1=jnp.zeros((G, F), jnp.bfloat16),
+    w2=w2, b2=jnp.zeros((G, D), jnp.bfloat16),
+)
+
+
+def pallas_pair(c, w0, w20):
+    o = _fused_forward(params, c, tile_m=512, interpret=False)
+    return (o * 1e-3).astype(jnp.bfloat16)
+
+
+report("pallas fused pair", timed(chain(pallas_pair), x, w, w2), 2 * flops)
+
+# 4) single big 2D matmul same total flops as grouped pair: [M, D] @ [D, G*F*2]?
+# closer comparison: M=4096, K=512, N=2048 single (1/6 of grouped flops)
+a = jax.random.normal(jax.random.PRNGKey(5), (M, D), jnp.bfloat16)
+b = jax.random.normal(jax.random.PRNGKey(6), (D, F), jnp.bfloat16)
+b2 = jax.random.normal(jax.random.PRNGKey(7), (F, D), jnp.bfloat16)
+
+
+def single_pair(c, w0, w20):
+    h = jnp.dot(c, w0, preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+    o = jnp.dot(h, w20, preferred_element_type=jnp.float32)
+    return (o * 1e-3).astype(jnp.bfloat16)
+
+
+report("single M4096 pair", timed(chain(single_pair), a, b, b2), 2 * 2 * M * D * F)
+
+# 5) wide single: M=24576 (=G*M rows) x [512, 2048] shared weights
+a = jax.random.normal(jax.random.PRNGKey(8), (G * M, D), jnp.bfloat16)
+
+
+def wide_pair(c, w0, w20):
+    h = jnp.dot(c, w0, preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+    o = jnp.dot(h, w20, preferred_element_type=jnp.float32)
+    return (o * 1e-3).astype(jnp.bfloat16)
+
+
+report("wide M24576 pair", timed(chain(wide_pair), a, b, b2), 2 * 2 * G * M * D * F)

@@ -1,0 +1,45 @@
+"""Measure the tunnel RTT floor: repeated fetch of an already-computed scalar,
+and a trivial jitted scalar op."""
+import sys, time
+import jax
+import jax.numpy as jnp
+
+x = jnp.float32(1.5) + 1  # on device
+times = []
+for _ in range(10):
+    t0 = time.perf_counter()
+    float(x)
+    times.append(time.perf_counter() - t0)
+print("fetch existing scalar:", [f"{t*1e3:.1f}ms" for t in times])
+
+f = jax.jit(lambda a: a * 2.0)
+y = f(x); float(y)
+times = []
+for _ in range(10):
+    t0 = time.perf_counter()
+    float(f(x))
+    times.append(time.perf_counter() - t0)
+print("trivial jit + fetch  :", [f"{t*1e3:.1f}ms" for t in times])
+
+# medium matmul, growing chain lengths -> slope = true per-iter time
+a = jax.random.normal(jax.random.PRNGKey(0), (4096, 4096), jnp.bfloat16)
+b = jax.random.normal(jax.random.PRNGKey(1), (4096, 4096), jnp.bfloat16)
+
+def chain(k):
+    def f(a0, b0):
+        def body(_, c):
+            return (jnp.dot(c, b0, preferred_element_type=jnp.float32) * 1e-2).astype(jnp.bfloat16)
+        out = jax.lax.fori_loop(0, k, body, a0)
+        return jnp.sum(out).astype(jnp.float32)
+    return jax.jit(f)
+
+for k in (8, 32, 128):
+    fk = chain(k)
+    float(fk(a, b))
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        float(fk(a, b))
+        ts.append(time.perf_counter() - t0)
+    dt = min(ts)
+    print(f"chain {k:4d}: total {dt*1e3:8.1f} ms   per-iter {dt/k*1e6:8.1f} us")

@@ -1,0 +1,45 @@
+"""Slope-based forward timing: per-forward time = (t_long - t_short)/(k_long - k_short),
+eliminating the ~106ms fixed dispatch overhead of the tunnel."""
+import sys, time
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+from glom_tpu.models.core import glom_forward, init_glom
+from glom_tpu.utils.config import GlomConfig
+from glom_tpu.utils.metrics import mfu
+
+cfg = GlomConfig(dim=512, levels=6, image_size=224, patch_size=14)
+batch, iters = 16, 12
+params = init_glom(jax.random.PRNGKey(0), cfg)
+img = jax.random.normal(jax.random.PRNGKey(1), (batch, 3, 224, 224), jnp.float32)
+
+
+def make_chain(k, use_pallas):
+    def multi(p, x):
+        def body(_, acc):
+            out = glom_forward(p, x + acc * 0.0, cfg, iters=iters,
+                               compute_dtype=jnp.bfloat16, use_pallas=use_pallas)
+            return jnp.sum(out).astype(jnp.float32) * 1e-9
+        return jax.lax.fori_loop(0, k, body, jnp.float32(0.0))
+    return jax.jit(multi)
+
+
+def t(f, repeats=4):
+    warm = float(f(params, img)); assert warm == warm
+    ts = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        float(f(params, img))
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+for name, up in [("xla", False), ("pallas", True)]:
+    k1, k2 = 8, 40
+    t1 = t(make_chain(k1, up))
+    t2 = t(make_chain(k2, up))
+    per_fwd = (t2 - t1) / (k2 - k1)
+    cis = batch * iters / per_fwd
+    print(f"{name:8s}: t{k1}={t1*1e3:7.1f}ms t{k2}={t2*1e3:7.1f}ms "
+          f"per_fwd={per_fwd*1e3:7.2f}ms col-iters/s={cis:9.1f} mfu={mfu(cfg, cis):.3f}")

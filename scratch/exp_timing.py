@@ -1,0 +1,101 @@
+"""Scratch experiment: time the XLA vs Pallas forward paths and the
+component ops on the real chip. Not part of the package."""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+
+from glom_tpu.models.core import glom_forward, init_glom
+from glom_tpu.ops.consensus import consensus_attention
+from glom_tpu.ops.ffw import grouped_ffw
+from glom_tpu.kernels import fused_grouped_ffw
+from glom_tpu.utils.config import GlomConfig
+from glom_tpu.utils.metrics import mfu
+
+cfg = GlomConfig(dim=512, levels=6, image_size=224, patch_size=14)
+batch, iters, chain = 16, 12, 8
+params = init_glom(jax.random.PRNGKey(0), cfg)
+img = jax.random.normal(jax.random.PRNGKey(1), (batch, 3, 224, 224), jnp.float32)
+
+
+def timed(fn, *args, repeats=3):
+    f = jax.jit(fn)
+    warm = float(f(*args))  # compile+warm, sync via scalar fetch
+    assert warm == warm, "nan"
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        float(f(*args))
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def fwd_chain(use_pallas):
+    def multi(p, x):
+        def body(_, acc):
+            out = glom_forward(p, x + acc * 0.0, cfg, iters=iters,
+                               compute_dtype=jnp.bfloat16, use_pallas=use_pallas)
+            return jnp.sum(out).astype(jnp.float32) * 1e-9
+        return jax.lax.fori_loop(0, chain, body, jnp.float32(0.0))
+    return multi
+
+
+for name, up in [("xla", False), ("pallas_ffw", True)]:
+    dt = timed(fwd_chain(up), params, img)
+    cis = batch * chain * iters / dt
+    print(f"{name:12s}: {dt*1e3:8.2f} ms  {cis:8.1f} col-iters/s  mfu={mfu(cfg, cis):.3f}")
+
+# ---- component timing: FFW alone (both impls), consensus alone ----
+n, L, d = cfg.num_patches, cfg.levels, cfg.dim
+x = jax.random.normal(jax.random.PRNGKey(2), (batch, n, L, d), jnp.bfloat16)
+bu = jax.tree_util.tree_map(lambda t: t.astype(jnp.bfloat16), params.bottom_up)
+
+K = iters * chain  # same number of applications as the full forward
+
+
+def ffw_chain(impl):
+    def f(p, x0):
+        def body(_, carry):
+            out = impl(p, carry)
+            return out.astype(carry.dtype) * 0.5  # keep magnitudes bounded
+        out = jax.lax.fori_loop(0, K, body, x0)
+        return jnp.sum(out).astype(jnp.float32)
+    return f
+
+
+def cons_chain(x0):
+    def body(_, carry):
+        out = consensus_attention(carry)
+        return out.astype(carry.dtype)
+    out = jax.lax.fori_loop(0, K, body, x0)
+    return jnp.sum(out).astype(jnp.float32)
+
+
+dt_x = timed(ffw_chain(grouped_ffw), bu, x)
+dt_p = timed(ffw_chain(fused_grouped_ffw), bu, x)
+dt_c = timed(cons_chain, x)
+print(f"ffw xla     : {dt_x*1e3:8.2f} ms total, {dt_x/K*1e6:8.1f} us/app")
+print(f"ffw pallas  : {dt_p*1e3:8.2f} ms total, {dt_p/K*1e6:8.1f} us/app")
+print(f"consensus   : {dt_c*1e3:8.2f} ms total, {dt_c/K*1e6:8.1f} us/app")
+
+# matmul roofline check: same M,K,N as one grouped-FFW level pair
+M = batch * n
+a = jax.random.normal(jax.random.PRNGKey(3), (L, M, d), jnp.bfloat16)
+w = jax.random.normal(jax.random.PRNGKey(4), (L, d, 4 * d), jnp.bfloat16)
+
+
+def mm_chain(a0, w0):
+    def body(_, carry):
+        h = jnp.einsum("gmd,gdf->gmf", carry, w0, preferred_element_type=jnp.float32)
+        return (h[..., :d] * 1e-3).astype(carry.dtype)
+    out = jax.lax.fori_loop(0, K, body, a0)
+    return jnp.sum(out).astype(jnp.float32)
+
+
+dt_m = timed(mm_chain, a, w)
+fl = 2 * L * M * d * 4 * d
+print(f"bare matmul : {dt_m/K*1e6:8.1f} us/app  -> {fl/(dt_m/K)/1e12:6.1f} TF/s")

@@ -88,7 +88,7 @@ class TestFusedGroupedFFW:
 
         params, _ = setup
         pb = jax.tree_util.tree_map(lambda t: t.astype(jnp.bfloat16), params)
-        x = jnp.zeros((2, 128, 4, 128), jnp.bfloat16)
+        x = jnp.zeros((4, 256, 128), jnp.bfloat16)  # level-major [G, M, d]
         g = jnp.zeros_like(x)
         jaxpr = jax.make_jaxpr(lambda p, x_, g_: _bwd(128, False, (p, x_), g_))(
             pb, x, g
@@ -97,3 +97,159 @@ class TestFusedGroupedFFW:
         assert dots, "backward lost its contractions?"
         for e in dots:
             assert e.params["preferred_element_type"] == jnp.float32
+
+
+class TestFusedConsensusUpdate:
+    """Blockwise consensus + 4-way mean kernel vs the dense XLA composition."""
+
+    def _reference(self, levels_lm, bu_lm, td_lm, side, radius, attend_self):
+        from glom_tpu.kernels.consensus_update import _xla_reference
+
+        return _xla_reference(
+            levels_lm, bu_lm, td_lm,
+            side=side, radius=radius, attend_self=attend_self,
+        )
+
+    def _rand(self, key, L, B, n, d):
+        k1, k2, k3 = jax.random.split(key, 3)
+        levels = jax.random.normal(k1, (L, B, n, d), jnp.float32)
+        bu = jax.random.normal(k2, (L, B, n, d), jnp.float32)
+        td = jax.random.normal(k3, (L - 1, B, n, d), jnp.float32)
+        return levels, bu, td
+
+    @pytest.mark.parametrize("radius", [0.0, 2.0, 7.0])
+    @pytest.mark.parametrize("attend_self", [False, True])
+    def test_matches_dense(self, radius, attend_self):
+        from glom_tpu.kernels import fused_consensus_update
+
+        L, B, side, d = 3, 2, 8, 128
+        n = side * side
+        levels, bu, td = self._rand(jax.random.PRNGKey(0), L, B, n, d)
+        got = fused_consensus_update(
+            levels, bu, td,
+            side=side, radius=radius, attend_self=attend_self, interpret=True,
+        )
+        want = self._reference(levels, bu, td, side, radius, attend_self)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+        )
+
+    def test_multirow_tiles_online_softmax(self):
+        """n large enough that the j-loop runs multiple online-softmax steps:
+        side=24 -> n=576, tile 64 -> 9 j-tiles per row-tile, exercising the
+        exp(m - m_new) carry correction, fully-masked-row self-healing, and
+        the block-sparsity j-window arithmetic."""
+        from glom_tpu.kernels.consensus_update import _fused, _pick_tile
+
+        L, B, side, d = 2, 1, 24, 128
+        n = side * side
+        assert _pick_tile(n) < n, "tile must split n or this test is vacuous"
+        levels, bu, td = self._rand(jax.random.PRNGKey(1), L, B, n, d)
+        # radius 3 on side 24: live window is a band; far j-tiles are skipped
+        got = _fused(levels, bu, td, side, 3.0, False, True)
+        want = self._reference(levels, bu, td, side, 3.0, False)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-3, atol=2e-5
+        )
+
+    def test_grad_matches_dense(self):
+        from glom_tpu.kernels import fused_consensus_update
+
+        L, B, side, d = 3, 1, 4, 128
+        n = side * side
+        levels, bu, td = self._rand(jax.random.PRNGKey(2), L, B, n, d)
+
+        def loss_fused(lv, b_, t_):
+            out = fused_consensus_update(
+                lv, b_, t_, side=side, radius=2.0, interpret=True
+            )
+            return jnp.mean(out ** 2)
+
+        def loss_ref(lv, b_, t_):
+            return jnp.mean(self._reference(lv, b_, t_, side, 2.0, False) ** 2)
+
+        g1 = jax.grad(loss_fused, argnums=(0, 1, 2))(levels, bu, td)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(levels, bu, td)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-5
+            )
+
+    def test_top_level_divisor_and_zero_topdown(self):
+        """Top level must ignore td entirely and divide by 3 (reference
+        :121-122/:130): poisoning td's clamped top tile must not change out."""
+        from glom_tpu.kernels import fused_consensus_update
+
+        L, B, side, d = 3, 1, 4, 128
+        n = side * side
+        levels, bu, td = self._rand(jax.random.PRNGKey(3), L, B, n, d)
+        out1 = fused_consensus_update(
+            levels, bu, td, side=side, interpret=True
+        )
+        td_poison = td.at[-1].set(1e6)
+        out2 = fused_consensus_update(
+            levels, bu, td_poison, side=side, interpret=True
+        )
+        # top level identical (never reads td), level L-2 changes
+        np.testing.assert_allclose(
+            np.asarray(out1[-1]), np.asarray(out2[-1]), rtol=0, atol=0
+        )
+        assert not np.allclose(np.asarray(out1[-2]), np.asarray(out2[-2]))
+
+
+class TestFusedForwardParity:
+    """The use_pallas=True fused level-major forward must match the
+    reference-layout path on every contract point (CPU: kernels fall back to
+    XLA, so this locks the LAYOUT/plumbing; kernel math is locked above in
+    interpret mode and on TPU)."""
+
+    def _cfg(self, **kw):
+        from glom_tpu.utils.config import GlomConfig
+
+        base = dict(dim=128, levels=4, image_size=32, patch_size=8)
+        base.update(kw)
+        return GlomConfig(**base)
+
+    def _run(self, cfg, **kw):
+        from glom_tpu.models.core import glom_forward, init_glom
+
+        params = init_glom(jax.random.PRNGKey(0), cfg)
+        img = jax.random.normal(jax.random.PRNGKey(1), (2, 3, cfg.image_size, cfg.image_size))
+        ref = glom_forward(params, img, cfg, use_pallas=False, **kw)
+        fused = glom_forward(params, img, cfg, use_pallas=True, **kw)
+        return ref, fused
+
+    def test_forward(self):
+        ref, fused = self._run(self._cfg())
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+    def test_return_all_and_radius(self):
+        ref, fused = self._run(self._cfg(local_consensus_radius=2), return_all=True, iters=3)
+        assert fused.shape == ref.shape  # [T+1, b, n, L, d]
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+    def test_levels_carry_in(self):
+        from glom_tpu.models.core import glom_forward, init_glom
+
+        cfg = self._cfg()
+        params = init_glom(jax.random.PRNGKey(0), cfg)
+        img = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 32, 32))
+        lv = glom_forward(params, img, cfg, iters=2)
+        ref = glom_forward(params, img, cfg, iters=2, levels=lv, use_pallas=False)
+        fused = glom_forward(params, img, cfg, iters=2, levels=lv, use_pallas=True)
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+    def test_grad_and_remat(self):
+        from glom_tpu.models.core import glom_forward, init_glom
+
+        cfg = self._cfg()
+        params = init_glom(jax.random.PRNGKey(0), cfg)
+        img = jax.random.normal(jax.random.PRNGKey(1), (1, 3, 32, 32))
+
+        def loss(p, up, rm):
+            return jnp.mean(glom_forward(p, img, cfg, iters=2, use_pallas=up, remat=rm) ** 2)
+
+        g_ref = jax.grad(loss)(params, False, False)
+        g_fused = jax.grad(loss)(params, True, True)
+        for a, b in zip(jax.tree_util.tree_leaves(g_ref), jax.tree_util.tree_leaves(g_fused)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-5)
