@@ -4,13 +4,14 @@ When `local_consensus_radius` r > 0 the reference still materializes the
 full n x n similarity and masks it (glom_pytorch/glom_pytorch.py:65-67).
 But locality means a patch only attends within r grid rows/cols — so with
 the patch grid sharded into contiguous ROW BANDS over the 'seq' axis, each
-shard needs exactly `ceil(r)` rows from each neighbor, not the whole ring:
-two nearest-neighbor ppermutes (one up, one down, both riding a single ICI
-hop) instead of S ring steps. Communication O(r * side * L * d) per shard,
-independent of n.
+shard needs exactly `floor(r)` rows from each neighbor (grid distances are
+integers: a patch within Euclidean radius r is at most floor(r) rows away),
+not the whole ring: two nearest-neighbor ppermutes (one up, one down, both
+riding a single ICI hop) instead of S ring steps. Communication
+O(r * side * L * d) per shard, independent of n.
 
-Requires rows_per_shard >= ceil(r) (one-hop halo); use the ring for larger
-radii or finer shardings.
+Requires rows_per_shard >= floor(r) (one-hop halo — the predicate is
+helpers.halo_supported); use the ring for larger radii or finer shardings.
 
 Out-of-image halo slots (top shard's upper halo, bottom shard's lower halo)
 arrive zero-filled from the non-periodic ppermute and are hard-masked via
@@ -27,7 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from glom_tpu.parallel.ring import NEG_MAX, _block_sim_masks
-from glom_tpu.utils.helpers import l2norm
+from glom_tpu.utils.helpers import halo_supported, l2norm
 
 
 def halo_consensus_shard(
@@ -67,8 +68,15 @@ def halo_consensus_shard(
         bot_halo = lax.ppermute(t[:, :h], axis_name, up_perm)  # from p+1
         return jnp.concatenate([top_halo, t, bot_halo], axis=1)
 
-    k_ext = exchange(k_loc)  # [b, n_loc + 2h, L, d]
-    v_ext = exchange(v_loc)
+    if h > 0:
+        k_ext = exchange(k_loc)  # [b, n_loc + 2h, L, d]
+        v_ext = exchange(v_loc)
+    else:
+        # radius < 1: no cross-shard pairs are within reach (adjacent grid
+        # rows are distance 1 apart), so skip the exchange entirely. The
+        # h == 0 slice t[:, -0:] would otherwise select the WHOLE block and
+        # mislabel a full neighbor copy with local global indices.
+        k_ext, v_ext = k_loc, v_loc
 
     i_offset = my * n_loc
     j_offset = i_offset - h  # the extended block starts h patches earlier
@@ -102,17 +110,17 @@ def make_halo_consensus(
     axis_name: str = "seq",
 ):
     """Build a consensus_fn for the local-radius path; n sharded over
-    `axis_name`. Validates the one-hop halo precondition at build time."""
-    if radius <= 0:
-        raise ValueError("halo consensus requires local_consensus_radius > 0")
+    `axis_name`. Validates the one-hop halo precondition at build time —
+    the same predicate callers can pre-check via helpers.halo_supported."""
     seq = mesh.shape[axis_name]
-    if side % seq != 0:
-        raise ValueError(f"grid side {side} not divisible by seq axis {seq}")
-    rows_per_shard = side // seq
-    if rows_per_shard < math.floor(radius):
+    if not halo_supported(seq, side, radius):
+        if radius <= 0:
+            raise ValueError("halo consensus requires local_consensus_radius > 0")
+        if side % seq != 0:
+            raise ValueError(f"grid side {side} not divisible by seq axis {seq}")
         raise ValueError(
             f"radius {radius} needs {math.floor(radius)} halo rows but shards "
-            f"only hold {rows_per_shard}; use ring consensus instead"
+            f"only hold {side // seq}; use ring consensus instead"
         )
     fn = partial(
         halo_consensus_shard,

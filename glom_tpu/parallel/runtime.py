@@ -17,6 +17,7 @@ Composition:
 
 from __future__ import annotations
 
+import warnings
 from typing import Iterator, Optional
 
 import jax
@@ -45,6 +46,7 @@ from glom_tpu.train.trainer import (
     make_train_step,
 )
 from glom_tpu.utils.config import GlomConfig, MeshConfig, TrainConfig
+from glom_tpu.utils.helpers import halo_supported
 
 SP_STRATEGIES = ("none", "ring", "ulysses", "halo")
 
@@ -74,11 +76,31 @@ def make_consensus_fn(
             axis_name=axis_name,
         )
     if strategy == "halo":
+        radius = float(cfg.local_consensus_radius)
+        if not halo_supported(mesh.shape[axis_name], cfg.num_patches_side, radius):
+            # Ring is exact for any radius (it carries the same masks); halo
+            # is only the cheaper special case when one-hop neighbor rows
+            # cover the radius. Fall back instead of crashing the config
+            # (BASELINE config 3: radius 7 on an 8-row grid, seq=2 -> 4 rows
+            # per shard < 7).
+            warnings.warn(
+                f"halo consensus unsupported (radius={radius}, "
+                f"side={cfg.num_patches_side}, seq={mesh.shape[axis_name]}); "
+                "falling back to ring consensus",
+                stacklevel=2,
+            )
+            return make_ring_consensus(
+                mesh,
+                attend_self=cfg.consensus_self,
+                side=cfg.num_patches_side,
+                radius=radius,
+                axis_name=axis_name,
+            )
         return make_halo_consensus(
             mesh,
             attend_self=cfg.consensus_self,
             side=cfg.num_patches_side,
-            radius=float(cfg.local_consensus_radius),
+            radius=radius,
             axis_name=axis_name,
         )
     raise ValueError(f"unknown SP strategy {strategy!r}; one of {SP_STRATEGIES}")

@@ -7,6 +7,8 @@ reference (glom_pytorch/glom_pytorch.py:13-17) used for the optional `iters` /
 
 from __future__ import annotations
 
+import math
+
 import jax.numpy as jnp
 
 # The *soft* self-attention penalty used by consensus attention when
@@ -29,6 +31,23 @@ def l2norm(x: jnp.ndarray, axis: int = -1, eps: float = 1e-12) -> jnp.ndarray:
     """
     norm = jnp.linalg.norm(x, ord=2, axis=axis, keepdims=True)
     return x / jnp.maximum(norm, eps)
+
+
+def halo_supported(seq: int, side: int, radius: float) -> bool:
+    """True when one-hop halo-exchange consensus is valid for `seq`-way
+    row-band sharding of a side x side patch grid with the given local
+    radius: the halo a shard needs from each neighbor (floor(radius) grid
+    rows — integer grid distances, so a patch within Euclidean radius r is
+    at most floor(r) rows away) must fit inside one neighboring shard.
+
+    Pure geometry — lives here (a leaf module) so config/preset code can
+    check it without importing the parallel runtime. parallel.halo validates
+    against this same predicate; ring consensus is the exact fallback for
+    any geometry where this is False.
+    """
+    if radius <= 0 or side % seq != 0:
+        return False
+    return (side // seq) >= math.floor(radius)
 
 
 def max_neg_value(dtype) -> float:

@@ -108,11 +108,13 @@ class TestCheckpoint:
 
 
 class TestPresets:
-    def test_all_five_exist(self):
+    def test_all_five_baseline_configs_exist(self):
+        # The five BASELINE.md configs, plus the long-context halo flagship.
         assert set(PRESETS) == {
             "mnist",
             "cifar10",
             "imagenet64-local",
+            "imagenet256-local",
             "imagenet224-dp8",
             "imagenet224-pod",
         }

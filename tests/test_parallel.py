@@ -107,6 +107,22 @@ class TestHaloConsensus:
             np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6
         )
 
+    def test_subrow_radius_matches_dense(self):
+        """0 < radius < 1: zero halo rows are needed (adjacent grid rows are
+        distance 1 > radius). Regression: the h=0 slice t[:, -0:] used to
+        grab the WHOLE neighbor block mislabeled with local indices."""
+        rng = np.random.default_rng(9)
+        x = jnp.asarray(rng.normal(size=(1, 64, 2, 16)), jnp.float32)
+        mesh = seq_mesh(4)
+        halo = make_halo_consensus(mesh, attend_self=True, side=8, radius=0.5)
+        got = jax.jit(halo)(x)
+        want = consensus_attention(
+            x, attend_self=True, local_mask=build_local_mask(8, 0.5)
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6
+        )
+
     def test_radius_too_large_raises(self):
         mesh = seq_mesh(8)
         with pytest.raises(ValueError, match="halo"):
