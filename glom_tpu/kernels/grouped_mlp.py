@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from glom_tpu.ops.ffw import GroupedFFWParams, grouped_ffw
+from glom_tpu.ops.ffw import GroupedFFWParams, grouped_ffw, grouped_ffw_lm
 
 
 
@@ -176,14 +176,7 @@ def _bwd(tile_m, interpret, res, g):
 _fused_lm.defvjp(_fwd, _bwd)
 
 
-def _xla_lm(params: GroupedFFWParams, x: jnp.ndarray) -> jnp.ndarray:
-    """XLA fallback in level-major layout (same math as ops.ffw.grouped_ffw)."""
-    w1, b1, w2, b2 = params
-    acc = jnp.float32
-    h = jnp.einsum("gmd,gdf->gmf", x, w1, preferred_element_type=acc)
-    h = jax.nn.gelu(h + b1[:, None, :], approximate=False).astype(x.dtype)
-    out = jnp.einsum("gmf,gfd->gmd", h, w2, preferred_element_type=acc)
-    return (out + b2[:, None, :]).astype(x.dtype)
+_xla_lm = grouped_ffw_lm  # XLA fallback in level-major layout
 
 
 def fused_grouped_ffw_lm(

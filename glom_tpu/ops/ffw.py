@@ -81,3 +81,15 @@ def grouped_ffw(
     out = jnp.einsum("...gf,gfd->...gd", h, w2, preferred_element_type=acc)
     out = out + b2
     return out.astype(x.dtype)
+
+
+def grouped_ffw_lm(params: GroupedFFWParams, x: jnp.ndarray) -> jnp.ndarray:
+    """Level-major form: x [G, M, d] -> [G, M, d]. Same math as grouped_ffw
+    (group axis leading instead of next-to-last) — the layout the fused
+    kernel and the level-major scan carry use natively."""
+    w1, b1, w2, b2 = params
+    acc = jnp.float32
+    h = jnp.einsum("gmd,gdf->gmf", x, w1, preferred_element_type=acc)
+    h = jax.nn.gelu(h + b1[:, None, :], approximate=False).astype(x.dtype)
+    out = jnp.einsum("gmf,gfd->gmd", h, w2, preferred_element_type=acc)
+    return (out + b2[:, None, :]).astype(x.dtype)

@@ -92,10 +92,14 @@ def ring_consensus_shard(
     i_offset = my * n_loc
 
     # The accumulators start device-invariant but become device-varying via
-    # the rotating blocks; mark them varying over the ring axis up front so
-    # the fori_loop carry types line up (JAX vma tracking under shard_map).
+    # the rotating blocks; mark them varying up front so the fori_loop carry
+    # types line up (JAX vma tracking under shard_map). Match x's varying
+    # axes, not just the ring axis — this body may run inside a larger
+    # manual region (e.g. parallel.manual's (data, seq) shard_map).
+    vma = tuple(jax.typeof(x).vma)
+
     def varying(t):
-        return lax.pcast(t, (axis_name,), to="varying")
+        return lax.pcast(t, vma, to="varying") if vma else t
 
     m0 = varying(jnp.full((b, L, n_loc, 1), NEG_MAX, jnp.float32))
     s0 = varying(jnp.zeros((b, L, n_loc, 1), jnp.float32))

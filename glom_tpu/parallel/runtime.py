@@ -17,6 +17,7 @@ Composition:
 
 from __future__ import annotations
 
+import dataclasses
 import warnings
 from typing import Iterator, Optional
 
@@ -30,6 +31,7 @@ from jax.sharding import PartitionSpec as P
 from glom_tpu.models.core import ConsensusFn
 from glom_tpu.ops.consensus import build_local_mask
 from glom_tpu.parallel.halo import make_halo_consensus
+from glom_tpu.parallel.manual import make_manual_train_step, manual_supported
 from glom_tpu.parallel.mesh import make_mesh
 from glom_tpu.parallel.ring import make_ring_consensus
 from glom_tpu.parallel.sharding import (
@@ -140,7 +142,27 @@ class DistributedTrainer:
         self.mesh = make_mesh(mesh_cfg, devices)
         self.metrics_writer = metrics_writer
 
-        consensus_fn = make_consensus_fn(self.mesh, cfg, sp_strategy)
+        # use_pallas routes through the fully-manual shard_map path (the
+        # kernels are per-device-legal there); TP still needs GSPMD, where
+        # the custom calls have no partitioning rule — fall back.
+        self.use_manual = bool(tcfg.use_pallas)
+        if self.use_manual and not manual_supported(self.mesh):
+            warnings.warn(
+                "use_pallas=True with a model-parallel mesh: the fused kernels "
+                "have no GSPMD partitioning rule for TP-sharded weights; "
+                "falling back to the GSPMD path without Pallas",
+                stacklevel=2,
+            )
+            self.use_manual = False
+            # Clear the flag for the GSPMD step too — glom_forward would
+            # otherwise emit Mosaic custom calls under TP-sharded weights,
+            # exactly the illegal configuration this fallback avoids.
+            tcfg = dataclasses.replace(tcfg, use_pallas=False)
+            self.tcfg = tcfg
+
+        consensus_fn = (
+            None if self.use_manual else make_consensus_fn(self.mesh, cfg, sp_strategy)
+        )
 
         key = jax.random.PRNGKey(tcfg.seed)
         self.rng, init_key = jax.random.split(key)
@@ -159,7 +181,14 @@ class DistributedTrainer:
         self.batch_sharding = NamedSharding(self.mesh, batch_spec())
         self.state = jax.device_put(state, self.state_shardings)
 
-        step_fn = make_train_step(cfg, tcfg, self.optimizer, consensus_fn=consensus_fn)
+        if self.use_manual:
+            step_fn = make_manual_train_step(
+                self.mesh, cfg, tcfg, self.optimizer, sp_strategy=sp_strategy
+            )
+        else:
+            step_fn = make_train_step(
+                cfg, tcfg, self.optimizer, consensus_fn=consensus_fn
+            )
         self._step = jax.jit(
             step_fn,
             in_shardings=(self.state_shardings, self.batch_sharding, None),

@@ -66,6 +66,7 @@ def denoise_loss(
     remat: bool = False,
     compute_dtype=None,
     consensus_fn: Optional[ConsensusFn] = None,
+    use_pallas: bool = False,
 ) -> jnp.ndarray:
     """MSE between the clean image and the reconstruction from the noised
     image's top level at iteration `recon_index`."""
@@ -83,6 +84,7 @@ def denoise_loss(
         remat=remat,
         compute_dtype=compute_dtype,
         consensus_fn=consensus_fn,
+        use_pallas=use_pallas,
     )
     top = final[:, :, -1]  # [b, n, d] — the top level
     with jax.named_scope("reconstruction"):

@@ -84,6 +84,7 @@ def make_train_step(
                 remat=tcfg.remat,
                 compute_dtype=compute_dtype,
                 consensus_fn=consensus_fn,
+                use_pallas=tcfg.use_pallas,
             )
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)

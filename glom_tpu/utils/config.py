@@ -93,4 +93,5 @@ class TrainConfig:
     iters: Optional[int] = None  # None -> model default (2L)
     remat: bool = False  # jax.checkpoint over the scan body ("ckpt over iters")
     compute_dtype: str = "float32"  # "bfloat16" for MXU-optimal training
+    use_pallas: bool = False  # fused TPU kernels on the forward hot path
     seed: int = 0
