@@ -8,11 +8,15 @@ top-down MLP, same-level cross-column consensus attention).
 Layering (bottom to top):
   ops/       pure tensor ops (grouped per-level MLP, consensus attention,
              patchify) — the math contract, verified against a NumPy oracle
-  kernels/   Pallas TPU kernels (blockwise consensus, fused update)
+  kernels/   Pallas TPU kernels: fused grouped-MLP, blockwise consensus
+             fused with the 4-way mean update (O(n) memory, block-sparse
+             local masking)
   models/    the functional GLOM core (lax.scan over iterations) and the
              reference-compatible `Glom` API class
   train/     self-supervised denoising trainer, temporal/video mode
-  parallel/  mesh / sharding / ring + halo + Ulysses sequence parallelism
+  parallel/  mesh (ICI + multi-slice DCN) / sharding / ring + halo + Ulysses
+             sequence parallelism / the fully-manual shard_map path that
+             runs the Pallas kernels under DP x SP
   utils/     config presets, checkpointing, metrics, profiling
 """
 

@@ -17,6 +17,11 @@ Strategy map (SURVEY.md §2.2 — everything here is absent in the reference):
 """
 
 from glom_tpu.parallel.halo import make_halo_consensus
+from glom_tpu.parallel.manual import (
+    make_manual_loss,
+    make_manual_train_step,
+    manual_supported,
+)
 from glom_tpu.parallel.mesh import initialize_multihost, make_mesh
 from glom_tpu.parallel.ring import make_ring_consensus
 from glom_tpu.parallel.runtime import (
@@ -37,6 +42,9 @@ from glom_tpu.parallel.ulysses import make_ulysses_consensus
 
 __all__ = [
     "make_halo_consensus",
+    "make_manual_loss",
+    "make_manual_train_step",
+    "manual_supported",
     "initialize_multihost",
     "make_mesh",
     "make_ring_consensus",

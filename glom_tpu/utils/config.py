@@ -60,11 +60,26 @@ class MeshConfig:
     data:  batch sharding (DP) — gradient allreduce over ICI
     seq:   patch-axis sharding (SP) — ring / halo consensus
     model: dim sharding (TP) of the FFW weights
+
+    num_slices > 1 marks a multi-slice (DCN-connected) topology: the data
+    axis is laid out slice-major, so its outermost num_slices-way split
+    rides DCN while everything inside a slice (the inner data split, seq,
+    model) rides ICI. Axis names and logical shape are unchanged — XLA
+    decomposes the data-axis allreduce hierarchically from the device
+    placement (mesh_utils.create_hybrid_device_mesh).
     """
 
     data: int = 1
     seq: int = 1
     model: int = 1
+    num_slices: int = 1
+
+    def __post_init__(self):
+        if self.num_slices > 1 and self.data % self.num_slices != 0:
+            raise ValueError(
+                f"data axis {self.data} not divisible by num_slices "
+                f"{self.num_slices} (the DCN split is the outer data axis)"
+            )
 
     @property
     def axis_names(self) -> Tuple[str, ...]:

@@ -34,7 +34,12 @@ class Preset:
             seq //= 2
         while data * seq * model > num_devices and data > 1:
             data //= 2
-        mesh = MeshConfig(data=data, seq=seq, model=model)
+        # A scaled-down mesh is a single-slice deployment (the virtual test
+        # harness, or one real slice): the multi-slice DCN split only
+        # describes the full-size topology, so collapse it when shrinking.
+        shrunk = (data, seq, model) != self.mesh.shape
+        ns = 1 if shrunk else self.mesh.num_slices
+        mesh = MeshConfig(data=data, seq=seq, model=model, num_slices=ns)
         sp = self.sp_strategy if mesh.seq > 1 else "none"
         if sp == "halo" and not halo_supported(
             mesh.seq, self.model.num_patches_side, self.model.local_consensus_radius
@@ -130,11 +135,15 @@ _register(
     )
 )
 
-# 5. ImageNet-224, patch=14, levels=12, dim=1024 — pod-scale v5e-256, remat
+# 5. ImageNet-224, patch=14, levels=12, dim=1024 — pod-scale v5e-256, remat.
+# Laid out as 4 DCN-connected slices of 64 chips: the 64-way data axis
+# factors into 4 (outer, DCN) x 16 (inner, ICI); seq/model ride ICI inside
+# a slice. XLA decomposes the gradient allreduce hierarchically from the
+# hybrid device placement (parallel/mesh.py).
 _register(
     Preset(
         name="imagenet224-pod",
-        description="ImageNet-224 p14 L12 d1024 — v5e-256 pod, remat over iters",
+        description="ImageNet-224 p14 L12 d1024 — v5e-256 pod (4 DCN slices), remat",
         model=GlomConfig(dim=1024, levels=12, image_size=224, patch_size=14),
         train=TrainConfig(
             batch_size=256,
@@ -143,7 +152,7 @@ _register(
             compute_dtype="bfloat16",
             remat=True,
         ),
-        mesh=MeshConfig(data=64, seq=2, model=2),
+        mesh=MeshConfig(data=64, seq=2, model=2, num_slices=4),
         sp_strategy="ring",
     )
 )

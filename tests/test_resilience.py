@@ -1,0 +1,203 @@
+"""Failure detection / recovery (SURVEY.md §5 row 3): fault injection and
+sharding-aware checkpoint restore.
+
+The reference has nothing here (single device, torch.save left to the
+user). The TPU-native recovery model is checkpoint-based restart: TPU
+slices are fixed-shape (no elastic resize), so "recovery" means the
+replacement job restores the latest committed Orbax step — possibly into a
+DIFFERENT mesh layout — and continues. These tests exercise exactly that:
+
+  * kill-a-worker: a real SIGKILL mid-training of a subprocess that
+    checkpoints every step; the committed steps must be restorable and
+    training must continue (Orbax's atomic commit protects against the
+    torn final step).
+  * sharded restore: restore lands directly in NamedShardings on the
+    8-device virtual mesh (no host bounce), including into a mesh of a
+    different shape than the one that saved.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from glom_tpu.data import gaussian_dataset
+from glom_tpu.parallel import DistributedTrainer
+from glom_tpu.utils.checkpoint import CheckpointManager
+from glom_tpu.utils.config import GlomConfig, MeshConfig, TrainConfig
+
+CFG = GlomConfig(dim=16, levels=3, image_size=8, patch_size=2)  # n=16
+TCFG = TrainConfig(batch_size=8, learning_rate=1e-3, iters=2, recon_iter_index=1)
+
+
+def _abstract_with_shardings(state, shardings):
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.ShapeDtypeStruct(np.shape(x), x.dtype, sharding=s),
+        state,
+        shardings,
+    )
+
+
+class TestShardedRestore:
+    def _train_and_save(self, tmp_path, mesh_cfg, steps=3):
+        trainer = DistributedTrainer(CFG, TCFG, mesh_cfg)
+        data = gaussian_dataset(TCFG.batch_size, CFG.image_size, seed=0)
+        for _ in range(steps):
+            trainer.step(next(data))
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+        mgr.save(steps, trainer.state)
+        mgr.wait()
+        return trainer, mgr
+
+    def test_restore_lands_in_mesh_shardings(self, tmp_path):
+        """Restore with an abstract state carrying NamedShardings: arrays
+        must come back already sharded over the mesh with identical values
+        (the path utils/checkpoint.py:8 advertises, untested in round 1)."""
+        mesh_cfg = MeshConfig(data=4, seq=2)
+        trainer, mgr = self._train_and_save(tmp_path, mesh_cfg)
+
+        fresh = DistributedTrainer(CFG, TCFG, mesh_cfg)
+        abstract = _abstract_with_shardings(fresh.state, fresh.state_shardings)
+        step, restored = mgr.restore(abstract_state=abstract)
+        mgr.close()
+        assert step == 3
+
+        for got, want, sh in zip(
+            jax.tree_util.tree_leaves(restored),
+            jax.tree_util.tree_leaves(trainer.state),
+            jax.tree_util.tree_leaves(fresh.state_shardings),
+        ):
+            assert got.sharding == sh
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+    def test_restore_into_different_mesh_shape(self, tmp_path):
+        """Recovery onto a different slice layout: save from (4 data x 2
+        seq), restore into (2 data x 2 seq x 2 model) and keep training."""
+        trainer, mgr = self._train_and_save(tmp_path, MeshConfig(data=4, seq=2))
+        loss_before = float(
+            trainer.step(
+                next(gaussian_dataset(TCFG.batch_size, CFG.image_size, seed=9))
+            )["loss"]
+        )
+
+        other = DistributedTrainer(CFG, TCFG, MeshConfig(data=2, seq=2, model=2))
+        abstract = _abstract_with_shardings(other.state, other.state_shardings)
+        step, other.state = mgr.restore(abstract_state=abstract)
+        mgr.close()
+        assert step == 3
+
+        # Same params, same data -> same next loss, despite the new layout.
+        loss_after = float(
+            other.step(
+                next(gaussian_dataset(TCFG.batch_size, CFG.image_size, seed=9))
+            )["loss"]
+        )
+        np.testing.assert_allclose(loss_after, loss_before, rtol=1e-5)
+
+
+_WORKER = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+from glom_tpu.data import gaussian_dataset
+from glom_tpu.parallel import DistributedTrainer
+from glom_tpu.utils.checkpoint import CheckpointManager
+from glom_tpu.utils.config import GlomConfig, MeshConfig, TrainConfig
+
+ckpt_dir, steps = sys.argv[1], int(sys.argv[2])
+cfg = GlomConfig(dim=16, levels=3, image_size=8, patch_size=2)
+tcfg = TrainConfig(batch_size=8, learning_rate=1e-3, iters=2, recon_iter_index=1)
+trainer = DistributedTrainer(cfg, tcfg, MeshConfig(data=4, seq=2))
+mgr = CheckpointManager(ckpt_dir, async_save=False, save_interval_steps=1)
+
+start = 0
+latest = mgr.latest_step()
+if latest is not None:
+    import numpy as np
+    abstract = jax.tree_util.tree_map(
+        lambda x, s: jax.ShapeDtypeStruct(np.shape(x), x.dtype, sharding=s),
+        trainer.state, trainer.state_shardings)
+    start, trainer.state = mgr.restore(abstract_state=abstract)
+    print(f"RESUMED_FROM {start}", flush=True)
+
+data = gaussian_dataset(tcfg.batch_size, cfg.image_size, seed=0)
+for _ in range(start):
+    next(data)  # realign the data stream
+for i in range(start, steps):
+    loss = float(trainer.step(next(data))["loss"])
+    assert loss == loss, "NaN loss"
+    mgr.save(i + 1, trainer.state)
+    mgr.wait()
+    print(f"STEP {i + 1} {loss}", flush=True)
+mgr.close()
+print("DONE", flush=True)
+"""
+
+
+class TestKillAWorker:
+    def test_sigkill_and_resume(self, tmp_path):
+        """Inject a real fault: SIGKILL the training process mid-run, then
+        restart it and require it to resume from the last committed step
+        and finish. Run on the same 8-virtual-device mesh as the tests."""
+        ckpt = str(tmp_path / "ckpt")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = [
+            f
+            for f in env.get("XLA_FLAGS", "").split()
+            if "host_platform_device_count" not in f
+        ]
+        env["XLA_FLAGS"] = " ".join(
+            flags + ["--xla_force_host_platform_device_count=8"]
+        )
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+        proc = subprocess.Popen(
+            [sys.executable, "-u", "-c", _WORKER, ckpt, "6"],
+            env=env,
+            cwd=repo,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        # Watchdog: the readline loop below blocks if the worker hangs
+        # without printing, so enforce the deadline out-of-band.
+        watchdog = threading.Timer(300, proc.kill)
+        watchdog.start()
+        try:
+            # Kill as soon as at least 2 steps have committed.
+            seen = []
+            for line in proc.stdout:
+                if line.startswith("STEP"):
+                    seen.append(line.split()[1])
+                if len(seen) >= 2:
+                    os.kill(proc.pid, signal.SIGKILL)
+                    break
+            else:  # pragma: no cover — stdout closed (hang-kill or crash)
+                pytest.fail(f"worker died/hung before 2 checkpointed steps: {seen}")
+            proc.wait(timeout=60)
+        finally:
+            watchdog.cancel()
+        assert proc.returncode != 0  # it was killed, not finished
+
+        # Restart: must resume from a committed step >= 2 and run to 6.
+        out = subprocess.run(
+            [sys.executable, "-u", "-c", _WORKER, ckpt, "6"],
+            env=env,
+            cwd=repo,
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "RESUMED_FROM" in out.stdout
+        resumed = int(out.stdout.split("RESUMED_FROM ")[1].split()[0])
+        assert resumed >= 2
+        assert "DONE" in out.stdout
+        assert "STEP 6" in out.stdout
