@@ -42,6 +42,39 @@ class TestFusedGroupedFFW:
                 np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-5
             )
 
+    def test_bwd_kernel_bf16_multi_tile(self, setup):
+        """The fused backward kernel in bf16: dw/db accumulate in f32 across
+        8 row tiles (M=4*256=1024, bwd tile 128), and the tanh-GELU
+        derivative matches the bf16 forward's activation to bf16
+        resolution."""
+        if jax.devices()[0].platform == "cpu":
+            pytest.skip("CPU XLA lacks bf16xbf16->f32 dot; covered on TPU")
+        params, _ = setup
+        G, d = 4, 128
+        pb = jax.tree_util.tree_map(lambda t: t.astype(jnp.bfloat16), params)
+        xb = jax.random.normal(jax.random.PRNGKey(3), (4, 256, G, d), jnp.bfloat16)
+
+        def loss_fused(p, x_):
+            return jnp.mean(
+                fused_grouped_ffw(p, x_, tile_m=128, interpret=True).astype(
+                    jnp.float32
+                )
+                ** 2
+            )
+
+        def loss_xla(p, x_):
+            return jnp.mean(grouped_ffw(p, x_).astype(jnp.float32) ** 2)
+
+        g1 = jax.grad(loss_fused, argnums=(0, 1))(pb, xb)
+        g2 = jax.grad(loss_xla, argnums=(0, 1))(pb, xb)
+        for a, b in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32),
+                np.asarray(b, np.float32),
+                rtol=0.1,
+                atol=2e-3,  # bf16 grads + tanh-vs-erf GELU derivative
+            )
+
     def test_fallback_on_unsupported_shape(self, setup):
         params, _ = setup
         # M=6 not divisible by tile -> must silently fall back, still correct
@@ -83,20 +116,51 @@ class TestFusedGroupedFFW:
     def test_bwd_accumulates_f32(self, setup):
         """The custom-VJP backward must pin f32 accumulation on every
         contraction regardless of input dtype (checked via the jaxpr, since
-        CPU cannot execute bf16 dots)."""
+        CPU cannot execute bf16 dots). Walks into pallas_call sub-jaxprs so
+        the dots inside the fused backward kernel are covered too."""
         from glom_tpu.kernels.grouped_mlp import _bwd
+
+        def all_dots(jaxpr):
+            for e in jaxpr.eqns:
+                if e.primitive.name == "dot_general":
+                    yield e
+                for sub in jax.core.jaxprs_in_params(e.params):
+                    yield from all_dots(sub)
 
         params, _ = setup
         pb = jax.tree_util.tree_map(lambda t: t.astype(jnp.bfloat16), params)
-        x = jnp.zeros((4, 256, 128), jnp.bfloat16)  # level-major [G, M, d]
-        g = jnp.zeros_like(x)
-        jaxpr = jax.make_jaxpr(lambda p, x_, g_: _bwd(128, False, (p, x_), g_))(
-            pb, x, g
-        )
-        dots = [e for e in jaxpr.jaxpr.eqns if e.primitive.name == "dot_general"]
-        assert dots, "backward lost its contractions?"
-        for e in dots:
-            assert e.params["preferred_element_type"] == jnp.float32
+        # level-major [G, M, d]: M=256 takes the fused kernel, M=192 the
+        # XLA fallback
+        for shape in [(4, 256, 128), (4, 192, 128)]:
+            x = jnp.zeros(shape, jnp.bfloat16)
+            g = jnp.zeros_like(x)
+            jaxpr = jax.make_jaxpr(
+                lambda p, x_, g_: _bwd(64, False, (p, x_), g_)
+            )(pb, x, g)
+            dots = list(all_dots(jaxpr.jaxpr))
+            assert len(dots) >= 5, "backward lost its contractions?"
+            for e in dots:
+                assert e.params["preferred_element_type"] == jnp.float32
+
+    def test_bwd_xla_fallback_grad(self, setup):
+        """M=192 has no 128-divisible bwd tile -> _bwd must take the
+        barrier+XLA fallback (with explicit fwd tile 64) and still match the
+        reference gradients."""
+        params, _ = setup
+        x = jax.random.normal(jax.random.PRNGKey(5), (3, 64, 4, 128), jnp.float32)
+
+        def loss_fused(p, x_):
+            return jnp.mean(fused_grouped_ffw(p, x_, tile_m=64, interpret=True) ** 2)
+
+        def loss_xla(p, x_):
+            return jnp.mean(grouped_ffw(p, x_) ** 2)
+
+        g1 = jax.grad(loss_fused, argnums=(0, 1))(params, x)
+        g2 = jax.grad(loss_xla, argnums=(0, 1))(params, x)
+        for a, b in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-5
+            )
 
 
 class TestFusedConsensusUpdate:
