@@ -1,0 +1,133 @@
+"""Long-context benchmark: consensus+update at large patch counts n.
+
+The patch axis n is GLOM's sequence axis (SURVEY.md §2.2): at the flagship
+ImageNet-224/14 config n is only 256 and the grouped MLPs dominate, but at
+larger images / smaller patches (n = 1024, 4096, ...) the O(n^2) consensus
+attention takes over — the regime the blockwise Pallas kernel
+(kernels/consensus_update.py) and its block-sparse local-radius skipping
+exist for.
+
+Measures one consensus+mean update (the scan body's attention half) at
+L=6, d=512, bf16 on one chip, for each implementation:
+
+  * dense   — the XLA composition that materializes the [L, B, n, n]
+              similarity (ops/consensus.py semantics via _xla_reference);
+  * fused   — the blockwise Pallas kernel, O(n) memory;
+  * both again at local radius 7 (BASELINE config 3's window), where the
+    fused kernel skips j-tiles entirely outside the radius band while the
+    dense path still pays the full n^2.
+
+Timing: same slope methodology as bench.py (chained fori_loop, scalar-fetch
+sync, (t_long - t_short)/(k_long - k_short)).
+
+Writes one JSON line per measurement to stdout and appends them to
+results/longctx_bench.jsonl.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from glom_tpu.kernels.consensus_update import _xla_reference, fused_consensus_update
+from glom_tpu.utils.metrics import detect_chip
+
+
+def slope_time(make_chain, repeats, calib_k=32, target_s=0.5):
+    """Slope timing with auto-calibrated chain lengths: sub-ms ops through
+    the tunnel are invisible next to the ~100 ms fixed dispatch RTT unless
+    the long chain carries hundreds of ms of device work, so first estimate
+    the per-call cost from a rough calibration chain, then size the chains
+    to put ~target_s of device time in the long one."""
+
+    chain = make_chain()  # ONE jit per variant; k is a traced fori_loop bound
+
+    def best(k):
+        kk = jnp.int32(k)
+        warm = float(chain(kk))
+        if not jnp.isfinite(warm):
+            raise RuntimeError(f"non-finite bench output: {warm}")
+        return min(
+            (lambda t0: (float(chain(kk)), time.perf_counter() - t0)[1])(
+                time.perf_counter()
+            )
+            for _ in range(repeats)
+        )
+
+    t_calib = best(calib_k)
+    per_est = max(t_calib - 0.1, 1e-4) / calib_k  # ~0.1 s tunnel RTT floor
+    k_long = int(min(max(target_s / per_est, calib_k * 2), 50_000))
+    k_short = max(k_long // 5, 1)
+    t_s, t_l = best(k_short), best(k_long)
+    per = (t_l - t_s) / (k_long - k_short)
+    if per <= 0:
+        raise RuntimeError(
+            f"degenerate slope: k=({k_short},{k_long}) t=({t_s:.4f},{t_l:.4f})"
+        )
+    return per
+
+
+def bench_variant(name, op, levels, bu, td, side, radius, repeats):
+    def make_chain():
+        def multi(k):
+            def body(_, acc):
+                out = op(levels + acc * 0.0, bu, td, side=side, radius=radius)
+                # FULL-output reduction: a partial slice would let XLA
+                # dead-code-eliminate the unobserved rows/levels of the
+                # dense einsums (measured: "847 TF/s" dense at radius 7).
+                return jnp.sum(out).astype(jnp.float32) * 1e-9
+
+            return jax.lax.fori_loop(0, k, body, jnp.float32(0.0))
+
+        return jax.jit(multi)
+
+    per_call = slope_time(make_chain, repeats)
+    L, B, n, d = levels.shape
+    # Dense-equivalent attention FLOPs (two n^2 contractions); for radius
+    # runs this is the work the dense path still does and the fused kernel
+    # skips, so fused radius throughput can exceed "peak" — that's the point.
+    tflops_equiv = 4 * B * L * n * n * d / per_call / 1e12
+    return {"impl": name, "n": n, "radius": radius, "ms_per_call": round(per_call * 1e3, 3),
+            "dense_equiv_tflops": round(tflops_equiv, 2)}
+
+
+def main():
+    chip = detect_chip()
+    on_tpu = chip != "cpu"
+    L, B, d = 6, 1, 512
+    sides = (32, 64) if on_tpu else (8,)
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    repeats = 3 if on_tpu else 2
+
+    def dense(lv, bu, td, *, side, radius):
+        return _xla_reference(lv, bu, td, side=side, radius=radius, attend_self=False)
+
+    def fused(lv, bu, td, *, side, radius):
+        return fused_consensus_update(lv, bu, td, side=side, radius=radius)
+
+    records = []
+    for side in sides:
+        n = side * side
+        key = jax.random.PRNGKey(side)
+        k1, k2, k3 = jax.random.split(key, 3)
+        levels = jax.random.normal(k1, (L, B, n, d), dtype)
+        bu = jax.random.normal(k2, (L, B, n, d), dtype)
+        td = jax.random.normal(k3, (L - 1, B, n, d), dtype)
+        for radius in (0.0, 7.0):
+            for name, op in (("dense_xla", dense), ("fused_pallas", fused)):
+                rec = bench_variant(
+                    name, op, levels, bu, td, side, radius, repeats
+                )
+                rec["chip"] = chip
+                records.append(rec)
+                print(json.dumps(rec))
+
+    if on_tpu:
+        with open("results/longctx_bench.jsonl", "a") as f:
+            for rec in records:
+                f.write(json.dumps(rec) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -162,8 +162,8 @@ def _consensus_update_kernel(
 
 
 def _pick_tile(n: int, cap: int = 256) -> int:
-    for t in (cap, 128, 64, 32, 16, 8):
-        if n % t == 0 and t <= n:
+    for t in (512, 256, 128, 64, 32, 16, 8):
+        if t <= cap and n % t == 0 and t <= n:
             return t
     return n
 
@@ -195,7 +195,11 @@ def _forward(
 ) -> jnp.ndarray:
     L, B, n, d = levels_lm.shape
     tile_i = _pick_tile(n)
-    tile_j = _pick_tile(n)
+    # Global consensus: a wider j-tile halves the online-softmax correction
+    # steps (measured 1.91 -> 1.69 ms at n=4096, beating the dense XLA
+    # path). Local radius: keep j-tiles at 256 so the block-sparse window
+    # stays fine-grained (a 512 tile erases the skip at side<=32).
+    tile_j = _pick_tile(n, cap=512 if radius <= 0 else 256)
     tile_b = _pick_tile_b(B, n, d, tile_i, tile_j, levels_lm.dtype.itemsize)
     grid = (L, B // tile_b, n // tile_i)
 
