@@ -32,10 +32,15 @@ Layout: level-major [L, B, n, d] ("lm") — the batched-matmul-natural
 layout; glom_tpu.models.core keeps the scan carry in this layout so no
 transposes appear between kernels.
 
-Backward: custom_vjp that recomputes the forward in plain XLA (dense
-consensus from ops/consensus.py) and differentiates that — exactly
-correct (same math contract, locked by tests), matmul-heavy, and saves
-nothing but levels/bu/td, the flash-attention residual trade.
+Backward: custom_vjp over two more Pallas kernels (flash-attention-style,
+saving nothing but levels/bu/td): a dq pass that recomputes the row
+statistics and consensus online (for D = rowsum(dcons*cons)) and
+accumulates dq over the j-window, and a dkv pass gridded over j that
+accumulates dv and dk over the i-window and pushes dk through the
+row-local k-normalization VJP. The [n, n] matrix is never materialized in
+either direction, so long-context TRAINING is O(n) memory too; both
+passes skip dead tiles under the local-radius band. The linear mean part
+(d bu, d td, the direct levels term) is plain XLA glue in _fused_bwd.
 """
 
 from __future__ import annotations
@@ -87,20 +92,10 @@ def _consensus_update_kernel(
     row_ids = i * tile_i + jax.lax.broadcasted_iota(jnp.int32, (tile_i, tile_j), 0)
     ri, ci = _row_col(row_ids, side)
 
-    n_j = n // tile_j
-
-    # Block sparsity for the local mask: patches interact only when their
-    # grid rows differ by <= radius, i.e. flat indices differ by less than
-    # (radius + 1) * side. The live j-window for this i-tile (i is traced,
-    # so the window is int32 arithmetic; fori_loop takes dynamic bounds):
-    if radius > 0:
-        reach = int(radius + 1) * side
-        lo = i * tile_i - reach
-        hi = i * tile_i + tile_i + reach
-        j_lo = jnp.maximum(lo // tile_j, 0)
-        j_hi = jnp.minimum(-(-hi // tile_j), n_j)
-    else:
-        j_lo, j_hi = 0, n_j
+    # Block sparsity for the local mask: the live j-window for this i-tile
+    # (i is traced, so the window is int32 arithmetic; fori_loop takes
+    # dynamic bounds). Shared with both backward kernels via _window.
+    j_lo, j_hi = _window(i * tile_i, tile_i, tile_j, n // tile_j, side, radius)
 
     m0 = jnp.full((tb, tile_i, 1), _NEG_MAX, jnp.float32)
     l0 = jnp.zeros((tb, tile_i, 1), jnp.float32)
@@ -109,11 +104,8 @@ def _consensus_update_kernel(
     def j_body(j, carry):
         m, l, acc = carry
         kv = kv_ref[0, :, pl.ds(j * tile_j, tile_j), :]  # [TB, TJ, d]
-        kv32 = kv.astype(jnp.float32)
-        # k-only L2 normalization (reference :56): v stays raw. Matches
-        # helpers.l2norm: x / max(||x||, 1e-12).
-        norm = jnp.sqrt(jnp.sum(kv32 * kv32, axis=-1, keepdims=True))
-        k = (kv32 / jnp.maximum(norm, 1e-12)).astype(x.dtype)
+        # k-only L2 normalization (reference :56): v stays raw.
+        k = _normalized_k(kv)
         s = (
             jax.lax.dot_general(
                 x, k, (((2,), (2,)), ((0,), (0,))),
@@ -232,6 +224,296 @@ def _forward(
     )(levels_lm, levels_lm, bu_lm, td_lm)
 
 
+def _normalized_k(kv_tile):
+    """k-only L2 normalization in f32, downcast to the compute dtype
+    (reference :56 / helpers.l2norm: x / max(||x||, 1e-12))."""
+    kv32 = kv_tile.astype(jnp.float32)
+    norm = jnp.sqrt(jnp.sum(kv32 * kv32, axis=-1, keepdims=True))
+    return (kv32 / jnp.maximum(norm, 1e-12)).astype(kv_tile.dtype)
+
+
+def _window(center_lo, extent, tile, n_tiles, side, radius):
+    """Live tile-window [lo, hi) along the opposite attention axis: flat
+    indices interact only when their grid rows differ by <= radius, i.e.
+    they are within (radius + 1) * side flat positions."""
+    if radius <= 0:
+        return 0, n_tiles
+    reach = int(radius + 1) * side
+    lo = center_lo - reach
+    hi = center_lo + extent + reach
+    return jnp.maximum(lo // tile, 0), jnp.minimum(-(-hi // tile), n_tiles)
+
+
+def _consensus_bwd_dq_kernel(
+    x_ref,      # [1, TB, TI, d]  levels q tile
+    kv_ref,     # [1, TB, n, d]   full levels rows (k and v)
+    dm_ref,     # [1, TB, TI, d]  dcons tile: the mean-divided cotangent,
+                #                 DOWNCAST to the compute dtype by the
+                #                 caller (halves its HBM/VMEM footprint;
+                #                 matmul accumulation stays f32)
+    dq_ref,     # [1, TB, TI, d]  f32
+    m_ref,      # [1, TB, TI, 1]  f32 row max (saved for the dkv kernel)
+    l_ref,      # [1, TB, TI, 1]  f32 row softmax denominator
+    dd_ref,     # [1, TB, TI, 1]  f32 D_i = sum_d dcons_i * cons_i
+    *, side, radius, attend_self, tile_i, tile_j, n,
+):
+    """Pass 1 of the blockwise consensus backward (flash-attention style,
+    adapted to GLOM: q = v = levels raw, k = normalize(levels), soft -5e-4
+    REPLACED diagonal, hard local mask). Nothing was saved by the forward
+    (the flash residual trade), so the first j-loop recomputes the row
+    statistics (m, l) and the consensus output (for D = rowsum(dcons*cons));
+    the second j-loop forms ds = p*(dP - D) and accumulates
+    dq_i = scale * sum_j ds_ij k_j. The [n, n] attention matrix is never
+    materialized — O(n) memory, same block-sparse j-window skipping as the
+    forward."""
+    i = pl.program_id(2)
+    tb = x_ref.shape[1]
+    d = x_ref.shape[-1]
+    scale = d ** -0.5
+    f32 = jnp.float32
+
+    x = x_ref[0]
+    dcons = dm_ref[0].astype(f32)
+    row_ids = i * tile_i + jax.lax.broadcasted_iota(jnp.int32, (tile_i, tile_j), 0)
+    ri, ci = _row_col(row_ids, side)
+    j_lo, j_hi = _window(i * tile_i, tile_i, tile_j, n // tile_j, side, radius)
+
+    def scores(j):
+        kv = kv_ref[0, :, pl.ds(j * tile_j, tile_j), :]
+        k = _normalized_k(kv)
+        s = (
+            jax.lax.dot_general(
+                x, k, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=f32,
+            )
+            * scale
+        )
+        col_ids = j * tile_j + jax.lax.broadcasted_iota(
+            jnp.int32, (tile_i, tile_j), 1
+        )
+        if not attend_self:
+            s = jnp.where((row_ids == col_ids)[None], TOKEN_ATTEND_SELF_VALUE, s)
+        if radius > 0:
+            rj, cj = _row_col(col_ids, side)
+            dist2 = (ri - rj) ** 2 + (ci - cj) ** 2
+            s = jnp.where(
+                (dist2.astype(f32) > radius * radius)[None], _NEG_MAX, s
+            )
+        return s, k, kv, col_ids
+
+    def stat_body(j, carry):
+        m, l, acc = carry
+        s, _, kv, _ = scores(j)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(x.dtype), kv, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=f32,
+        )
+        return m_new, l_new, acc * corr + pv
+
+    m0 = jnp.full((tb, tile_i, 1), _NEG_MAX, f32)
+    l0 = jnp.zeros((tb, tile_i, 1), f32)
+    acc0 = jnp.zeros((tb, tile_i, d), f32)
+    m, l, acc = jax.lax.fori_loop(j_lo, j_hi, stat_body, (m0, l0, acc0))
+    cons = acc / l
+    dd = jnp.sum(dcons * cons, axis=-1, keepdims=True)  # [TB, TI, 1]
+
+    def dq_body(j, dq):
+        s, k, kv, col_ids = scores(j)
+        p = jnp.exp(s - m) / l  # normalized probabilities, f32
+        dp = jax.lax.dot_general(
+            dcons.astype(x.dtype), kv, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=f32,
+        )  # dP_ij = dcons_i . v_j
+        ds = p * (dp - dd)
+        if not attend_self:
+            # the diagonal was REPLACED by a constant: no grad flows there
+            ds = jnp.where((row_ids == col_ids)[None], 0.0, ds)
+        dq_step = jax.lax.dot_general(
+            ds.astype(x.dtype), k, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=f32,
+        )
+        return dq + dq_step
+
+    dq = jax.lax.fori_loop(
+        j_lo, j_hi, dq_body, jnp.zeros((tb, tile_i, d), f32)
+    )
+    dq_ref[0] = dq * scale
+    m_ref[0] = m
+    l_ref[0] = l
+    dd_ref[0] = dd
+
+
+def _consensus_bwd_dkv_kernel(
+    xj_ref,     # [1, TB, TJ, d]  levels j-tile (k_j, v_j live here)
+    q_ref,      # [1, TB, n, d]   full levels rows (queries)
+    dm_ref,     # [1, TB, n, d]   full dcons rows (compute dtype, same
+                #                 downcast trade as in the dq kernel)
+    m_ref,      # [1, TB, n, 1]   f32 stats from the dq kernel
+    l_ref,      # [1, TB, n, 1]
+    dd_ref,     # [1, TB, n, 1]
+    out_ref,    # [1, TB, TJ, d]  f32: dv_j + normalizeVJP(dk_j)
+    *, side, radius, attend_self, tile_i, tile_j, n,
+):
+    """Pass 2: for each j-tile, loop the i-window and accumulate
+    dv_j = sum_i p_ij dcons_i and dk_j = scale * sum_i ds_ij q_i, then push
+    dk through the k-normalization VJP (row-local) so the kernel emits a
+    single dlevels contribution per j position."""
+    j = pl.program_id(2)
+    tb = xj_ref.shape[1]
+    d = xj_ref.shape[-1]
+    scale = d ** -0.5
+    f32 = jnp.float32
+
+    xj = xj_ref[0]            # [TB, TJ, d] raw levels (v_j; k_j after norm)
+    k = _normalized_k(xj)
+    col_ids = j * tile_j + jax.lax.broadcasted_iota(jnp.int32, (tile_j, tile_i), 0)
+    rj, cj = _row_col(col_ids, side)
+    i_lo, i_hi = _window(j * tile_j, tile_j, tile_i, n // tile_i, side, radius)
+
+    def i_body(i, carry):
+        dv, dk = carry
+        q = q_ref[0, :, pl.ds(i * tile_i, tile_i), :]        # [TB, TI, d]
+        dcons = dm_ref[0, :, pl.ds(i * tile_i, tile_i), :]   # [TB, TI, d]
+        m = m_ref[0, :, pl.ds(i * tile_i, tile_i), 0]        # [TB, TI]
+        l = l_ref[0, :, pl.ds(i * tile_i, tile_i), 0]
+        dd = dd_ref[0, :, pl.ds(i * tile_i, tile_i), 0]
+
+        # s2[b, tj, ti] = s[i, j] transposed
+        s2 = (
+            jax.lax.dot_general(
+                k, q, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=f32,
+            )
+            * scale
+        )  # [TB, TJ, TI]
+        row_ids = i * tile_i + jax.lax.broadcasted_iota(
+            jnp.int32, (tile_j, tile_i), 1
+        )  # query index along the LAST axis here
+        if not attend_self:
+            s2 = jnp.where((col_ids == row_ids)[None], TOKEN_ATTEND_SELF_VALUE, s2)
+        if radius > 0:
+            ri2, ci2 = _row_col(row_ids, side)
+            dist2 = (rj - ri2) ** 2 + (cj - ci2) ** 2
+            s2 = jnp.where(
+                (dist2.astype(f32) > radius * radius)[None], _NEG_MAX, s2
+            )
+
+        p2 = jnp.exp(s2 - m[:, None, :]) / l[:, None, :]     # [TB, TJ, TI]
+        p2c = p2.astype(xj.dtype)
+        dv_step = jax.lax.dot_general(
+            p2c, dcons, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=f32,
+        )
+        dp2 = jax.lax.dot_general(
+            xj, dcons, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=f32,
+        )  # dP2[b, tj, ti] = v_j . dcons_i
+        ds2 = p2 * (dp2 - dd[:, None, :])
+        if not attend_self:
+            ds2 = jnp.where((col_ids == row_ids)[None], 0.0, ds2)
+        dk_step = jax.lax.dot_general(
+            ds2.astype(xj.dtype), q, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=f32,
+        )
+        return dv + dv_step, dk + dk_step
+
+    dv0 = jnp.zeros((tb, tile_j, d), f32)
+    dk0 = jnp.zeros((tb, tile_j, d), f32)
+    dv, dk = jax.lax.fori_loop(i_lo, i_hi, i_body, (dv0, dk0))
+    dk = dk * scale
+
+    # k-normalization VJP (row-local): k = x / max(||x||, eps).
+    x32 = xj.astype(f32)
+    r = jnp.sqrt(jnp.sum(x32 * x32, axis=-1, keepdims=True))
+    inv = 1.0 / jnp.maximum(r, 1e-12)
+    a = jnp.sum(dk * x32, axis=-1, keepdims=True)
+    dxn = dk * inv - jnp.where(r >= 1e-12, a * x32 * inv * inv / r, 0.0)
+    out_ref[0] = dv + dxn
+
+
+def _pick_tile_b_bwd(B: int, n: int, d: int, tile: int, itemsize: int) -> int:
+    """Batch tile for the BACKWARD kernels, whose working set is heavier
+    than the forward's: the dkv pass keeps TWO full-row operands resident
+    (q and dcons, levels dtype) plus f32 dq/out tile blocks, and the dq
+    pass one full-row operand plus the f32 dq block — the forward's budget
+    model undercounts that by ~2x in the long-context regime."""
+    budget = 12 * 1024 * 1024
+    for tb in (8, 4, 2, 1):
+        if B % tb != 0:
+            continue
+        full_rows = 2 * tb * n * d * itemsize          # q + dcons, resident
+        tiles = tb * tile * d * (itemsize + 4) * 2     # in (dtype) + out (f32), 2x buf
+        stats = 3 * tb * n * 4
+        scratch = 2 * tb * tile * tile * 4 + 2 * tb * tile * d * 4  # s2/ds + dv/dk acc
+        if full_rows + tiles + stats + scratch <= budget:
+            return tb
+    return 1
+
+
+def _consensus_update_bwd(levels_lm, g32, *, side, radius, attend_self, interpret):
+    """Blockwise backward for the fused consensus+update: returns
+    d(levels) = dmean + dq + (dv + dk-through-normalization), with dmean
+    (= dout/div) handled by the caller. g32 here is dcons = dout32/div."""
+    L, B, n, d = levels_lm.shape
+    tile_i = _pick_tile(n)
+    tile_j = _pick_tile(n, cap=256)
+    tile_b = _pick_tile_b_bwd(
+        B, n, d, max(tile_i, tile_j), levels_lm.dtype.itemsize
+    )
+    grid = (L, B // tile_b, n // tile_i)
+    f32 = jnp.float32
+
+    kw = dict(
+        side=side, radius=float(radius), attend_self=attend_self,
+        tile_i=tile_i, tile_j=tile_j, n=n,
+    )
+    dq, m_, l_, dd_ = pl.pallas_call(
+        partial(_consensus_bwd_dq_kernel, **kw),
+        out_shape=(
+            jax.ShapeDtypeStruct((L, B, n, d), f32),
+            jax.ShapeDtypeStruct((L, B, n, 1), f32),
+            jax.ShapeDtypeStruct((L, B, n, 1), f32),
+            jax.ShapeDtypeStruct((L, B, n, 1), f32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, tile_b, tile_i, d), lambda g, b, i: (g, b, i, 0)),
+            pl.BlockSpec((1, tile_b, n, d), lambda g, b, i: (g, b, 0, 0)),
+            pl.BlockSpec((1, tile_b, tile_i, d), lambda g, b, i: (g, b, i, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, tile_b, tile_i, d), lambda g, b, i: (g, b, i, 0)),
+            pl.BlockSpec((1, tile_b, tile_i, 1), lambda g, b, i: (g, b, i, 0)),
+            pl.BlockSpec((1, tile_b, tile_i, 1), lambda g, b, i: (g, b, i, 0)),
+            pl.BlockSpec((1, tile_b, tile_i, 1), lambda g, b, i: (g, b, i, 0)),
+        ),
+        interpret=interpret,
+    )(levels_lm, levels_lm, g32.astype(levels_lm.dtype))
+
+    grid_j = (L, B // tile_b, n // tile_j)
+    dkv = pl.pallas_call(
+        partial(_consensus_bwd_dkv_kernel, **kw),
+        out_shape=jax.ShapeDtypeStruct((L, B, n, d), f32),
+        grid=grid_j,
+        in_specs=[
+            pl.BlockSpec((1, tile_b, tile_j, d), lambda g, b, j: (g, b, j, 0)),
+            pl.BlockSpec((1, tile_b, n, d), lambda g, b, j: (g, b, 0, 0)),
+            pl.BlockSpec((1, tile_b, n, d), lambda g, b, j: (g, b, 0, 0)),
+            pl.BlockSpec((1, tile_b, n, 1), lambda g, b, j: (g, b, 0, 0)),
+            pl.BlockSpec((1, tile_b, n, 1), lambda g, b, j: (g, b, 0, 0)),
+            pl.BlockSpec((1, tile_b, n, 1), lambda g, b, j: (g, b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, tile_b, tile_j, d), lambda g, b, j: (g, b, j, 0)),
+        interpret=interpret,
+    )(levels_lm, levels_lm, g32.astype(levels_lm.dtype), m_, l_, dd_)
+
+    return dq + dkv
+
+
 def _xla_reference(levels_lm, bu_lm, td_lm, *, side, radius, attend_self):
     """Plain-XLA recomputation of the fused op (used for the backward pass).
     Must match the kernel's math contract bit-for-bit at the op level."""
@@ -271,14 +553,24 @@ def _fused_fwd(levels_lm, bu_lm, td_lm, side, radius, attend_self, interpret):
 
 
 def _fused_bwd(side, radius, attend_self, interpret, res, g):
+    """Blockwise backward: the mean is linear (d bu = d td = dout/div) and
+    the attention part runs in the two Pallas kernels above — the [n, n]
+    matrix is never materialized in the backward either, so long-context
+    TRAINING keeps O(n) memory (the dense-recompute VJP this replaces
+    rebuilt the full similarity and undid that property)."""
+    from glom_tpu.models.core import contribution_divisor  # lazy: no cycle
+
     levels_lm, bu_lm, td_lm = res
-    _, vjp = jax.vjp(
-        lambda lv, bu, td: _xla_reference(
-            lv, bu, td, side=side, radius=radius, attend_self=attend_self
-        ),
-        levels_lm, bu_lm, td_lm,
+    L = levels_lm.shape[0]
+    f32 = jnp.float32
+    div = contribution_divisor(L, dtype=f32).reshape(L, 1, 1, 1)
+    dmean = g.astype(f32) / div
+    dlv_attn = _consensus_update_bwd(
+        levels_lm, dmean,
+        side=side, radius=radius, attend_self=attend_self, interpret=interpret,
     )
-    return vjp(g)
+    dlv = (dmean + dlv_attn).astype(levels_lm.dtype)
+    return dlv, dmean.astype(bu_lm.dtype), dmean[: L - 1].astype(td_lm.dtype)
 
 
 _fused.defvjp(_fused_fwd, _fused_bwd)

@@ -239,6 +239,36 @@ class TestFusedConsensusUpdate:
                 np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-5
             )
 
+    @pytest.mark.parametrize("radius", [0.0, 3.0])
+    def test_grad_multirow_tiles(self, radius):
+        """Backward across many i/j tiles (side=24 -> n=576, tile 64): the
+        dq kernel's recomputed stats must match what the dkv kernel reads
+        back, the block-sparse windows must cover exactly the live band in
+        BOTH kernels (i-major and j-major), and ds must vanish on the
+        replaced diagonal."""
+        from glom_tpu.kernels.consensus_update import _fused, _xla_reference
+
+        L, B, side, d = 2, 1, 24, 128
+        n = side * side
+        levels, bu, td = self._rand(jax.random.PRNGKey(7), L, B, n, d)
+
+        def loss_fused(lv, b_, t_):
+            out = _fused(lv, b_, t_, side, radius, False, True)
+            return jnp.mean(out ** 2)
+
+        def loss_ref(lv, b_, t_):
+            out = _xla_reference(
+                lv, b_, t_, side=side, radius=radius, attend_self=False
+            )
+            return jnp.mean(out ** 2)
+
+        g1 = jax.grad(loss_fused, argnums=(0, 1, 2))(levels, bu, td)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(levels, bu, td)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5
+            )
+
     def test_top_level_divisor_and_zero_topdown(self):
         """Top level must ignore td entirely and divide by 3 (reference
         :121-122/:130): poisoning td's clamped top tile must not change out."""
