@@ -68,11 +68,12 @@ def slope_time(make_chain, repeats, calib_k=32, target_s=0.5):
     return per
 
 
-def bench_variant(name, op, levels, bu, td, side, radius, repeats):
+def bench_variant(name, op, levels, bu, td, side, radius, repeats, flops_mult=1):
     def make_chain():
         def multi(k):
             def body(_, acc):
-                out = op(levels + acc * 0.0, bu, td, side=side, radius=radius)
+                out = op(levels + (acc * 0.0).astype(levels.dtype), bu, td,
+                         side=side, radius=radius)
                 # FULL-output reduction: a partial slice would let XLA
                 # dead-code-eliminate the unobserved rows/levels of the
                 # dense einsums (measured: "847 TF/s" dense at radius 7).
@@ -87,7 +88,7 @@ def bench_variant(name, op, levels, bu, td, side, radius, repeats):
     # Dense-equivalent attention FLOPs (two n^2 contractions); for radius
     # runs this is the work the dense path still does and the fused kernel
     # skips, so fused radius throughput can exceed "peak" — that's the point.
-    tflops_equiv = 4 * B * L * n * n * d / per_call / 1e12
+    tflops_equiv = flops_mult * 4 * B * L * n * n * d / per_call / 1e12
     return {"impl": name, "n": n, "radius": radius, "ms_per_call": round(per_call * 1e3, 3),
             "dense_equiv_tflops": round(tflops_equiv, 2)}
 
@@ -106,7 +107,19 @@ def main():
     def fused(lv, bu, td, *, side, radius):
         return fused_consensus_update(lv, bu, td, side=side, radius=radius)
 
-    records = []
+    def grad_of(op):
+        def gop(lv, bu_, td_, *, side, radius):
+            def loss(a, b, c):
+                out = op(a, b, c, side=side, radius=radius)
+                return jnp.mean(out.astype(jnp.float32) ** 2)
+
+            glv, gbu, gtd = jax.grad(loss, argnums=(0, 1, 2))(lv, bu_, td_)
+            # same output contract as the fwd ops so bench_variant's full-sum
+            # sync covers every gradient element
+            return glv + gbu + jnp.concatenate([gtd, gtd[:1]], axis=0)
+
+        return gop
+
     for side in sides:
         n = side * side
         key = jax.random.PRNGKey(side)
@@ -114,19 +127,27 @@ def main():
         levels = jax.random.normal(k1, (L, B, n, d), dtype)
         bu = jax.random.normal(k2, (L, B, n, d), dtype)
         td = jax.random.normal(k3, (L - 1, B, n, d), dtype)
+        variants = [
+            ("dense_xla", dense, 1),
+            ("fused_pallas", fused, 1),
+            # training direction: value+grad through the op (bwd counted as
+            # 2x fwd) — the dense VJP materializes [L, B, n, n] TWICE
+            # (fwd + bwd); the blockwise backward keeps O(n) memory
+            ("dense_xla_grad", grad_of(dense), 3),
+            ("fused_pallas_grad", grad_of(fused), 3),
+        ]
         for radius in (0.0, 7.0):
-            for name, op in (("dense_xla", dense), ("fused_pallas", fused)):
+            for name, op, mult in variants:
                 rec = bench_variant(
-                    name, op, levels, bu, td, side, radius, repeats
+                    name, op, levels, bu, td, side, radius, repeats, flops_mult=mult
                 )
                 rec["chip"] = chip
-                records.append(rec)
                 print(json.dumps(rec))
-
-    if on_tpu:
-        with open("results/longctx_bench.jsonl", "a") as f:
-            for rec in records:
-                f.write(json.dumps(rec) + "\n")
+                if on_tpu:
+                    # append-as-you-go: a tunnel hiccup mid-run must not
+                    # lose the completed measurements
+                    with open("results/longctx_bench.jsonl", "a") as f:
+                        f.write(json.dumps(rec) + "\n")
 
 
 if __name__ == "__main__":

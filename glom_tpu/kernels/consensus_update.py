@@ -55,6 +55,11 @@ from glom_tpu.utils.helpers import TOKEN_ATTEND_SELF_VALUE
 
 _NEG_MAX = float(jnp.finfo(jnp.float32).min)
 
+# Max bytes of ONE full [n, d] levels row for the blockwise BACKWARD kernels
+# (the dkv pass holds two such rows resident in VMEM); beyond this the
+# custom VJP falls back to the dense recompute.
+_BWD_ROW_LIMIT = 4 * 1024 * 1024
+
 
 def _row_col(idx, side):
     """Patch-grid (row, col) coordinates of flat patch indices."""
@@ -459,8 +464,10 @@ def _consensus_update_bwd(levels_lm, g32, *, side, radius, attend_self, interpre
     d(levels) = dmean + dq + (dv + dk-through-normalization), with dmean
     (= dout/div) handled by the caller. g32 here is dcons = dout32/div."""
     L, B, n, d = levels_lm.shape
+    # Rows here are guaranteed <= _BWD_ROW_LIMIT bytes (bigger shapes take
+    # _fused_bwd's dense fallback), so the default 256 tiles always fit.
     tile_i = _pick_tile(n)
-    tile_j = _pick_tile(n, cap=256)
+    tile_j = _pick_tile(n)
     tile_b = _pick_tile_b_bwd(
         B, n, d, max(tile_i, tile_j), levels_lm.dtype.itemsize
     )
@@ -561,7 +568,20 @@ def _fused_bwd(side, radius, attend_self, interpret, res, g):
     from glom_tpu.models.core import contribution_divisor  # lazy: no cycle
 
     levels_lm, bu_lm, td_lm = res
-    L = levels_lm.shape[0]
+    L, B, n, d = levels_lm.shape
+    # The dkv pass keeps TWO full levels rows resident in VMEM; past
+    # _BWD_ROW_LIMIT per row (f32 at n=4096, bf16 at n=8192) the kernels
+    # cannot fit (measured: f32/n=4096 overflows scoped VMEM at every tile
+    # size) and the dense-recompute VJP — O(n^2) HBM but correct — takes
+    # over.
+    if n * d * levels_lm.dtype.itemsize > _BWD_ROW_LIMIT:
+        _, vjp = jax.vjp(
+            lambda lv, bu, td: _xla_reference(
+                lv, bu, td, side=side, radius=radius, attend_self=attend_self
+            ),
+            levels_lm, bu_lm, td_lm,
+        )
+        return vjp(g)
     f32 = jnp.float32
     div = contribution_divisor(L, dtype=f32).reshape(L, 1, 1, 1)
     dmean = g.astype(f32) / div

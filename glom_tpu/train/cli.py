@@ -26,6 +26,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--data", choices=["shapes", "gaussian"], default="shapes")
     p.add_argument("--metrics-file", default=None, help="JSONL metrics path")
+    p.add_argument(
+        "--tensorboard", default=None, metavar="DIR",
+        help="also mirror scalar metrics to TensorBoard summaries in DIR",
+    )
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=100)
     p.add_argument("--resume", action="store_true", help="resume from latest ckpt")
@@ -69,7 +73,9 @@ def main(argv=None) -> int:
         tcfg = dataclasses.replace(tcfg, **overrides)
     cfg = preset.model
 
-    writer = MetricsWriter(args.metrics_file, echo=True)
+    writer = MetricsWriter(
+        args.metrics_file, echo=True, tensorboard_dir=args.tensorboard
+    )
     make_data = shapes_dataset if args.data == "shapes" else gaussian_dataset
     data = make_data(tcfg.batch_size, cfg.image_size, seed=tcfg.seed)
 

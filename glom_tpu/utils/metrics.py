@@ -81,17 +81,39 @@ def mfu(
 
 
 class MetricsWriter:
-    """Append-only JSONL metrics log, one dict per line, with wall time."""
+    """Append-only JSONL metrics log, one dict per line, with wall time.
 
-    def __init__(self, path: Optional[str] = None, echo: bool = True):
+    `tensorboard_dir` additionally mirrors numeric scalars to TensorBoard
+    via clu.metric_writers (XProf/TensorBoard is the stack's native UI);
+    records carrying a `step` key are written at that step, others at an
+    internal counter. The JSONL file stays the artifact of record — it is
+    what the benches and tests read back."""
+
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        echo: bool = True,
+        tensorboard_dir: Optional[str] = None,
+    ):
         self.path = Path(path) if path else None
         self.echo = echo
         self._t0 = time.time()
+        self._seq = 0
         if self.path:
             self.path.parent.mkdir(parents=True, exist_ok=True)
             self._fh = self.path.open("a")
         else:
             self._fh = None
+        self._tb = None
+        if tensorboard_dir:
+            try:
+                from clu import metric_writers  # deferred: heavy import
+            except ImportError as e:
+                raise ImportError(
+                    "tensorboard_dir requires the optional `clu` package "
+                    "(pip install clu); JSONL metrics work without it"
+                ) from e
+            self._tb = metric_writers.SummaryWriter(tensorboard_dir)
 
     def write(self, metrics: dict):
         rec = {"wall_time": round(time.time() - self._t0, 3), **metrics}
@@ -101,7 +123,19 @@ class MetricsWriter:
             self._fh.flush()
         if self.echo:
             print(line)
+        if self._tb is not None:
+            scalars = {
+                k: float(v)
+                for k, v in rec.items()
+                if isinstance(v, (int, float)) and not isinstance(v, bool)
+            }
+            step = int(scalars.pop("step", self._seq))
+            self._seq = step + 1
+            if scalars:
+                self._tb.write_scalars(step, scalars)
 
     def close(self):
         if self._fh:
             self._fh.close()
+        if self._tb is not None:
+            self._tb.close()

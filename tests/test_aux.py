@@ -156,6 +156,26 @@ class TestFlopModel:
         assert abs(mfu(cfg, rate, chip="v5e") - 0.7) < 1e-6
 
 
+class TestMetricsWriter:
+    def test_tensorboard_mirror(self, tmp_path):
+        """tensorboard_dir mirrors numeric scalars to clu summaries (bools
+        and strings skipped, `step` consumed as the TB step) while the JSONL
+        file stays the artifact of record."""
+        pytest.importorskip("clu")
+        from glom_tpu.utils.metrics import MetricsWriter
+
+        tb = tmp_path / "tb"
+        jsonl = tmp_path / "m.jsonl"
+        w = MetricsWriter(str(jsonl), echo=False, tensorboard_dir=str(tb))
+        w.write({"step": 3, "loss": 0.5, "note": "text", "flag": True})
+        w.write({"loss": 0.25})  # no step -> internal counter (4)
+        w.close()
+        events = list(tb.glob("events.out.tfevents.*"))
+        assert events, "no TensorBoard event file written"
+        lines = jsonl.read_text().strip().splitlines()
+        assert len(lines) == 2 and '"loss": 0.5' in lines[0]
+
+
 class TestCLI:
     def test_end_to_end_smoke(self, tmp_path):
         """Drive the CLI as a subprocess on CPU: train, checkpoint, resume."""
