@@ -206,17 +206,26 @@ class TestDistributedTrainer:
         h = dist.fit(data, num_steps=3, log_every=1)
         assert all(np.isfinite(m["loss"]) for m in h)
 
-    def test_dp_sp_matches_single_device(self):
+    @pytest.mark.parametrize("strategy", ["ring", "ulysses", "halo"])
+    def test_dp_sp_matches_single_device(self, strategy):
+        """Every SP strategy must match single-device training THROUGH the
+        trainer (not just the forward): ring (exact ppermute rotation),
+        ulysses (all-to-all over levels), halo (local-radius neighbor
+        exchange — needs a radius config)."""
+        cfg = CFG if strategy != "halo" else GlomConfig(
+            dim=16, levels=4, image_size=8, patch_size=2,
+            local_consensus_radius=1,
+        )
         tcfg = TrainConfig(batch_size=4, learning_rate=1e-3, noise_std=0.3, seed=5)
-        single = Trainer(CFG, tcfg)
+        single = Trainer(cfg, tcfg)
         dist = DistributedTrainer(
-            CFG,
+            cfg,
             tcfg,
             MeshConfig(data=2, seq=2, model=1),
-            sp_strategy="ring",
+            sp_strategy=strategy,
         )
-        data1 = shapes_dataset(4, CFG.image_size, seed=3)
-        data2 = shapes_dataset(4, CFG.image_size, seed=3)
+        data1 = shapes_dataset(4, cfg.image_size, seed=3)
+        data2 = shapes_dataset(4, cfg.image_size, seed=3)
         h1 = single.fit(data1, num_steps=2, log_every=1)
         h2 = dist.fit(data2, num_steps=2, log_every=1)
         for a, b in zip(h1, h2):
