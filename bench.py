@@ -43,8 +43,11 @@ def main():
     on_tpu = chip != "cpu"
     if on_tpu:
         cfg = GlomConfig(dim=512, levels=6, image_size=224, patch_size=14)
-        batch, iters, repeats = 8, 12, 4
-        k_short, k_long = 8, 40
+        batch, iters, repeats = 8, 12, 6
+        # Chains sized so even the SHORT one carries ~2x the ~100 ms tunnel
+        # RTT of device work — an RTT-dominated short chain makes the slope
+        # hostage to dispatch jitter (observed 20% spread at k_short=8).
+        k_short, k_long = 32, 96
     else:  # CPU fallback so the harness stays runnable anywhere
         cfg = GlomConfig(dim=128, levels=4, image_size=32, patch_size=4)
         batch, iters, repeats = 4, 8, 2
