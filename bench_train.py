@@ -44,8 +44,11 @@ def bench_train_step():
     on_tpu = chip != "cpu"
     if on_tpu:
         cfg = GlomConfig(dim=512, levels=6, image_size=224, patch_size=14)
-        batch, repeats = 16, 4
-        k_short, k_long = 2, 10
+        batch, repeats = 16, 6
+        # Short chain must dwarf the ~100 ms tunnel RTT (~40 ms/step: 6
+        # steps ~ 240 ms) or the slope inherits dispatch jitter — the same
+        # fix as bench.py; k_short=2 produced 2.77k-3.2k swings.
+        k_short, k_long = 6, 18
     else:
         cfg = GlomConfig(dim=128, levels=4, image_size=32, patch_size=4)
         batch, repeats = 4, 2
