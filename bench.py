@@ -13,14 +13,15 @@ metadata: vs_baseline reports measured-MFU / 0.70.
 Timing methodology (the tunneled chip adds a large FIXED dispatch cost that
 is not device throughput):
   * K whole forwards run inside a single compiled fori_loop; the loop carry
-    (a scalar folded into the next input) serializes iterations so no
-    dedup/overlap can fake speedups;
+    (a tiny data-dependent scalar added to the next input — NOT a
+    multiply-by-zero that the compiler could fold away) serializes
+    iterations so no dedup/overlap/hoisting can fake speedups;
   * sync by fetching the device-side-reduced scalar (block_until_ready
     returns early on tunneled platforms);
-  * per-forward time is the SLOPE between a short and a long chain:
-    (t_long - t_short) / (k_long - k_short). The fixed host-dispatch
-    overhead (~100 ms through the tunnel, ~1/3 of a short run's wall time)
-    cancels exactly; what remains is steady-state device throughput;
+  * per-forward time = (t_chain - t_rtt) / K with ONE long chain (seconds
+    of device work) and t_rtt measured by fetching a trivial jitted scalar
+    — see glom_tpu/utils/timing.py for why the earlier two-chain slope was
+    rejected (it over-credited past the physical matmul-bound floor);
   * min over repeats: jitter and throttling only ever slow things down.
 
 Prints exactly one JSON line:
@@ -28,7 +29,6 @@ Prints exactly one JSON line:
 """
 
 import json
-import time
 
 import jax
 import jax.numpy as jnp
@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from glom_tpu.models.core import glom_forward, init_glom
 from glom_tpu.utils.config import GlomConfig
 from glom_tpu.utils.metrics import detect_chip, mfu
+from glom_tpu.utils.timing import best_fetch_time, measure_rtt
 
 
 def main():
@@ -44,14 +45,14 @@ def main():
     if on_tpu:
         cfg = GlomConfig(dim=512, levels=6, image_size=224, patch_size=14)
         batch, iters, repeats = 8, 12, 6
-        # Chains sized so even the SHORT one carries ~2x the ~100 ms tunnel
-        # RTT of device work — an RTT-dominated short chain makes the slope
-        # hostage to dispatch jitter (observed 20% spread at k_short=8).
-        k_short, k_long = 32, 96
+        # ~7 ms/forward: k=192 gives ~1.4 s of device work per call, so the
+        # ~100 ms tunnel RTT (measured and subtracted) is ~7% of the total
+        # and its jitter bounds the error at ~2%.
+        k_chain = 192
     else:  # CPU fallback so the harness stays runnable anywhere
         cfg = GlomConfig(dim=128, levels=4, image_size=32, patch_size=4)
         batch, iters, repeats = 4, 8, 2
-        k_short, k_long = 1, 3
+        k_chain = 3
 
     params = init_glom(jax.random.PRNGKey(0), cfg)
     img = jax.random.normal(
@@ -61,33 +62,23 @@ def main():
     def make_chain(k):
         def multi(p, x):
             def body(_, acc):
+                # acc is a genuinely data-dependent ~1e-6-scale scalar: it
+                # serializes iterations without perturbing the numerics, and
+                # the compiler cannot fold it away (unlike `acc * 0.0`).
                 out = glom_forward(
-                    p, x + acc * 0.0, cfg, iters=iters,
+                    p, x + acc, cfg, iters=iters,
                     compute_dtype=jnp.bfloat16, use_pallas=on_tpu,
                 )
                 return jnp.sum(out).astype(jnp.float32) * 1e-9
             return jax.lax.fori_loop(0, k, body, jnp.float32(0.0))
         return jax.jit(multi)
 
-    def best_time(fn):
-        warm = float(fn(params, img))  # compile + warm
-        if not jnp.isfinite(warm):
-            raise RuntimeError(f"non-finite benchmark output: {warm}")
-        times = []
-        for _ in range(repeats):
-            t0 = time.perf_counter()
-            out = float(fn(params, img))
-            times.append(time.perf_counter() - t0)
-            if not jnp.isfinite(out):
-                raise RuntimeError(f"non-finite benchmark output: {out}")
-        return min(times)
-
-    t_short = best_time(make_chain(k_short))
-    t_long = best_time(make_chain(k_long))
-    per_forward = (t_long - t_short) / (k_long - k_short)
+    t_rtt = measure_rtt(img, repeats=repeats)
+    t_chain = best_fetch_time(make_chain(k_chain), params, img, repeats=repeats)
+    per_forward = (t_chain - t_rtt) / k_chain
     if per_forward <= 0:
         raise RuntimeError(
-            f"degenerate slope timing: t_short={t_short:.4f}s t_long={t_long:.4f}s"
+            f"degenerate timing: t_chain={t_chain:.4f}s t_rtt={t_rtt:.4f}s"
         )
 
     column_iters_per_sec = batch * iters / per_forward

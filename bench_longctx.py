@@ -17,62 +17,33 @@ L=6, d=512, bf16 on one chip, for each implementation:
     fused kernel skips j-tiles entirely outside the radius band while the
     dense path still pays the full n^2.
 
-Timing: same slope methodology as bench.py (chained fori_loop, scalar-fetch
-sync, (t_long - t_short)/(k_long - k_short)).
+Timing: same methodology as bench.py (chained fori_loop, scalar-fetch sync,
+per-op = (t_chain - t_rtt) / k with an auto-calibrated chain length — see
+glom_tpu/utils/timing.py), except the chain length adapts per variant
+because op costs here span µs..ms.
 
 Writes one JSON line per measurement to stdout and appends them to
 results/longctx_bench.jsonl.
 """
 
 import json
-import time
 
 import jax
 import jax.numpy as jnp
 
 from glom_tpu.kernels.consensus_update import _xla_reference, fused_consensus_update
 from glom_tpu.utils.metrics import detect_chip
+from glom_tpu.utils.timing import calibrated_chain_time, measure_rtt
 
 
-def slope_time(make_chain, repeats, calib_k=32, target_s=0.5):
-    """Slope timing with auto-calibrated chain lengths: sub-ms ops through
-    the tunnel are invisible next to the ~100 ms fixed dispatch RTT unless
-    the long chain carries hundreds of ms of device work, so first estimate
-    the per-call cost from a rough calibration chain, then size the chains
-    to put ~target_s of device time in the long one."""
-
-    chain = make_chain()  # ONE jit per variant; k is a traced fori_loop bound
-
-    def best(k):
-        kk = jnp.int32(k)
-        warm = float(chain(kk))
-        if not jnp.isfinite(warm):
-            raise RuntimeError(f"non-finite bench output: {warm}")
-        return min(
-            (lambda t0: (float(chain(kk)), time.perf_counter() - t0)[1])(
-                time.perf_counter()
-            )
-            for _ in range(repeats)
-        )
-
-    t_calib = best(calib_k)
-    per_est = max(t_calib - 0.1, 1e-4) / calib_k  # ~0.1 s tunnel RTT floor
-    k_long = int(min(max(target_s / per_est, calib_k * 2), 50_000))
-    k_short = max(k_long // 5, 1)
-    t_s, t_l = best(k_short), best(k_long)
-    per = (t_l - t_s) / (k_long - k_short)
-    if per <= 0:
-        raise RuntimeError(
-            f"degenerate slope: k=({k_short},{k_long}) t=({t_s:.4f},{t_l:.4f})"
-        )
-    return per
-
-
-def bench_variant(name, op, levels, bu, td, side, radius, repeats, flops_mult=1):
+def bench_variant(name, op, levels, bu, td, side, radius, repeats, rtt,
+                  flops_mult=1):
     def make_chain():
         def multi(k):
             def body(_, acc):
-                out = op(levels + (acc * 0.0).astype(levels.dtype), bu, td,
+                # genuinely data-dependent ~1e-9-scale coupling (an `acc*0`
+                # form could be folded, letting the compiler hoist the body)
+                out = op(levels + acc.astype(levels.dtype), bu, td,
                          side=side, radius=radius)
                 # FULL-output reduction: a partial slice would let XLA
                 # dead-code-eliminate the unobserved rows/levels of the
@@ -83,7 +54,7 @@ def bench_variant(name, op, levels, bu, td, side, radius, repeats, flops_mult=1)
 
         return jax.jit(multi)
 
-    per_call = slope_time(make_chain, repeats)
+    per_call = calibrated_chain_time(make_chain(), rtt, repeats=repeats)
     L, B, n, d = levels.shape
     # Dense-equivalent attention FLOPs (two n^2 contractions); for radius
     # runs this is the work the dense path still does and the fused kernel
@@ -127,6 +98,7 @@ def main():
         levels = jax.random.normal(k1, (L, B, n, d), dtype)
         bu = jax.random.normal(k2, (L, B, n, d), dtype)
         td = jax.random.normal(k3, (L - 1, B, n, d), dtype)
+        rtt = measure_rtt(levels, repeats=repeats)
         variants = [
             ("dense_xla", dense, 1),
             ("fused_pallas", fused, 1),
@@ -139,7 +111,8 @@ def main():
         for radius in (0.0, 7.0):
             for name, op, mult in variants:
                 rec = bench_variant(
-                    name, op, levels, bu, td, side, radius, repeats, flops_mult=mult
+                    name, op, levels, bu, td, side, radius, repeats, rtt,
+                    flops_mult=mult,
                 )
                 rec["chip"] = chip
                 print(json.dumps(rec))

@@ -16,13 +16,15 @@ enough. This harness:
 Timing methodology matches bench.py: K train steps chained inside one
 compiled fori_loop (the optimizer state carry serializes them), synced by
 fetching the final device-side loss scalar (block_until_ready is a no-op on
-the tunneled platform), per-step time taken as the slope between a short and
-a long chain so the fixed host-dispatch overhead cancels.
+the tunneled platform), per-step time = (t_chain - t_rtt) / K with ONE long
+chain and the tunnel RTT measured by fetching a trivial jitted scalar (see
+glom_tpu/utils/timing.py for why the earlier two-chain slope was rejected:
+clock-ramp differences between chains let it over-credit past the physical
+peak).
 """
 
 import argparse
 import json
-import time
 
 import jax
 import jax.numpy as jnp
@@ -30,6 +32,7 @@ import jax.numpy as jnp
 from glom_tpu.train.trainer import create_train_state, make_train_step
 from glom_tpu.utils.config import GlomConfig, TrainConfig
 from glom_tpu.utils.metrics import detect_chip, mfu
+from glom_tpu.utils.timing import best_fetch_time, measure_rtt
 
 
 def _train_iters(cfg: GlomConfig, tcfg: TrainConfig) -> int:
@@ -45,14 +48,14 @@ def bench_train_step():
     if on_tpu:
         cfg = GlomConfig(dim=512, levels=6, image_size=224, patch_size=14)
         batch, repeats = 16, 6
-        # Short chain must dwarf the ~100 ms tunnel RTT (~40 ms/step: 6
-        # steps ~ 240 ms) or the slope inherits dispatch jitter — the same
-        # fix as bench.py; k_short=2 produced 2.77k-3.2k swings.
-        k_short, k_long = 6, 18
+        # ~37 ms/step: k=36 gives ~1.3 s of device work per call, so the
+        # ~100 ms tunnel RTT (measured and subtracted) bounds the error
+        # at ~2%.
+        k_chain = 36
     else:
         cfg = GlomConfig(dim=128, levels=4, image_size=32, patch_size=4)
         batch, repeats = 4, 2
-        k_short, k_long = 1, 3
+        k_chain = 3
 
     tcfg = TrainConfig(
         batch_size=batch,
@@ -81,25 +84,12 @@ def bench_train_step():
             return loss
         return jax.jit(multi)
 
-    def best_time(fn):
-        warm = float(fn(state, img))  # compile + warm; also checks finiteness
-        if not jnp.isfinite(warm):
-            raise RuntimeError(f"non-finite loss in train bench: {warm}")
-        times = []
-        for _ in range(repeats):
-            t0 = time.perf_counter()
-            out = float(fn(state, img))
-            times.append(time.perf_counter() - t0)
-            if not jnp.isfinite(out):
-                raise RuntimeError(f"non-finite loss in train bench: {out}")
-        return min(times)
-
-    t_short = best_time(make_chain(k_short))
-    t_long = best_time(make_chain(k_long))
-    per_step = (t_long - t_short) / (k_long - k_short)
+    t_rtt = measure_rtt(img, repeats=repeats)
+    t_chain = best_fetch_time(make_chain(k_chain), state, img, repeats=repeats)
+    per_step = (t_chain - t_rtt) / k_chain
     if per_step <= 0:
         raise RuntimeError(
-            f"degenerate slope timing: t_short={t_short:.4f}s t_long={t_long:.4f}s"
+            f"degenerate timing: t_chain={t_chain:.4f}s t_rtt={t_rtt:.4f}s"
         )
 
     column_iters_per_sec = batch * k_iters / per_step
