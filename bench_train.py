@@ -62,6 +62,9 @@ def bench_train_step():
         learning_rate=3e-4,
         compute_dtype="bfloat16" if on_tpu else "float32",
         use_pallas=on_tpu,
+        # Unrolling the 7 executed iterations removes the scan-autodiff
+        # residual-stack bookkeeping: ~3-5% step time, measured back-to-back.
+        scan_unroll=on_tpu,
     )
     k_iters = _train_iters(cfg, tcfg)
 

@@ -113,6 +113,7 @@ def glom_forward(
     compute_dtype=None,
     consensus_fn: Optional[ConsensusFn] = None,
     use_pallas: bool = False,
+    unroll: bool = False,
 ) -> jnp.ndarray:
     """The T-iteration GLOM forward (reference :103-152).
 
@@ -130,6 +131,9 @@ def glom_forward(
     the update. Auto-falls back to XLA ops off-TPU / unsupported shapes.
     Leave False inside GSPMD-sharded model-parallel regions — the custom
     calls have no partitioning rule for sharded weights.
+
+    unroll=True unrolls the scan into straight-line code (identical math;
+    see TrainConfig.scan_unroll for the trade-off).
     """
     T = default(iters, cfg.default_iters)
 
@@ -143,7 +147,7 @@ def glom_forward(
                 levels = levels.astype(compute_dtype)
         return _glom_forward_fused(
             params, img, cfg, iters=T, levels_in=levels,
-            return_all=return_all, remat=remat,
+            return_all=return_all, remat=remat, unroll=unroll,
         )
 
     if use_pallas:
@@ -194,7 +198,7 @@ def glom_forward(
     if remat:
         body = jax.checkpoint(body)
 
-    final, stacked = jax.lax.scan(body, levels, None, length=T)
+    final, stacked = jax.lax.scan(body, levels, None, length=T, unroll=unroll)
 
     if return_all:
         return jnp.concatenate([levels[None], stacked], axis=0)  # [T+1, b, n, L, d]
@@ -210,6 +214,7 @@ def _glom_forward_fused(
     levels_in: Optional[jnp.ndarray],
     return_all: bool,
     remat: bool,
+    unroll: bool = False,
 ) -> jnp.ndarray:
     """The fused TPU forward: level-major carry + Pallas kernels.
 
@@ -269,7 +274,7 @@ def _glom_forward_fused(
     if remat:
         body = jax.checkpoint(body)
 
-    final, stacked = jax.lax.scan(body, levels_lm, None, length=iters)
+    final, stacked = jax.lax.scan(body, levels_lm, None, length=iters, unroll=unroll)
 
     if return_all:
         all_lm = jnp.concatenate([levels_lm[None], stacked], axis=0)

@@ -119,6 +119,7 @@ def _forward_local(
     consensus_shard,
     remat: bool,
     use_pallas: bool,
+    unroll: bool = False,
 ) -> jnp.ndarray:
     """Per-shard forward: local batch, local patch band. Returns the final
     top level [b_loc, n_loc, d] after `iters` scan steps (level-major carry,
@@ -199,7 +200,7 @@ def _forward_local(
 
     if remat:
         body = jax.checkpoint(body)
-    final, _ = lax.scan(body, levels_lm, None, length=iters)
+    final, _ = lax.scan(body, levels_lm, None, length=iters, unroll=unroll)
     return final[-1]  # top level, [b_loc, n_loc, d]
 
 
@@ -259,6 +260,7 @@ def make_manual_loss(
             consensus_shard=consensus_shard,
             remat=tcfg.remat,
             use_pallas=use_pallas,
+            unroll=tcfg.scan_unroll,
         )  # [b_loc, n_loc, d]
 
         # Reconstruction + MSE in PATCH space: identical pixel set to the

@@ -67,6 +67,7 @@ def denoise_loss(
     compute_dtype=None,
     consensus_fn: Optional[ConsensusFn] = None,
     use_pallas: bool = False,
+    unroll: bool = False,
 ) -> jnp.ndarray:
     """MSE between the clean image and the reconstruction from the noised
     image's top level at iteration `recon_index`."""
@@ -85,6 +86,7 @@ def denoise_loss(
         compute_dtype=compute_dtype,
         consensus_fn=consensus_fn,
         use_pallas=use_pallas,
+        unroll=unroll,
     )
     top = final[:, :, -1]  # [b, n, d] — the top level
     with jax.named_scope("reconstruction"):

@@ -85,6 +85,7 @@ def make_train_step(
                 compute_dtype=compute_dtype,
                 consensus_fn=consensus_fn,
                 use_pallas=tcfg.use_pallas,
+                unroll=tcfg.scan_unroll,
             )
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)

@@ -49,6 +49,22 @@ def test_truncated_iters_equals_full_stack_selection():
     )
 
 
+def test_unrolled_scan_matches_rolled():
+    """scan_unroll is a pure scheduling change: loss AND grads must match the
+    rolled scan exactly (same ops, same order, straight-line vs while loop)."""
+    params = init_denoise(jax.random.PRNGKey(0), CFG)
+    img = jnp.asarray(np.random.default_rng(3).normal(size=(2, 3, 8, 8)), jnp.float32)
+    noise = jnp.asarray(np.random.default_rng(4).normal(size=(2, 3, 8, 8)), jnp.float32)
+    vg = jax.value_and_grad(denoise_loss)
+    loss_r, grads_r = vg(params, img, noise, CFG)
+    loss_u, grads_u = vg(params, img, noise, CFG, unroll=True)
+    np.testing.assert_allclose(float(loss_r), float(loss_u), rtol=1e-6)
+    for gr, gu in zip(
+        jax.tree_util.tree_leaves(grads_r), jax.tree_util.tree_leaves(grads_u)
+    ):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gu), rtol=1e-5, atol=1e-6)
+
+
 def test_training_loss_decreases():
     """BASELINE config-2 style smoke: a few steps of denoise training on
     structured synthetic images must reduce the loss."""
