@@ -1,0 +1,169 @@
+"""PyTorch oracle of the GLOM forward + denoise-training contract.
+
+The BASELINE.json north star is "match the PyTorch-CUDA reference loss
+curve". The reference itself publishes no curve (BASELINE.md), so this
+module IS the PyTorch side of that comparison: an independent torch
+implementation written directly from the behavioral spec (SURVEY.md §3.2
+for the forward, §3.3 for the denoise recipe), sharing no code with
+glom_tpu — torch autograd + torch.optim.Adam against jax.grad + optax.adam.
+
+Functional style over plain tensor dicts (not nn.Modules) so weights
+convert 1:1 from glom_tpu's pytrees: the parity tests transplant the SAME
+initial weights into both frameworks, feed the SAME data and noise, and
+compare per-step losses.
+
+Used by tests/test_torch_parity.py and parity_torch.py (the committed
+loss-curve artifact). CPU-only (the torch in this image has no CUDA), which
+is fine: the comparison locks the math, not torch's device performance.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+TOKEN_ATTEND_SELF_VALUE = -5e-4
+
+
+# ---------------------------------------------------------------- weights
+
+
+def params_from_jax(denoise_params, requires_grad: bool = True) -> dict:
+    """Flatten a glom_tpu DenoiseParams pytree into a name->torch.Tensor
+    dict (float32, leaf tensors)."""
+    g = denoise_params.glom
+    raw = {
+        "token_w": g.token_embed.w, "token_b": g.token_embed.b,
+        "pos_emb": g.pos_emb, "init_levels": g.init_levels,
+        "bu_w1": g.bottom_up.w1, "bu_b1": g.bottom_up.b1,
+        "bu_w2": g.bottom_up.w2, "bu_b2": g.bottom_up.b2,
+        "td_w1": g.top_down.w1, "td_b1": g.top_down.b1,
+        "td_w2": g.top_down.w2, "td_b2": g.top_down.b2,
+        "pix_w": denoise_params.to_pixels.w, "pix_b": denoise_params.to_pixels.b,
+    }
+    out = {}
+    for name, arr in raw.items():
+        t = torch.from_numpy(np.asarray(arr, dtype=np.float32).copy())
+        t.requires_grad_(requires_grad)
+        out[name] = t
+    return out
+
+
+# ---------------------------------------------------------------- ops
+
+
+def grouped_ffw(x, w1, b1, w2, b2):
+    """x: [..., G, d]; per-group d -> f -> d MLP, exact-erf GELU."""
+    h = torch.einsum("...gd,gdf->...gf", x, w1) + b1
+    h = F.gelu(h)  # default approximate='none' = exact erf, matching jax.nn.gelu(approximate=False)
+    return torch.einsum("...gf,gfd->...gd", h, w2) + b2
+
+
+def local_mask(side: int, radius: float):
+    """[n, n] bool: True where patch-grid euclidean distance > radius."""
+    if radius <= 0:
+        return None
+    hs, ws = torch.meshgrid(torch.arange(side), torch.arange(side), indexing="ij")
+    coords = torch.stack([hs, ws], -1).reshape(-1, 2).to(torch.float64)
+    dist = torch.cdist(coords, coords)
+    return dist > radius
+
+
+def consensus(levels, attend_self=False, mask=None):
+    """Same-level cross-column attention. levels: [b, n, L, d].
+    k-only L2 norm, d^-1/2 scale, -5e-4 soft self mask, -finfo.max local."""
+    b, n, L, d = levels.shape
+    k = F.normalize(levels, dim=-1)  # eps 1e-12, same as the jax op
+    sim = torch.einsum("bild,bjld->blij", levels, k) * (d ** -0.5)
+    if not attend_self:
+        eye = torch.eye(n, dtype=torch.bool)
+        sim = sim.masked_fill(eye[None, None], TOKEN_ATTEND_SELF_VALUE)
+    if mask is not None:
+        sim = sim.masked_fill(mask[None, None], -torch.finfo(sim.dtype).max)
+    attn = sim.softmax(dim=-1)
+    return torch.einsum("blij,bjld->bild", attn, levels)
+
+
+def patchify(img, p: int):
+    """[b, c, H, W] -> [b, n, p*p*c], channel innermost within a patch."""
+    b, c, H, W = img.shape
+    h, w = H // p, W // p
+    x = img.reshape(b, c, h, p, w, p)
+    x = x.permute(0, 2, 4, 3, 5, 1)  # b h w p1 p2 c
+    return x.reshape(b, h * w, p * p * c)
+
+
+def unpatchify(x, p: int, image_size: int, c: int = 3):
+    b, n, _ = x.shape
+    h = image_size // p
+    x = x.reshape(b, h, h, p, p, c)
+    x = x.permute(0, 5, 1, 3, 2, 4)  # b c h p1 w p2
+    return x.reshape(b, c, image_size, image_size)
+
+
+# ---------------------------------------------------------------- model
+
+
+def forward(params, img, cfg, iters=None, levels=None, return_all=False):
+    """The T-iteration column update (SURVEY.md §3.2). img: [b, c, H, W]."""
+    L = cfg.levels
+    T = iters if iters is not None else 2 * L
+    p = cfg.patch_size
+    side = cfg.image_size // p
+    n = side * side
+    mask = local_mask(side, cfg.local_consensus_radius)
+
+    tokens = patchify(img, p) @ params["token_w"] + params["token_b"]  # [b,n,d]
+    b = tokens.shape[0]
+    pos = params["pos_emb"].reshape(1, n, 1, -1)
+    bottom = tokens[:, :, None]  # [b, n, 1, d]
+    if levels is None:
+        levels = params["init_levels"].expand(b, n, L, -1)
+
+    divisor = torch.full((L, 1), 4.0)
+    divisor[-1] = 3.0
+
+    hiddens = [levels]
+    for _ in range(T):
+        with_input = torch.cat([bottom, levels], dim=-2)  # [b, n, L+1, d]
+        bu = grouped_ffw(with_input[..., :-1, :],
+                         params["bu_w1"], params["bu_b1"],
+                         params["bu_w2"], params["bu_b2"])
+        td = grouped_ffw(with_input[..., 2:, :] + pos,
+                         params["td_w1"], params["td_b1"],
+                         params["td_w2"], params["td_b2"])
+        td = F.pad(td, (0, 0, 0, 1))  # zero top-down for the top level
+        cons = consensus(levels, attend_self=cfg.consensus_self, mask=mask)
+        levels = (levels + bu + td + cons) / divisor
+        hiddens.append(levels)
+
+    if return_all:
+        return torch.stack(hiddens)  # [T+1, b, n, L, d]
+    return levels
+
+
+def denoise_loss(params, img, noise, cfg, recon_index=None, iters=None):
+    """MSE(clean img, reconstruction from the noised image's top level at
+    iteration recon_index) — the README recipe (SURVEY.md §3.3)."""
+    T = iters if iters is not None else 2 * cfg.levels
+    k = recon_index if recon_index is not None else T // 2 + 1
+    final = forward(params, img + noise, cfg, iters=k)  # iters k+1..T are dead
+    top = final[:, :, -1]  # [b, n, d]
+    recon = unpatchify(top @ params["pix_w"] + params["pix_b"],
+                       cfg.patch_size, cfg.image_size, cfg.channels)
+    return ((img - recon) ** 2).mean()
+
+
+def train(params, images, noises, cfg, lr: float):
+    """Adam training over pre-generated (image, noise) step pairs; returns
+    the per-step losses. Hyperparameters match optax.adam defaults."""
+    opt = torch.optim.Adam(params.values(), lr=lr, betas=(0.9, 0.999), eps=1e-8)
+    losses = []
+    for img, noise in zip(images, noises):
+        opt.zero_grad()
+        loss = denoise_loss(params, torch.from_numpy(img), torch.from_numpy(noise), cfg)
+        loss.backward()
+        opt.step()
+        losses.append(float(loss.detach()))
+    return losses

@@ -1,0 +1,102 @@
+"""Cross-framework parity: glom_tpu (jax) vs the independent PyTorch oracle.
+
+The BASELINE.json north star is "match the PyTorch-CUDA reference loss
+curve". These tests make that checkable at unit scale: transplant IDENTICAL
+initial weights into both frameworks, feed IDENTICAL data and noise, and
+require matching forwards and matching per-step Adam training losses
+(torch autograd + torch.optim.Adam vs jax.grad + optax.adam).
+
+The committed full-scale curve artifact is produced by parity_torch.py.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+from glom_tpu.models.core import glom_forward  # noqa: E402
+from glom_tpu.train.objectives import denoise_loss, init_denoise  # noqa: E402
+from glom_tpu.utils.config import GlomConfig  # noqa: E402
+
+import oracle_torch  # noqa: E402  (tests/ is on sys.path via conftest rootdir)
+
+CFG = GlomConfig(dim=16, levels=3, image_size=8, patch_size=4)  # n=4 patches
+
+
+def _setup(seed=0, cfg=CFG):
+    params = init_denoise(jax.random.PRNGKey(seed), cfg)
+    tparams = oracle_torch.params_from_jax(params)
+    rng = np.random.default_rng(seed + 100)
+    img = rng.normal(size=(2, 3, cfg.image_size, cfg.image_size)).astype(np.float32)
+    return params, tparams, img
+
+
+@pytest.mark.parametrize(
+    "cfg",
+    [
+        CFG,
+        GlomConfig(dim=16, levels=3, image_size=16, patch_size=4,
+                   local_consensus_radius=1),
+        GlomConfig(dim=16, levels=3, image_size=8, patch_size=4,
+                   consensus_self=True),
+    ],
+    ids=["global", "local-radius", "attend-self"],
+)
+def test_forward_matches_torch(cfg):
+    params, tparams, img = _setup(cfg=cfg)
+    out_jax = np.asarray(glom_forward(params.glom, jnp.asarray(img), cfg))
+    with torch.no_grad():
+        out_torch = oracle_torch.forward(tparams, torch.from_numpy(img), cfg)
+    np.testing.assert_allclose(out_jax, out_torch.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_return_all_matches_torch():
+    params, tparams, img = _setup()
+    out_jax = np.asarray(
+        glom_forward(params.glom, jnp.asarray(img), CFG, return_all=True)
+    )
+    with torch.no_grad():
+        out_torch = oracle_torch.forward(
+            tparams, torch.from_numpy(img), CFG, return_all=True
+        )
+    assert out_jax.shape == tuple(out_torch.shape)  # T+1 stacked states
+    np.testing.assert_allclose(out_jax, out_torch.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_adam_loss_curve_matches_torch():
+    """5 Adam steps, identical weights/data/noise: per-step losses must track
+    to float32 tolerance — the north-star loss-curve match at unit scale."""
+    steps, lr = 5, 1e-3
+    params, tparams, _ = _setup()
+    rng = np.random.default_rng(7)
+    shape = (2, 3, CFG.image_size, CFG.image_size)
+    images = [rng.normal(size=shape).astype(np.float32) for _ in range(steps)]
+    noises = [rng.normal(size=shape).astype(np.float32) for _ in range(steps)]
+
+    # torch side
+    torch_losses = oracle_torch.train(tparams, images, noises, CFG, lr)
+
+    # jax side: same objective, optax.adam (defaults match torch.optim.Adam)
+    opt = optax.adam(lr)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, img, noise):
+        loss, grads = jax.value_and_grad(denoise_loss)(
+            params, img, noise, CFG
+        )
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    jax_losses = []
+    for img, noise in zip(images, noises):
+        params, opt_state, loss = step(
+            params, opt_state, jnp.asarray(img), jnp.asarray(noise)
+        )
+        jax_losses.append(float(loss))
+
+    np.testing.assert_allclose(jax_losses, torch_losses, rtol=5e-4)
