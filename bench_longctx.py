@@ -33,10 +33,10 @@ import jax.numpy as jnp
 
 from glom_tpu.kernels.consensus_update import _xla_reference, fused_consensus_update
 from glom_tpu.utils.metrics import detect_chip
-from glom_tpu.utils.timing import calibrated_chain_time, measure_rtt
+from glom_tpu.utils.timing import calibrated_chain_time
 
 
-def bench_variant(name, op, levels, bu, td, side, radius, repeats, rtt,
+def bench_variant(name, op, levels, bu, td, side, radius, repeats,
                   flops_mult=1):
     def make_chain():
         def multi(k):
@@ -54,7 +54,9 @@ def bench_variant(name, op, levels, bu, td, side, radius, repeats, rtt,
 
         return jax.jit(multi)
 
-    per_call = calibrated_chain_time(make_chain(), rtt, repeats=repeats)
+    # calibrated_chain_time re-measures RTT right before the measured chain
+    # (a per-n RTT taken minutes earlier would drift).
+    per_call = calibrated_chain_time(make_chain(), levels, repeats=repeats)
     L, B, n, d = levels.shape
     # Dense-equivalent attention FLOPs (two n^2 contractions); for radius
     # runs this is the work the dense path still does and the fused kernel
@@ -98,7 +100,6 @@ def main():
         levels = jax.random.normal(k1, (L, B, n, d), dtype)
         bu = jax.random.normal(k2, (L, B, n, d), dtype)
         td = jax.random.normal(k3, (L - 1, B, n, d), dtype)
-        rtt = measure_rtt(levels, repeats=repeats)
         variants = [
             ("dense_xla", dense, 1),
             ("fused_pallas", fused, 1),
@@ -111,7 +112,7 @@ def main():
         for radius in (0.0, 7.0):
             for name, op, mult in variants:
                 rec = bench_variant(
-                    name, op, levels, bu, td, side, radius, repeats, rtt,
+                    name, op, levels, bu, td, side, radius, repeats,
                     flops_mult=mult,
                 )
                 rec["chip"] = chip

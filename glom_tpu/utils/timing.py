@@ -55,25 +55,32 @@ def measure_rtt(example, repeats: int = 6) -> float:
 
 def calibrated_chain_time(
     chain,
-    rtt: float,
+    rtt_example,
     *,
     repeats: int = 6,
     calib_k: int = 32,
-    target_s: float = 0.5,
+    target_s: float = 1.0,
     max_k: int = 50_000,
 ) -> float:
     """Per-iteration time of `chain(k) -> scalar` (k a traced fori_loop
     bound, so ONE jit serves every k). For ops whose cost spans µs..ms the
     chain length must adapt: first estimate per-op cost from a short
     calibration chain, then size k to put ~target_s of device work in the
-    measured chain, and return (t_chain - rtt) / k."""
+    measured chain, and return (t_chain - rtt) / k.
+
+    `rtt_example`: a device-resident array the RTT probe reads. RTT is
+    re-measured HERE, immediately before the measured chain — a stale RTT
+    taken minutes earlier would re-introduce drift the subtraction exists
+    to cancel. target_s=1.0 keeps the rtt-jitter error bound at ~2%."""
 
     def best(k):
         return best_fetch_time(chain, jnp.int32(k), repeats=repeats)
 
+    rtt0 = measure_rtt(rtt_example, repeats=repeats)
     t_calib = best(calib_k)
-    per_est = max((t_calib - rtt) / calib_k, 1e-7)
+    per_est = max((t_calib - rtt0) / calib_k, 1e-7)
     k = int(min(max(target_s / per_est, calib_k), max_k))
+    rtt = measure_rtt(rtt_example, repeats=repeats)
     per = (best(k) - rtt) / k
     if per <= 0:
         raise RuntimeError(f"degenerate chain timing: k={k} rtt={rtt:.4f}")
