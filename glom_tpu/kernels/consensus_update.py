@@ -50,6 +50,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from glom_tpu.utils.helpers import TOKEN_ATTEND_SELF_VALUE
 
@@ -252,10 +253,11 @@ def _window(center_lo, extent, tile, n_tiles, side, radius):
 def _consensus_bwd_dq_kernel(
     x_ref,      # [1, TB, TI, d]  levels q tile
     kv_ref,     # [1, TB, n, d]   full levels rows (k and v)
-    dm_ref,     # [1, TB, TI, d]  dcons tile: the mean-divided cotangent,
-                #                 DOWNCAST to the compute dtype by the
-                #                 caller (halves its HBM/VMEM footprint;
-                #                 matmul accumulation stays f32)
+    dm_ref,     # [1, TB, TI, d]  RAW output-cotangent tile (compute dtype;
+                #                 the 4-vs-3 mean divisor is applied HERE,
+                #                 from the level grid index — feeding the
+                #                 kernel g directly avoids a separate
+                #                 divide+downcast HBM sweep in the caller)
     dq_ref,     # [1, TB, TI, d]  f32
     m_ref,      # [1, TB, TI, 1]  f32 row max (saved for the dkv kernel)
     l_ref,      # [1, TB, TI, 1]  f32 row softmax denominator
@@ -278,7 +280,9 @@ def _consensus_bwd_dq_kernel(
     f32 = jnp.float32
 
     x = x_ref[0]
-    dcons = dm_ref[0].astype(f32)
+    # dcons = g / div: top level (last grid-0 index) averages 3 contributions
+    div = jnp.where(pl.program_id(0) == pl.num_programs(0) - 1, 3.0, 4.0)
+    dcons = dm_ref[0].astype(f32) / div
     row_ids = i * tile_i + jax.lax.broadcasted_iota(jnp.int32, (tile_i, tile_j), 0)
     ri, ci = _row_col(row_ids, side)
     j_lo, j_hi = _window(i * tile_i, tile_i, tile_j, n // tile_j, side, radius)
@@ -355,18 +359,23 @@ def _consensus_bwd_dq_kernel(
 def _consensus_bwd_dkv_kernel(
     xj_ref,     # [1, TB, TJ, d]  levels j-tile (k_j, v_j live here)
     q_ref,      # [1, TB, n, d]   full levels rows (queries)
-    dm_ref,     # [1, TB, n, d]   full dcons rows (compute dtype, same
-                #                 downcast trade as in the dq kernel)
+    dm_ref,     # [1, TB, n, d]   full RAW output-cotangent rows (compute
+                #                 dtype; the mean divisor is applied here,
+                #                 same trade as in the dq kernel)
+    dq_ref,     # [1, TB, TJ, d]  f32 dq tile from pass 1 (j-aligned)
     m_ref,      # [1, TB, n, 1]   f32 stats from the dq kernel
     l_ref,      # [1, TB, n, 1]
     dd_ref,     # [1, TB, n, 1]
-    out_ref,    # [1, TB, TJ, d]  f32: dv_j + normalizeVJP(dk_j)
+    out_ref,    # [1, TB, TJ, d]  levels dtype: the COMPLETE dlevels tile
+                #                 (dmean + dq + dv + normalizeVJP(dk)) —
+                #                 folding the sum here removes the separate
+                #                 XLA add/convert HBM sweeps
     *, side, radius, attend_self, tile_i, tile_j, n,
 ):
     """Pass 2: for each j-tile, loop the i-window and accumulate
-    dv_j = sum_i p_ij dcons_i and dk_j = scale * sum_i ds_ij q_i, then push
-    dk through the k-normalization VJP (row-local) so the kernel emits a
-    single dlevels contribution per j position."""
+    dv_j = sum_i p_ij dcons_i and dk_j = scale * sum_i ds_ij q_i, push dk
+    through the k-normalization VJP (row-local), then finish dlevels in the
+    epilogue: out_j = g_j/div + dq_j + dv_j + dxn_j, downcast once."""
     j = pl.program_id(2)
     tb = xj_ref.shape[1]
     d = xj_ref.shape[-1]
@@ -375,6 +384,9 @@ def _consensus_bwd_dkv_kernel(
 
     xj = xj_ref[0]            # [TB, TJ, d] raw levels (v_j; k_j after norm)
     k = _normalized_k(xj)
+    # g / div applied via the LINEAR uses of dcons: dv and dP are both
+    # linear in dcons, so the divide moves onto the accumulated dots.
+    inv_div = 1.0 / jnp.where(pl.program_id(0) == pl.num_programs(0) - 1, 3.0, 4.0)
     col_ids = j * tile_j + jax.lax.broadcasted_iota(jnp.int32, (tile_j, tile_i), 0)
     rj, cj = _row_col(col_ids, side)
     i_lo, i_hi = _window(j * tile_j, tile_j, tile_i, n // tile_i, side, radius)
@@ -413,10 +425,13 @@ def _consensus_bwd_dkv_kernel(
             p2c, dcons, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=f32,
         )
-        dp2 = jax.lax.dot_general(
-            xj, dcons, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=f32,
-        )  # dP2[b, tj, ti] = v_j . dcons_i
+        dp2 = (
+            jax.lax.dot_general(
+                xj, dcons, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=f32,
+            )
+            * inv_div
+        )  # dP2[b, tj, ti] = v_j . (dcons_i / div_i); dd is already divided
         ds2 = p2 * (dp2 - dd[:, None, :])
         if not attend_self:
             ds2 = jnp.where((col_ids == row_ids)[None], 0.0, ds2)
@@ -429,6 +444,7 @@ def _consensus_bwd_dkv_kernel(
     dv0 = jnp.zeros((tb, tile_j, d), f32)
     dk0 = jnp.zeros((tb, tile_j, d), f32)
     dv, dk = jax.lax.fori_loop(i_lo, i_hi, i_body, (dv0, dk0))
+    dv = dv * inv_div  # dv accumulated against the RAW cotangent rows
     dk = dk * scale
 
     # k-normalization VJP (row-local): k = x / max(||x||, eps).
@@ -437,21 +453,25 @@ def _consensus_bwd_dkv_kernel(
     inv = 1.0 / jnp.maximum(r, 1e-12)
     a = jnp.sum(dk * x32, axis=-1, keepdims=True)
     dxn = dk * inv - jnp.where(r >= 1e-12, a * x32 * inv * inv / r, 0.0)
-    out_ref[0] = dv + dxn
+    # Epilogue: complete dlevels for this j-tile. dmean_j = g_j / div.
+    gj = dm_ref[0, :, pl.ds(j * tile_j, tile_j), :].astype(f32) * inv_div
+    out_ref[0] = (gj + dq_ref[0] + dv + dxn).astype(out_ref.dtype)
 
 
 def _pick_tile_b_bwd(B: int, n: int, d: int, tile: int, itemsize: int) -> int:
     """Batch tile for the BACKWARD kernels, whose working set is heavier
     than the forward's: the dkv pass keeps TWO full-row operands resident
-    (q and dcons, levels dtype) plus f32 dq/out tile blocks, and the dq
-    pass one full-row operand plus the f32 dq block — the forward's budget
-    model undercounts that by ~2x in the long-context regime."""
+    (q and the raw cotangent, levels dtype) plus an f32 dq input tile and
+    a levels-dtype out tile, and the dq pass one full-row operand plus the
+    f32 dq block — the forward's budget model undercounts that by ~2x in
+    the long-context regime."""
     budget = 12 * 1024 * 1024
     for tb in (8, 4, 2, 1):
         if B % tb != 0:
             continue
         full_rows = 2 * tb * n * d * itemsize          # q + dcons, resident
-        tiles = tb * tile * d * (itemsize + 4) * 2     # in (dtype) + out (f32), 2x buf
+        # in tiles (xj dtype + dq f32) + out tile (dtype), 2x buffered
+        tiles = tb * tile * d * (2 * itemsize + 4) * 2
         stats = 3 * tb * n * 4
         scratch = 2 * tb * tile * tile * 4 + 2 * tb * tile * d * 4  # s2/ds + dv/dk acc
         if full_rows + tiles + stats + scratch <= budget:
@@ -459,10 +479,14 @@ def _pick_tile_b_bwd(B: int, n: int, d: int, tile: int, itemsize: int) -> int:
     return 1
 
 
-def _consensus_update_bwd(levels_lm, g32, *, side, radius, attend_self, interpret):
-    """Blockwise backward for the fused consensus+update: returns
-    d(levels) = dmean + dq + (dv + dk-through-normalization), with dmean
-    (= dout/div) handled by the caller. g32 here is dcons = dout32/div."""
+def _consensus_update_bwd(levels_lm, g, *, side, radius, attend_self, interpret):
+    """Blockwise backward for the fused consensus+update: returns the
+    COMPLETE d(levels) = dmean + dq + (dv + dk-through-normalization), in
+    the levels dtype. `g` is the RAW output cotangent in the compute dtype
+    — the 4-vs-3 mean divisor is applied inside the kernels from the level
+    grid index, and the dkv pass's epilogue folds dmean + dq into its
+    output, so neither a divided copy of g nor the f32 partial sums ever
+    make a separate HBM round trip."""
     L, B, n, d = levels_lm.shape
     # Rows here are guaranteed <= _BWD_ROW_LIMIT bytes (bigger shapes take
     # _fused_bwd's dense fallback), so the default 256 tiles always fit.
@@ -498,27 +522,33 @@ def _consensus_update_bwd(levels_lm, g32, *, side, radius, attend_self, interpre
             pl.BlockSpec((1, tile_b, tile_i, 1), lambda g, b, i: (g, b, i, 0)),
             pl.BlockSpec((1, tile_b, tile_i, 1), lambda g, b, i: (g, b, i, 0)),
         ),
+        # At the long-context limit (n=4096 rows, _BWD_ROW_LIMIT) the
+        # resident rows + tiles land just over Mosaic's default 16MB
+        # scoped-vmem budget; raise the scope (v5e has 128MB physical).
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=48 * 1024 * 1024),
         interpret=interpret,
-    )(levels_lm, levels_lm, g32.astype(levels_lm.dtype))
+    )(levels_lm, levels_lm, g.astype(levels_lm.dtype))
 
     grid_j = (L, B // tile_b, n // tile_j)
-    dkv = pl.pallas_call(
+    dlv = pl.pallas_call(
         partial(_consensus_bwd_dkv_kernel, **kw),
-        out_shape=jax.ShapeDtypeStruct((L, B, n, d), f32),
+        out_shape=jax.ShapeDtypeStruct((L, B, n, d), levels_lm.dtype),
         grid=grid_j,
         in_specs=[
             pl.BlockSpec((1, tile_b, tile_j, d), lambda g, b, j: (g, b, j, 0)),
             pl.BlockSpec((1, tile_b, n, d), lambda g, b, j: (g, b, 0, 0)),
             pl.BlockSpec((1, tile_b, n, d), lambda g, b, j: (g, b, 0, 0)),
+            pl.BlockSpec((1, tile_b, tile_j, d), lambda g, b, j: (g, b, j, 0)),
             pl.BlockSpec((1, tile_b, n, 1), lambda g, b, j: (g, b, 0, 0)),
             pl.BlockSpec((1, tile_b, n, 1), lambda g, b, j: (g, b, 0, 0)),
             pl.BlockSpec((1, tile_b, n, 1), lambda g, b, j: (g, b, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, tile_b, tile_j, d), lambda g, b, j: (g, b, j, 0)),
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=48 * 1024 * 1024),
         interpret=interpret,
-    )(levels_lm, levels_lm, g32.astype(levels_lm.dtype), m_, l_, dd_)
+    )(levels_lm, levels_lm, g.astype(levels_lm.dtype), dq, m_, l_, dd_)
 
-    return dq + dkv
+    return dlv
 
 
 def _xla_reference(levels_lm, bu_lm, td_lm, *, side, radius, attend_self):
@@ -584,12 +614,14 @@ def _fused_bwd(side, radius, attend_self, interpret, res, g):
         return vjp(g)
     f32 = jnp.float32
     div = contribution_divisor(L, dtype=f32).reshape(L, 1, 1, 1)
-    dmean = g.astype(f32) / div
-    dlv_attn = _consensus_update_bwd(
-        levels_lm, dmean,
+    # The kernels take the RAW cotangent, apply the divisor in-kernel (from
+    # the level grid index), and the dkv pass emits the COMPLETE dlv in the
+    # levels dtype — no divided/partial-sum copies of g hit HBM.
+    dlv = _consensus_update_bwd(
+        levels_lm, g,
         side=side, radius=radius, attend_self=attend_self, interpret=interpret,
     )
-    dlv = (dmean + dlv_attn).astype(levels_lm.dtype)
+    dmean = g.astype(f32) / div
     return dlv, dmean.astype(bu_lm.dtype), dmean[: L - 1].astype(td_lm.dtype)
 
 
