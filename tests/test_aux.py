@@ -177,13 +177,18 @@ class TestMetricsWriter:
 
 
 class TestCLI:
+    # Shared subprocess bootstrap: virtual 8-device CPU platform (the
+    # config.update is required — env vars alone are defeated by this
+    # image's sitecustomize TPU pre-registration).
+    ENV_SNIPPET = (
+        "import os; os.environ['XLA_FLAGS']='--xla_force_host_platform_device_count=8';"
+        "import jax; jax.config.update('jax_platforms','cpu');"
+        "from glom_tpu.train.cli import main; import sys;"
+    )
+
     def test_end_to_end_smoke(self, tmp_path):
         """Drive the CLI as a subprocess on CPU: train, checkpoint, resume."""
-        env_snippet = (
-            "import os; os.environ['XLA_FLAGS']='--xla_force_host_platform_device_count=8';"
-            "import jax; jax.config.update('jax_platforms','cpu');"
-            "from glom_tpu.train.cli import main; import sys;"
-        )
+        env_snippet = self.ENV_SNIPPET
         ckpt = tmp_path / "ck"
         metrics = tmp_path / "m.jsonl"
         r = subprocess.run(
@@ -219,3 +224,45 @@ class TestCLI:
         )
         assert r2.returncode == 0, r2.stderr[-2000:]
         assert "resumed from step 4" in r2.stderr
+
+    def test_distributed_smoke(self, tmp_path):
+        """--distributed scales the preset mesh to the visible devices and
+        trains on the virtual 8-device mesh."""
+        env_snippet = self.ENV_SNIPPET
+        metrics = tmp_path / "m.jsonl"
+        r = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                env_snippet
+                + f"sys.exit(main(['--preset','mnist','--steps','3','--log-every','1',"
+                f"'--batch-size','8','--data','gaussian','--distributed',"
+                f"'--metrics-file','{metrics}']))",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=600,
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "mesh" in r.stderr  # the mesh banner printed
+        lines = [json.loads(l) for l in metrics.read_text().splitlines()]
+        assert lines and all(np.isfinite(m["loss"]) for m in lines)
+
+    def test_check_parity_smoke(self):
+        """--check-parity runs sharded-vs-single and exits 0 when the loss
+        histories agree (the race-detection / sanitizer mode, SURVEY §5)."""
+        env_snippet = self.ENV_SNIPPET
+        r = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                env_snippet
+                + "sys.exit(main(['--preset','mnist','--steps','2','--log-every','1',"
+                "'--batch-size','8','--data','gaussian','--check-parity']))",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=600,
+        )
+        assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+        assert "parity: worst relative loss deviation" in r.stdout
