@@ -2,8 +2,11 @@
 
 The BASELINE.json north star is "match the PyTorch-CUDA reference loss
 curve". The reference publishes no curve (BASELINE.md), so this harness
-produces the comparison from both directions itself, at BASELINE config 2
-scale (CIFAR-10 32x32, patch=4, levels=5, dim=256):
+produces the comparison from both directions itself. `--config` selects
+the scale: `cifar10` (BASELINE config 2 — cheap enough for a 100-step
+curve, the default) or `imagenet224` (the north-star L=6/d=512 config —
+few steps at small batch; the torch side is ~15 s/step on CPU). Three
+runs per invocation:
 
   * torch     — tests/oracle_torch.py (independent from-spec implementation,
                 torch autograd + torch.optim.Adam), CPU fp32;
@@ -15,7 +18,8 @@ scale (CIFAR-10 32x32, patch=4, levels=5, dim=256):
 
 All three start from IDENTICAL weights and see IDENTICAL images and noise
 (pre-generated on host). Writes one JSONL record per step with the three
-losses and diffs, plus a summary line, to results/loss_parity_torch.jsonl.
+losses and diffs, plus a summary line, to
+results/loss_parity_torch[_<config>].jsonl.
 
 Expectation, stated up front: jax_f32 matches torch to fp32 tolerance for
 the early steps and stays within a small relative band thereafter (the
@@ -30,7 +34,17 @@ import json
 import numpy as np
 
 
-def main(steps: int, batch: int, out_path: str):
+CONFIGS = {
+    # BASELINE config 2 scale — cheap enough for a 100-step curve.
+    "cifar10": dict(dim=256, levels=5, image_size=32, patch_size=4),
+    # The north-star config ("match the PyTorch loss curve on ImageNet-224,
+    # L=6, d=512") — the torch side runs ~15 s/step on CPU, so use few
+    # steps at small batch.
+    "imagenet224": dict(dim=512, levels=6, image_size=224, patch_size=14),
+}
+
+
+def main(steps: int, batch: int, out_path: str, config: str = "cifar10"):
     import jax
     import jax.numpy as jnp
     import optax
@@ -45,7 +59,7 @@ def main(steps: int, batch: int, out_path: str):
     from glom_tpu.utils.config import GlomConfig
     from glom_tpu.utils.metrics import detect_chip
 
-    cfg = GlomConfig(dim=256, levels=5, image_size=32, patch_size=4)
+    cfg = GlomConfig(**CONFIGS[config])
     lr, noise_std = 3e-4, 0.5
     chip = detect_chip()
 
@@ -109,7 +123,7 @@ def main(steps: int, batch: int, out_path: str):
             f.write(json.dumps(rec) + "\n")
         summary = {
             "summary": True,
-            "config": "cifar10-scale (BASELINE config 2)",
+            "config": config,
             "steps": steps,
             "batch": batch,
             "chip": chip,
@@ -133,6 +147,14 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=100)
     ap.add_argument("--batch", type=int, default=8)
-    ap.add_argument("--out", default="results/loss_parity_torch.jsonl")
+    ap.add_argument("--config", choices=sorted(CONFIGS), default="cifar10")
+    # Default output varies with config so an imagenet224 run cannot
+    # silently clobber the committed cifar10 artifact.
+    ap.add_argument("--out", default=None)
     args = ap.parse_args()
-    main(args.steps, args.batch, args.out)
+    out = args.out or (
+        "results/loss_parity_torch.jsonl"
+        if args.config == "cifar10"
+        else f"results/loss_parity_torch_{args.config}.jsonl"
+    )
+    main(args.steps, args.batch, out, args.config)
