@@ -10,16 +10,17 @@ Grid layout: (G, M_tiles) with the m axis innermost, so each group's weight
 pair stays resident in VMEM across all of its row tiles (revisits cost
 nothing; the next group triggers one weight DMA).
 
-Backward: custom_vjp with its own Pallas kernel. Only x and params are
-saved; the kernel recomputes the pre-activation in VMEM and emits dx plus
-the dpre/h tensors (compute dtype) that the four weight/bias grads then
-contract against as clean batched XLA matmuls. Profiling of the plain XLA
-backward showed why this matters: XLA materializes the [G, M, f] hidden
-chain in float32 HBM (HBM-bound at ~125 GF/s) and fuses the scan-residual
-dynamic-slices + grad-accumulation selects INTO the dw matmuls, dropping
-them to ~64 GF/s (33% MFU). The fused path keeps the chain VMEM-resident
-and hands XLA clean operands: train-step throughput 1955 -> 2769
-column-iters/s on v5e (37.6% -> 53.2% fwd+bwd MFU).
+Backward: custom_vjp over ONE fully-fused Pallas kernel that emits dx and
+accumulates all four weight/bias grads in-kernel (f32 accumulators on
+constant-index output blocks across the inner m grid axis). On the bf16
+training path the forward also saves the pre-activation so the backward
+skips its recompute matmul (4 matmuls/tile; f32 keeps the 5-matmul
+recompute form — see _fwd for the measured trade and
+results/profiles/PROFILE.md for the history: the plain-XLA backward ran
+the dw matmuls at 33% MFU off scan-residual fusions, the two-stage
+kernel+einsum design fixed that, and folding dw/db+save-pre in-kernel
+removed the [G, M, f] round trips entirely; 1955 -> ~3470
+column-iters/s on v5e across those generations).
 
 Falls back to the XLA einsum path (ops/ffw.py) off-TPU, under interpret
 testing, and for shapes that don't tile cleanly.
@@ -84,7 +85,7 @@ def _gelu_exact(x):
     return _gelu_value_and_grad(x, tanh_approx=False)[0]
 
 
-def _mlp_kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, out_ref):
+def _mlp_kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, out_ref, *pre_ref):
     """One (group, row-tile) program: [TM, d] -> [TM, d] through the f-wide
     hidden layer entirely in VMEM.
 
@@ -94,14 +95,20 @@ def _mlp_kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, out_ref):
     of the whole kernel on the VPU (measured 156 -> 179 TF/s). Float32
     compute keeps the exact erf so the f32 path stays bit-comparable to
     the reference contract.
+
+    When a trailing `pre_ref` output is present (the training forward under
+    custom_vjp), the pre-activation is also emitted (compute dtype) so the
+    backward kernel can skip its recompute matmul — see _fwd for the trade.
     """
     x = x_ref[0]  # [TM, d]
-    h = jnp.dot(x, w1_ref[0], preferred_element_type=jnp.float32)
-    h = h + b1_ref[0].astype(jnp.float32)  # b1_ref[0]: [1, f], broadcasts
+    pre = jnp.dot(x, w1_ref[0], preferred_element_type=jnp.float32)
+    pre = pre + b1_ref[0].astype(jnp.float32)  # b1_ref[0]: [1, f], broadcasts
+    if pre_ref:
+        pre_ref[0][0] = pre.astype(x.dtype)
     if x.dtype == jnp.bfloat16:
-        h = jax.nn.gelu(h, approximate=True)
+        h = jax.nn.gelu(pre, approximate=True)
     else:
-        h = _gelu_exact(h)
+        h = _gelu_exact(pre)
     h = h.astype(x.dtype)
     out = jnp.dot(h, w2_ref[0], preferred_element_type=jnp.float32)
     out = out + b2_ref[0].astype(jnp.float32)
@@ -109,18 +116,30 @@ def _mlp_kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, out_ref):
 
 
 def _fused_forward(
-    params: GroupedFFWParams, x: jnp.ndarray, *, tile_m: int, interpret: bool
-) -> jnp.ndarray:
+    params: GroupedFFWParams,
+    x: jnp.ndarray,
+    *,
+    tile_m: int,
+    interpret: bool,
+    save_pre: bool = False,
+):
     """x: [G, M, d] -> [G, M, d] (group-major so every block keeps the
-    tile-aligned [TM, d] trailing dims the TPU lowering requires)."""
+    tile-aligned [TM, d] trailing dims the TPU lowering requires).
+    save_pre=True additionally returns the [G, M, f] pre-activation
+    (compute dtype) for the backward."""
     G, M, d = x.shape
     f = params.w1.shape[-1]
     # m innermost: each group's weight pair stays VMEM-resident across all
     # of its row tiles.
     grid = (G, M // tile_m)
+    out_shape = jax.ShapeDtypeStruct((G, M, d), x.dtype)
+    out_spec = pl.BlockSpec((1, tile_m, d), lambda g, m: (g, m, 0))
+    if save_pre:
+        out_shape = (out_shape, jax.ShapeDtypeStruct((G, M, f), x.dtype))
+        out_spec = (out_spec, pl.BlockSpec((1, tile_m, f), lambda g, m: (g, m, 0)))
     return pl.pallas_call(
         _mlp_kernel,
-        out_shape=jax.ShapeDtypeStruct((G, M, d), x.dtype),
+        out_shape=out_shape,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, tile_m, d), lambda g, m: (g, m, 0)),  # x
@@ -131,7 +150,8 @@ def _fused_forward(
             pl.BlockSpec((1, f, d), lambda g, m: (g, 0, 0)),  # w2
             pl.BlockSpec((1, 1, d), lambda g, m: (g, 0, 0)),  # b2
         ],
-        out_specs=pl.BlockSpec((1, tile_m, d), lambda g, m: (g, m, 0)),
+        out_specs=out_spec,
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=32 * 1024 * 1024),
         interpret=interpret,
     )(x, params.w1, params.b1[:, None, :], params.w2, params.b2[:, None, :])
 
@@ -232,6 +252,65 @@ def _mlp_bwd_kernel(
         db2_ref[0] += db2_step
 
 
+def _mlp_bwd_kernel_saved(
+    x_ref,      # [1, TM, d]
+    w1_ref,     # [1, d, f]
+    pre_ref,    # [1, TM, f]   pre-activation SAVED by the forward (compute
+                #              dtype) — replaces the recompute matmul
+    w2_ref,     # [1, f, d]
+    g_ref,      # [1, TM, d]
+    dx_ref,     # [1, TM, d]
+    dw1_ref,    # [1, d, f]    f32 accumulators, as in _mlp_bwd_kernel
+    db1_ref,    # [1, 1, f]
+    dw2_ref,    # [1, f, d]
+    db2_ref,    # [1, 1, d]
+):
+    """_mlp_bwd_kernel minus the pre-activation recompute: 4 matmuls per
+    tile instead of 5. Used on the bf16 path where the forward saved pre
+    (see _fwd for the measured trade); the GELU value/derivative are
+    re-derived from the SAVED (rounded-to-bf16) pre, which differs from
+    the recompute path by at most one bf16 ulp of pre — inside the bf16
+    training tolerance."""
+    f32 = jnp.float32
+    m = pl.program_id(1)
+    x = x_ref[0]
+    g = g_ref[0]
+    w1 = w1_ref[0]
+    w2 = w2_ref[0]
+
+    pre = pre_ref[0].astype(f32)
+    h32, dact = _gelu_value_and_grad(pre, tanh_approx=x.dtype == jnp.bfloat16)
+    h = h32.astype(x.dtype)
+
+    dh = jax.lax.dot_general(g, w2, (((1,), (1,)), ((), ())), preferred_element_type=f32)
+    dpre = (dh * dact).astype(x.dtype)
+    dx = jax.lax.dot_general(dpre, w1, (((1,), (1,)), ((), ())), preferred_element_type=f32)
+    dx_ref[0] = dx.astype(dx_ref.dtype)
+
+    dw1_step = jax.lax.dot_general(
+        x, dpre, (((0,), (0,)), ((), ())), preferred_element_type=f32
+    )
+    dw2_step = jax.lax.dot_general(
+        h, g, (((0,), (0,)), ((), ())), preferred_element_type=f32
+    )
+    db1_step = jnp.sum(dpre.astype(f32), axis=0, keepdims=True)
+    db2_step = jnp.sum(g.astype(f32), axis=0, keepdims=True)
+
+    @pl.when(m == 0)
+    def _init():
+        dw1_ref[0] = dw1_step
+        db1_ref[0] = db1_step
+        dw2_ref[0] = dw2_step
+        db2_ref[0] = db2_step
+
+    @pl.when(m != 0)
+    def _accum():
+        dw1_ref[0] += dw1_step
+        db1_ref[0] += db1_step
+        dw2_ref[0] += dw2_step
+        db2_ref[0] += db2_step
+
+
 # Larger row tiles give the in-kernel dw matmuls a longer contraction axis;
 # the raised vmem_limit_bytes scope makes them fit.
 # 512 measured best on v5e at the flagship config (3227 col-iters/s vs 2907
@@ -247,7 +326,7 @@ def _pick_bwd_tile(M: int) -> int | None:
     return None
 
 
-def _fused_backward(params, x, g, *, tile_m: int, interpret: bool):
+def _fused_backward(params, x, g, *, tile_m: int, interpret: bool, pre=None):
     G, M, d = x.shape
     f = params.w1.shape[-1]
     f32 = jnp.float32
@@ -259,14 +338,22 @@ def _fused_backward(params, x, g, *, tile_m: int, interpret: bool):
         jax.ShapeDtypeStruct((G, f, d), f32),  # dw2
         jax.ShapeDtypeStruct((G, 1, d), f32),  # db2
     )
+    if pre is not None:
+        kernel = _mlp_bwd_kernel_saved
+        second_in = pre
+        second_spec = pl.BlockSpec((1, tile_m, f), lambda gi, m: (gi, m, 0))
+    else:
+        kernel = _mlp_bwd_kernel
+        second_in = params.b1[:, None, :]
+        second_spec = pl.BlockSpec((1, 1, f), lambda gi, m: (gi, 0, 0))
     dx, dw1, db1, dw2, db2 = pl.pallas_call(
-        _mlp_bwd_kernel,
+        kernel,
         out_shape=out_shapes,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, tile_m, d), lambda gi, m: (gi, m, 0)),  # x
             pl.BlockSpec((1, d, f), lambda gi, m: (gi, 0, 0)),  # w1
-            pl.BlockSpec((1, 1, f), lambda gi, m: (gi, 0, 0)),  # b1
+            second_spec,  # b1 (recompute) or saved pre
             pl.BlockSpec((1, f, d), lambda gi, m: (gi, 0, 0)),  # w2
             pl.BlockSpec((1, tile_m, d), lambda gi, m: (gi, m, 0)),  # g
         ],
@@ -284,7 +371,7 @@ def _fused_backward(params, x, g, *, tile_m: int, interpret: bool):
         # contraction efficiency).
         compiler_params=pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024),
         interpret=interpret,
-    )(x, params.w1, params.b1[:, None, :], params.w2, g)
+    )(x, params.w1, second_in, params.w2, g)
 
     w1, b1, w2, b2 = params
     grads = GroupedFFWParams(
@@ -348,14 +435,27 @@ def _fused_lm(params, x, tile_m, interpret):
 
 
 def _fwd(params, x, tile_m, interpret):
-    return _fused_lm(params, x, tile_m, interpret), (params, x)
+    # bf16 training: ALSO save the pre-activation so the backward kernel
+    # drops its recompute matmul (5 -> 4 per tile). The [G, M, f] bf16
+    # round trip (~1.7 ms/step at the flagship config) costs less than the
+    # ~3.5 ms of MXU recompute it replaces — the opposite verdict from the
+    # PRE-merged-kernel measurement in results/profiles/PROFILE.md, because
+    # back then the backward also emitted dpre/h and the extra output
+    # overflowed VMEM at useful tiles. f32 keeps the recompute (saving f32
+    # pre doubles the traffic and f32 runs are parity/testing paths).
+    if x.dtype == jnp.bfloat16 and _pick_bwd_tile(x.shape[1]) is not None:
+        out, pre = _fused_forward(
+            params, x, tile_m=tile_m, interpret=interpret, save_pre=True
+        )
+        return out, (params, x, pre)
+    return _fused_lm(params, x, tile_m, interpret), (params, x, None)
 
 
 def _bwd(tile_m, interpret, res, g):
-    params, x = res  # x: [G, M, d]
+    params, x, pre = res  # x: [G, M, d]
     bt = _pick_bwd_tile(x.shape[1])
     if bt is not None:
-        return _fused_backward(params, x, g, tile_m=bt, interpret=interpret)
+        return _fused_backward(params, x, g, tile_m=bt, interpret=interpret, pre=pre)
     # Inside a scan's backward, x arrives as a dynamic-slice of the stacked
     # residuals and the dw outputs feed the gradient-accumulation add; XLA
     # fuses both INTO the dw matmuls (select_add / slice fusions), dropping

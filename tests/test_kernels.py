@@ -129,16 +129,28 @@ class TestFusedGroupedFFW:
 
         params, _ = setup
         pb = jax.tree_util.tree_map(lambda t: t.astype(jnp.bfloat16), params)
-        # level-major [G, M, d]: M=256 takes the fused kernel, M=192 the
-        # XLA fallback
-        for shape in [(4, 256, 128), (4, 192, 128)]:
+        f = pb.w1.shape[-1]
+        # level-major [G, M, d]: M=256 takes the fused kernel (with and
+        # without a saved pre-activation), M=192 the XLA fallback
+        for shape, with_pre in [
+            ((4, 256, 128), False),
+            ((4, 256, 128), True),
+            ((4, 192, 128), False),
+        ]:
             x = jnp.zeros(shape, jnp.bfloat16)
             g = jnp.zeros_like(x)
+            pre = jnp.zeros((shape[0], shape[1], f), jnp.bfloat16) if with_pre else None
             jaxpr = jax.make_jaxpr(
-                lambda p, x_, g_: _bwd(64, False, (p, x_), g_)
+                lambda p, x_, g_: _bwd(64, False, (p, x_, pre), g_)
             )(pb, x, g)
             dots = list(all_dots(jaxpr.jaxpr))
-            assert len(dots) >= 5, "backward lost its contractions?"
+            # saved-pre kernel drops the recompute contraction (5 -> 4);
+            # exact counts so a silent fall-back to the recompute kernel
+            # (or a lost contraction) both fail
+            if shape[1] == 256:
+                assert len(dots) == (4 if with_pre else 5), len(dots)
+            else:  # XLA fallback path
+                assert len(dots) >= 5, "backward lost its contractions?"
             for e in dots:
                 assert e.params["preferred_element_type"] == jnp.float32
 
