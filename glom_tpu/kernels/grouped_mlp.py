@@ -151,12 +151,22 @@ def _fused_forward(
             pl.BlockSpec((1, 1, d), lambda g, m: (g, 0, 0)),  # b2
         ],
         out_specs=out_spec,
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=32 * 1024 * 1024),
+        # Only the save_pre variant (training fwd) carries the extra
+        # [TM, f] output block that can overflow Mosaic's default 16MB
+        # scope; the inference forward keeps the default budget.
+        compiler_params=(
+            pltpu.CompilerParams(vmem_limit_bytes=32 * 1024 * 1024)
+            if save_pre
+            else None
+        ),
         interpret=interpret,
     )(x, params.w1, params.b1[:, None, :], params.w2, params.b2[:, None, :])
 
 
-TILE_CANDIDATES = (512, 256, 128)  # 1024 overflows the 16MB VMEM budget in-scan
+# Forward row tiles. 1024 overflowed the default scope in-scan when this was
+# tuned and 512 remains the measured sweet spot; the save_pre variant raises
+# vmem_limit_bytes for its extra output block, not to admit bigger tiles.
+TILE_CANDIDATES = (512, 256, 128)
 
 
 def _pick_tile(M: int) -> int | None:
@@ -208,14 +218,22 @@ def _mlp_bwd_kernel(
     GELU derivative matches the forward's per-dtype choice: tanh-GELU in
     bfloat16 (the fwd kernel's bf16 activation), exact erf in float32.
     """
+    pre = jnp.dot(
+        x_ref[0], w1_ref[0], preferred_element_type=jnp.float32
+    ) + b1_ref[0].astype(jnp.float32)
+    _mlp_bwd_tail(
+        pre, x_ref[0], g_ref[0], w1_ref[0], w2_ref[0],
+        dx_ref, dw1_ref, db1_ref, dw2_ref, db2_ref,
+    )
+
+
+def _mlp_bwd_tail(pre, x, g, w1, w2, dx_ref, dw1_ref, db1_ref, dw2_ref, db2_ref):
+    """Shared tail of both backward kernels (recompute and saved-pre): the
+    dh/dx matmuls, the in-kernel dw/db accumulation, and the init/accum
+    revisit logic. `pre` is f32 however the caller obtained it."""
     f32 = jnp.float32
     m = pl.program_id(1)
-    x = x_ref[0]  # [TM, d]
-    g = g_ref[0]  # [TM, d]
-    w1 = w1_ref[0]
-    w2 = w2_ref[0]
 
-    pre = jnp.dot(x, w1, preferred_element_type=f32) + b1_ref[0].astype(f32)
     h32, dact = _gelu_value_and_grad(pre, tanh_approx=x.dtype == jnp.bfloat16)
     h = h32.astype(x.dtype)
 
@@ -271,44 +289,10 @@ def _mlp_bwd_kernel_saved(
     re-derived from the SAVED (rounded-to-bf16) pre, which differs from
     the recompute path by at most one bf16 ulp of pre — inside the bf16
     training tolerance."""
-    f32 = jnp.float32
-    m = pl.program_id(1)
-    x = x_ref[0]
-    g = g_ref[0]
-    w1 = w1_ref[0]
-    w2 = w2_ref[0]
-
-    pre = pre_ref[0].astype(f32)
-    h32, dact = _gelu_value_and_grad(pre, tanh_approx=x.dtype == jnp.bfloat16)
-    h = h32.astype(x.dtype)
-
-    dh = jax.lax.dot_general(g, w2, (((1,), (1,)), ((), ())), preferred_element_type=f32)
-    dpre = (dh * dact).astype(x.dtype)
-    dx = jax.lax.dot_general(dpre, w1, (((1,), (1,)), ((), ())), preferred_element_type=f32)
-    dx_ref[0] = dx.astype(dx_ref.dtype)
-
-    dw1_step = jax.lax.dot_general(
-        x, dpre, (((0,), (0,)), ((), ())), preferred_element_type=f32
+    _mlp_bwd_tail(
+        pre_ref[0].astype(jnp.float32), x_ref[0], g_ref[0], w1_ref[0], w2_ref[0],
+        dx_ref, dw1_ref, db1_ref, dw2_ref, db2_ref,
     )
-    dw2_step = jax.lax.dot_general(
-        h, g, (((0,), (0,)), ((), ())), preferred_element_type=f32
-    )
-    db1_step = jnp.sum(dpre.astype(f32), axis=0, keepdims=True)
-    db2_step = jnp.sum(g.astype(f32), axis=0, keepdims=True)
-
-    @pl.when(m == 0)
-    def _init():
-        dw1_ref[0] = dw1_step
-        db1_ref[0] = db1_step
-        dw2_ref[0] = dw2_step
-        db2_ref[0] = db2_step
-
-    @pl.when(m != 0)
-    def _accum():
-        dw1_ref[0] += dw1_step
-        db1_ref[0] += db1_step
-        dw2_ref[0] += dw2_step
-        db2_ref[0] += db2_step
 
 
 # Larger row tiles give the in-kernel dw matmuls a longer contraction axis;

@@ -156,6 +156,36 @@ def check_train():
     assert losses[-1] < losses[0], losses
 
 
+@check("train_step_bf16_pallas_vs_xla_trajectory")
+def check_train_cross_path():
+    """The production (Pallas, level-major, save-pre backward) train step
+    and the plain-XLA step must produce closely tracking bf16 loss
+    trajectories from identical state/data/noise — a whole-step cross-path
+    guard the CPU suite cannot run (no real bf16 dots there)."""
+    from glom_tpu.train.trainer import create_train_state, make_train_step
+    from glom_tpu.utils.config import GlomConfig, TrainConfig
+
+    cfg = GlomConfig(dim=256, levels=4, image_size=64, patch_size=8)
+    img = jax.random.normal(jax.random.PRNGKey(1), (8, 3, 64, 64), jnp.float32)
+
+    def run(use_pallas):
+        tcfg = TrainConfig(batch_size=8, learning_rate=3e-4,
+                           compute_dtype="bfloat16", use_pallas=use_pallas,
+                           scan_unroll=use_pallas)
+        state, optimizer = create_train_state(jax.random.PRNGKey(0), cfg, tcfg)
+        step = jax.jit(make_train_step(cfg, tcfg, optimizer))
+        losses = []
+        for i in range(6):
+            state, m = step(state, img, jax.random.fold_in(jax.random.PRNGKey(2), i))
+            losses.append(float(m["loss"]))
+        return losses
+
+    lp, lx = run(True), run(False)
+    assert all(np.isfinite(lp)) and all(np.isfinite(lx)), (lp, lx)
+    worst = max(abs(a - b) / max(abs(b), 1e-9) for a, b in zip(lp, lx))
+    assert worst < 5e-2, (worst, lp, lx)
+
+
 def main():
     dev = jax.devices()[0]
     if dev.platform != "tpu":
@@ -165,7 +195,7 @@ def main():
         check_ffw_fwd, check_ffw_grad,
         check_cons_fwd_256, check_cons_fwd_1024,
         check_cons_grad_f32, check_cons_grad_bf16, check_cons_grad_bf16_r7,
-        check_train,
+        check_train, check_train_cross_path,
     ):
         fn()
     ok = all(r["ok"] for r in RESULTS)
