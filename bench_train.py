@@ -47,11 +47,16 @@ def bench_train_step():
     on_tpu = chip != "cpu"
     if on_tpu:
         cfg = GlomConfig(dim=512, levels=6, image_size=224, patch_size=14)
-        batch, repeats = 16, 6
-        # ~37 ms/step: k=36 gives ~1.3 s of device work per call, so the
+        # Batch 64 amortizes the batch-independent per-step work (adam,
+        # grad-norm, cross-iteration dw adds): 3348 / 3525 / 3642 / 3673
+        # col-iters/s at batch 8 / 16 / 32 / 64 with the current kernels.
+        # (An earlier batch-32 rejection predated scan_unroll + the merged
+        # backward — see results/profiles/PROFILE.md.)
+        batch, repeats = 64, 6
+        # ~122 ms/step: k=9 gives ~1.1 s of device work per call, so the
         # ~100 ms tunnel RTT (measured and subtracted) bounds the error
         # at ~2%.
-        k_chain = 36
+        k_chain = 9
     else:
         cfg = GlomConfig(dim=128, levels=4, image_size=32, patch_size=4)
         batch, repeats = 4, 2
