@@ -76,7 +76,8 @@ _register(
         description="CIFAR-10 32x32 p4 L5 d256 — self-supervised denoise train",
         model=GlomConfig(dim=256, levels=5, image_size=32, patch_size=4),
         train=TrainConfig(
-            batch_size=64, learning_rate=3e-4, noise_std=0.5, compute_dtype="bfloat16"
+            batch_size=64, learning_rate=3e-4, noise_std=0.5,
+            compute_dtype="bfloat16", use_pallas=True, scan_unroll=True,
         ),
         mesh=MeshConfig(),
     )
@@ -96,7 +97,8 @@ _register(
             dim=512, levels=6, image_size=64, patch_size=8, local_consensus_radius=7
         ),
         train=TrainConfig(
-            batch_size=64, learning_rate=3e-4, noise_std=0.5, compute_dtype="bfloat16"
+            batch_size=64, learning_rate=3e-4, noise_std=0.5,
+            compute_dtype="bfloat16", use_pallas=True, scan_unroll=True,
         ),
         mesh=MeshConfig(data=4, seq=2),
         sp_strategy="ring",
@@ -115,7 +117,8 @@ _register(
             dim=512, levels=6, image_size=256, patch_size=8, local_consensus_radius=7
         ),
         train=TrainConfig(
-            batch_size=32, learning_rate=3e-4, noise_std=0.5, compute_dtype="bfloat16"
+            batch_size=32, learning_rate=3e-4, noise_std=0.5,
+            compute_dtype="bfloat16", use_pallas=True, scan_unroll=True,
         ),
         mesh=MeshConfig(data=2, seq=4),
         sp_strategy="halo",
@@ -129,7 +132,8 @@ _register(
         description="ImageNet-224 p14 L6 d512 — DP over a v5e-8 slice",
         model=GlomConfig(dim=512, levels=6, image_size=224, patch_size=14),
         train=TrainConfig(
-            batch_size=64, learning_rate=3e-4, noise_std=0.5, compute_dtype="bfloat16"
+            batch_size=64, learning_rate=3e-4, noise_std=0.5,
+            compute_dtype="bfloat16", use_pallas=True, scan_unroll=True,
         ),
         mesh=MeshConfig(data=8),
     )
@@ -150,6 +154,11 @@ _register(
             learning_rate=3e-4,
             noise_std=0.5,
             compute_dtype="bfloat16",
+            # use_pallas stays off: the declared mesh carries a TP axis
+            # (model=2), where the kernels have no GSPMD partition rule and
+            # DistributedTrainer would strip the flag with a warning at the
+            # preset's own target topology. scan_unroll stays off: remat +
+            # unroll defeat each other.
             remat=True,
         ),
         mesh=MeshConfig(data=64, seq=2, model=2, num_slices=4),
