@@ -1,0 +1,93 @@
+"""Device prefetch for the input pipeline (SURVEY.md §5: the reference has
+no data subsystem at all — its README pulls tensors synchronously).
+
+On TPU the host->device batch transfer otherwise sits on the train step's
+critical path; staging the next batches from a background thread while the
+current step runs hides it entirely (the standard TPU input-pipeline
+pattern; jax transfers are thread-safe and async, so the worker only
+initiates DMAs — it never blocks on compute).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional
+
+import jax
+
+_END = object()
+
+
+def prefetch_to_device(
+    data: Iterator,
+    *,
+    size: int = 2,
+    sharding: Optional[jax.sharding.Sharding] = None,
+) -> Iterator:
+    """Wrap `data` so the next `size` batches are already on device (laid
+    out per `sharding` if given — pass the DistributedTrainer's batch
+    sharding to stage shards directly on their target devices) while the
+    consumer runs.
+
+    Validation and the worker thread start HERE, at the call — prefetching
+    begins immediately, and a bad `size` fails at the call site rather
+    than deep inside a training loop. Exceptions from `data` propagate to
+    the consumer at the point of the failed batch. Dropping the returned
+    iterator (the common case: `fit` pulls num_steps batches from an
+    infinite dataset and returns) signals the worker to stop and drains
+    the staged batches, so neither the thread nor the device buffers
+    outlive the consumer.
+    """
+    if size < 1:
+        raise ValueError(f"prefetch size must be >= 1, got {size}")
+    q: queue.Queue = queue.Queue(maxsize=size)
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        """Blocking put that aborts when the consumer is gone."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for batch in data:
+                staged = (
+                    jax.device_put(batch, sharding)
+                    if sharding is not None
+                    else jax.device_put(batch)
+                )
+                if not put(staged):
+                    return
+        except BaseException as e:  # noqa: BLE001 - relay to the consumer
+            put((_END, e))
+            return
+        put((_END, None))
+
+    threading.Thread(target=worker, daemon=True).start()
+
+    def gen():
+        try:
+            while True:
+                item = q.get()
+                if isinstance(item, tuple) and len(item) == 2 and item[0] is _END:
+                    if item[1] is not None:
+                        raise item[1]
+                    return
+                yield item
+        finally:
+            # Consumer done (exhausted, closed, or GC'd): unblock the
+            # worker and drop any staged device buffers promptly.
+            stop.set()
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+
+    return gen()

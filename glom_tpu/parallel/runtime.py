@@ -198,13 +198,30 @@ class DistributedTrainer:
 
     def step(self, batch: np.ndarray):
         # device_put on the host array shards directly host->devices in one
-        # transfer (no staging of the full batch on device 0 first).
+        # transfer (no staging of the full batch on device 0 first); a no-op
+        # when the batch was already staged by prefetch_to_device.
         batch = jax.device_put(batch, self.batch_sharding)
         self.rng, step_rng = jax.random.split(self.rng)
         self.state, metrics = self._step(self.state, batch, step_rng)
         return metrics
 
-    def fit(self, data: Iterator, num_steps: int, *, log_every: int = 10) -> list[dict]:
+    def fit(
+        self,
+        data: Iterator,
+        num_steps: int,
+        *,
+        log_every: int = 10,
+        prefetch: int = 0,
+    ) -> list[dict]:
+        """prefetch > 0 stages that many upcoming batches SHARDED on their
+        target devices from a background thread (the step's device_put then
+        sees already-committed shards and is a no-op)."""
+        if prefetch > 0:
+            from glom_tpu.data import prefetch_to_device
+
+            data = prefetch_to_device(
+                data, size=prefetch, sharding=self.batch_sharding
+            )
         return fit_loop(
             self.step,
             data,

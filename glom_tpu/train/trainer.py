@@ -160,8 +160,15 @@ class Trainer:
         num_steps: int,
         *,
         log_every: int = 10,
+        prefetch: int = 0,
     ) -> list[dict]:
-        """Run `num_steps` updates pulling [b, c, H, W] batches from `data`."""
+        """Run `num_steps` updates pulling [b, c, H, W] batches from `data`.
+        prefetch > 0 stages that many upcoming batches on device from a
+        background thread (hides the host->device transfer)."""
+        if prefetch > 0:
+            from glom_tpu.data import prefetch_to_device
+
+            data = prefetch_to_device(data, size=prefetch)
         return fit_loop(
             self.step,
             data,
