@@ -14,6 +14,13 @@ import sys
 import jax
 
 
+def _nonneg_int(s: str) -> int:
+    v = int(s)
+    if v < 0:
+        raise argparse.ArgumentTypeError(f"must be >= 0, got {v}")
+    return v
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="glom-tpu-train", description="Train GLOM (self-supervised denoising)"
@@ -25,6 +32,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--learning-rate", type=float, default=None)
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--data", choices=["shapes", "gaussian"], default="shapes")
+    p.add_argument(
+        "--prefetch", type=_nonneg_int, default=2, metavar="N",
+        help="stage N batches on device from a background thread (0 = off)",
+    )
     p.add_argument("--metrics-file", default=None, help="JSONL metrics path")
     p.add_argument(
         "--tensorboard", default=None, metavar="DIR",
@@ -128,6 +139,20 @@ def main(argv=None) -> int:
                 abstract_state=abstract_like(trainer.state)
             )
             print(f"resumed from step {start_step}", file=sys.stderr)
+
+    if args.prefetch > 0:
+        # Wrap ONCE, outside the checkpoint-span loop: a per-span wrap over
+        # the shared iterator would discard its staged batches at every
+        # span boundary (skewing the data stream vs a --prefetch 0 run)
+        # and race the dying worker against the next span's on the same
+        # generator. Negative values fail here, at the call site.
+        from glom_tpu.data import prefetch_to_device
+
+        data = prefetch_to_device(
+            data,
+            size=args.prefetch,
+            sharding=getattr(trainer, "batch_sharding", None),
+        )
 
     def run(steps):
         remaining = steps - start_step
