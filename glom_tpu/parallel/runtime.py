@@ -143,14 +143,16 @@ class DistributedTrainer:
         self.metrics_writer = metrics_writer
 
         # use_pallas routes through the fully-manual shard_map path (the
-        # kernels are per-device-legal there); TP still needs GSPMD, where
-        # the custom calls have no partitioning rule — fall back.
+        # kernels are per-device-legal there), including hidden-axis TP
+        # (Megatron psum hand-written in the manual body). Only the
+        # EP-style 'levels' TP stays GSPMD-only.
         self.use_manual = bool(tcfg.use_pallas)
-        if self.use_manual and not manual_supported(self.mesh):
+        if self.use_manual and not manual_supported(self.mesh, tp_axis):
             warnings.warn(
-                "use_pallas=True with a model-parallel mesh: the fused kernels "
-                "have no GSPMD partitioning rule for TP-sharded weights; "
-                "falling back to the GSPMD path without Pallas",
+                "use_pallas=True with tp_axis='levels': the manual fused path "
+                "implements hidden-axis TP only, and the fused kernels have no "
+                "GSPMD partitioning rule for TP-sharded weights; falling back "
+                "to the GSPMD path without Pallas",
                 stacklevel=2,
             )
             self.use_manual = False
@@ -215,7 +217,12 @@ class DistributedTrainer:
     ) -> list[dict]:
         """prefetch > 0 stages that many upcoming batches SHARDED on their
         target devices from a background thread (the step's device_put then
-        sees already-committed shards and is a no-op)."""
+        sees already-committed shards and is a no-op).
+
+        CAUTION: the wrap is PER CALL — repeated fit(prefetch=N) over one
+        shared iterator discards staged batches at every boundary; wrap
+        once with data.prefetch_to_device for that pattern (see
+        train/cli.py)."""
         if prefetch > 0:
             from glom_tpu.data import prefetch_to_device
 

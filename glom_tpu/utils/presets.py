@@ -25,15 +25,19 @@ class Preset:
     sp_strategy: str = "none"  # none | ring | ulysses | halo
 
     def scaled_to(self, num_devices: int) -> "Preset":
-        """Shrink the mesh to fit `num_devices` (keeps axis priorities:
-        data first, then seq, then model)."""
+        """Shrink the mesh to fit `num_devices`. Data parallelism is the
+        elastic axis — shrink it FIRST so the structurally interesting
+        axes (seq sharding, the TP hidden split) survive on small device
+        counts; a scaled-down pod preset still exercises its declared
+        data x seq x model composition. Divisibility is preserved: halving
+        an axis keeps batch % data == 0 and num_patches % seq == 0."""
         data, seq, model = self.mesh.data, self.mesh.seq, self.mesh.model
-        while data * seq * model > num_devices and model > 1:
-            model //= 2
-        while data * seq * model > num_devices and seq > 1:
-            seq //= 2
         while data * seq * model > num_devices and data > 1:
             data //= 2
+        while data * seq * model > num_devices and seq > 1:
+            seq //= 2
+        while data * seq * model > num_devices and model > 1:
+            model //= 2
         # A scaled-down mesh is a single-slice deployment (the virtual test
         # harness, or one real slice): the multi-slice DCN split only
         # describes the full-size topology, so collapse it when shrinking.
@@ -154,11 +158,12 @@ _register(
             learning_rate=3e-4,
             noise_std=0.5,
             compute_dtype="bfloat16",
-            # use_pallas stays off: the declared mesh carries a TP axis
-            # (model=2), where the kernels have no GSPMD partition rule and
-            # DistributedTrainer would strip the flag with a warning at the
-            # preset's own target topology. scan_unroll stays off: remat +
-            # unroll defeat each other.
+            # use_pallas rides the manual shard_map path, which composes the
+            # fused kernels with the declared data x seq x model mesh: the
+            # TP (model=2) hidden split is a hand-written Megatron psum in
+            # parallel/manual.py, per-rank f/mp = 2048 stays MXU-tileable.
+            # scan_unroll stays off: remat + unroll defeat each other.
+            use_pallas=True,
             remat=True,
         ),
         mesh=MeshConfig(data=64, seq=2, model=2, num_slices=4),

@@ -45,6 +45,8 @@ MESHES = [
     ("dp4", MeshConfig(data=4), "none"),
     ("dp2xsp2-ring", MeshConfig(data=2, seq=2), "ring"),
     ("sp4-ring", MeshConfig(seq=4), "ring"),
+    ("dp2xtp2", MeshConfig(data=2, model=2), "none"),
+    ("dp2xsp2xtp2-ring", MeshConfig(data=2, seq=2, model=2), "ring"),
 ]
 
 
@@ -64,6 +66,25 @@ def test_manual_grads_match_dense():
     single-device backward (the DP psum + SP collective transposes)."""
     mesh_cfg = MeshConfig(data=2, seq=2)
     mesh = make_mesh(mesh_cfg, jax.devices()[:4])
+    params = init_denoise(jax.random.PRNGKey(0), CFG)
+    img, noise = _data()
+    loss_fn = make_manual_loss(mesh, CFG, TCFG, sp_strategy="ring")
+    g_manual = jax.jit(jax.grad(loss_fn))(params, img, noise)
+    g_ref = jax.jit(jax.grad(_ref_loss))(params, img, noise)
+    flat_m, _ = jax.tree_util.tree_flatten(g_manual)
+    flat_r, _ = jax.tree_util.tree_flatten(g_ref)
+    for m, r in zip(flat_m, flat_r):
+        np.testing.assert_allclose(
+            np.asarray(m), np.asarray(r), rtol=2e-4, atol=1e-6
+        )
+
+
+def test_manual_tp_grads_match_dense():
+    """Hidden-axis TP in the manual region: the hand-written Megatron psum
+    plus the shard_map transpose must reproduce the single-device gradients
+    for every leaf — sharded FFW weights (local cotangents), replicated
+    embeddings (psum'd partials), and the 1/mp-scaled b2."""
+    mesh = make_mesh(MeshConfig(data=2, seq=2, model=2), jax.devices()[:8])
     params = init_denoise(jax.random.PRNGKey(0), CFG)
     img, noise = _data()
     loss_fn = make_manual_loss(mesh, CFG, TCFG, sp_strategy="ring")
@@ -126,16 +147,43 @@ def test_manual_train_step_matches_single_device():
     assert int(state2.step) == 1
 
 
-def test_tp_fallback_clears_use_pallas():
-    """TP mesh + use_pallas must fall back to GSPMD with the flag CLEARED —
-    otherwise glom_forward would emit Mosaic custom calls under TP-sharded
-    weights (unpartitionable; invisible on CPU where kernels fall back)."""
+def test_tp_hidden_uses_manual_path():
+    """Hidden-axis TP + use_pallas rides the manual shard_map path (round-2
+    VERDICT item 1: the pod preset must reach the fused kernels), and a
+    step's loss matches the single-device trainer."""
     from glom_tpu.parallel import DistributedTrainer
 
     tcfg = dataclasses.replace(TCFG, use_pallas=True, batch_size=4)
-    with pytest.warns(UserWarning, match="model-parallel"):
+    tr = DistributedTrainer(
+        CFG, tcfg, MeshConfig(data=2, model=2), sp_strategy="none"
+    )
+    assert tr.use_manual
+    assert tr.tcfg.use_pallas
+    img, _ = _data()
+    metrics = tr.step(np.asarray(img))
+
+    single = Trainer(CFG, dataclasses.replace(TCFG, batch_size=4))
+    ref_metrics = single.step(np.asarray(img))
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(ref_metrics["loss"]), rtol=1e-5
+    )
+
+
+def test_tp_levels_fallback_clears_use_pallas():
+    """EP-style 'levels' TP has no manual-region body: must fall back to
+    GSPMD with the flag CLEARED — otherwise glom_forward would emit Mosaic
+    custom calls under TP-sharded weights (unpartitionable; invisible on
+    CPU where kernels fall back)."""
+    from glom_tpu.parallel import DistributedTrainer
+
+    # levels=4: the EP-style spec shards bottom_up's group axis (G = L) over
+    # model=2, so L must divide.
+    cfg = dataclasses.replace(CFG, levels=4)
+    tcfg = dataclasses.replace(TCFG, use_pallas=True, batch_size=4)
+    with pytest.warns(UserWarning, match="levels"):
         tr = DistributedTrainer(
-            CFG, tcfg, MeshConfig(data=2, model=2), sp_strategy="none"
+            cfg, tcfg, MeshConfig(data=2, model=2), sp_strategy="none",
+            tp_axis="levels",
         )
     assert not tr.use_manual
     assert not tr.tcfg.use_pallas
@@ -151,4 +199,6 @@ def test_manual_supported_predicate():
     m_ok = make_mesh(MeshConfig(data=4), jax.devices()[:4])
     m_tp = make_mesh(MeshConfig(data=2, model=2), jax.devices()[:4])
     assert manual_supported(m_ok)
-    assert not manual_supported(m_tp)
+    assert manual_supported(m_tp)  # hidden-axis TP: manual Megatron psum
+    assert manual_supported(m_ok, "levels")  # model=1: nothing to shard
+    assert not manual_supported(m_tp, "levels")  # EP-style stays GSPMD

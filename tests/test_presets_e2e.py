@@ -103,11 +103,13 @@ class TestHybridMesh:
         pod = get_preset("imagenet224-pod")
         assert pod.mesh.num_slices == 4
         small = pod.scaled_to(8)
-        # model shrinks first, then seq: (64,2,2) -> (8,1,1) on 8 devices —
+        # DATA shrinks first (the elastic axis): (64,2,2) -> (2,2,2) on 8
+        # devices, preserving the declared seq x model composition so the
+        # scaled-down pod still exercises TP+SP with the fused kernels —
         # and a scaled-down mesh is a single-slice deployment, so the DCN
         # split must collapse (it would otherwise force the hybrid-mesh
         # path on a topology that has no 4-way slice factor).
-        assert small.mesh.shape == (8, 1, 1)
+        assert small.mesh.shape == (2, 2, 2)
         assert small.mesh.num_slices == 1
         # Unchanged size keeps the declared multi-slice layout.
         assert pod.scaled_to(256).mesh.num_slices == 4
