@@ -32,15 +32,37 @@ Layout: level-major [L, B, n, d] ("lm") — the batched-matmul-natural
 layout; glom_tpu.models.core keeps the scan carry in this layout so no
 transposes appear between kernels.
 
-Backward: custom_vjp over two more Pallas kernels (flash-attention-style,
-saving nothing but levels/bu/td): a dq pass that recomputes the row
-statistics and consensus online (for D = rowsum(dcons*cons)) and
-accumulates dq over the j-window, and a dkv pass gridded over j that
-accumulates dv and dk over the i-window and pushes dk through the
-row-local k-normalization VJP. The [n, n] matrix is never materialized in
-either direction, so long-context TRAINING is O(n) memory too; both
-passes skip dead tiles under the local-radius band. The linear mean part
-(d bu, d td, the direct levels term) is plain XLA glue in _fused_bwd.
+Backward: custom_vjp over two more Pallas kernels (flash-attention-style).
+The training forward additionally saves the per-row softmax statistics
+(m, l) — two [L, B, n, 1] f32 outputs, the flash-attention logsumexp
+residual trade — so NEITHER backward kernel re-derives them online:
+p_ij = exp(s_ij - m_i) / l_i directly, which makes both passes pure
+accumulations that stream k/v (resp. q/dcons) tiles through a WINDOWED
+INNER GRID AXIS with f32 VMEM scratch accumulators. No full [n, d] row
+ever sits resident in VMEM (the round-2 design's _BWD_ROW_LIMIT and its
+dense fallback past n=4096 are gone — any n streams at O(n) memory,
+double-buffered by the Mosaic pipeline).
+
+The dq pass avoids needing D = rowsum(dcons . cons) up front via the
+decomposition ds_ij = p_ij (dP_ij - D_i):
+
+    dq_i = scale * (A_i - D_i * B_i),  A = sum_j (p*dP)~ @ k,
+                                       B = sum_j p~ @ k,
+                                       D = sum_j rowsum(p*dP)
+
+(~ = diagonal zeroed when attend_self=False; D keeps the full sum) — one
+j-sweep, 4 matmuls per tile, emitting D as a byproduct for the dkv pass.
+The dkv pass accumulates dv_j and dk_j over the i-window, pushes dk
+through the row-local k-normalization VJP, and its epilogue folds the
+complete dlevels (dmean + dq + dv + dk-VJP) into one output write. Both
+passes skip dead tiles under the local-radius band: the inner grid axis
+is sized to the LIVE window (static arithmetic), with edge duplicates
+masked by pl.when.
+
+Dispatch: the dense-recompute VJP (one XLA fusion over the materialized
+[n, n] similarity) beats the blockwise kernels where n is small or the
+mask has no sparsity to skip — _fused_bwd picks by a measured crossover
+on (n, radius); see _use_blockwise_bwd for the table.
 """
 
 from __future__ import annotations
@@ -56,12 +78,6 @@ from glom_tpu.utils.helpers import TOKEN_ATTEND_SELF_VALUE
 
 _NEG_MAX = float(jnp.finfo(jnp.float32).min)
 
-# Max bytes of ONE full [n, d] levels row for the blockwise BACKWARD kernels
-# (the dkv pass holds two such rows resident in VMEM); beyond this the
-# custom VJP falls back to the dense recompute.
-_BWD_ROW_LIMIT = 4 * 1024 * 1024
-
-
 def _row_col(idx, side):
     """Patch-grid (row, col) coordinates of flat patch indices."""
     return idx // side, idx % side
@@ -73,7 +89,9 @@ def _consensus_update_kernel(
     bu_ref,     # [1, TB, TI, d] bottom-up contribution tile
     td_ref,     # [1, TB, TI, d] top-down tile (index-clamped at the top level)
     out_ref,    # [1, TB, TI, d]
-    *,
+    *stats_refs,  # training fwd: m_ref, l_ref [1, TB, TI, 1] f32 — the
+                #   flash-style softmax residuals the backward kernels
+                #   consume instead of recomputing the row statistics
     levels_count: int,
     side: int,
     radius: float,
@@ -147,6 +165,10 @@ def _consensus_update_kernel(
 
     m, l, acc = jax.lax.fori_loop(j_lo, j_hi, j_body, (m0, l0, acc0))
     cons = acc / l
+    if stats_refs:
+        m_ref, l_ref = stats_refs
+        m_ref[0] = m
+        l_ref[0] = l
 
     bu = bu_ref[0].astype(jnp.float32)
     td = td_ref[0].astype(jnp.float32)
@@ -190,7 +212,10 @@ def _forward(
     radius: float,
     attend_self: bool,
     interpret: bool,
-) -> jnp.ndarray:
+    save_stats: bool = False,
+):
+    """save_stats=True (the training forward under custom_vjp) also emits
+    the f32 row statistics (m, l) consumed by the backward kernels."""
     L, B, n, d = levels_lm.shape
     tile_i = _pick_tile(n)
     # Global consensus: a wider j-tile halves the online-softmax correction
@@ -211,9 +236,16 @@ def _forward(
         tile_j=tile_j,
         n=n,
     )
+    out_shape = jax.ShapeDtypeStruct((L, B, n, d), levels_lm.dtype)
+    out_spec = pl.BlockSpec((1, tile_b, tile_i, d), lambda g, b, i: (g, b, i, 0))
+    if save_stats:
+        stat_shape = jax.ShapeDtypeStruct((L, B, n, 1), jnp.float32)
+        stat_spec = pl.BlockSpec((1, tile_b, tile_i, 1), lambda g, b, i: (g, b, i, 0))
+        out_shape = (out_shape, stat_shape, stat_shape)
+        out_spec = (out_spec, stat_spec, stat_spec)
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((L, B, n, d), levels_lm.dtype),
+        out_shape=out_shape,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, tile_b, tile_i, d), lambda g, b, i: (g, b, i, 0)),  # x
@@ -225,7 +257,7 @@ def _forward(
                 lambda g, b, i, _L=L: (jnp.minimum(g, _L - 2), b, i, 0),
             ),
         ],
-        out_specs=pl.BlockSpec((1, tile_b, tile_i, d), lambda g, b, i: (g, b, i, 0)),
+        out_specs=out_spec,
         interpret=interpret,
     )(levels_lm, levels_lm, bu_lm, td_lm)
 
@@ -250,45 +282,93 @@ def _window(center_lo, extent, tile, n_tiles, side, radius):
     return jnp.maximum(lo // tile, 0), jnp.minimum(-(-hi // tile), n_tiles)
 
 
+def _win_lo_tile(t, tile_self, tile_other, side, radius):
+    """First live tile index on the opposite attention axis for tile `t`
+    (traced int32): flat indices interact only within (radius+1)*side."""
+    if radius <= 0:
+        return jnp.int32(0)
+    reach = int(radius + 1) * side
+    return jnp.maximum((t * tile_self - reach) // tile_other, 0)
+
+
+def _win_hi_tile(t, tile_self, tile_other, n_tiles, side, radius):
+    """One-past-last live tile index (traced int32)."""
+    if radius <= 0:
+        return jnp.int32(n_tiles)
+    reach = int(radius + 1) * side
+    return jnp.minimum(-(-(t * tile_self + tile_self + reach) // tile_other), n_tiles)
+
+
+def _win_len(tile_self, tile_other, n_tiles, side, radius) -> int:
+    """STATIC upper bound on live tiles per window — the size of the inner
+    streaming grid axis. Edge tiles whose (lo + w) lands past hi are DMA'd
+    clamped and masked off with pl.when."""
+    if radius <= 0:
+        return n_tiles
+    reach = int(radius + 1) * side
+    return min(n_tiles, (tile_self + 2 * reach) // tile_other + 2)
+
+
 def _consensus_bwd_dq_kernel(
-    x_ref,      # [1, TB, TI, d]  levels q tile
-    kv_ref,     # [1, TB, n, d]   full levels rows (k and v)
+    x_ref,      # [1, TB, TI, d]  levels q tile (resident across jw)
+    kv_ref,     # [1, TB, TJ, d]  STREAMED levels j-tile (k_j and v_j)
     dm_ref,     # [1, TB, TI, d]  RAW output-cotangent tile (compute dtype;
                 #                 the 4-vs-3 mean divisor is applied HERE,
                 #                 from the level grid index — feeding the
                 #                 kernel g directly avoids a separate
                 #                 divide+downcast HBM sweep in the caller)
-    dq_ref,     # [1, TB, TI, d]  f32
-    m_ref,      # [1, TB, TI, 1]  f32 row max (saved for the dkv kernel)
-    l_ref,      # [1, TB, TI, 1]  f32 row softmax denominator
-    dd_ref,     # [1, TB, TI, 1]  f32 D_i = sum_d dcons_i * cons_i
+    m_ref,      # [1, TB, TI, 1]  f32 row max SAVED BY THE FORWARD
+    l_ref,      # [1, TB, TI, 1]  f32 row softmax denominator (forward)
+    dq_ref,     # [1, TB, TI, d]  f32 out (written at the last jw step)
+    dd_ref,     # [1, TB, TI, 1]  f32 out: D_i = sum_j p_ij dP_ij,
+                #                 consumed by the dkv pass
+    a_acc,      # VMEM scratch [TB, TI, d] f32: sum_j (p*dP)~ @ k
+    b_acc,      # VMEM scratch [TB, TI, d] f32: sum_j p~ @ k
+    d_acc,      # VMEM scratch [TB, TI, 1] f32: running D
     *, side, radius, attend_self, tile_i, tile_j, n,
 ):
-    """Pass 1 of the blockwise consensus backward (flash-attention style,
-    adapted to GLOM: q = v = levels raw, k = normalize(levels), soft -5e-4
-    REPLACED diagonal, hard local mask). Nothing was saved by the forward
-    (the flash residual trade), so the first j-loop recomputes the row
-    statistics (m, l) and the consensus output (for D = rowsum(dcons*cons));
-    the second j-loop forms ds = p*(dP - D) and accumulates
-    dq_i = scale * sum_j ds_ij k_j. The [n, n] attention matrix is never
-    materialized — O(n) memory, same block-sparse j-window skipping as the
-    forward."""
+    """Pass 1 of the blockwise consensus backward: ONE streamed j-sweep.
+    With (m, l) saved by the forward, p_ij = exp(s_ij - m_i)/l_i directly,
+    and the D-before-ds ordering problem dissolves via
+
+        dq_i = scale * (A_i - D_i B_i),
+        A = sum_j (p*dP)~ @ k,  B = sum_j p~ @ k,  D = sum_j rowsum(p*dP)
+
+    (~ = diagonal zeroed when attend_self=False — the diagonal score was
+    REPLACED by a constant so no grad flows through it; D keeps the FULL
+    sum, since D_i = rowsum(dcons_i * cons_i) includes the diagonal's v).
+    The inner grid axis jw walks the live j-window (block sparsity under
+    the local-radius band is grid-level: dead tiles are never DMA'd);
+    accumulators persist in VMEM scratch across jw."""
     i = pl.program_id(2)
-    tb = x_ref.shape[1]
+    jw = pl.program_id(3)
+    num_jw = pl.num_programs(3)
+    # dcons = g / div: top level (last grid-0 index) averages 3. program_id
+    # must be read at kernel top level — inside a pl.when branch (a
+    # lax.cond) the interpret-mode substitution misses it.
+    div = jnp.where(pl.program_id(0) == pl.num_programs(0) - 1, 3.0, 4.0)
     d = x_ref.shape[-1]
     scale = d ** -0.5
     f32 = jnp.float32
+    n_tj = n // tile_j
 
-    x = x_ref[0]
-    # dcons = g / div: top level (last grid-0 index) averages 3 contributions
-    div = jnp.where(pl.program_id(0) == pl.num_programs(0) - 1, 3.0, 4.0)
-    dcons = dm_ref[0].astype(f32) / div
-    row_ids = i * tile_i + jax.lax.broadcasted_iota(jnp.int32, (tile_i, tile_j), 0)
-    ri, ci = _row_col(row_ids, side)
-    j_lo, j_hi = _window(i * tile_i, tile_i, tile_j, n // tile_j, side, radius)
+    @pl.when(jw == 0)
+    def _init():
+        a_acc[...] = jnp.zeros_like(a_acc)
+        b_acc[...] = jnp.zeros_like(b_acc)
+        d_acc[...] = jnp.zeros_like(d_acc)
 
-    def scores(j):
-        kv = kv_ref[0, :, pl.ds(j * tile_j, tile_j), :]
+    lo = _win_lo_tile(i, tile_i, tile_j, side, radius)
+    hi = _win_hi_tile(i, tile_i, tile_j, n_tj, side, radius)
+    j = lo + jw
+
+    @pl.when(j < hi)
+    def _step():
+        x = x_ref[0]
+        dcons = dm_ref[0].astype(f32) / div
+        m = m_ref[0]
+        l = l_ref[0]
+        kv = kv_ref[0]
         k = _normalized_k(kv)
         s = (
             jax.lax.dot_general(
@@ -297,107 +377,107 @@ def _consensus_bwd_dq_kernel(
             )
             * scale
         )
+        row_ids = i * tile_i + jax.lax.broadcasted_iota(
+            jnp.int32, (tile_i, tile_j), 0
+        )
         col_ids = j * tile_j + jax.lax.broadcasted_iota(
             jnp.int32, (tile_i, tile_j), 1
         )
         if not attend_self:
             s = jnp.where((row_ids == col_ids)[None], TOKEN_ATTEND_SELF_VALUE, s)
         if radius > 0:
+            ri, ci = _row_col(row_ids, side)
             rj, cj = _row_col(col_ids, side)
             dist2 = (ri - rj) ** 2 + (ci - cj) ** 2
             s = jnp.where(
                 (dist2.astype(f32) > radius * radius)[None], _NEG_MAX, s
             )
-        return s, k, kv, col_ids
-
-    def stat_body(j, carry):
-        m, l, acc = carry
-        s, _, kv, _ = scores(j)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        corr = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p.astype(x.dtype), kv, (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=f32,
-        )
-        return m_new, l_new, acc * corr + pv
-
-    m0 = jnp.full((tb, tile_i, 1), _NEG_MAX, f32)
-    l0 = jnp.zeros((tb, tile_i, 1), f32)
-    acc0 = jnp.zeros((tb, tile_i, d), f32)
-    m, l, acc = jax.lax.fori_loop(j_lo, j_hi, stat_body, (m0, l0, acc0))
-    cons = acc / l
-    dd = jnp.sum(dcons * cons, axis=-1, keepdims=True)  # [TB, TI, 1]
-
-    def dq_body(j, dq):
-        s, k, kv, col_ids = scores(j)
-        p = jnp.exp(s - m) / l  # normalized probabilities, f32
+        p = jnp.exp(s - m) / l  # [TB, TI, TJ] f32
         dp = jax.lax.dot_general(
             dcons.astype(x.dtype), kv, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=f32,
         )  # dP_ij = dcons_i . v_j
-        ds = p * (dp - dd)
+        t = p * dp
+        d_acc[...] += jnp.sum(t, axis=-1, keepdims=True)
         if not attend_self:
-            # the diagonal was REPLACED by a constant: no grad flows there
-            ds = jnp.where((row_ids == col_ids)[None], 0.0, ds)
-        dq_step = jax.lax.dot_general(
-            ds.astype(x.dtype), k, (((2,), (1,)), ((0,), (0,))),
+            diag = (row_ids == col_ids)[None]
+            t = jnp.where(diag, 0.0, t)
+            p = jnp.where(diag, 0.0, p)
+        a_acc[...] += jax.lax.dot_general(
+            t.astype(x.dtype), k, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=f32,
         )
-        return dq + dq_step
+        b_acc[...] += jax.lax.dot_general(
+            p.astype(x.dtype), k, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=f32,
+        )
 
-    dq = jax.lax.fori_loop(
-        j_lo, j_hi, dq_body, jnp.zeros((tb, tile_i, d), f32)
-    )
-    dq_ref[0] = dq * scale
-    m_ref[0] = m
-    l_ref[0] = l
-    dd_ref[0] = dd
+    @pl.when(jw == num_jw - 1)
+    def _final():
+        dd = d_acc[...]
+        dq_ref[0] = (a_acc[...] - dd * b_acc[...]) * scale
+        dd_ref[0] = dd
 
 
 def _consensus_bwd_dkv_kernel(
-    xj_ref,     # [1, TB, TJ, d]  levels j-tile (k_j, v_j live here)
-    q_ref,      # [1, TB, n, d]   full levels rows (queries)
-    dm_ref,     # [1, TB, n, d]   full RAW output-cotangent rows (compute
-                #                 dtype; the mean divisor is applied here,
-                #                 same trade as in the dq kernel)
-    dq_ref,     # [1, TB, TJ, d]  f32 dq tile from pass 1 (j-aligned)
-    m_ref,      # [1, TB, n, 1]   f32 stats from the dq kernel
-    l_ref,      # [1, TB, n, 1]
-    dd_ref,     # [1, TB, n, 1]
+    xj_ref,     # [1, TB, TJ, d]  levels j-tile (k_j, v_j; resident)
+    gj_ref,     # [1, TB, TJ, d]  RAW cotangent j-tile (resident; epilogue)
+    dqj_ref,    # [1, TB, TJ, d]  f32 dq tile from pass 1 (resident; epilogue)
+    q_ref,      # [1, TB, TI, d]  STREAMED levels i-tile (queries)
+    dm_ref,     # [1, TB, TI, d]  STREAMED raw cotangent i-tile (the mean
+                #                 divisor is applied here, as in the dq pass)
+    m_ref,      # [1, TB, TI, 1]  STREAMED f32 stats (forward / dq pass)
+    l_ref,      # [1, TB, TI, 1]
+    dd_ref,     # [1, TB, TI, 1]
     out_ref,    # [1, TB, TJ, d]  levels dtype: the COMPLETE dlevels tile
                 #                 (dmean + dq + dv + normalizeVJP(dk)) —
                 #                 folding the sum here removes the separate
                 #                 XLA add/convert HBM sweeps
+    dv_acc,     # VMEM scratch [TB, TJ, d] f32
+    dk_acc,     # VMEM scratch [TB, TJ, d] f32
     *, side, radius, attend_self, tile_i, tile_j, n,
 ):
-    """Pass 2: for each j-tile, loop the i-window and accumulate
-    dv_j = sum_i p_ij dcons_i and dk_j = scale * sum_i ds_ij q_i, push dk
-    through the k-normalization VJP (row-local), then finish dlevels in the
-    epilogue: out_j = g_j/div + dq_j + dv_j + dxn_j, downcast once."""
+    """Pass 2: for each j-tile, stream the live i-window (inner grid axis
+    iw) and accumulate dv_j = sum_i p_ij dcons_i and
+    dk_j = scale * sum_i ds_ij q_i in VMEM scratch; the last iw step pushes
+    dk through the row-local k-normalization VJP and finishes dlevels:
+    out_j = g_j/div + dq_j + dv_j + dxn_j, downcast once."""
     j = pl.program_id(2)
-    tb = xj_ref.shape[1]
+    iw = pl.program_id(3)
+    num_iw = pl.num_programs(3)
+    # program_id reads must stay at kernel top level (see the dq kernel).
+    inv_div = 1.0 / jnp.where(
+        pl.program_id(0) == pl.num_programs(0) - 1, 3.0, 4.0
+    )
     d = xj_ref.shape[-1]
     scale = d ** -0.5
     f32 = jnp.float32
+    n_ti = n // tile_i
 
-    xj = xj_ref[0]            # [TB, TJ, d] raw levels (v_j; k_j after norm)
-    k = _normalized_k(xj)
+    @pl.when(iw == 0)
+    def _init():
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+
+    lo = _win_lo_tile(j, tile_j, tile_i, side, radius)
+    hi = _win_hi_tile(j, tile_j, tile_i, n_ti, side, radius)
+    i = lo + iw
+
     # g / div applied via the LINEAR uses of dcons: dv and dP are both
     # linear in dcons, so the divide moves onto the accumulated dots.
-    inv_div = 1.0 / jnp.where(pl.program_id(0) == pl.num_programs(0) - 1, 3.0, 4.0)
-    col_ids = j * tile_j + jax.lax.broadcasted_iota(jnp.int32, (tile_j, tile_i), 0)
-    rj, cj = _row_col(col_ids, side)
-    i_lo, i_hi = _window(j * tile_j, tile_j, tile_i, n // tile_i, side, radius)
+    xj = xj_ref[0]            # [TB, TJ, d] raw levels (v_j; k_j after norm)
 
-    def i_body(i, carry):
-        dv, dk = carry
-        q = q_ref[0, :, pl.ds(i * tile_i, tile_i), :]        # [TB, TI, d]
-        dcons = dm_ref[0, :, pl.ds(i * tile_i, tile_i), :]   # [TB, TI, d]
-        m = m_ref[0, :, pl.ds(i * tile_i, tile_i), 0]        # [TB, TI]
-        l = l_ref[0, :, pl.ds(i * tile_i, tile_i), 0]
-        dd = dd_ref[0, :, pl.ds(i * tile_i, tile_i), 0]
+    @pl.when(i < hi)
+    def _step():
+        k = _normalized_k(xj)
+        col_ids = j * tile_j + jax.lax.broadcasted_iota(
+            jnp.int32, (tile_j, tile_i), 0
+        )
+        q = q_ref[0]              # [TB, TI, d]
+        dcons = dm_ref[0]         # [TB, TI, d] raw
+        m = m_ref[0][..., 0]      # [TB, TI]
+        l = l_ref[0][..., 0]
+        dd = dd_ref[0][..., 0]
 
         # s2[b, tj, ti] = s[i, j] transposed
         s2 = (
@@ -413,6 +493,7 @@ def _consensus_bwd_dkv_kernel(
         if not attend_self:
             s2 = jnp.where((col_ids == row_ids)[None], TOKEN_ATTEND_SELF_VALUE, s2)
         if radius > 0:
+            rj, cj = _row_col(col_ids, side)
             ri2, ci2 = _row_col(row_ids, side)
             dist2 = (rj - ri2) ** 2 + (cj - ci2) ** 2
             s2 = jnp.where(
@@ -421,7 +502,7 @@ def _consensus_bwd_dkv_kernel(
 
         p2 = jnp.exp(s2 - m[:, None, :]) / l[:, None, :]     # [TB, TJ, TI]
         p2c = p2.astype(xj.dtype)
-        dv_step = jax.lax.dot_general(
+        dv_acc[...] += jax.lax.dot_general(
             p2c, dcons, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=f32,
         )
@@ -435,118 +516,142 @@ def _consensus_bwd_dkv_kernel(
         ds2 = p2 * (dp2 - dd[:, None, :])
         if not attend_self:
             ds2 = jnp.where((col_ids == row_ids)[None], 0.0, ds2)
-        dk_step = jax.lax.dot_general(
+        dk_acc[...] += jax.lax.dot_general(
             ds2.astype(xj.dtype), q, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=f32,
         )
-        return dv + dv_step, dk + dk_step
 
-    dv0 = jnp.zeros((tb, tile_j, d), f32)
-    dk0 = jnp.zeros((tb, tile_j, d), f32)
-    dv, dk = jax.lax.fori_loop(i_lo, i_hi, i_body, (dv0, dk0))
-    dv = dv * inv_div  # dv accumulated against the RAW cotangent rows
-    dk = dk * scale
+    @pl.when(iw == num_iw - 1)
+    def _final():
+        dv = dv_acc[...] * inv_div  # accumulated against the RAW cotangents
+        dk = dk_acc[...] * scale
 
-    # k-normalization VJP (row-local): k = x / max(||x||, eps).
-    x32 = xj.astype(f32)
-    r = jnp.sqrt(jnp.sum(x32 * x32, axis=-1, keepdims=True))
-    inv = 1.0 / jnp.maximum(r, 1e-12)
-    a = jnp.sum(dk * x32, axis=-1, keepdims=True)
-    dxn = dk * inv - jnp.where(r >= 1e-12, a * x32 * inv * inv / r, 0.0)
-    # Epilogue: complete dlevels for this j-tile. dmean_j = g_j / div.
-    gj = dm_ref[0, :, pl.ds(j * tile_j, tile_j), :].astype(f32) * inv_div
-    out_ref[0] = (gj + dq_ref[0] + dv + dxn).astype(out_ref.dtype)
+        # k-normalization VJP (row-local): k = x / max(||x||, eps).
+        x32 = xj.astype(f32)
+        r = jnp.sqrt(jnp.sum(x32 * x32, axis=-1, keepdims=True))
+        inv = 1.0 / jnp.maximum(r, 1e-12)
+        a = jnp.sum(dk * x32, axis=-1, keepdims=True)
+        dxn = dk * inv - jnp.where(r >= 1e-12, a * x32 * inv * inv / r, 0.0)
+        # Epilogue: complete dlevels for this j-tile. dmean_j = g_j / div.
+        gj = gj_ref[0].astype(f32) * inv_div
+        out_ref[0] = (gj + dqj_ref[0] + dv + dxn).astype(out_ref.dtype)
 
 
 def _pick_tile_b_bwd(B: int, n: int, d: int, tile: int, itemsize: int) -> int:
-    """Batch tile for the BACKWARD kernels, whose working set is heavier
-    than the forward's: the dkv pass keeps TWO full-row operands resident
-    (q and the raw cotangent, levels dtype) plus an f32 dq input tile and
-    a levels-dtype out tile, and the dq pass one full-row operand plus the
-    f32 dq block — the forward's budget model undercounts that by ~2x in
-    the long-context regime."""
+    """Batch tile for the BACKWARD kernels. Nothing full-row is resident
+    any more (the i/j windows stream through the inner grid axis); the
+    working set is resident tiles (x/dm or xj/gj/dqj), one streamed tile
+    pair 2x-buffered, the f32 scratch accumulators, and the out block."""
     budget = 12 * 1024 * 1024
     for tb in (8, 4, 2, 1):
         if B % tb != 0:
             continue
-        full_rows = 2 * tb * n * d * itemsize          # q + dcons, resident
-        # in tiles (xj dtype + dq f32) + out tile (dtype), 2x buffered
-        tiles = tb * tile * d * (2 * itemsize + 4) * 2
-        stats = 3 * tb * n * 4
-        scratch = 2 * tb * tile * tile * 4 + 2 * tb * tile * d * 4  # s2/ds + dv/dk acc
-        if full_rows + tiles + stats + scratch <= budget:
+        resident = tb * tile * d * (2 * itemsize + 4)      # x/dm + f32 dqj
+        streamed = 2 * tb * tile * d * (itemsize + itemsize)  # q + dm tiles
+        scratch = 2 * tb * tile * d * 4 + tb * tile * 4    # A/B (or dv/dk) + D
+        sim = 2 * tb * tile * tile * 4                     # p / dp tiles
+        out = tb * tile * d * (4 + itemsize)
+        if resident + streamed + scratch + sim + out <= budget:
             return tb
     return 1
 
 
-def _consensus_update_bwd(levels_lm, g, *, side, radius, attend_self, interpret):
+def _consensus_update_bwd(
+    levels_lm, g, m, l, *, side, radius, attend_self, interpret
+):
     """Blockwise backward for the fused consensus+update: returns the
     COMPLETE d(levels) = dmean + dq + (dv + dk-through-normalization), in
     the levels dtype. `g` is the RAW output cotangent in the compute dtype
     — the 4-vs-3 mean divisor is applied inside the kernels from the level
     grid index, and the dkv pass's epilogue folds dmean + dq into its
     output, so neither a divided copy of g nor the f32 partial sums ever
-    make a separate HBM round trip."""
+    make a separate HBM round trip. (m, l) are the forward's saved row
+    statistics; both passes stream their opposite-axis tiles through a
+    windowed inner grid axis — O(n) VMEM at ANY n."""
     L, B, n, d = levels_lm.shape
-    # Rows here are guaranteed <= _BWD_ROW_LIMIT bytes (bigger shapes take
-    # _fused_bwd's dense fallback), so the default 256 tiles always fit.
     tile_i = _pick_tile(n)
     tile_j = _pick_tile(n)
     tile_b = _pick_tile_b_bwd(
         B, n, d, max(tile_i, tile_j), levels_lm.dtype.itemsize
     )
-    grid = (L, B // tile_b, n // tile_i)
+    n_ti, n_tj = n // tile_i, n // tile_j
     f32 = jnp.float32
+    graw = g.astype(levels_lm.dtype)
 
     kw = dict(
         side=side, radius=float(radius), attend_self=attend_self,
         tile_i=tile_i, tile_j=tile_j, n=n,
     )
-    dq, m_, l_, dd_ = pl.pallas_call(
+
+    def _i_spec(shape_last):
+        return pl.BlockSpec(
+            (1, tile_b, tile_i, shape_last), lambda g, b, i, jw: (g, b, i, 0)
+        )
+
+    def _kv_map(g, b, i, jw, _tj=n_tj):
+        lo = _win_lo_tile(i, tile_i, tile_j, side, radius)
+        return (g, b, jnp.minimum(lo + jw, _tj - 1), 0)
+
+    jw_len = _win_len(tile_i, tile_j, n_tj, side, radius)
+    dq, dd = pl.pallas_call(
         partial(_consensus_bwd_dq_kernel, **kw),
         out_shape=(
             jax.ShapeDtypeStruct((L, B, n, d), f32),
             jax.ShapeDtypeStruct((L, B, n, 1), f32),
-            jax.ShapeDtypeStruct((L, B, n, 1), f32),
-            jax.ShapeDtypeStruct((L, B, n, 1), f32),
         ),
-        grid=grid,
+        grid=(L, B // tile_b, n_ti, jw_len),
         in_specs=[
-            pl.BlockSpec((1, tile_b, tile_i, d), lambda g, b, i: (g, b, i, 0)),
-            pl.BlockSpec((1, tile_b, n, d), lambda g, b, i: (g, b, 0, 0)),
-            pl.BlockSpec((1, tile_b, tile_i, d), lambda g, b, i: (g, b, i, 0)),
+            _i_spec(d),  # x
+            pl.BlockSpec((1, tile_b, tile_j, d), _kv_map),  # streamed kv
+            _i_spec(d),  # dm (raw cotangent)
+            _i_spec(1),  # m
+            _i_spec(1),  # l
         ],
-        out_specs=(
-            pl.BlockSpec((1, tile_b, tile_i, d), lambda g, b, i: (g, b, i, 0)),
-            pl.BlockSpec((1, tile_b, tile_i, 1), lambda g, b, i: (g, b, i, 0)),
-            pl.BlockSpec((1, tile_b, tile_i, 1), lambda g, b, i: (g, b, i, 0)),
-            pl.BlockSpec((1, tile_b, tile_i, 1), lambda g, b, i: (g, b, i, 0)),
-        ),
-        # At the long-context limit (n=4096 rows, _BWD_ROW_LIMIT) the
-        # resident rows + tiles land just over Mosaic's default 16MB
-        # scoped-vmem budget; raise the scope (v5e has 128MB physical).
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=48 * 1024 * 1024),
+        out_specs=(_i_spec(d), _i_spec(1)),
+        scratch_shapes=[
+            pltpu.VMEM((tile_b, tile_i, d), f32),
+            pltpu.VMEM((tile_b, tile_i, d), f32),
+            pltpu.VMEM((tile_b, tile_i, 1), f32),
+        ],
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=32 * 1024 * 1024),
         interpret=interpret,
-    )(levels_lm, levels_lm, g.astype(levels_lm.dtype))
+    )(levels_lm, levels_lm, graw, m, l)
 
-    grid_j = (L, B // tile_b, n // tile_j)
+    def _j_spec(shape_last):
+        return pl.BlockSpec(
+            (1, tile_b, tile_j, shape_last), lambda g, b, j, iw: (g, b, j, 0)
+        )
+
+    def _q_map(g, b, j, iw, _ti=n_ti):
+        lo = _win_lo_tile(j, tile_j, tile_i, side, radius)
+        return (g, b, jnp.minimum(lo + iw, _ti - 1), 0)
+
+    def _qspec(shape_last):
+        return pl.BlockSpec((1, tile_b, tile_i, shape_last), _q_map)
+
+    iw_len = _win_len(tile_j, tile_i, n_ti, side, radius)
     dlv = pl.pallas_call(
         partial(_consensus_bwd_dkv_kernel, **kw),
         out_shape=jax.ShapeDtypeStruct((L, B, n, d), levels_lm.dtype),
-        grid=grid_j,
+        grid=(L, B // tile_b, n_tj, iw_len),
         in_specs=[
-            pl.BlockSpec((1, tile_b, tile_j, d), lambda g, b, j: (g, b, j, 0)),
-            pl.BlockSpec((1, tile_b, n, d), lambda g, b, j: (g, b, 0, 0)),
-            pl.BlockSpec((1, tile_b, n, d), lambda g, b, j: (g, b, 0, 0)),
-            pl.BlockSpec((1, tile_b, tile_j, d), lambda g, b, j: (g, b, j, 0)),
-            pl.BlockSpec((1, tile_b, n, 1), lambda g, b, j: (g, b, 0, 0)),
-            pl.BlockSpec((1, tile_b, n, 1), lambda g, b, j: (g, b, 0, 0)),
-            pl.BlockSpec((1, tile_b, n, 1), lambda g, b, j: (g, b, 0, 0)),
+            _j_spec(d),   # xj (resident)
+            _j_spec(d),   # gj (resident, epilogue)
+            _j_spec(d),   # dq j-tile (resident, epilogue)
+            _qspec(d),    # streamed q i-tile
+            _qspec(d),    # streamed dm i-tile
+            _qspec(1),    # m
+            _qspec(1),    # l
+            _qspec(1),    # dd
         ],
-        out_specs=pl.BlockSpec((1, tile_b, tile_j, d), lambda g, b, j: (g, b, j, 0)),
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=48 * 1024 * 1024),
+        out_specs=_j_spec(d),
+        scratch_shapes=[
+            pltpu.VMEM((tile_b, tile_j, d), f32),
+            pltpu.VMEM((tile_b, tile_j, d), f32),
+        ],
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=32 * 1024 * 1024),
         interpret=interpret,
-    )(levels_lm, levels_lm, g.astype(levels_lm.dtype), dq, m_, l_, dd_)
+    )(levels_lm, graw, dq, levels_lm, graw, m, l, dd)
 
     return dlv
 
@@ -576,40 +681,87 @@ def _xla_reference(levels_lm, bu_lm, td_lm, *, side, radius, attend_self):
     return new.astype(levels_lm.dtype)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _fused(levels_lm, bu_lm, td_lm, side, radius, attend_self, interpret):
+# Dense-recompute VJP sim-buffer cap: above this the [L, B, n, n] f32
+# materialization (twice: p and ds fusions) is an HBM-pressure hazard and
+# the blockwise kernels take over regardless of the speed crossover.
+_DENSE_SIM_LIMIT = 2 * 1024 * 1024 * 1024
+
+
+def _use_blockwise_bwd(levels_shape, side, radius, bwd_impl: str) -> bool:
+    """Measured (n, radius) crossover between the dense-recompute VJP and
+    the blockwise backward kernels (results/longctx_bench.jsonl):
+
+      * the dense VJP — one XLA fusion over the materialized [n, n]
+        similarity — wins for global consensus at every n that fits HBM
+        (it runs the same matmul count at full MXU rate, no tile logic);
+      * the blockwise kernels win when the local-radius band prunes most
+        of the row (its grid never visits dead tiles), and are the ONLY
+        option when the dense sim buffer would blow HBM (any n, since the
+        streaming rewrite removed the row-residency cap).
+
+    bwd_impl forces a side ('blockwise' / 'dense') for tests and benches.
+    """
+    L, B, n, d = levels_shape
+    if bwd_impl == "blockwise":
+        return True
+    if bwd_impl == "dense":
+        return False
+    if radius > 0:
+        reach = int(radius + 1) * side
+        live = min(n, 2 * reach + _pick_tile(n))
+        if 2 * live <= n:  # window covers <= half the row: sparsity pays
+            return True
+    return 2 * L * B * n * n * 4 > _DENSE_SIM_LIMIT
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _fused(levels_lm, bu_lm, td_lm, side, radius, attend_self, interpret,
+           bwd_impl="auto"):
     return _forward(
         levels_lm, bu_lm, td_lm,
         side=side, radius=radius, attend_self=attend_self, interpret=interpret,
     )
 
 
-def _fused_fwd(levels_lm, bu_lm, td_lm, side, radius, attend_self, interpret):
-    out = _fused(levels_lm, bu_lm, td_lm, side, radius, attend_self, interpret)
-    return out, (levels_lm, bu_lm, td_lm)
+def _fused_fwd(levels_lm, bu_lm, td_lm, side, radius, attend_self, interpret,
+               bwd_impl):
+    if _use_blockwise_bwd(levels_lm.shape, side, radius, bwd_impl):
+        # Training forward on the blockwise path saves (m, l) — the flash
+        # logsumexp residual trade that lets both backward kernels run a
+        # single streamed pass with no stat recompute. bu/td are NOT
+        # residuals: their cotangent is g/div, values never needed.
+        out, m, l = _forward(
+            levels_lm, bu_lm, td_lm,
+            side=side, radius=radius, attend_self=attend_self,
+            interpret=interpret, save_stats=True,
+        )
+        return out, (levels_lm, m, l)
+    out = _fused(
+        levels_lm, bu_lm, td_lm, side, radius, attend_self, interpret, bwd_impl
+    )
+    return out, (levels_lm, None, None)
 
 
-def _fused_bwd(side, radius, attend_self, interpret, res, g):
-    """Blockwise backward: the mean is linear (d bu = d td = dout/div) and
-    the attention part runs in the two Pallas kernels above — the [n, n]
-    matrix is never materialized in the backward either, so long-context
-    TRAINING keeps O(n) memory (the dense-recompute VJP this replaces
-    rebuilt the full similarity and undid that property)."""
+def _fused_bwd(side, radius, attend_self, interpret, bwd_impl, res, g):
+    """The mean is linear (d bu = d td = dout/div); the attention part runs
+    either in the streamed blockwise kernels (O(n) memory at any n) or
+    through the dense-recompute VJP where that measured faster — see
+    _use_blockwise_bwd."""
     from glom_tpu.models.core import contribution_divisor  # lazy: no cycle
 
-    levels_lm, bu_lm, td_lm = res
+    levels_lm, m, l = res
     L, B, n, d = levels_lm.shape
-    # The dkv pass keeps TWO full levels rows resident in VMEM; past
-    # _BWD_ROW_LIMIT per row (f32 at n=4096, bf16 at n=8192) the kernels
-    # cannot fit (measured: f32/n=4096 overflows scoped VMEM at every tile
-    # size) and the dense-recompute VJP — O(n^2) HBM but correct — takes
-    # over.
-    if n * d * levels_lm.dtype.itemsize > _BWD_ROW_LIMIT:
+    if m is None:
+        # Dense-recompute VJP. bu/td enter _xla_reference LINEARLY, so no
+        # cotangent depends on their values — zeros stand in and the saved
+        # residual set stays levels-only on this path too.
         _, vjp = jax.vjp(
             lambda lv, bu, td: _xla_reference(
                 lv, bu, td, side=side, radius=radius, attend_self=attend_self
             ),
-            levels_lm, bu_lm, td_lm,
+            levels_lm,
+            jnp.zeros_like(levels_lm),
+            jnp.zeros_like(levels_lm[: L - 1]),
         )
         return vjp(g)
     f32 = jnp.float32
@@ -618,11 +770,15 @@ def _fused_bwd(side, radius, attend_self, interpret, res, g):
     # the level grid index), and the dkv pass emits the COMPLETE dlv in the
     # levels dtype — no divided/partial-sum copies of g hit HBM.
     dlv = _consensus_update_bwd(
-        levels_lm, g,
+        levels_lm, g, m, l,
         side=side, radius=radius, attend_self=attend_self, interpret=interpret,
     )
     dmean = g.astype(f32) / div
-    return dlv, dmean.astype(bu_lm.dtype), dmean[: L - 1].astype(td_lm.dtype)
+    return (
+        dlv,
+        dmean.astype(levels_lm.dtype),
+        dmean[: L - 1].astype(levels_lm.dtype),
+    )
 
 
 _fused.defvjp(_fused_fwd, _fused_bwd)
@@ -637,12 +793,16 @@ def fused_consensus_update(
     radius: float = 0.0,
     attend_self: bool = False,
     interpret: bool = False,
+    bwd_impl: str = "auto",
 ) -> jnp.ndarray:
     """new_levels = (levels + bu + pad(td) + consensus(levels)) / div, fused.
 
     levels_lm: [L, B, n, d] level-major; bu_lm: [L, B, n, d];
     td_lm: [L-1, B, n, d] (top level's zero contribution is implicit).
     Returns [L, B, n, d]. Falls back to the XLA composition off-TPU.
+    bwd_impl: 'auto' dispatches the backward between the dense-recompute
+    VJP and the streamed blockwise kernels by the measured (n, radius)
+    crossover; 'blockwise'/'dense' force a side (tests, benches).
     """
     L, B, n, d = levels_lm.shape
     on_tpu = jax.devices()[0].platform == "tpu"
@@ -652,4 +812,6 @@ def fused_consensus_update(
             levels_lm, bu_lm, td_lm,
             side=side, radius=radius, attend_self=attend_self,
         )
-    return _fused(levels_lm, bu_lm, td_lm, side, radius, attend_self, interpret)
+    return _fused(
+        levels_lm, bu_lm, td_lm, side, radius, attend_self, interpret, bwd_impl
+    )

@@ -237,7 +237,8 @@ class TestFusedConsensusUpdate:
 
         def loss_fused(lv, b_, t_):
             out = fused_consensus_update(
-                lv, b_, t_, side=side, radius=2.0, interpret=True
+                lv, b_, t_, side=side, radius=2.0, interpret=True,
+                bwd_impl="blockwise",
             )
             return jnp.mean(out ** 2)
 
@@ -265,7 +266,7 @@ class TestFusedConsensusUpdate:
         levels, bu, td = self._rand(jax.random.PRNGKey(7), L, B, n, d)
 
         def loss_fused(lv, b_, t_):
-            out = _fused(lv, b_, t_, side, radius, False, True)
+            out = _fused(lv, b_, t_, side, radius, False, True, "blockwise")
             return jnp.mean(out ** 2)
 
         def loss_ref(lv, b_, t_):
@@ -280,6 +281,50 @@ class TestFusedConsensusUpdate:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5
             )
+
+    def test_grad_dense_dispatch_matches_blockwise(self):
+        """Both sides of the backward dispatch (dense-recompute VJP vs the
+        streamed blockwise kernels) must produce the same gradients; 'auto'
+        must agree with whichever side it picks."""
+        from glom_tpu.kernels.consensus_update import _fused
+
+        L, B, side, d = 2, 1, 8, 128
+        n = side * side
+        levels, bu, td = self._rand(jax.random.PRNGKey(11), L, B, n, d)
+
+        def grads(impl):
+            def loss(lv, b_, t_):
+                out = _fused(lv, b_, t_, side, 0.0, False, True, impl)
+                return jnp.mean(out ** 2)
+
+            return jax.grad(loss, argnums=(0, 1, 2))(levels, bu, td)
+
+        g_block, g_dense, g_auto = grads("blockwise"), grads("dense"), grads("auto")
+        for a, b, c in zip(g_block, g_dense, g_auto):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-5
+            )
+            np.testing.assert_allclose(
+                np.asarray(c), np.asarray(b), rtol=2e-3, atol=1e-5
+            )
+
+    def test_bwd_dispatch_predicate(self):
+        """The measured-crossover dispatch: global consensus stays dense
+        until the sim buffer would blow HBM; a truly-sparse local band goes
+        blockwise; forced sides are honored."""
+        from glom_tpu.kernels.consensus_update import _use_blockwise_bwd
+
+        # flagship: n=256, global -> dense
+        assert not _use_blockwise_bwd((6, 64, 256, 512), 16, 0.0, "auto")
+        # n=4096 global, small batch: sim fits -> dense (measured faster)
+        assert not _use_blockwise_bwd((6, 1, 4096, 512), 64, 0.0, "auto")
+        # n=4096, radius 7 on side 64: band covers <1/2 the row -> blockwise
+        assert _use_blockwise_bwd((6, 1, 4096, 512), 64, 7.0, "auto")
+        # n=16384 global (side 128): sim buffer 2*L*B*n^2*4 > 2GB -> blockwise
+        assert _use_blockwise_bwd((6, 1, 16384, 512), 128, 0.0, "auto")
+        # forced
+        assert _use_blockwise_bwd((6, 64, 256, 512), 16, 0.0, "blockwise")
+        assert not _use_blockwise_bwd((6, 1, 4096, 512), 64, 7.0, "dense")
 
     def test_top_level_divisor_and_zero_topdown(self):
         """Top level must ignore td entirely and divide by 3 (reference

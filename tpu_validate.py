@@ -79,7 +79,10 @@ def check_ffw_grad():
         )
 
 
-def _consensus_case(side, radius, dtype, rtol, atol, grad):
+def _consensus_case(side, radius, dtype, rtol, atol, grad, bwd_impl="blockwise"):
+    """grad checks default to FORCING the blockwise kernels — under 'auto'
+    the measured-crossover dispatch would route these shapes to the dense
+    VJP and the Pallas backward would go unvalidated on hardware."""
     from glom_tpu.kernels.consensus_update import _fused, _xla_reference
 
     L, B, d = 6, 2, 512
@@ -91,7 +94,10 @@ def _consensus_case(side, radius, dtype, rtol, atol, grad):
 
     if grad:
         def lf(lv, b_, t_):
-            return jnp.mean(_fused(lv, b_, t_, side, radius, False, False).astype(jnp.float32) ** 2)
+            return jnp.mean(
+                _fused(lv, b_, t_, side, radius, False, False, bwd_impl)
+                .astype(jnp.float32) ** 2
+            )
 
         def lr(lv, b_, t_):
             return jnp.mean(
@@ -135,6 +141,12 @@ def check_cons_grad_bf16():
 @check("consensus_bf16_grad_parity_n1024_radius7")
 def check_cons_grad_bf16_r7():
     _consensus_case(32, 7.0, jnp.bfloat16, 0.1, 2e-2, grad=True)
+
+
+@check("consensus_bf16_grad_dispatch_auto_n1024")
+def check_cons_grad_auto():
+    """The 'auto' dispatch side (dense VJP at this shape) on hardware."""
+    _consensus_case(32, 0.0, jnp.bfloat16, 0.1, 2e-2, grad=True, bwd_impl="auto")
 
 
 @check("train_step_bf16_loss_decreases")
@@ -195,6 +207,7 @@ def main():
         check_ffw_fwd, check_ffw_grad,
         check_cons_fwd_256, check_cons_fwd_1024,
         check_cons_grad_f32, check_cons_grad_bf16, check_cons_grad_bf16_r7,
+        check_cons_grad_auto,
         check_train, check_train_cross_path,
     ):
         fn()
