@@ -33,6 +33,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--data", choices=["shapes", "gaussian"], default="shapes")
     p.add_argument(
+        "--data-dir", default=None, metavar="PATH",
+        help="train on REAL data: a directory of images (resized to the "
+        "config's image_size), a .npy file, or a directory of .npy shards "
+        "([N,H,W,C] or [N,C,H,W], uint8 or float). Overrides --data. "
+        "Multi-host runs shard the file list by process automatically.",
+    )
+    p.add_argument(
         "--prefetch", type=_nonneg_int, default=2, metavar="N",
         help="stage N batches on device from a background thread (0 = off)",
     )
@@ -87,7 +94,16 @@ def main(argv=None) -> int:
     writer = MetricsWriter(
         args.metrics_file, echo=True, tensorboard_dir=args.tensorboard
     )
-    make_data = shapes_dataset if args.data == "shapes" else gaussian_dataset
+    if args.data_dir is not None:
+        from glom_tpu.data import file_dataset
+
+        def make_data(batch_size, image_size, seed=0):
+            return file_dataset(
+                args.data_dir, batch_size, image_size, seed=seed,
+                shard_index=jax.process_index(), num_shards=jax.process_count(),
+            )
+    else:
+        make_data = shapes_dataset if args.data == "shapes" else gaussian_dataset
     data = make_data(tcfg.batch_size, cfg.image_size, seed=tcfg.seed)
 
     if args.check_parity:
