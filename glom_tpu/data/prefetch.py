@@ -69,7 +69,15 @@ def prefetch_to_device(
             return
         put((_END, None))
 
-    threading.Thread(target=worker, daemon=True).start()
+    thread = threading.Thread(target=worker, daemon=True)
+    thread.start()
+
+    def drain():
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
 
     def gen():
         try:
@@ -82,12 +90,20 @@ def prefetch_to_device(
                 yield item
         finally:
             # Consumer done (exhausted, closed, or GC'd): unblock the
-            # worker and drop any staged device buffers promptly.
+            # worker and drop any staged device buffers promptly. A worker
+            # mid-put can still enqueue ONE already-transferred batch after
+            # a single drain, so alternate drain/join until it has actually
+            # exited (bounded: a worker stuck inside `data` itself is a
+            # daemon thread and cannot re-enqueue once stop is set and the
+            # final drain has run).
             stop.set()
-            try:
-                while True:
-                    q.get_nowait()
-            except queue.Empty:
-                pass
+            deadline = 20  # x 0.1s join timeout = 2s bound
+            while True:
+                drain()
+                thread.join(timeout=0.1)
+                if not thread.is_alive() or deadline <= 0:
+                    break
+                deadline -= 1
+            drain()
 
     return gen()

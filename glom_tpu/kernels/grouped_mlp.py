@@ -418,6 +418,16 @@ def _fused_lm(params, x, tile_m, interpret):
     return _fused_forward(params, x, tile_m=tile_m, interpret=interpret)
 
 
+# Per-call cap on the saved [G, M, f] pre-activation residual. Under a
+# NON-remat scan the residual is stacked once per iteration, so an
+# unconditional save at larger-than-flagship configs (d=1024-class) risks
+# HBM exhaustion where the recompute form previously fit; the flagship
+# bf16 config (~400MB/FFW call) stays under and keeps its measured win.
+# Remat configs never stack (the body recomputes), so they are safe either
+# way.
+_SAVE_PRE_LIMIT = 512 * 1024 * 1024
+
+
 def _fwd(params, x, tile_m, interpret):
     # bf16 training: ALSO save the pre-activation so the backward kernel
     # drops its recompute matmul (5 -> 4 per tile). The [G, M, f] bf16
@@ -427,7 +437,13 @@ def _fwd(params, x, tile_m, interpret):
     # back then the backward also emitted dpre/h and the extra output
     # overflowed VMEM at useful tiles. f32 keeps the recompute (saving f32
     # pre doubles the traffic and f32 runs are parity/testing paths).
-    if x.dtype == jnp.bfloat16 and _pick_bwd_tile(x.shape[1]) is not None:
+    # Gated on _SAVE_PRE_LIMIT so large non-remat configs keep recompute.
+    save_bytes = x.shape[0] * x.shape[1] * params.w1.shape[-1] * x.dtype.itemsize
+    if (
+        x.dtype == jnp.bfloat16
+        and _pick_bwd_tile(x.shape[1]) is not None
+        and save_bytes <= _SAVE_PRE_LIMIT
+    ):
         out, pre = _fused_forward(
             params, x, tile_m=tile_m, interpret=interpret, save_pre=True
         )

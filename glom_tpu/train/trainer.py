@@ -164,7 +164,14 @@ class Trainer:
     ) -> list[dict]:
         """Run `num_steps` updates pulling [b, c, H, W] batches from `data`.
         prefetch > 0 stages that many upcoming batches on device from a
-        background thread (hides the host->device transfer)."""
+        background thread (hides the host->device transfer).
+
+        CAUTION: prefetch wraps `data` PER CALL. Calling fit(prefetch=N)
+        repeatedly over one shared iterator (e.g. a checkpoint-span loop)
+        discards up to N staged batches at every boundary, skewing the
+        stream vs prefetch=0. For that pattern, wrap once yourself with
+        data.prefetch_to_device and pass prefetch=0 here — see
+        train/cli.py for the reference usage."""
         if prefetch > 0:
             from glom_tpu.data import prefetch_to_device
 
