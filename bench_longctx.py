@@ -70,7 +70,12 @@ def main():
     chip = detect_chip()
     on_tpu = chip != "cpu"
     L, B, d = 6, 1, 512
-    sides = (32, 64) if on_tpu else (8,)
+    # side 16 = the flagship n=256 (anchors the dispatch crossover at the
+    # config the train bench runs); side 96 -> n=9216, the past-the-old-cap
+    # long-context point the streamed backward unlocked (dense grad at this
+    # n materializes a ~2GB sim twice — measured if it fits, recorded as
+    # oom otherwise).
+    sides = (16, 32, 64, 96) if on_tpu else (8,)
     dtype = jnp.bfloat16 if on_tpu else jnp.float32
     repeats = 3 if on_tpu else 2
 
@@ -79,6 +84,13 @@ def main():
 
     def fused(lv, bu, td, *, side, radius):
         return fused_consensus_update(lv, bu, td, side=side, radius=radius)
+
+    def fused_bw(lv, bu, td, *, side, radius):
+        # force the blockwise backward so the kernel is measured even where
+        # the auto dispatch would (correctly) route to the dense VJP
+        return fused_consensus_update(
+            lv, bu, td, side=side, radius=radius, bwd_impl="blockwise"
+        )
 
     def grad_of(op):
         def gop(lv, bu_, td_, *, side, radius):
@@ -107,14 +119,19 @@ def main():
             # 2x fwd) — the dense VJP materializes [L, B, n, n] TWICE
             # (fwd + bwd); the blockwise backward keeps O(n) memory
             ("dense_xla_grad", grad_of(dense), 3),
-            ("fused_pallas_grad", grad_of(fused), 3),
+            ("fused_pallas_grad", grad_of(fused_bw), 3),
+            ("auto_dispatch_grad", grad_of(fused), 3),
         ]
         for radius in (0.0, 7.0):
             for name, op, mult in variants:
-                rec = bench_variant(
-                    name, op, levels, bu, td, side, radius, repeats,
-                    flops_mult=mult,
-                )
+                try:
+                    rec = bench_variant(
+                        name, op, levels, bu, td, side, radius, repeats,
+                        flops_mult=mult,
+                    )
+                except Exception as e:  # noqa: BLE001 - record OOM/compile fails
+                    rec = {"impl": name, "n": side * side, "radius": radius,
+                           "error": f"{type(e).__name__}: {e}"[:200]}
                 rec["chip"] = chip
                 print(json.dumps(rec))
                 if on_tpu:

@@ -1,0 +1,114 @@
+"""Ring-vs-Ulysses crossover probe: the single-chip-measurable component.
+
+docs/PARALLELISM.md claims Ulysses wins when L >= seq and n/seq is too
+small to feed the MXU. With one physical chip, the COMM side (ring's
+seq-1 ppermute hops vs Ulysses' one-shot all_to_all — both O(n*d*L/seq)
+volume) cannot be measured; what CAN be measured is the COMPUTE-SHAPE
+side of the claim, which is the mechanism behind it:
+
+  * ring: each device runs seq sequential attention steps over
+    [n/seq x n/seq] similarity chunks per level (L-batched small matmuls
+    + seq-1 online-softmax combine passes);
+  * ulysses: one dense attention over the FULL [n x n] similarity for
+    L/seq levels (big matmuls, one softmax).
+
+Total device FLOPs are identical (2 * n^2/seq * L * d per einsum either
+way); the difference is pure matmul granularity + online-softmax
+overhead — measured here per (n, seq, L) on the real chip, bf16, B=1.
+Appends JSONL rows to results/sp_crossover.jsonl.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from glom_tpu.ops.consensus import consensus_attention
+from glom_tpu.utils.helpers import l2norm
+from glom_tpu.utils.metrics import detect_chip
+from glom_tpu.utils.timing import calibrated_chain_time
+
+
+def ring_compute(levels_full, n_loc, seq):
+    """The per-device compute of one ring consensus pass, comms elided:
+    queries = this shard's n_loc rows; k/v chunks arrive over `seq` steps
+    (here: sliced from the resident full array — same matmul shapes and
+    online-softmax combine as ring.py, zero ppermute)."""
+    b, n, L, d = levels_full.shape
+    q = levels_full[:, :n_loc]  # this shard's query band
+    scale = d ** -0.5
+
+    def step(s, carry):
+        m, l, acc = carry
+        kv = lax.dynamic_slice_in_dim(levels_full, s * n_loc, n_loc, axis=1)
+        k = l2norm(kv)
+        sim = jnp.einsum("bild,bjld->blij", q, k) * scale
+        m_new = jnp.maximum(m, jnp.max(sim, axis=-1, keepdims=True))
+        p = jnp.exp(sim - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.einsum("blij,bjld->bild", p.astype(levels_full.dtype), kv)
+        acc_new = acc * corr.transpose(0, 2, 1, 3) + pv
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((b, L, n_loc, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, L, n_loc, 1), jnp.float32)
+    a0 = jnp.zeros((b, n_loc, L, d), jnp.float32)
+    m, l, acc = lax.fori_loop(0, seq, step, (m0, l0, a0))
+    return acc / l.transpose(0, 2, 1, 3)
+
+
+def main():
+    chip = detect_chip()
+    on_tpu = chip != "cpu"
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    d, B = 512, 1
+    cases = (
+        [(16, 8, s) for s in (2, 4, 8)]      # n=256: the small-n/seq regime
+        + [(32, 8, s) for s in (2, 4, 8)]    # n=1024
+        + [(64, 8, s) for s in (2, 4)]       # n=4096: MXU well fed either way
+    ) if on_tpu else [(8, 4, 2)]
+
+    for side, L, seq in cases:
+        n = side * side
+        levels = jax.random.normal(
+            jax.random.PRNGKey(side + seq), (B, n, L, d), dtype
+        )
+
+        def ring_chain(k, _lv=levels, _s=seq, _nl=n // seq):
+            def body(i, acc):
+                out = ring_compute(_lv + acc.astype(_lv.dtype), _nl, _s)
+                return jnp.sum(out).astype(jnp.float32) * 1e-9
+            return lax.fori_loop(0, k, body, jnp.float32(0.0))
+
+        def uly_chain(k, _lv=levels[:, :, : max(L // seq, 1)], _n=n):
+            # ulysses local compute: full n, L/seq levels, dense
+            def body(i, acc):
+                out = consensus_attention(
+                    _lv + acc.astype(_lv.dtype), attend_self=False
+                )
+                return jnp.sum(out).astype(jnp.float32) * 1e-9
+            return lax.fori_loop(0, k, body, jnp.float32(0.0))
+
+        t_ring = calibrated_chain_time(
+            jax.jit(ring_chain), levels, repeats=3, calib_k=8, target_s=0.5
+        )
+        t_uly = calibrated_chain_time(
+            jax.jit(uly_chain), levels, repeats=3, calib_k=8, target_s=0.5
+        )
+        rec = {
+            "n": n, "L": L, "seq": seq, "d": d,
+            "ring_compute_ms": round(t_ring * 1e3, 4),
+            "ulysses_compute_ms": round(t_uly * 1e3, 4),
+            "ulysses_speedup": round(t_ring / t_uly, 3),
+            "chip": chip,
+        }
+        print(json.dumps(rec))
+        if on_tpu:
+            with open("results/sp_crossover.jsonl", "a") as f:
+                f.write(json.dumps(rec) + "\n")
+
+
+if __name__ == "__main__":
+    main()

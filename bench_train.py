@@ -24,6 +24,7 @@ peak).
 """
 
 import argparse
+import dataclasses
 import json
 
 import jax
@@ -32,7 +33,11 @@ import jax.numpy as jnp
 from glom_tpu.train.trainer import create_train_state, make_train_step
 from glom_tpu.utils.config import GlomConfig, TrainConfig
 from glom_tpu.utils.metrics import detect_chip, mfu
-from glom_tpu.utils.timing import best_fetch_time, measure_rtt
+from glom_tpu.utils.timing import (
+    best_fetch_time,
+    calibrated_chain_time,
+    measure_rtt,
+)
 
 
 def _train_iters(cfg: GlomConfig, tcfg: TrainConfig) -> int:
@@ -40,6 +45,71 @@ def _train_iters(cfg: GlomConfig, tcfg: TrainConfig) -> int:
     top level at recon_index, so iterations past it are dead code."""
     T = tcfg.iters if tcfg.iters is not None else cfg.default_iters
     return tcfg.recon_iter_index if tcfg.recon_iter_index is not None else T // 2 + 1
+
+
+def bench_preset_train_step(preset_name: str, batch_override=None):
+    """Single-chip train-step measurement at an arbitrary preset's MODEL
+    shape (e.g. imagenet224-pod: L=12/d=1024/bf16/remat) — the per-chip
+    anchor the analytic pod scaling model (docs/PARALLELISM.md) multiplies
+    out. Chain length auto-calibrates (per-step cost varies by config)."""
+    from glom_tpu.utils.presets import get_preset
+
+    chip = detect_chip()
+    on_tpu = chip != "cpu"
+    p = get_preset(preset_name)
+    cfg = p.model
+    batch = batch_override or (16 if on_tpu else 2)
+    tcfg = dataclasses.replace(
+        p.train,
+        batch_size=batch,
+        compute_dtype=p.train.compute_dtype if on_tpu else "float32",
+        use_pallas=p.train.use_pallas and on_tpu,
+    )
+    k_iters = _train_iters(cfg, tcfg)
+
+    state, optimizer = create_train_state(jax.random.PRNGKey(0), cfg, tcfg)
+    step_fn = make_train_step(cfg, tcfg, optimizer)
+    img = jax.device_put(
+        jax.random.normal(
+            jax.random.PRNGKey(1), (batch, 3, cfg.image_size, cfg.image_size),
+            jnp.float32,
+        )
+    )
+    base_rng = jax.random.PRNGKey(2)
+
+    def multi(k):
+        def body(i, carry):
+            st, _ = carry
+            st, metrics = step_fn(st, img, jax.random.fold_in(base_rng, i))
+            return st, metrics["loss"]
+
+        _, loss = jax.lax.fori_loop(
+            0, k, body, (state, jnp.zeros((), jnp.float32))
+        )
+        return loss
+
+    per_step = calibrated_chain_time(
+        jax.jit(multi), img, repeats=3 if on_tpu else 2, calib_k=3,
+        target_s=2.0,
+    )
+    cips = batch * k_iters / per_step
+    measured_mfu = mfu(cfg, cips, chip=chip, backward=True)
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"train_step column_iters_per_sec_per_chip ({preset_name}"
+                    f" single-chip: L={cfg.levels}, d={cfg.dim}, "
+                    f"batch={batch}, {tcfg.compute_dtype}"
+                    f"{', remat' if tcfg.remat else ''}"
+                    f"{', pallas' if tcfg.use_pallas else ''}, {chip})"
+                ),
+                "value": round(cips, 2),
+                "unit": "column-iters/s/chip",
+                "vs_baseline": round(measured_mfu / 0.70, 4),
+            }
+        )
+    )
 
 
 def bench_train_step():
@@ -163,8 +233,15 @@ if __name__ == "__main__":
     ap.add_argument(
         "--out", default="results/cifar10_loss_curve.jsonl", help="loss-curve output"
     )
+    ap.add_argument(
+        "--preset", default=None,
+        help="measure a preset's MODEL shape single-chip (e.g. imagenet224-pod)",
+    )
+    ap.add_argument("--batch", type=int, default=None, help="with --preset")
     args = ap.parse_args()
     if args.loss_curve > 0:
         run_loss_curve(args.loss_curve, args.out)
+    elif args.preset:
+        bench_preset_train_step(args.preset, args.batch)
     else:
         bench_train_step()

@@ -701,6 +701,13 @@ def _use_blockwise_bwd(levels_shape, side, radius, bwd_impl: str) -> bool:
 
     bwd_impl forces a side ('blockwise' / 'dense') for tests and benches.
     """
+    import os
+
+    if bwd_impl == "auto":
+        # bench/debug override (read at trace time): lets bench_train
+        # compare dispatch sides at the full train step without a config
+        # field for what is a measurement knob.
+        bwd_impl = os.environ.get("GLOM_CONSENSUS_BWD", "auto")
     L, B, n, d = levels_shape
     if bwd_impl == "blockwise":
         return True

@@ -13,9 +13,10 @@ work. Collectives are explicit and minimal:
            (a psum of the per-shard cotangents) — the same collective GSPMD
            would have inserted, now riding the manual region.
   * SP   — the patch axis n sharded over 'seq'; consensus attention runs the
-           existing per-shard ring / halo bodies (ring.py / halo.py), which
-           were written exactly for this context (lax.ppermute over 'seq').
-           With seq=1 the fused consensus+update kernel runs whole.
+           existing per-shard ring / halo / ulysses bodies (ring.py /
+           halo.py / ulysses.py), which were written exactly for this
+           context (lax.ppermute / all_to_all over 'seq'). With seq=1 the
+           fused consensus+update kernel runs whole.
   * loss — per-shard MSE over the local (batch-band x patch-band) block,
            pmean'd over both axes. Reconstruction compares PATCHES (the
            pixel set is identical to the reference's image-space MSE, so the
@@ -80,9 +81,8 @@ def _shard_consensus_fn(cfg: GlomConfig, seq: int, sp_strategy: str):
     use the fused consensus+update kernel instead.
 
     Strategy handling mirrors runtime.make_consensus_fn: unknown strategies
-    raise, impossible-halo and ulysses fall back to ring WITH a warning
-    (ring is exact for any geometry; ulysses' all-to-all decomposition has
-    no per-shard body in the manual region yet)."""
+    raise; impossible-halo and indivisible-ulysses fall back to ring WITH a
+    warning (ring is exact for any geometry)."""
     from glom_tpu.parallel.runtime import SP_STRATEGIES
 
     if sp_strategy not in SP_STRATEGIES:
@@ -92,6 +92,16 @@ def _shard_consensus_fn(cfg: GlomConfig, seq: int, sp_strategy: str):
     if seq == 1:
         return None
     radius = float(cfg.local_consensus_radius)
+    if sp_strategy == "ulysses" and cfg.levels % seq == 0:
+        from glom_tpu.ops.consensus import build_local_mask
+        from glom_tpu.parallel.ulysses import ulysses_consensus_shard
+
+        return partial(
+            ulysses_consensus_shard,
+            axis_name=SEQ_AXIS,
+            attend_self=cfg.consensus_self,
+            local_mask=build_local_mask(cfg.num_patches_side, radius),
+        )
     if sp_strategy == "halo" and halo_supported(seq, cfg.num_patches_side, radius):
         return partial(
             halo_consensus_shard,
@@ -108,8 +118,8 @@ def _shard_consensus_fn(cfg: GlomConfig, seq: int, sp_strategy: str):
         )
     elif sp_strategy == "ulysses":
         warnings.warn(
-            "ulysses has no per-shard body in the manual fused path; using "
-            "ring (identical result, different collective pattern)",
+            f"ulysses needs levels ({cfg.levels}) divisible by the seq axis "
+            f"({seq}); using ring (identical result, different collectives)",
             stacklevel=3,
         )
     return partial(
