@@ -26,6 +26,7 @@ Writes one JSON line per measurement to stdout and appends them to
 results/longctx_bench.jsonl.
 """
 
+import argparse
 import json
 
 import jax
@@ -66,7 +67,7 @@ def bench_variant(name, op, levels, bu, td, side, radius, repeats,
             "dense_equiv_tflops": round(tflops_equiv, 2)}
 
 
-def main():
+def main(only_sides=None):
     chip = detect_chip()
     on_tpu = chip != "cpu"
     L, B, d = 6, 1, 512
@@ -76,6 +77,10 @@ def main():
     # n materializes a ~2GB sim twice — measured if it fits, recorded as
     # oom otherwise).
     sides = (16, 32, 64, 96) if on_tpu else (8,)
+    if only_sides is not None:
+        if not only_sides:
+            raise ValueError("--sides given but empty; pass side values")
+        sides = tuple(only_sides)
     dtype = jnp.bfloat16 if on_tpu else jnp.float32
     repeats = 3 if on_tpu else 2
 
@@ -142,4 +147,9 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--sides", type=int, nargs="*", default=None,
+        help="restrict to these grid sides (rerun specific rows)",
+    )
+    main(ap.parse_args().sides)

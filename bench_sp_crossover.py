@@ -91,11 +91,14 @@ def main():
                 return jnp.sum(out).astype(jnp.float32) * 1e-9
             return lax.fori_loop(0, k, body, jnp.float32(0.0))
 
+        # target_s=2.5: the fastest cases here are ~5 us/op, where a 0.5 s
+        # chain leaves (t_chain - rtt) within the ~100 ms tunnel-RTT jitter
+        # (observed as degenerate timings); a longer chain amortizes it.
         t_ring = calibrated_chain_time(
-            jax.jit(ring_chain), levels, repeats=3, calib_k=8, target_s=0.5
+            jax.jit(ring_chain), levels, repeats=4, calib_k=8, target_s=2.5
         )
         t_uly = calibrated_chain_time(
-            jax.jit(uly_chain), levels, repeats=3, calib_k=8, target_s=0.5
+            jax.jit(uly_chain), levels, repeats=4, calib_k=8, target_s=2.5
         )
         rec = {
             "n": n, "L": L, "seq": seq, "d": d,

@@ -83,6 +83,35 @@ def _row_col(idx, side):
     return idx // side, idx % side
 
 
+def _apply_masks(s, row_ids, col_ids, *, side, radius, attend_self):
+    """The dual mask semantics shared by EVERY kernel (reference :9/:61-67):
+    diagonal REPLACED by the soft -5e-4 when attend_self=False; pairs past
+    the Euclidean grid radius hard-masked to -3e38. row_ids/col_ids are
+    QUERY-/KEY-index iotas shaped like s's trailing two dims."""
+    if not attend_self:
+        s = jnp.where((row_ids == col_ids)[None], TOKEN_ATTEND_SELF_VALUE, s)
+    if radius > 0:
+        ri, ci = _row_col(row_ids, side)
+        rj, cj = _row_col(col_ids, side)
+        dist2 = (ri - rj) ** 2 + (ci - cj) ** 2
+        s = jnp.where(
+            (dist2.astype(jnp.float32) > radius * radius)[None], _NEG_MAX, s
+        )
+    return s
+
+
+def _norm_vjp(dk, x):
+    """VJP of the row-local k-normalization k = x / max(||x||, eps)
+    (helpers.l2norm), shared by every backward kernel. dk f32, x compute
+    dtype; returns f32."""
+    f32 = jnp.float32
+    x32 = x.astype(f32)
+    r = jnp.sqrt(jnp.sum(x32 * x32, axis=-1, keepdims=True))
+    inv = 1.0 / jnp.maximum(r, 1e-12)
+    a = jnp.sum(dk * x32, axis=-1, keepdims=True)
+    return dk * inv - jnp.where(r >= 1e-12, a * x32 * inv * inv / r, 0.0)
+
+
 def _consensus_update_kernel(
     x_ref,      # [1, TB, TI, d] levels q/self tile
     kv_ref,     # [1, TB, n, d]  full rows of levels for (g, b-tile): k and v
@@ -114,7 +143,6 @@ def _consensus_update_kernel(
     q32 = x.astype(jnp.float32)
 
     row_ids = i * tile_i + jax.lax.broadcasted_iota(jnp.int32, (tile_i, tile_j), 0)
-    ri, ci = _row_col(row_ids, side)
 
     # Block sparsity for the local mask: the live j-window for this i-tile
     # (i is traced, so the window is int32 arithmetic; fori_loop takes
@@ -141,14 +169,10 @@ def _consensus_update_kernel(
         col_ids = j * tile_j + jax.lax.broadcasted_iota(
             jnp.int32, (tile_i, tile_j), 1
         )
-        if not attend_self:
-            s = jnp.where((row_ids == col_ids)[None], TOKEN_ATTEND_SELF_VALUE, s)
-        if radius > 0:
-            rj, cj = _row_col(col_ids, side)
-            dist2 = (ri - rj) ** 2 + (ci - cj) ** 2
-            s = jnp.where(
-                (dist2.astype(jnp.float32) > radius * radius)[None], _NEG_MAX, s
-            )
+        s = _apply_masks(
+            s, row_ids, col_ids,
+            side=side, radius=radius, attend_self=attend_self,
+        )
 
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         corr = jnp.exp(m - m_new)
@@ -181,6 +205,111 @@ def _consensus_update_kernel(
     out_ref[0] = new.astype(out_ref.dtype)
 
 
+def _consensus_update_kernel_streamed(
+    x_ref,      # [1, TB, TI, d] levels q/self tile (resident across jw)
+    kv_ref,     # [1, TB, TJ, d] STREAMED levels j-tile
+    bu_ref,     # [1, TB, TI, d] (resident; epilogue)
+    td_ref,     # [1, TB, TI, d] (resident, index-clamped at the top level)
+    out_ref,    # [1, TB, TI, d] written at the last jw step
+    *stats_refs,  # optional m_ref, l_ref [1, TB, TI, 1] f32 outs
+    levels_count: int,
+    side: int,
+    radius: float,
+    attend_self: bool,
+    tile_i: int,
+    tile_j: int,
+    n: int,
+):
+    """Large-n forward: the same online softmax as _consensus_update_kernel
+    but with the j sweep as a STREAMED inner grid axis (windowed under the
+    local-radius band) and the (m, l, acc) carry in VMEM scratch — no full
+    [n, d] k/v row residency, O(n) VMEM at any n. Dispatched by _forward
+    when the resident-row working set would overflow the scoped-VMEM
+    budget (measured: bf16 n=9216 needs 47MB > the 43MB scope with the
+    resident-row kernel)."""
+    m_acc, l_acc, acc_acc = stats_refs[-3:]
+    out_stats = stats_refs[:-3]
+    g = pl.program_id(0)
+    i = pl.program_id(2)
+    jw = pl.program_id(3)
+    num_jw = pl.num_programs(3)
+    d = x_ref.shape[-1]
+    scale = d ** -0.5
+    f32 = jnp.float32
+    n_tj = n // tile_j
+
+    @pl.when(jw == 0)
+    def _init():
+        m_acc[...] = jnp.full_like(m_acc, _NEG_MAX)
+        l_acc[...] = jnp.zeros_like(l_acc)
+        acc_acc[...] = jnp.zeros_like(acc_acc)
+
+    lo = _win_lo_tile(i, tile_i, tile_j, side, radius)
+    hi = _win_hi_tile(i, tile_i, tile_j, n_tj, side, radius)
+    j = lo + jw
+
+    @pl.when(j < hi)
+    def _step():
+        x = x_ref[0]
+        kv = kv_ref[0]
+        k = _normalized_k(kv)
+        s = (
+            jax.lax.dot_general(
+                x, k, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=f32,
+            )
+            * scale
+        )
+        row_ids = i * tile_i + jax.lax.broadcasted_iota(
+            jnp.int32, (tile_i, tile_j), 0
+        )
+        col_ids = j * tile_j + jax.lax.broadcasted_iota(
+            jnp.int32, (tile_i, tile_j), 1
+        )
+        s = _apply_masks(
+            s, row_ids, col_ids,
+            side=side, radius=radius, attend_self=attend_self,
+        )
+        m = m_acc[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_acc[...] = l_acc[...] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(x.dtype), kv, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=f32,
+        )
+        acc_acc[...] = acc_acc[...] * corr + pv
+        m_acc[...] = m_new
+
+    @pl.when(jw == num_jw - 1)
+    def _final():
+        m = m_acc[...]
+        l = l_acc[...]
+        cons = acc_acc[...] / l
+        if out_stats:
+            out_stats[0][0] = m
+            out_stats[1][0] = l
+        bu = bu_ref[0].astype(f32)
+        td = td_ref[0].astype(f32)
+        is_top = g == levels_count - 1
+        td = jnp.where(is_top, 0.0, td)
+        div = jnp.where(is_top, 3.0, 4.0)
+        out_ref[0] = ((x_ref[0].astype(f32) + bu + td + cons) / div).astype(
+            out_ref.dtype
+        )
+
+
+# Resident-row cap for the FORWARD kernel: beyond this the [TB, n, d] k/v
+# block (double-buffered by the pipeline) pushes the scoped-VMEM working
+# set over Mosaic's budget and the streamed-forward variant dispatches.
+_FWD_ROW_LIMIT = 4 * 1024 * 1024
+
+# Largest n the single-tile fused backward handles (whole row as one block;
+# sim + tiles stay within the VMEM budget at d<=1024).
+_SMALL_BWD_N = 512
+
+
 def _pick_tile(n: int, cap: int = 256) -> int:
     for t in (512, 256, 128, 64, 32, 16, 8):
         if t <= cap and n % t == 0 and t <= n:
@@ -188,19 +317,39 @@ def _pick_tile(n: int, cap: int = 256) -> int:
     return n
 
 
-def _pick_tile_b(B: int, n: int, d: int, tile_i: int, tile_j: int, itemsize: int) -> int:
-    """Largest batch tile dividing B that keeps the working set well under
-    VMEM: ~2x-buffered in/out blocks + f32 accumulators + the sim tile."""
-    budget = 12 * 1024 * 1024
+# Shared per-program VMEM budget for picking the batch tile (the kernels'
+# scoped limits are higher; this leaves pipelining headroom).
+_TILE_B_BUDGET = 12 * 1024 * 1024
+
+
+def _fit_tile_b(B: int, ws_of_tb) -> int:
+    """Largest batch tile in (8, 4, 2, 1) dividing B whose working set
+    (bytes, per ws_of_tb(tb)) fits _TILE_B_BUDGET. The single source of
+    the candidate ladder + budget for all three consensus kernels."""
     for tb in (8, 4, 2, 1):
-        if B % tb != 0:
-            continue
-        blocks = 5 * tb * tile_i * d * itemsize * 2  # x/bu/td/out/kv, 2x buffered
-        kv_extra = tb * (n - tile_i) * d * itemsize * 2 if n > tile_i else 0
-        scratch = tb * tile_i * (d + 1) * 4 * 2 + tb * tile_i * tile_j * 4
-        if blocks + kv_extra + scratch <= budget:
+        if B % tb == 0 and ws_of_tb(tb) <= _TILE_B_BUDGET:
             return tb
     return 1
+
+
+def _pick_tile_b(
+    B: int, n: int, d: int, tile_i: int, tile_j: int, itemsize: int,
+    *, streamed: bool = False,
+) -> int:
+    """Batch tile for the FORWARD: ~2x-buffered in/out blocks + f32
+    accumulators + the sim tile. The streamed layout replaces the resident
+    k/v rows with one 2x-buffered j-tile + the f32 (m, l, acc) scratch."""
+
+    def ws(tb):
+        blocks = 5 * tb * tile_i * d * itemsize * 2  # x/bu/td/out/kv, 2x buffered
+        if streamed:
+            kv_extra = tb * tile_j * d * itemsize * 2
+        else:
+            kv_extra = tb * (n - tile_i) * d * itemsize * 2 if n > tile_i else 0
+        scratch = tb * tile_i * (d + 1) * 4 * 2 + tb * tile_i * tile_j * 4
+        return blocks + kv_extra + scratch
+
+    return _fit_tile_b(B, ws)
 
 
 def _forward(
@@ -215,7 +364,12 @@ def _forward(
     save_stats: bool = False,
 ):
     """save_stats=True (the training forward under custom_vjp) also emits
-    the f32 row statistics (m, l) consumed by the backward kernels."""
+    the f32 row statistics (m, l) consumed by the backward kernels.
+
+    Two grid layouts behind one contract: resident-row (k/v rows live in
+    VMEM, fori_loop over j — fastest when they fit) vs streamed (j as a
+    windowed inner grid axis, (m, l, acc) in scratch — O(n) VMEM at any
+    n); dispatched on _FWD_ROW_LIMIT."""
     L, B, n, d = levels_lm.shape
     tile_i = _pick_tile(n)
     # Global consensus: a wider j-tile halves the online-softmax correction
@@ -223,11 +377,12 @@ def _forward(
     # path). Local radius: keep j-tiles at 256 so the block-sparse window
     # stays fine-grained (a 512 tile erases the skip at side<=32).
     tile_j = _pick_tile(n, cap=512 if radius <= 0 else 256)
-    tile_b = _pick_tile_b(B, n, d, tile_i, tile_j, levels_lm.dtype.itemsize)
-    grid = (L, B // tile_b, n // tile_i)
+    streamed = n * d * levels_lm.dtype.itemsize > _FWD_ROW_LIMIT
+    tile_b = _pick_tile_b(
+        B, n, d, tile_i, tile_j, levels_lm.dtype.itemsize, streamed=streamed
+    )
 
-    kernel = partial(
-        _consensus_update_kernel,
+    kw = dict(
         levels_count=L,
         side=side,
         radius=float(radius),
@@ -237,6 +392,53 @@ def _forward(
         n=n,
     )
     out_shape = jax.ShapeDtypeStruct((L, B, n, d), levels_lm.dtype)
+    if streamed:
+        def i_spec(last):
+            return pl.BlockSpec(
+                (1, tile_b, tile_i, last), lambda g, b, i, jw: (g, b, i, 0)
+            )
+
+        n_tj = n // tile_j
+
+        def kv_map(g, b, i, jw, _tj=n_tj):
+            lo = _win_lo_tile(i, tile_i, tile_j, side, radius)
+            return (g, b, jnp.minimum(lo + jw, _tj - 1), 0)
+
+        out_spec = i_spec(d)
+        if save_stats:
+            stat_shape = jax.ShapeDtypeStruct((L, B, n, 1), jnp.float32)
+            out_shape = (out_shape, stat_shape, stat_shape)
+            out_spec = (out_spec, i_spec(1), i_spec(1))
+        f32 = jnp.float32
+        return pl.pallas_call(
+            partial(_consensus_update_kernel_streamed, **kw),
+            out_shape=out_shape,
+            grid=(
+                L, B // tile_b, n // tile_i,
+                _win_len(tile_i, tile_j, n_tj, side, radius),
+            ),
+            in_specs=[
+                i_spec(d),  # x
+                pl.BlockSpec((1, tile_b, tile_j, d), kv_map),  # streamed kv
+                i_spec(d),  # bu
+                pl.BlockSpec(
+                    (1, tile_b, tile_i, d),
+                    lambda g, b, i, jw, _L=L: (jnp.minimum(g, _L - 2), b, i, 0),
+                ),  # td (clamped top)
+            ],
+            out_specs=out_spec,
+            scratch_shapes=[
+                pltpu.VMEM((tile_b, tile_i, 1), f32),  # m
+                pltpu.VMEM((tile_b, tile_i, 1), f32),  # l
+                pltpu.VMEM((tile_b, tile_i, d), f32),  # acc
+            ],
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=32 * 1024 * 1024
+            ),
+            interpret=interpret,
+        )(levels_lm, levels_lm, bu_lm, td_lm)
+
+    grid = (L, B // tile_b, n // tile_i)
     out_spec = pl.BlockSpec((1, tile_b, tile_i, d), lambda g, b, i: (g, b, i, 0))
     if save_stats:
         stat_shape = jax.ShapeDtypeStruct((L, B, n, 1), jnp.float32)
@@ -244,7 +446,7 @@ def _forward(
         out_shape = (out_shape, stat_shape, stat_shape)
         out_spec = (out_spec, stat_spec, stat_spec)
     return pl.pallas_call(
-        kernel,
+        partial(_consensus_update_kernel, **kw),
         out_shape=out_shape,
         grid=grid,
         in_specs=[
@@ -383,15 +585,10 @@ def _consensus_bwd_dq_kernel(
         col_ids = j * tile_j + jax.lax.broadcasted_iota(
             jnp.int32, (tile_i, tile_j), 1
         )
-        if not attend_self:
-            s = jnp.where((row_ids == col_ids)[None], TOKEN_ATTEND_SELF_VALUE, s)
-        if radius > 0:
-            ri, ci = _row_col(row_ids, side)
-            rj, cj = _row_col(col_ids, side)
-            dist2 = (ri - rj) ** 2 + (ci - cj) ** 2
-            s = jnp.where(
-                (dist2.astype(f32) > radius * radius)[None], _NEG_MAX, s
-            )
+        s = _apply_masks(
+            s, row_ids, col_ids,
+            side=side, radius=radius, attend_self=attend_self,
+        )
         p = jnp.exp(s - m) / l  # [TB, TI, TJ] f32
         dp = jax.lax.dot_general(
             dcons.astype(x.dtype), kv, (((2,), (2,)), ((0,), (0,))),
@@ -417,6 +614,81 @@ def _consensus_bwd_dq_kernel(
         dd = d_acc[...]
         dq_ref[0] = (a_acc[...] - dd * b_acc[...]) * scale
         dd_ref[0] = dd
+
+
+def _consensus_bwd_small_kernel(
+    x_ref,      # [1, TB, n, d]  levels (q = k-source = v), whole row
+    dm_ref,     # [1, TB, n, d]  RAW output cotangent (compute dtype)
+    m_ref,      # [1, TB, n, 1]  f32 forward stats
+    l_ref,      # [1, TB, n, 1]
+    dlv_ref,    # [1, TB, n, d]  COMPLETE dlevels (levels dtype)
+    dmean_ref,  # [1, TB, n, d]  g/div downcast — the d(bu) cotangent
+                #                (d(td) is its [:L-1] slice), emitted here
+                #                so the caller's divide+downcast sweep of g
+                #                disappears
+    *, side, radius, attend_self, n,
+):
+    """Single-tile consensus backward: when the whole patch row fits one
+    tile (n <= 512 — the flagship n=256 lives here), the i- and j-ranges
+    coincide, so ONE program computes the scores ONCE and emits the
+    complete dlevels: 5 matmuls (s, dP, dq, dv, dk) vs the 8 of the
+    two-pass form, ONE exp, and — the dominant saving at train shapes —
+    no [L, B, n, d] f32 dq / [L, B, n, 1] stats round-tripping through
+    HBM between passes (~200 MB per scan iteration at the flagship).
+    With dd known in-register the ds = p*(dP - dd) form needs no A/B
+    decomposition."""
+    f32 = jnp.float32
+    d = x_ref.shape[-1]
+    scale = d ** -0.5
+    div = jnp.where(pl.program_id(0) == pl.num_programs(0) - 1, 3.0, 4.0)
+
+    x = x_ref[0]              # [TB, n, d]
+    k = _normalized_k(x)
+    dcons = dm_ref[0].astype(f32) / div
+    m = m_ref[0]
+    l = l_ref[0]
+
+    s = (
+        jax.lax.dot_general(
+            x, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=f32
+        )
+        * scale
+    )  # [TB, n, n]
+    row_ids = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
+    col_ids = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
+    diag = (row_ids == col_ids)[None]
+    s = _apply_masks(
+        s, row_ids, col_ids, side=side, radius=radius, attend_self=attend_self
+    )
+
+    p = jnp.exp(s - m) / l  # [TB, n(i), n(j)] f32
+    dp = jax.lax.dot_general(
+        dcons.astype(x.dtype), x, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=f32,
+    )  # dP_ij = dcons_i . v_j
+    dd = jnp.sum(p * dp, axis=-1, keepdims=True)  # FULL sum incl. diagonal
+    ds = p * (dp - dd)
+    if not attend_self:
+        ds = jnp.where(diag, 0.0, ds)
+    dsc = ds.astype(x.dtype)
+
+    # dq_i = scale * sum_j ds_ij k_j
+    dq = jax.lax.dot_general(
+        dsc, k, (((2,), (1,)), ((0,), (0,))), preferred_element_type=f32
+    ) * scale
+    # dv_j = sum_i p_ij dcons_i  (UNMASKED p: the diagonal feeds v)
+    dv = jax.lax.dot_general(
+        p.astype(x.dtype), dcons.astype(x.dtype), (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=f32,
+    )
+    # dk_j = scale * sum_i ds_ij q_i
+    dk = jax.lax.dot_general(
+        dsc, x, (((1,), (1,)), ((0,), (0,))), preferred_element_type=f32
+    ) * scale
+
+    dxn = _norm_vjp(dk, x)
+    dlv_ref[0] = (dcons + dq + dv + dxn).astype(dlv_ref.dtype)
+    dmean_ref[0] = dcons.astype(dmean_ref.dtype)
 
 
 def _consensus_bwd_dkv_kernel(
@@ -489,16 +761,12 @@ def _consensus_bwd_dkv_kernel(
         )  # [TB, TJ, TI]
         row_ids = i * tile_i + jax.lax.broadcasted_iota(
             jnp.int32, (tile_j, tile_i), 1
-        )  # query index along the LAST axis here
-        if not attend_self:
-            s2 = jnp.where((col_ids == row_ids)[None], TOKEN_ATTEND_SELF_VALUE, s2)
-        if radius > 0:
-            rj, cj = _row_col(col_ids, side)
-            ri2, ci2 = _row_col(row_ids, side)
-            dist2 = (rj - ri2) ** 2 + (cj - ci2) ** 2
-            s2 = jnp.where(
-                (dist2.astype(f32) > radius * radius)[None], _NEG_MAX, s2
-            )
+        )  # query index along the LAST axis here (both masks are symmetric
+        #    in the pair, so the transposed orientation reuses the helper)
+        s2 = _apply_masks(
+            s2, col_ids, row_ids,
+            side=side, radius=radius, attend_self=attend_self,
+        )
 
         p2 = jnp.exp(s2 - m[:, None, :]) / l[:, None, :]     # [TB, TJ, TI]
         p2c = p2.astype(xj.dtype)
@@ -525,13 +793,7 @@ def _consensus_bwd_dkv_kernel(
     def _final():
         dv = dv_acc[...] * inv_div  # accumulated against the RAW cotangents
         dk = dk_acc[...] * scale
-
-        # k-normalization VJP (row-local): k = x / max(||x||, eps).
-        x32 = xj.astype(f32)
-        r = jnp.sqrt(jnp.sum(x32 * x32, axis=-1, keepdims=True))
-        inv = 1.0 / jnp.maximum(r, 1e-12)
-        a = jnp.sum(dk * x32, axis=-1, keepdims=True)
-        dxn = dk * inv - jnp.where(r >= 1e-12, a * x32 * inv * inv / r, 0.0)
+        dxn = _norm_vjp(dk, xj)
         # Epilogue: complete dlevels for this j-tile. dmean_j = g_j / div.
         gj = gj_ref[0].astype(f32) * inv_div
         out_ref[0] = (gj + dqj_ref[0] + dv + dxn).astype(out_ref.dtype)
@@ -542,18 +804,16 @@ def _pick_tile_b_bwd(B: int, n: int, d: int, tile: int, itemsize: int) -> int:
     any more (the i/j windows stream through the inner grid axis); the
     working set is resident tiles (x/dm or xj/gj/dqj), one streamed tile
     pair 2x-buffered, the f32 scratch accumulators, and the out block."""
-    budget = 12 * 1024 * 1024
-    for tb in (8, 4, 2, 1):
-        if B % tb != 0:
-            continue
+
+    def ws(tb):
         resident = tb * tile * d * (2 * itemsize + 4)      # x/dm + f32 dqj
         streamed = 2 * tb * tile * d * (itemsize + itemsize)  # q + dm tiles
         scratch = 2 * tb * tile * d * 4 + tb * tile * 4    # A/B (or dv/dk) + D
         sim = 2 * tb * tile * tile * 4                     # p / dp tiles
         out = tb * tile * d * (4 + itemsize)
-        if resident + streamed + scratch + sim + out <= budget:
-            return tb
-    return 1
+        return resident + streamed + scratch + sim + out
+
+    return _fit_tile_b(B, ws)
 
 
 def _consensus_update_bwd(
@@ -567,16 +827,56 @@ def _consensus_update_bwd(
     output, so neither a divided copy of g nor the f32 partial sums ever
     make a separate HBM round trip. (m, l) are the forward's saved row
     statistics; both passes stream their opposite-axis tiles through a
-    windowed inner grid axis — O(n) VMEM at ANY n."""
+    windowed inner grid axis — O(n) VMEM at ANY n.
+
+    Returns (dlv, dmean): dmean (= g/div, levels dtype — the d(bu)
+    cotangent; d(td) is its [:L-1] slice) is non-None only on the
+    single-tile path, whose kernel emits it for free."""
     L, B, n, d = levels_lm.shape
     tile_i = _pick_tile(n)
+    f32 = jnp.float32
+    graw = g.astype(levels_lm.dtype)
+
+    if n <= _SMALL_BWD_N:
+        # Whole row in one tile (flagship n=256 and smaller): the fused
+        # single-pass kernel — scores once, complete dlv + dmean out,
+        # nothing between passes because there are no passes.
+        itemsize = levels_lm.dtype.itemsize
+        tile_b = _fit_tile_b(
+            B,
+            lambda tb: (
+                3 * tb * n * n * 4  # s/p + dp + ds live f32
+                + 6 * tb * n * d * (itemsize + 1)  # x/g/k/dcons/outs
+            ),
+        )
+
+        def spec(last):
+            return pl.BlockSpec((1, tile_b, n, last), lambda g_, b: (g_, b, 0, 0))
+
+        dlv, dmean = pl.pallas_call(
+            partial(
+                _consensus_bwd_small_kernel,
+                side=side, radius=float(radius), attend_self=attend_self, n=n,
+            ),
+            out_shape=(
+                jax.ShapeDtypeStruct((L, B, n, d), levels_lm.dtype),
+                jax.ShapeDtypeStruct((L, B, n, d), levels_lm.dtype),
+            ),
+            grid=(L, B // tile_b),
+            in_specs=[spec(d), spec(d), spec(1), spec(1)],
+            out_specs=(spec(d), spec(d)),
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=32 * 1024 * 1024
+            ),
+            interpret=interpret,
+        )(levels_lm, graw, m, l)
+        return dlv, dmean
+
     tile_j = _pick_tile(n)
     tile_b = _pick_tile_b_bwd(
         B, n, d, max(tile_i, tile_j), levels_lm.dtype.itemsize
     )
     n_ti, n_tj = n // tile_i, n // tile_j
-    f32 = jnp.float32
-    graw = g.astype(levels_lm.dtype)
 
     kw = dict(
         side=side, radius=float(radius), attend_self=attend_self,
@@ -653,7 +953,7 @@ def _consensus_update_bwd(
         interpret=interpret,
     )(levels_lm, graw, dq, levels_lm, graw, m, l, dd)
 
-    return dlv
+    return dlv, None
 
 
 def _xla_reference(levels_lm, bu_lm, td_lm, *, side, radius, attend_self):
@@ -702,12 +1002,25 @@ def _use_blockwise_bwd(levels_shape, side, radius, bwd_impl: str) -> bool:
     bwd_impl forces a side ('blockwise' / 'dense') for tests and benches.
     """
     import os
+    import warnings
 
     if bwd_impl == "auto":
         # bench/debug override (read at trace time): lets bench_train
         # compare dispatch sides at the full train step without a config
         # field for what is a measurement knob.
-        bwd_impl = os.environ.get("GLOM_CONSENSUS_BWD", "auto")
+        env = os.environ.get("GLOM_CONSENSUS_BWD", "auto")
+        if env in ("auto", "blockwise", "dense"):
+            bwd_impl = env
+        else:
+            warnings.warn(
+                f"GLOM_CONSENSUS_BWD={env!r} ignored (valid: auto / "
+                "blockwise / dense)",
+                stacklevel=3,
+            )
+    if bwd_impl not in ("auto", "blockwise", "dense"):
+        raise ValueError(
+            f"bwd_impl={bwd_impl!r}: one of 'auto', 'blockwise', 'dense'"
+        )
     L, B, n, d = levels_shape
     if bwd_impl == "blockwise":
         return True
@@ -718,6 +1031,17 @@ def _use_blockwise_bwd(levels_shape, side, radius, bwd_impl: str) -> bool:
         live = min(n, 2 * reach + _pick_tile(n))
         if 2 * live <= n:  # window covers <= half the row: sparsity pays
             return True
+    # Batched-training regime AT SINGLE-TILE ROWS: the fused single-tile
+    # backward keeps the scores in VMEM while the dense VJP sweeps the
+    # [B, L, n, n] scores through HBM several times — measured at the
+    # flagship train step (B=64, n=256): ~3950 vs 3522 col-iters/s
+    # full-step. Confined to the measured region (batched AND n within
+    # the single-tile kernel); the batched long-row region (B>=8,
+    # n>=1024 global) is unmeasured and stays on the dense side that won
+    # at B=1 (0.28 vs 0.47 ms at n=1024, 7.2 vs 7.6 ms at n=4096) until
+    # its sim buffer trips the memory cap below.
+    if B >= 8 and n <= _SMALL_BWD_N:
+        return True
     return 2 * L * B * n * n * 4 > _DENSE_SIM_LIMIT
 
 
@@ -772,14 +1096,18 @@ def _fused_bwd(side, radius, attend_self, interpret, bwd_impl, res, g):
         )
         return vjp(g)
     f32 = jnp.float32
-    div = contribution_divisor(L, dtype=f32).reshape(L, 1, 1, 1)
     # The kernels take the RAW cotangent, apply the divisor in-kernel (from
-    # the level grid index), and the dkv pass emits the COMPLETE dlv in the
-    # levels dtype — no divided/partial-sum copies of g hit HBM.
-    dlv = _consensus_update_bwd(
+    # the level grid index), and emit the COMPLETE dlv in the levels dtype
+    # — no divided/partial-sum copies of g hit HBM. The single-tile kernel
+    # also emits dmean (the d(bu)/d(td) cotangent) so the caller-side
+    # divide+downcast sweep of g disappears with it.
+    dlv, dmean_k = _consensus_update_bwd(
         levels_lm, g, m, l,
         side=side, radius=radius, attend_self=attend_self, interpret=interpret,
     )
+    if dmean_k is not None:
+        return dlv, dmean_k, dmean_k[: L - 1]
+    div = contribution_divisor(L, dtype=f32).reshape(L, 1, 1, 1)
     dmean = g.astype(f32) / div
     return (
         dlv,

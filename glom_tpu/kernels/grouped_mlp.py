@@ -151,12 +151,14 @@ def _fused_forward(
             pl.BlockSpec((1, 1, d), lambda g, m: (g, 0, 0)),  # b2
         ],
         out_specs=out_spec,
-        # Only the save_pre variant (training fwd) carries the extra
-        # [TM, f] output block that can overflow Mosaic's default 16MB
-        # scope; the inference forward keeps the default budget.
+        # The save_pre variant (training fwd) carries the extra [TM, f]
+        # output block, and d>=1024 shapes carry 16MB+ of resident weights
+        # — both overflow Mosaic's default 16MB scope (the d=1024/f=4096
+        # pod shape needs 44MB); v5e has 128MB physical. Smaller inference
+        # shapes keep the default budget (the measured-fast configuration).
         compiler_params=(
-            pltpu.CompilerParams(vmem_limit_bytes=32 * 1024 * 1024)
-            if save_pre
+            pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024)
+            if save_pre or _fwd_ws(tile_m, d, f, x.dtype.itemsize) > 14 * 1024 * 1024
             else None
         ),
         interpret=interpret,
@@ -168,11 +170,41 @@ def _fused_forward(
 # vmem_limit_bytes for its extra output block, not to admit bigger tiles.
 TILE_CANDIDATES = (512, 256, 128)
 
+# Working-set budget per kernel program, under the 64MB scoped-vmem caps
+# (v5e: 128MB physical, and the whole PROGRAM must co-schedule buffers,
+# register-spill slots, and remat recompute — measured 131-144M > 128M at
+# d=1024/f=4096 where the backward's resident f32 dw accumulators alone
+# are 32M+32M). 48M sends that shape to the XLA backward while keeping
+# the kernel at the flagship (24M @ tile 512) and at the pod's declared
+# per-TP-rank f/mp=2048 (40M @ tile 512).
+_WS_BUDGET = 48 * 1024 * 1024
 
-def _pick_tile(M: int) -> int | None:
-    """Largest MXU-friendly row tile dividing M (None -> no clean tiling)."""
+
+def _fwd_ws(tile: int, d: int, f: int, itemsize: int) -> int:
+    """Forward working set: resident weight pair + f32 pre scratch +
+    2x-buffered x/out (+pre out on the save_pre path, counted always —
+    it is the training configuration)."""
+    weights = 2 * d * f * itemsize
+    pre_scratch = tile * f * 4
+    blocks = tile * d * itemsize * 2 * 2 + tile * f * itemsize * 2
+    return weights + pre_scratch + blocks
+
+
+def _bwd_ws(tile: int, d: int, f: int, itemsize: int) -> int:
+    """Backward working set: weights + f32 dw accumulators (resident
+    across the m axis) + f32 dpre + 2x-buffered x/g/pre-in/dx blocks."""
+    weights = 2 * d * f * itemsize
+    accums = 2 * d * f * 4 + (d + f) * 4
+    dpre = tile * f * 4
+    blocks = tile * (2 * d * itemsize * 2 + f * itemsize * 2 + d * itemsize * 2)
+    return weights + accums + dpre + blocks
+
+
+def _pick_tile(M: int, d: int = 512, f: int = 2048, itemsize: int = 2) -> int | None:
+    """Largest MXU-friendly row tile dividing M whose forward working set
+    fits the budget (None -> no clean tiling)."""
     for t in TILE_CANDIDATES:
-        if M % t == 0:
+        if M % t == 0 and _fwd_ws(t, d, f, itemsize) <= _WS_BUDGET:
             return t
     return None
 
@@ -303,9 +335,11 @@ def _mlp_bwd_kernel_saved(
 BWD_TILE_CANDIDATES = (512, 256, 128)
 
 
-def _pick_bwd_tile(M: int) -> int | None:
+def _pick_bwd_tile(
+    M: int, d: int = 512, f: int = 2048, itemsize: int = 2
+) -> int | None:
     for t in BWD_TILE_CANDIDATES:
-        if M % t == 0:
+        if M % t == 0 and _bwd_ws(t, d, f, itemsize) <= _WS_BUDGET:
             return t
     return None
 
@@ -441,7 +475,9 @@ def _fwd(params, x, tile_m, interpret):
     save_bytes = x.shape[0] * x.shape[1] * params.w1.shape[-1] * x.dtype.itemsize
     if (
         x.dtype == jnp.bfloat16
-        and _pick_bwd_tile(x.shape[1]) is not None
+        and _pick_bwd_tile(
+            x.shape[1], x.shape[2], params.w1.shape[-1], x.dtype.itemsize
+        ) is not None
         and save_bytes <= _SAVE_PRE_LIMIT
     ):
         out, pre = _fused_forward(
@@ -453,7 +489,7 @@ def _fwd(params, x, tile_m, interpret):
 
 def _bwd(tile_m, interpret, res, g):
     params, x, pre = res  # x: [G, M, d]
-    bt = _pick_bwd_tile(x.shape[1])
+    bt = _pick_bwd_tile(x.shape[1], x.shape[2], params.w1.shape[-1], x.dtype.itemsize)
     if bt is not None:
         return _fused_backward(params, x, g, tile_m=bt, interpret=interpret, pre=pre)
     # Inside a scan's backward, x arrives as a dynamic-slice of the stacked
@@ -483,7 +519,7 @@ def fused_grouped_ffw_lm(
     (XLA einsum fallback off-TPU / unsupported shapes)."""
     G, M, d = x.shape
     if tile_m is None:
-        tile_m = _pick_tile(M)
+        tile_m = _pick_tile(M, d, params.w1.shape[-1], x.dtype.itemsize)
     elif M % tile_m != 0:
         tile_m = None
     on_tpu = jax.devices()[0].platform == "tpu"
@@ -512,7 +548,7 @@ def fused_grouped_ffw(
     for s in x.shape[:-2]:
         M *= s
     if tile_m is None:
-        tile_m = _pick_tile(M)
+        tile_m = _pick_tile(M, x.shape[-1], params.w1.shape[-1], x.dtype.itemsize)
     elif M % tile_m != 0:
         tile_m = None
     on_tpu = jax.devices()[0].platform == "tpu"

@@ -458,9 +458,11 @@ def make_manual_train_step(
     optimizer: optax.GradientTransformation,
     *,
     sp_strategy: str = "none",
+    with_grad_norm: bool = True,
 ):
     """(state, img, rng) -> (state, metrics): the manual-region analog of
-    train.trainer.make_train_step, same metrics contract."""
+    train.trainer.make_train_step, same metrics contract (incl. the
+    with_grad_norm fast variant for non-logging steps)."""
     if tcfg.compute_dtype not in ("float32", "bfloat16"):
         raise ValueError(
             f"compute_dtype={tcfg.compute_dtype!r}: must be 'float32' or 'bfloat16'"
@@ -473,11 +475,9 @@ def make_manual_train_step(
         loss, grads = jax.value_and_grad(loss_fn)(state.params, img, noise)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-        metrics = {
-            "loss": loss,
-            "grad_norm": optax.global_norm(grads),
-            "step": state.step,
-        }
+        metrics = {"loss": loss, "step": state.step}
+        if with_grad_norm:
+            metrics["grad_norm"] = optax.global_norm(grads)
         return TrainState(params, opt_state, state.step + 1), metrics
 
     return train_step

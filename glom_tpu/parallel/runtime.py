@@ -183,20 +183,26 @@ class DistributedTrainer:
         self.batch_sharding = NamedSharding(self.mesh, batch_spec())
         self.state = jax.device_put(state, self.state_shardings)
 
-        if self.use_manual:
-            step_fn = make_manual_train_step(
-                self.mesh, cfg, tcfg, self.optimizer, sp_strategy=sp_strategy
+        def build(with_grad_norm):
+            if self.use_manual:
+                fn = make_manual_train_step(
+                    self.mesh, cfg, tcfg, self.optimizer,
+                    sp_strategy=sp_strategy, with_grad_norm=with_grad_norm,
+                )
+            else:
+                fn = make_train_step(
+                    cfg, tcfg, self.optimizer, consensus_fn=consensus_fn,
+                    with_grad_norm=with_grad_norm,
+                )
+            return jax.jit(
+                fn,
+                in_shardings=(self.state_shardings, self.batch_sharding, None),
+                out_shardings=(self.state_shardings, None),
+                donate_argnums=(0,),
             )
-        else:
-            step_fn = make_train_step(
-                cfg, tcfg, self.optimizer, consensus_fn=consensus_fn
-            )
-        self._step = jax.jit(
-            step_fn,
-            in_shardings=(self.state_shardings, self.batch_sharding, None),
-            out_shardings=(self.state_shardings, None),
-            donate_argnums=(0,),
-        )
+
+        self._step = build(True)
+        self._step_fast = build(False)
 
     def step(self, batch: np.ndarray):
         # device_put on the host array shards directly host->devices in one
@@ -205,6 +211,13 @@ class DistributedTrainer:
         batch = jax.device_put(batch, self.batch_sharding)
         self.rng, step_rng = jax.random.split(self.rng)
         self.state, metrics = self._step(self.state, batch, step_rng)
+        return metrics
+
+    def step_fast(self, batch: np.ndarray):
+        """Non-logging iteration: no grad-norm sweep."""
+        batch = jax.device_put(batch, self.batch_sharding)
+        self.rng, step_rng = jax.random.split(self.rng)
+        self.state, metrics = self._step_fast(self.state, batch, step_rng)
         return metrics
 
     def fit(
@@ -235,4 +248,5 @@ class DistributedTrainer:
             num_steps,
             log_every=log_every,
             metrics_writer=self.metrics_writer,
+            step_fast=self.step_fast,
         )

@@ -60,9 +60,15 @@ def make_train_step(
     optimizer: optax.GradientTransformation,
     *,
     consensus_fn: Optional[ConsensusFn] = None,
+    with_grad_norm: bool = True,
 ) -> Callable[[TrainState, jnp.ndarray, jax.Array], Tuple[TrainState, dict]]:
     """Build the pure train step. Noise is generated ON DEVICE from the rng
-    (no host->device transfer of noise tensors)."""
+    (no host->device transfer of noise tensors).
+
+    with_grad_norm=False omits the grad-norm metric: optax.global_norm is
+    a full extra sweep over every gradient buffer, pure observability —
+    the fit loops compile BOTH variants and run the fast one on
+    non-logging steps (the sustained-throughput step)."""
     if tcfg.compute_dtype not in ("float32", "bfloat16"):
         raise ValueError(
             f"compute_dtype={tcfg.compute_dtype!r}: must be 'float32' or 'bfloat16'"
@@ -91,11 +97,9 @@ def make_train_step(
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-        metrics = {
-            "loss": loss,
-            "grad_norm": optax.global_norm(grads),
-            "step": state.step,
-        }
+        metrics = {"loss": loss, "step": state.step}
+        if with_grad_norm:
+            metrics["grad_norm"] = optax.global_norm(grads)
         return TrainState(params, opt_state, state.step + 1), metrics
 
     return train_step
@@ -108,14 +112,19 @@ def fit_loop(
     *,
     log_every: int = 10,
     metrics_writer=None,
+    step_fast: Optional[Callable[[Any], dict]] = None,
 ) -> list[dict]:
     """Shared training loop: pull batches, step, log every `log_every`.
-    Used by both the single-device Trainer and the DistributedTrainer."""
+    Used by both the single-device Trainer and the DistributedTrainer.
+    step_fast (when given) runs the non-logging iterations — the variant
+    without observability-only work (grad-norm sweep)."""
     history = []
     t0 = time.perf_counter()
     for i in range(num_steps):
-        metrics = step(next(data))
-        if (i + 1) % log_every == 0 or i == num_steps - 1:
+        logging_step = (i + 1) % log_every == 0 or i == num_steps - 1
+        fn = step if (logging_step or step_fast is None) else step_fast
+        metrics = fn(next(data))
+        if logging_step:
             metrics = {k: float(v) for k, v in metrics.items()}
             metrics["steps_per_sec"] = (i + 1) / (time.perf_counter() - t0)
             history.append(metrics)
@@ -147,11 +156,23 @@ class Trainer:
         self.state, self.optimizer = create_train_state(init_key, cfg, tcfg, optimizer)
         step_fn = make_train_step(cfg, tcfg, self.optimizer, consensus_fn=consensus_fn)
         self._step = jax.jit(step_fn, donate_argnums=(0,))
+        fast_fn = make_train_step(
+            cfg, tcfg, self.optimizer,
+            consensus_fn=consensus_fn, with_grad_norm=False,
+        )
+        self._step_fast = jax.jit(fast_fn, donate_argnums=(0,))
         self.metrics_writer = metrics_writer
 
     def step(self, batch) -> dict:
         self.rng, step_rng = jax.random.split(self.rng)
         self.state, metrics = self._step(self.state, batch, step_rng)
+        return metrics
+
+    def step_fast(self, batch) -> dict:
+        """The sustained-throughput step: no grad-norm sweep (fit runs this
+        on non-logging iterations)."""
+        self.rng, step_rng = jax.random.split(self.rng)
+        self.state, metrics = self._step_fast(self.state, batch, step_rng)
         return metrics
 
     def fit(
@@ -182,4 +203,5 @@ class Trainer:
             num_steps,
             log_every=log_every,
             metrics_writer=self.metrics_writer,
+            step_fast=self.step_fast,
         )

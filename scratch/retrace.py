@@ -1,0 +1,31 @@
+"""Regenerate the train-step profile trace (flagship config, scan_unroll)."""
+import sys
+sys.path.insert(0, "/root/repo")
+import jax
+import jax.numpy as jnp
+
+from glom_tpu.train.trainer import create_train_state, make_train_step
+from glom_tpu.utils.config import GlomConfig, TrainConfig
+
+BATCH = 64  # matches the official bench_train.py config
+cfg = GlomConfig(dim=512, levels=6, image_size=224, patch_size=14)
+tcfg = TrainConfig(batch_size=BATCH, learning_rate=3e-4, compute_dtype="bfloat16",
+                   use_pallas=True, scan_unroll=True)
+state, optimizer = create_train_state(jax.random.PRNGKey(0), cfg, tcfg)
+step_fn = jax.jit(
+    make_train_step(cfg, tcfg, optimizer, with_grad_norm=False),
+    donate_argnums=(0,),
+)
+img = jax.random.normal(jax.random.PRNGKey(1), (BATCH, 3, 224, 224), jnp.float32)
+rng = jax.random.PRNGKey(2)
+
+# warm/compile outside the trace
+state, m = step_fn(state, img, rng)
+print("warm loss:", float(m["loss"]))
+
+out = sys.argv[1] if len(sys.argv) > 1 else "results/profiles/train_step"
+with jax.profiler.trace(out):
+    for i in range(3):
+        state, m = step_fn(state, img, jax.random.fold_in(rng, i))
+    print("traced loss:", float(m["loss"]))  # fetch = sync inside trace
+print("trace written to", out)

@@ -282,6 +282,43 @@ class TestFusedConsensusUpdate:
                 np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5
             )
 
+    def test_streamed_forward_matches(self, monkeypatch):
+        """The large-n streamed forward layout (j as a windowed inner grid
+        axis, (m,l,acc) in scratch) must match the resident-row kernel and
+        the dense reference — forced here by dropping _FWD_ROW_LIMIT so
+        interpret mode exercises it at test size, incl. the saved-stats
+        path through the blockwise backward."""
+        from glom_tpu.kernels import consensus_update as cu
+
+        L, B, side, d = 2, 1, 24, 128
+        n = side * side
+        levels, bu, td = self._rand(jax.random.PRNGKey(5), L, B, n, d)
+        for radius in (0.0, 3.0):
+            want = self._reference(levels, bu, td, side, radius, False)
+            monkeypatch.setattr(cu, "_FWD_ROW_LIMIT", 1)
+            got = cu._fused(levels, bu, td, side, radius, False, True, "auto")
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), rtol=1e-3, atol=2e-5
+            )
+
+            def loss(lv):
+                out = cu._fused(lv, bu, td, side, radius, False, True,
+                                "blockwise")
+                return jnp.mean(out ** 2)
+
+            def loss_ref(lv):
+                out = cu._xla_reference(
+                    lv, bu, td, side=side, radius=radius, attend_self=False
+                )
+                return jnp.mean(out ** 2)
+
+            g1 = jax.grad(loss)(levels)
+            monkeypatch.undo()
+            g2 = jax.grad(loss_ref)(levels)
+            np.testing.assert_allclose(
+                np.asarray(g1), np.asarray(g2), rtol=2e-3, atol=2e-5
+            )
+
     def test_grad_dense_dispatch_matches_blockwise(self):
         """Both sides of the backward dispatch (dense-recompute VJP vs the
         streamed blockwise kernels) must produce the same gradients; 'auto'
@@ -314,8 +351,15 @@ class TestFusedConsensusUpdate:
         blockwise; forced sides are honored."""
         from glom_tpu.kernels.consensus_update import _use_blockwise_bwd
 
-        # flagship: n=256, global -> dense
-        assert not _use_blockwise_bwd((6, 64, 256, 512), 16, 0.0, "auto")
+        # flagship train (B=64, single-tile row): batched regime ->
+        # blockwise (measured faster at the full train step)
+        assert _use_blockwise_bwd((6, 64, 256, 512), 16, 0.0, "auto")
+        # small-batch inference-style at n=256 -> dense
+        assert not _use_blockwise_bwd((6, 2, 256, 512), 16, 0.0, "auto")
+        # batched long-row global (unmeasured region): stays dense until
+        # the sim-buffer memory cap trips
+        assert not _use_blockwise_bwd((6, 8, 1024, 512), 32, 0.0, "auto")
+        assert _use_blockwise_bwd((6, 8, 4096, 512), 64, 0.0, "auto")  # 6.4GB sim
         # n=4096 global, small batch: sim fits -> dense (measured faster)
         assert not _use_blockwise_bwd((6, 1, 4096, 512), 64, 0.0, "auto")
         # n=4096, radius 7 on side 64: band covers <1/2 the row -> blockwise
