@@ -226,6 +226,11 @@ def run_loss_curve(num_steps: int, out_path: str):
         {
             "summary": True,
             "config": "cifar10",
+            # Honest data provenance: the CIFAR-10 *config* trained on the
+            # procedural shapes dataset — no real dataset ships in this
+            # zero-egress environment (real data runs use --data-dir via
+            # the CLI; see data/loaders.py).
+            "data": "synthetic-shapes",
             "chip": chip,
             "steps": num_steps,
             "final_loss": history[-1]["loss"],
