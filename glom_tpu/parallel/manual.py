@@ -467,12 +467,29 @@ def make_manual_train_step(
         raise ValueError(
             f"compute_dtype={tcfg.compute_dtype!r}: must be 'float32' or 'bfloat16'"
         )
+    if tcfg.grad_accum < 1 or tcfg.batch_size % tcfg.grad_accum != 0:
+        raise ValueError(
+            f"grad_accum={tcfg.grad_accum} must divide batch_size="
+            f"{tcfg.batch_size}"
+        )
+    if (tcfg.batch_size // tcfg.grad_accum) % mesh.shape[DATA_AXIS] != 0:
+        raise ValueError(
+            f"microbatch {tcfg.batch_size // tcfg.grad_accum} not divisible "
+            f"by data axis {mesh.shape[DATA_AXIS]}"
+        )
     loss_fn = make_manual_loss(mesh, cfg, tcfg, sp_strategy=sp_strategy)
 
     def train_step(state: TrainState, img: jnp.ndarray, rng: jax.Array):
         noise_rng = jax.random.fold_in(rng, state.step)
         noise = tcfg.noise_std * jax.random.normal(noise_rng, img.shape, img.dtype)
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, img, noise)
+        if tcfg.grad_accum > 1:
+            from glom_tpu.train.trainer import accumulate_grads
+
+            loss, grads = accumulate_grads(
+                loss_fn, state.params, img, noise, tcfg.grad_accum
+            )
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(state.params, img, noise)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         metrics = {"loss": loss, "step": state.step}

@@ -132,6 +132,17 @@ class DistributedTrainer:
             raise ValueError(
                 f"batch {tcfg.batch_size} not divisible by data axis {mesh_cfg.data}"
             )
+        if (
+            tcfg.grad_accum > 1
+            and (tcfg.batch_size // tcfg.grad_accum) % mesh_cfg.data != 0
+        ):
+            # Both step paths (GSPMD and manual) scan over microbatches;
+            # an indivisible microbatch would silently pad/idle devices.
+            raise ValueError(
+                f"microbatch {tcfg.batch_size // tcfg.grad_accum} "
+                f"(batch {tcfg.batch_size} / grad_accum {tcfg.grad_accum}) "
+                f"not divisible by data axis {mesh_cfg.data}"
+            )
         if cfg.num_patches % mesh_cfg.seq != 0:
             raise ValueError(
                 f"patches {cfg.num_patches} not divisible by seq axis {mesh_cfg.seq}"

@@ -30,6 +30,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--learning-rate", type=float, default=None)
+    p.add_argument(
+        "--lr-schedule", choices=["constant", "cosine", "warmup_cosine"],
+        default=None,
+    )
+    p.add_argument("--warmup-steps", type=int, default=None)
+    p.add_argument(
+        "--schedule-steps", type=int, default=None,
+        help="cosine decay horizon (defaults to --steps when a schedule is set)",
+    )
+    p.add_argument(
+        "--grad-accum", type=int, default=None, metavar="A",
+        help="split each batch into A microbatches, accumulate grads, one "
+        "optimizer update (peak activation memory of one microbatch)",
+    )
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--data", choices=["shapes", "gaussian"], default="shapes")
     p.add_argument(
@@ -85,6 +99,21 @@ def main(argv=None) -> int:
         overrides["batch_size"] = args.batch_size
     if args.learning_rate is not None:
         overrides["learning_rate"] = args.learning_rate
+    if args.lr_schedule is not None:
+        overrides["lr_schedule"] = args.lr_schedule
+        overrides["schedule_steps"] = (
+            args.schedule_steps if args.schedule_steps is not None else args.steps
+        )
+    elif args.schedule_steps is not None or args.warmup_steps is not None:
+        # Fail loudly instead of silently training at a constant LR.
+        raise SystemExit(
+            "--schedule-steps/--warmup-steps require --lr-schedule "
+            "(the preset's default schedule is 'constant')"
+        )
+    if args.warmup_steps is not None:
+        overrides["warmup_steps"] = args.warmup_steps
+    if args.grad_accum is not None:
+        overrides["grad_accum"] = args.grad_accum
     if args.seed is not None:
         overrides["seed"] = args.seed
     if overrides:

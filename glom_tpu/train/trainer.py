@@ -48,10 +48,70 @@ def create_train_state(
     )
 
 
+def make_lr_schedule(tcfg: TrainConfig):
+    """Learning-rate schedule from the config: a float (constant) or an
+    optax schedule fn. Cosine decays to lr_final_fraction * lr; for
+    warmup_cosine, schedule_steps is the TOTAL length INCLUDING the
+    linear warmup (optax semantics: cosine decay runs over
+    schedule_steps - warmup_steps)."""
+    if tcfg.lr_schedule == "constant":
+        return tcfg.learning_rate
+    if tcfg.lr_schedule == "cosine":
+        return optax.cosine_decay_schedule(
+            tcfg.learning_rate, tcfg.schedule_steps, alpha=tcfg.lr_final_fraction
+        )
+    if tcfg.lr_schedule == "warmup_cosine":
+        if not 0 <= tcfg.warmup_steps < tcfg.schedule_steps:
+            raise ValueError(
+                f"warmup_steps={tcfg.warmup_steps} must be < schedule_steps="
+                f"{tcfg.schedule_steps} (schedule_steps is the TOTAL length "
+                "including warmup)"
+            )
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=tcfg.learning_rate,
+            warmup_steps=tcfg.warmup_steps,
+            decay_steps=tcfg.schedule_steps,
+            end_value=tcfg.learning_rate * tcfg.lr_final_fraction,
+        )
+    raise ValueError(
+        f"lr_schedule={tcfg.lr_schedule!r}: one of 'constant', 'cosine', "
+        "'warmup_cosine'"
+    )
+
+
+def accumulate_grads(loss_fn, params, img, noise, accum: int):
+    """Exact microbatch gradient accumulation shared by the single-device
+    and manual-shard_map train steps: STRIDED split (microbatch i takes
+    rows i, i+accum, ...) so a batch sharded over a 'data' mesh axis keeps
+    every microbatch row-local to its shard (a contiguous split would
+    reshuffle half the batch across devices on every scan step); the
+    accumulated sum over all examples is invariant to the grouping, so
+    loss/grads equal the full-batch values exactly (mean of microbatch
+    means). Returns (loss, grads)."""
+    imgs = img.reshape(-1, accum, *img.shape[1:]).swapaxes(0, 1)
+    noises = noise.reshape(-1, accum, *noise.shape[1:]).swapaxes(0, 1)
+
+    def micro(carry, xs):
+        acc_l, acc_g = carry
+        mi, mn = xs
+        l, g = jax.value_and_grad(loss_fn)(params, mi, mn)
+        return (acc_l + l, jax.tree_util.tree_map(jnp.add, acc_g, g)), None
+
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    (loss_sum, grads_sum), _ = jax.lax.scan(
+        micro, (jnp.zeros((), jnp.float32), zeros), (imgs, noises)
+    )
+    return loss_sum / accum, jax.tree_util.tree_map(
+        lambda t: t / accum, grads_sum
+    )
+
+
 def default_optimizer(tcfg: TrainConfig) -> optax.GradientTransformation:
+    lr = make_lr_schedule(tcfg)
     if tcfg.weight_decay > 0:
-        return optax.adamw(tcfg.learning_rate, weight_decay=tcfg.weight_decay)
-    return optax.adam(tcfg.learning_rate)
+        return optax.adamw(lr, weight_decay=tcfg.weight_decay)
+    return optax.adam(lr)
 
 
 def make_train_step(
@@ -73,28 +133,38 @@ def make_train_step(
         raise ValueError(
             f"compute_dtype={tcfg.compute_dtype!r}: must be 'float32' or 'bfloat16'"
         )
+    if tcfg.grad_accum < 1 or tcfg.batch_size % tcfg.grad_accum != 0:
+        raise ValueError(
+            f"grad_accum={tcfg.grad_accum} must divide batch_size="
+            f"{tcfg.batch_size}"
+        )
     compute_dtype = jnp.bfloat16 if tcfg.compute_dtype == "bfloat16" else None
+
+    def loss_of(params, img, noise):
+        return denoise_loss(
+            params,
+            img,
+            noise,
+            cfg,
+            recon_index=tcfg.recon_iter_index,
+            iters=tcfg.iters,
+            remat=tcfg.remat,
+            compute_dtype=compute_dtype,
+            consensus_fn=consensus_fn,
+            use_pallas=tcfg.use_pallas,
+            unroll=tcfg.scan_unroll,
+        )
 
     def train_step(state: TrainState, img: jnp.ndarray, rng: jax.Array):
         noise_rng = jax.random.fold_in(rng, state.step)
         noise = tcfg.noise_std * jax.random.normal(noise_rng, img.shape, img.dtype)
 
-        def loss_fn(params):
-            return denoise_loss(
-                params,
-                img,
-                noise,
-                cfg,
-                recon_index=tcfg.recon_iter_index,
-                iters=tcfg.iters,
-                remat=tcfg.remat,
-                compute_dtype=compute_dtype,
-                consensus_fn=consensus_fn,
-                use_pallas=tcfg.use_pallas,
-                unroll=tcfg.scan_unroll,
+        if tcfg.grad_accum > 1:
+            loss, grads = accumulate_grads(
+                loss_of, state.params, img, noise, tcfg.grad_accum
             )
-
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        else:
+            loss, grads = jax.value_and_grad(loss_of)(state.params, img, noise)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         metrics = {"loss": loss, "step": state.step}

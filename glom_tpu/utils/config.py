@@ -101,6 +101,21 @@ class TrainConfig:
     batch_size: int = 8
     learning_rate: float = 1e-4
     weight_decay: float = 0.0
+    # Learning-rate schedule: "constant" | "cosine" | "warmup_cosine".
+    # Cosine decays to lr_final_fraction * learning_rate; schedule_steps
+    # is the TOTAL schedule length — for warmup_cosine that INCLUDES the
+    # warmup_steps of linear warmup (cosine decay then spans
+    # schedule_steps - warmup_steps; optax semantics). Anything beyond
+    # these composes via passing an optax optimizer to the Trainer.
+    lr_schedule: str = "constant"
+    schedule_steps: int = 10_000
+    warmup_steps: int = 0
+    lr_final_fraction: float = 0.0
+    # Gradient accumulation: split each batch into grad_accum microbatches,
+    # scan value_and_grad over them accumulating gradients, ONE optimizer
+    # update — trains an effective batch grad_accum x larger than what
+    # fits in HBM at once (batch_size must divide evenly).
+    grad_accum: int = 1
     noise_std: float = 1.0
     # Which stacked iteration's top level feeds the reconstruction head.
     # Reference README uses index 7 for L=6/T=12 (mid-iteration top level).

@@ -182,6 +182,30 @@ def test_manual_train_step_matches_single_device():
     assert int(state2.step) == 1
 
 
+def test_manual_grad_accum_matches_full_batch():
+    """Microbatch accumulation through the manual shard_map region: same
+    post-step params as the full-batch manual step."""
+    mesh = make_mesh(MeshConfig(data=2, seq=2), jax.devices()[:4])
+    img, _ = _data()
+    rng = jax.random.PRNGKey(7)
+    states = []
+    for tcfg in (TCFG, dataclasses.replace(TCFG, grad_accum=2)):
+        state, opt = create_train_state(jax.random.PRNGKey(0), CFG, tcfg)
+        step = jax.jit(
+            make_manual_train_step(mesh, CFG, tcfg, opt, sp_strategy="ring")
+        )
+        state, metrics = step(state, img, rng)
+        assert np.isfinite(float(metrics["loss"]))
+        states.append(state)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(states[0].params),
+        jax.tree_util.tree_leaves(states[1].params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+        )
+
+
 def test_tp_hidden_uses_manual_path():
     """Hidden-axis TP + use_pallas rides the manual shard_map path (round-2
     VERDICT item 1: the pod preset must reach the fused kernels), and a
