@@ -1,4 +1,8 @@
-from glom_tpu.kernels.grouped_mlp import fused_grouped_ffw
+from glom_tpu.kernels.grouped_mlp import fused_grouped_ffw, fused_grouped_ffw_lm
 from glom_tpu.kernels.consensus_update import fused_consensus_update
 
-__all__ = ["fused_grouped_ffw", "fused_consensus_update"]
+__all__ = [
+    "fused_consensus_update",
+    "fused_grouped_ffw",
+    "fused_grouped_ffw_lm",
+]

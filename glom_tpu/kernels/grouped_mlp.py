@@ -115,6 +115,36 @@ def _mlp_kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, out_ref, *pre_ref):
     out_ref[0] = out.astype(out_ref.dtype)
 
 
+def _tiled_add(x, a):
+    """x [TM, d] + a [n, d] with TM % n == 0: the positional addend
+    repeats every n rows (M = b*n with n inner), so the tile-local add is
+    a reshape-broadcast — no materialized [G, M, d] sum ever hits HBM."""
+    tm, d = x.shape
+    n = a.shape[0]
+    return (x.reshape(tm // n, n, d) + a[None]).reshape(tm, d)
+
+
+def _mlp_kernel_add(x_ref, a_ref, w1_ref, b1_ref, w2_ref, b2_ref, out_ref,
+                    *pre_ref):
+    """_mlp_kernel with a positional addend folded into the input load:
+    pre = (x + a)@w1 + b1. A trailing pre output is present only on the
+    training forward (no-grad forwards skip the [G, M, f] HBM write);
+    GELU form follows the dtype like _mlp_kernel."""
+    xa = _tiled_add(x_ref[0], a_ref[...]).astype(x_ref.dtype)
+    pre = jnp.dot(xa, w1_ref[0], preferred_element_type=jnp.float32)
+    pre = pre + b1_ref[0].astype(jnp.float32)
+    if pre_ref:
+        pre_ref[0][0] = pre.astype(xa.dtype)
+    if xa.dtype == jnp.bfloat16:
+        h = jax.nn.gelu(pre, approximate=True)
+    else:
+        h = _gelu_exact(pre)
+    h = h.astype(xa.dtype)
+    out = jnp.dot(h, w2_ref[0], preferred_element_type=jnp.float32)
+    out = out + b2_ref[0].astype(jnp.float32)
+    out_ref[0] = out.astype(out_ref.dtype)
+
+
 def _fused_forward(
     params: GroupedFFWParams,
     x: jnp.ndarray,
@@ -163,6 +193,44 @@ def _fused_forward(
         ),
         interpret=interpret,
     )(x, params.w1, params.b1[:, None, :], params.w2, params.b2[:, None, :])
+
+
+def _fused_forward_add(
+    params: GroupedFFWParams,
+    x: jnp.ndarray,
+    a: jnp.ndarray,
+    *,
+    tile_m: int,
+    interpret: bool,
+    save_pre: bool = False,
+):
+    """Forward with the positional addend folded in-kernel. x [G, M, d],
+    a [n, d] with tile_m % n == 0; save_pre only on the training path
+    (a no-grad forward must not write the [G, M, f] pre to HBM)."""
+    G, M, d = x.shape
+    f = params.w1.shape[-1]
+    grid = (G, M // tile_m)
+    out_shape = jax.ShapeDtypeStruct((G, M, d), x.dtype)
+    out_spec = pl.BlockSpec((1, tile_m, d), lambda g, m: (g, m, 0))
+    if save_pre:
+        out_shape = (out_shape, jax.ShapeDtypeStruct((G, M, f), x.dtype))
+        out_spec = (out_spec, pl.BlockSpec((1, tile_m, f), lambda g, m: (g, m, 0)))
+    return pl.pallas_call(
+        _mlp_kernel_add,
+        out_shape=out_shape,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, tile_m, d), lambda g, m: (g, m, 0)),  # x
+            pl.BlockSpec(a.shape, lambda g, m: (0, 0)),  # add (resident)
+            pl.BlockSpec((1, d, f), lambda g, m: (g, 0, 0)),  # w1
+            pl.BlockSpec((1, 1, f), lambda g, m: (g, 0, 0)),  # b1
+            pl.BlockSpec((1, f, d), lambda g, m: (g, 0, 0)),  # w2
+            pl.BlockSpec((1, 1, d), lambda g, m: (g, 0, 0)),  # b2
+        ],
+        out_specs=out_spec,
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024),
+        interpret=interpret,
+    )(x, a, params.w1, params.b1[:, None, :], params.w2, params.b2[:, None, :])
 
 
 # Forward row tiles. 1024 overflowed the default scope in-scan when this was
@@ -276,6 +344,7 @@ def _mlp_bwd_tail(pre, x, g, w1, w2, dx_ref, dw1_ref, db1_ref, dw2_ref, db2_ref)
     # dx = dpre @ w1^T (contract f)
     dx = jax.lax.dot_general(dpre, w1, (((1,), (1,)), ((), ())), preferred_element_type=f32)
     dx_ref[0] = dx.astype(dx_ref.dtype)
+    dx32 = dx  # returned for the add-variant's da accumulation
 
     # Weight/bias grad contributions of this row tile (contract TM).
     dw1_step = jax.lax.dot_general(
@@ -301,6 +370,8 @@ def _mlp_bwd_tail(pre, x, g, w1, w2, dx_ref, dw1_ref, db1_ref, dw2_ref, db2_ref)
         dw2_ref[0] += dw2_step
         db2_ref[0] += db2_step
 
+    return dx32
+
 
 def _mlp_bwd_kernel_saved(
     x_ref,      # [1, TM, d]
@@ -325,6 +396,45 @@ def _mlp_bwd_kernel_saved(
         pre_ref[0].astype(jnp.float32), x_ref[0], g_ref[0], w1_ref[0], w2_ref[0],
         dx_ref, dw1_ref, db1_ref, dw2_ref, db2_ref,
     )
+
+
+def _mlp_bwd_kernel_saved_add(
+    x_ref,      # [1, TM, d]   RAW x (addend NOT applied)
+    a_ref,      # [n, d]       positional addend (resident)
+    w1_ref,     # [1, d, f]
+    pre_ref,    # [1, TM, f]   saved pre (already includes the addend)
+    w2_ref,     # [1, f, d]
+    g_ref,      # [1, TM, d]
+    dx_ref,     # [1, TM, d]
+    dw1_ref,    # [1, d, f]    f32 accumulators (constant index across m)
+    db1_ref,    # [1, 1, f]
+    dw2_ref,    # [1, f, d]
+    db2_ref,    # [1, 1, d]
+    da_ref,     # [n, d]       f32 accumulator, constant index across the
+                #              WHOLE grid: da = sum over groups, batch
+                #              copies, and tiles of dx
+):
+    """_mlp_bwd_kernel_saved for the folded positional addend: the dw1
+    contraction uses xa = x + tile(a) (the true layer input), dx is the
+    cotangent of BOTH x and (reduced) a — the da reduction rides the
+    kernel instead of a separate XLA sweep."""
+    xa = _tiled_add(x_ref[0], a_ref[...]).astype(x_ref.dtype)
+    dx32 = _mlp_bwd_tail(
+        pre_ref[0].astype(jnp.float32), xa, g_ref[0], w1_ref[0], w2_ref[0],
+        dx_ref, dw1_ref, db1_ref, dw2_ref, db2_ref,
+    )
+    tm, d = dx32.shape
+    n = a_ref.shape[0]
+    da_step = jnp.sum(dx32.reshape(tm // n, n, d), axis=0)
+    first = (pl.program_id(0) == 0) & (pl.program_id(1) == 0)
+
+    @pl.when(first)
+    def _init_da():
+        da_ref[...] = da_step
+
+    @pl.when(jnp.logical_not(first))
+    def _accum_da():
+        da_ref[...] += da_step
 
 
 # Larger row tiles give the in-kernel dw matmuls a longer contraction axis;
@@ -401,6 +511,55 @@ def _fused_backward(params, x, g, *, tile_m: int, interpret: bool, pre=None):
     return grads, dx
 
 
+def _fused_backward_add(params, x, a, pre, g, *, tile_m: int, interpret: bool):
+    """_fused_backward for the folded-addend path (saved-pre form only):
+    additionally emits da [n, d] accumulated in-kernel across the whole
+    grid."""
+    G, M, d = x.shape
+    f = params.w1.shape[-1]
+    f32 = jnp.float32
+    n = a.shape[0]
+    dx, dw1, db1, dw2, db2, da = pl.pallas_call(
+        _mlp_bwd_kernel_saved_add,
+        out_shape=(
+            jax.ShapeDtypeStruct((G, M, d), x.dtype),  # dx
+            jax.ShapeDtypeStruct((G, d, f), f32),  # dw1
+            jax.ShapeDtypeStruct((G, 1, f), f32),  # db1
+            jax.ShapeDtypeStruct((G, f, d), f32),  # dw2
+            jax.ShapeDtypeStruct((G, 1, d), f32),  # db2
+            jax.ShapeDtypeStruct((n, d), f32),  # da
+        ),
+        grid=(G, M // tile_m),
+        in_specs=[
+            pl.BlockSpec((1, tile_m, d), lambda gi, m: (gi, m, 0)),  # x
+            pl.BlockSpec((n, d), lambda gi, m: (0, 0)),  # a (resident)
+            pl.BlockSpec((1, d, f), lambda gi, m: (gi, 0, 0)),  # w1
+            pl.BlockSpec((1, tile_m, f), lambda gi, m: (gi, m, 0)),  # pre
+            pl.BlockSpec((1, f, d), lambda gi, m: (gi, 0, 0)),  # w2
+            pl.BlockSpec((1, tile_m, d), lambda gi, m: (gi, m, 0)),  # g
+        ],
+        out_specs=(
+            pl.BlockSpec((1, tile_m, d), lambda gi, m: (gi, m, 0)),  # dx
+            pl.BlockSpec((1, d, f), lambda gi, m: (gi, 0, 0)),  # dw1
+            pl.BlockSpec((1, 1, f), lambda gi, m: (gi, 0, 0)),  # db1
+            pl.BlockSpec((1, f, d), lambda gi, m: (gi, 0, 0)),  # dw2
+            pl.BlockSpec((1, 1, d), lambda gi, m: (gi, 0, 0)),  # db2
+            pl.BlockSpec((n, d), lambda gi, m: (0, 0)),  # da (whole-grid acc)
+        ),
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024),
+        interpret=interpret,
+    )(x, a, params.w1, pre, params.w2, g)
+
+    w1, b1, w2, b2 = params
+    grads = GroupedFFWParams(
+        dw1.astype(w1.dtype),
+        db1[:, 0].astype(b1.dtype),
+        dw2.astype(w2.dtype),
+        db2[:, 0].astype(b2.dtype),
+    )
+    return grads, dx, da.astype(a.dtype)
+
+
 def _weight_grads(params, x, dpre, h, g):
     """The four weight/bias grads shared by both backward paths: batched
     matmuls with f32 accumulation, results cast back to the param dtypes."""
@@ -462,6 +621,20 @@ def _fused_lm(params, x, tile_m, interpret):
 _SAVE_PRE_LIMIT = 512 * 1024 * 1024
 
 
+def _save_pre_ok(params: GroupedFFWParams, x: jnp.ndarray) -> bool:
+    """Single source of the save-pre eligibility (bf16, bwd-tileable,
+    residual under the memory cap) — shared by the plain training forward
+    and the folded-addend gate so the invariant cannot drift."""
+    f = params.w1.shape[-1]
+    save_bytes = x.shape[0] * x.shape[1] * f * x.dtype.itemsize
+    return (
+        x.dtype == jnp.bfloat16
+        and _pick_bwd_tile(x.shape[1], x.shape[2], f, x.dtype.itemsize)
+        is not None
+        and save_bytes <= _SAVE_PRE_LIMIT
+    )
+
+
 def _fwd(params, x, tile_m, interpret):
     # bf16 training: ALSO save the pre-activation so the backward kernel
     # drops its recompute matmul (5 -> 4 per tile). The [G, M, f] bf16
@@ -471,15 +644,8 @@ def _fwd(params, x, tile_m, interpret):
     # back then the backward also emitted dpre/h and the extra output
     # overflowed VMEM at useful tiles. f32 keeps the recompute (saving f32
     # pre doubles the traffic and f32 runs are parity/testing paths).
-    # Gated on _SAVE_PRE_LIMIT so large non-remat configs keep recompute.
-    save_bytes = x.shape[0] * x.shape[1] * params.w1.shape[-1] * x.dtype.itemsize
-    if (
-        x.dtype == jnp.bfloat16
-        and _pick_bwd_tile(
-            x.shape[1], x.shape[2], params.w1.shape[-1], x.dtype.itemsize
-        ) is not None
-        and save_bytes <= _SAVE_PRE_LIMIT
-    ):
+    # Gated on _save_pre_ok so large non-remat configs keep recompute.
+    if _save_pre_ok(params, x):
         out, pre = _fused_forward(
             params, x, tile_m=tile_m, interpret=interpret, save_pre=True
         )
@@ -505,6 +671,47 @@ def _bwd(tile_m, interpret, res, g):
 _fused_lm.defvjp(_fwd, _bwd)
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _fused_lm_add(params, x, a, tile_m, interpret):
+    """Level-major core with a folded positional addend: equals
+    _fused_lm(params, x + tile(a)) but the [G, M, d] sum never exists —
+    the kernels add the [n, d] addend on tile load (forward AND backward),
+    and da is reduced in-kernel. The primal (no-grad forward) skips the
+    pre write; the training forward saves it (callers gate eligibility
+    via _save_pre_ok)."""
+    return _fused_forward_add(params, x, a, tile_m=tile_m, interpret=interpret)
+
+
+def _fwd_add(params, x, a, tile_m, interpret):
+    out, pre = _fused_forward_add(
+        params, x, a, tile_m=tile_m, interpret=interpret, save_pre=True
+    )
+    return out, (params, x, a, pre)
+
+
+def _bwd_add(tile_m, interpret, res, g):
+    params, x, a, pre = res
+    bt = _pick_bwd_tile(x.shape[1], x.shape[2], params.w1.shape[-1], x.dtype.itemsize)
+    if bt is not None and bt % a.shape[0] == 0:
+        return _fused_backward_add(
+            params, x, a, pre, g, tile_m=bt, interpret=interpret
+        )
+    # Fallback (shouldn't trigger given the caller gate, but stays exact):
+    # recompute xa in XLA and reduce da there.
+    G, M, d = x.shape
+    reps = M // a.shape[0]
+    xa = x + jnp.tile(a, (reps, 1))[None]
+    params_b, xa_b, g_b = jax.lax.optimization_barrier((params, xa, g))
+    grads, dxa = _xla_backward(params_b, xa_b, g_b)
+    da = jnp.sum(
+        dxa.astype(jnp.float32).reshape(G, reps, a.shape[0], d), axis=(0, 1)
+    )
+    return grads, dxa, da.astype(a.dtype)
+
+
+_fused_lm_add.defvjp(_fwd_add, _bwd_add)
+
+
 _xla_lm = grouped_ffw_lm  # XLA fallback in level-major layout
 
 
@@ -512,18 +719,56 @@ def fused_grouped_ffw_lm(
     params: GroupedFFWParams,
     x: jnp.ndarray,
     *,
+    add: jnp.ndarray | None = None,
     tile_m: int | None = None,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Level-major entry: x [G, M, d] -> [G, M, d] through the Pallas kernel
-    (XLA einsum fallback off-TPU / unsupported shapes)."""
+    (XLA einsum fallback off-TPU / unsupported shapes).
+
+    add: optional [n, d] positional addend with M = b*n (n inner): the
+    result equals fused_grouped_ffw_lm(params, x + tile(add)) but on the
+    bf16 training path the add folds into the kernels' tile loads and the
+    [G, M, d] sum never touches HBM (~2 ms/step at the flagship config).
+    Unsupported shapes/dtypes fall back to the explicit add."""
     G, M, d = x.shape
     if tile_m is None:
         tile_m = _pick_tile(M, d, params.w1.shape[-1], x.dtype.itemsize)
     elif M % tile_m != 0:
         tile_m = None
     on_tpu = jax.devices()[0].platform == "tpu"
-    if not _supported(params, x, tile_m) or not (on_tpu or interpret):
+    kernel_ok = _supported(params, x, tile_m) and (on_tpu or interpret)
+    if add is not None:
+        n = add.shape[0]
+        f = params.w1.shape[-1]
+        bt = (
+            _pick_bwd_tile(M, d, f, x.dtype.itemsize) if kernel_ok else None
+        )
+        # The add-backward keeps two extra residents the generic _bwd_ws
+        # model doesn't count: the [n, d] addend block and the whole-grid
+        # f32 da accumulator.
+        add_extra = n * d * (x.dtype.itemsize + 4)
+        fold = (
+            kernel_ok
+            # bf16 is the production fold; f32 folds only under interpret
+            # (CI coverage of the add kernels — f32 save-pre stays off the
+            # hardware path, same verdict as the plain save-pre gate).
+            and (_save_pre_ok(params, x) or (interpret and x.dtype == jnp.float32))
+            # No dtype-promotion surprise: the fold computes in x.dtype,
+            # so only take it when the explicit x + add would too.
+            and jnp.result_type(x.dtype, add.dtype) == x.dtype
+            and M % n == 0
+            and tile_m % n == 0
+            and bt is not None
+            and bt % n == 0
+            and _bwd_ws(bt, d, f, x.dtype.itemsize) + add_extra <= _WS_BUDGET
+        )
+        if fold:
+            return _fused_lm_add(params, x, add.astype(x.dtype), tile_m, interpret)
+        # Fallback preserves jnp promotion semantics (e.g. f32 pos_emb +
+        # bf16 carry promotes to f32, exactly like the explicit add did).
+        x = x + jnp.tile(add, (M // n, 1))[None]
+    if not kernel_ok:
         return _xla_lm(params, x)
     return _fused_lm(params, x, tile_m, interpret)
 

@@ -233,7 +233,6 @@ def _glom_forward_fused(
     b, n, d = tokens.shape
     L = cfg.levels
     tokens_lm = tokens[None]  # [1, b, n, d]
-    pos_lm = params.pos_emb[None, None]  # [1, 1, n, d]
 
     if exists(levels_in):
         # Keep the caller's carry dtype (the reference path's scan carry is
@@ -256,11 +255,14 @@ def _glom_forward_fused(
             ).reshape(L, b, n, d)
         # Top-down input: levels 2..L with pos-emb injected HERE only
         # (reference :129); the top level's zero pad + the 4-vs-3 divisor
-        # live in the consensus kernel's epilogue.
+        # live in the consensus kernel's epilogue. The pos addend folds
+        # into the kernel's tile loads (add=) — the [L-1, b, n, d] sum
+        # never materializes on the fused path.
         with jax.named_scope("top_down"):
-            td_in = lv[1:] + pos_lm
             td_out = fused_grouped_ffw_lm(
-                params.top_down, td_in.reshape(L - 1, b * n, d)
+                params.top_down,
+                lv[1:].reshape(L - 1, b * n, d),
+                add=params.pos_emb,
             ).reshape(L - 1, b, n, d)
         with jax.named_scope("consensus_update"):
             new = fused_consensus_update(

@@ -154,6 +154,71 @@ class TestFusedGroupedFFW:
             for e in dots:
                 assert e.params["preferred_element_type"] == jnp.float32
 
+    def test_add_kwarg_fallback_matches_explicit(self, setup):
+        """f32 (no fold: bf16-only path) add= must equal the explicit
+        x + tile(add) composition — the wrapper's fallback correctness."""
+        from glom_tpu.kernels import fused_grouped_ffw_lm
+
+        params, _ = setup
+        G, n, d = 4, 8, 128
+        M = 2 * n
+        x = jax.random.normal(jax.random.PRNGKey(5), (G, M, d), jnp.float32)
+        a = jax.random.normal(jax.random.PRNGKey(6), (n, d), jnp.float32)
+
+        def loss_add(p, x_, a_):
+            out = fused_grouped_ffw_lm(p, x_, add=a_, interpret=True)
+            return jnp.mean(out ** 2)
+
+        def loss_exp(p, x_, a_):
+            xa = x_ + jnp.tile(a_, (M // n, 1))[None]
+            out = fused_grouped_ffw_lm(p, xa, interpret=True)
+            return jnp.mean(out ** 2)
+
+        v1, g1 = jax.value_and_grad(loss_add, argnums=(0, 1, 2))(params, x, a)
+        v2, g2 = jax.value_and_grad(loss_exp, argnums=(0, 1, 2))(params, x, a)
+        np.testing.assert_allclose(float(v1), float(v2), rtol=1e-6)
+        for t1, t2 in zip(
+            jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(t1), np.asarray(t2), rtol=1e-5, atol=1e-6
+            )
+
+    def test_add_fold_kernels_match_explicit(self, setup):
+        """The FOLD path itself (f32 under interpret — CI coverage of
+        _mlp_kernel_add / _mlp_bwd_kernel_saved_add and the whole-grid da
+        accumulation): forward and ALL grads incl. da must equal the
+        explicit x + tile(add) composition."""
+        from glom_tpu.kernels import fused_grouped_ffw_lm
+        from glom_tpu.kernels.grouped_mlp import _pick_tile
+        from glom_tpu.ops.ffw import init_grouped_ffw
+
+        G, n, d = 3, 128, 128
+        M = 2 * n
+        params = init_grouped_ffw(jax.random.PRNGKey(9), G, d, mult=4)
+        x = jax.random.normal(jax.random.PRNGKey(10), (G, M, d), jnp.float32)
+        a = jax.random.normal(jax.random.PRNGKey(11), (n, d), jnp.float32)
+        assert _pick_tile(M, d, 4 * d, 4) % n == 0  # the fold gate holds
+
+        def loss_add(p, x_, a_):
+            out = fused_grouped_ffw_lm(p, x_, add=a_, interpret=True)
+            return jnp.mean(out ** 2)
+
+        def loss_exp(p, x_, a_):
+            xa = x_ + jnp.tile(a_, (M // n, 1))[None]
+            out = fused_grouped_ffw_lm(p, xa, interpret=True)
+            return jnp.mean(out ** 2)
+
+        v1, g1 = jax.value_and_grad(loss_add, argnums=(0, 1, 2))(params, x, a)
+        v2, g2 = jax.value_and_grad(loss_exp, argnums=(0, 1, 2))(params, x, a)
+        np.testing.assert_allclose(float(v1), float(v2), rtol=1e-5)
+        for t1, t2 in zip(
+            jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(t1), np.asarray(t2), rtol=2e-4, atol=1e-5
+            )
+
     def test_bwd_xla_fallback_grad(self, setup):
         """M=192 has no 128-divisible bwd tile -> _bwd must take the
         barrier+XLA fallback (with explicit fwd tile 64) and still match the

@@ -118,6 +118,45 @@ def _consensus_case(side, radius, dtype, rtol, atol, grad, bwd_impl="blockwise")
         )
 
 
+@check("grouped_ffw_bf16_add_fold_parity")
+def check_ffw_add_fold():
+    """The folded positional addend (add=) must equal the explicit
+    x + tile(add) composition — forward AND all grads including da (the
+    pos-emb cotangent reduced in-kernel across the whole grid)."""
+    from glom_tpu.kernels import fused_grouped_ffw_lm
+    from glom_tpu.ops.ffw import init_grouped_ffw
+
+    G, b, n, d = 5, 4, 256, 512
+    M = b * n
+    params = _bf16_tree(init_grouped_ffw(jax.random.PRNGKey(0), G, d, mult=4))
+    x = jax.random.normal(jax.random.PRNGKey(1), (G, M, d), jnp.bfloat16)
+    a = jax.random.normal(jax.random.PRNGKey(2), (n, d), jnp.bfloat16)
+
+    def loss_fold(p, x_, a_):
+        out = fused_grouped_ffw_lm(p, x_, add=a_)
+        return jnp.mean(out.astype(jnp.float32) ** 2)
+
+    def loss_explicit(p, x_, a_):
+        xa = x_ + jnp.tile(a_, (M // n, 1))[None]
+        out = fused_grouped_ffw_lm(p, xa)
+        return jnp.mean(out.astype(jnp.float32) ** 2)
+
+    v1, g1 = jax.jit(jax.value_and_grad(loss_fold, argnums=(0, 1, 2)))(
+        params, x, a
+    )
+    v2, g2 = jax.jit(jax.value_and_grad(loss_explicit, argnums=(0, 1, 2)))(
+        params, x, a
+    )
+    np.testing.assert_allclose(float(v1), float(v2), rtol=2e-3)
+    for t1, t2 in zip(
+        jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(t1, np.float32), np.asarray(t2, np.float32),
+            rtol=5e-2, atol=5e-2,
+        )
+
+
 @check("consensus_bf16_forward_parity_n256")
 def check_cons_fwd_256():
     _consensus_case(16, 0.0, jnp.bfloat16, 5e-2, 5e-2, grad=False)
@@ -204,7 +243,7 @@ def main():
         print(json.dumps({"skipped": True, "reason": f"platform={dev.platform}"}))
         return 0
     for fn in (
-        check_ffw_fwd, check_ffw_grad,
+        check_ffw_fwd, check_ffw_grad, check_ffw_add_fold,
         check_cons_fwd_256, check_cons_fwd_1024,
         check_cons_grad_f32, check_cons_grad_bf16, check_cons_grad_bf16_r7,
         check_cons_grad_auto,
