@@ -638,15 +638,26 @@ def _consensus_bwd_small_kernel(
     With dd known in-register the ds = p*(dP - dd) form needs no A/B
     decomposition."""
     f32 = jnp.float32
-    d = x_ref.shape[-1]
-    scale = d ** -0.5
     div = jnp.where(pl.program_id(0) == pl.num_programs(0) - 1, 3.0, 4.0)
-
     x = x_ref[0]              # [TB, n, d]
-    k = _normalized_k(x)
     dcons = dm_ref[0].astype(f32) / div
-    m = m_ref[0]
-    l = l_ref[0]
+    dlv = _small_bwd_math(
+        x, dcons, m_ref[0], l_ref[0],
+        side=side, radius=radius, attend_self=attend_self, n=n,
+    )
+    dlv_ref[0] = dlv.astype(dlv_ref.dtype)
+    dmean_ref[0] = dcons.astype(dmean_ref.dtype)
+
+
+def _small_bwd_math(x, dcons, m, l, *, side, radius, attend_self, n):
+    """The single-tile backward's math, shared with the hand-rolled loop
+    VJP's combine kernel (kernels/fused_loop.py): given the whole patch row
+    x [TB, n, d] and the DIVIDED f32 output cotangent dcons, return the
+    complete f32 d(levels) = dcons + dq + dv + norm-VJP(dk)."""
+    f32 = jnp.float32
+    d = x.shape[-1]
+    scale = d ** -0.5
+    k = _normalized_k(x)
 
     s = (
         jax.lax.dot_general(
@@ -687,8 +698,7 @@ def _consensus_bwd_small_kernel(
     ) * scale
 
     dxn = _norm_vjp(dk, x)
-    dlv_ref[0] = (dcons + dq + dv + dxn).astype(dlv_ref.dtype)
-    dmean_ref[0] = dcons.astype(dmean_ref.dtype)
+    return dcons + dq + dv + dxn
 
 
 def _consensus_bwd_dkv_kernel(

@@ -327,10 +327,17 @@ def _mlp_bwd_kernel(
     )
 
 
-def _mlp_bwd_tail(pre, x, g, w1, w2, dx_ref, dw1_ref, db1_ref, dw2_ref, db2_ref):
+def _mlp_bwd_tail(pre, x, g, w1, w2, dx_ref, dw1_ref, db1_ref, dw2_ref, db2_ref,
+                  inc=None):
     """Shared tail of both backward kernels (recompute and saved-pre): the
     dh/dx matmuls, the in-kernel dw/db accumulation, and the init/accum
-    revisit logic. `pre` is f32 however the caller obtained it."""
+    revisit logic. `pre` is f32 however the caller obtained it.
+
+    inc: optional (dw1_in, db1_in, dw2_in, db2_in) refs of INCOMING f32
+    accumulators (same block indices as the outputs) — the cross-iteration
+    accumulation the hand-rolled loop VJP (kernels/fused_loop.py) chains
+    through the backward instead of XLA add_any sweeps: the init-at-m==0
+    branch seeds from the incoming value rather than zero."""
     f32 = jnp.float32
     m = pl.program_id(1)
 
@@ -358,10 +365,16 @@ def _mlp_bwd_tail(pre, x, g, w1, w2, dx_ref, dw1_ref, db1_ref, dw2_ref, db2_ref)
 
     @pl.when(m == 0)
     def _init():
-        dw1_ref[0] = dw1_step
-        db1_ref[0] = db1_step
-        dw2_ref[0] = dw2_step
-        db2_ref[0] = db2_step
+        if inc is None:
+            dw1_ref[0] = dw1_step
+            db1_ref[0] = db1_step
+            dw2_ref[0] = dw2_step
+            db2_ref[0] = db2_step
+        else:
+            dw1_ref[0] = inc[0][0] + dw1_step
+            db1_ref[0] = inc[1][0] + db1_step
+            dw2_ref[0] = inc[2][0] + dw2_step
+            db2_ref[0] = inc[3][0] + db2_step
 
     @pl.when(m != 0)
     def _accum():

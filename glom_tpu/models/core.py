@@ -205,6 +205,36 @@ def glom_forward(
     return final
 
 
+def _use_fused_loop(
+    params: GlomParams, cfg: GlomConfig, b: int, n: int, d: int,
+    iters: int, levels_in, return_all: bool, remat: bool,
+) -> bool:
+    """Dispatch to the hand-rolled whole-loop VJP (kernels/fused_loop.py)
+    on the flagship training regime: TPU, no remat, final-state-only, the
+    single-tile consensus row, tileable FFW shapes, and the measured
+    batched regime where the in-VMEM backward wins (B >= 8 — see
+    consensus_update._use_blockwise_bwd's crossover table). The
+    GLOM_CONSENSUS_BWD=dense override disables it so bench A/B comparisons
+    still reach the dense VJP."""
+    import os
+
+    from glom_tpu.kernels.fused_loop import loop_supported
+
+    if return_all or remat or jax.devices()[0].platform != "tpu":
+        return False
+    # Any non-auto override pins the SCAN path so bench A/B comparisons
+    # measure the side they name (blockwise scan vs dense VJP), not the
+    # whole-loop VJP; _use_blockwise_bwd warns about invalid values.
+    if b < 8 or os.environ.get("GLOM_CONSENSUS_BWD", "auto") != "auto":
+        return False
+    if exists(levels_in) and levels_in.dtype != params.init_levels.dtype:
+        return False
+    return loop_supported(
+        cfg.levels, b, n, d, params.bottom_up.w1.shape[-1],
+        params.init_levels.dtype.itemsize, iters, params.pos_emb.shape[0],
+    )
+
+
 def _glom_forward_fused(
     params: GlomParams,
     img: jnp.ndarray,
@@ -243,6 +273,16 @@ def _glom_forward_fused(
         levels_lm = jnp.broadcast_to(
             params.init_levels[:, None, None], (L, b, n, d)
         ).astype(tokens.dtype)
+
+    if _use_fused_loop(params, cfg, b, n, d, iters, levels_in, return_all, remat):
+        from glom_tpu.kernels.fused_loop import fused_glom_loop
+
+        final = fused_glom_loop(
+            params.bottom_up, params.top_down, params.pos_emb, tokens,
+            levels_lm, iters, cfg.num_patches_side,
+            float(cfg.local_consensus_radius), cfg.consensus_self, False,
+        )
+        return jnp.transpose(final, (1, 2, 0, 3))  # [b, n, L, d]
 
     def body(carry, _):
         lv = carry

@@ -513,3 +513,103 @@ class TestFusedForwardParity:
         g_fused = jax.grad(loss)(params, True, True)
         for a, b in zip(jax.tree_util.tree_leaves(g_ref), jax.tree_util.tree_leaves(g_fused)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-5)
+
+
+class TestFusedLoop:
+    """The hand-rolled whole-loop VJP (kernels/fused_loop.py) vs a reference
+    loop composed from the XLA ops (models.core.update_step) — forward and
+    EVERY cotangent (both FFWs' weights, pos_emb, tokens, levels0)."""
+
+    L, B, n, d, side = 4, 8, 16, 128, 4
+
+    def _inputs(self, dtype=jnp.float32):
+        from glom_tpu.ops.ffw import init_grouped_ffw
+
+        k = jax.random.split(jax.random.PRNGKey(0), 5)
+        bu = init_grouped_ffw(k[0], self.L, self.d, 4, dtype)
+        td = init_grouped_ffw(k[1], self.L - 1, self.d, 4, dtype)
+        pos = jax.random.normal(k[2], (self.n, self.d), dtype)
+        tokens = jax.random.normal(k[3], (self.B, self.n, self.d), dtype)
+        lv0 = jax.random.normal(k[4], (self.L, self.B, self.n, self.d), dtype)
+        return bu, td, pos, tokens, lv0
+
+    def _ref_loop(self, bu_p, td_p, pos, tokens, lv0, iters, radius, attend_self):
+        from functools import partial
+
+        from glom_tpu.models.core import contribution_divisor, update_step
+        from glom_tpu.ops.consensus import build_local_mask, consensus_attention
+
+        class P:  # update_step only touches these three fields
+            bottom_up, top_down, pos_emb = bu_p, td_p, pos
+
+        levels = jnp.transpose(lv0, (1, 2, 0, 3))  # [B, n, L, d]
+        bottom = tokens[:, :, None, :]
+        pos4 = pos[None, :, None, :]
+        div = contribution_divisor(self.L)
+        cons = partial(
+            consensus_attention,
+            attend_self=attend_self,
+            local_mask=build_local_mask(self.side, radius),
+        )
+        for _ in range(iters):
+            levels = update_step(P, levels, bottom, pos4, div, consensus_fn=cons)
+        return jnp.transpose(levels, (2, 0, 1, 3))
+
+    @pytest.mark.parametrize(
+        "radius,attend_self", [(0.0, False), (1.5, False), (0.0, True)]
+    )
+    def test_forward_and_grads(self, radius, attend_self):
+        from glom_tpu.kernels.fused_loop import fused_glom_loop, loop_supported
+
+        assert loop_supported(self.L, self.B, self.n, self.d, 4 * self.d, 4, 3, self.n)
+        args = self._inputs()
+        iters = 3
+
+        def loss_loop(*a):
+            out = fused_glom_loop(
+                *a, iters, self.side, radius, attend_self, True
+            )
+            return jnp.mean(out**2), out
+
+        def loss_ref(*a):
+            out = self._ref_loop(*a, iters, radius, attend_self)
+            return jnp.mean(out**2), out
+
+        (l1, o1), g1 = jax.value_and_grad(loss_loop, argnums=tuple(range(5)), has_aux=True)(*args)
+        (l2, o2), g2 = jax.value_and_grad(loss_ref, argnums=tuple(range(5)), has_aux=True)(*args)
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), rtol=2e-4, atol=2e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5
+            )
+
+    def test_single_iteration(self):
+        """iters=1 exercises the no-combine backward variant alone."""
+        from glom_tpu.kernels.fused_loop import fused_glom_loop
+
+        args = self._inputs()
+
+        def loss_loop(*a):
+            return jnp.mean(
+                fused_glom_loop(*a, 1, self.side, 0.0, False, True) ** 2
+            )
+
+        def loss_ref(*a):
+            return jnp.mean(self._ref_loop(*a, 1, 0.0, False) ** 2)
+
+        g1 = jax.grad(loss_loop, argnums=tuple(range(5)))(*args)
+        g2 = jax.grad(loss_ref, argnums=tuple(range(5)))(*args)
+        for a, b in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5
+            )
+
+    def test_dispatch_gate(self):
+        """loop_supported must reject the shapes the kernels cannot tile."""
+        from glom_tpu.kernels.fused_loop import loop_supported
+
+        ok = loop_supported(6, 64, 256, 512, 2048, 2, 7, 256)
+        assert ok  # the flagship training shape
+        assert not loop_supported(6, 64, 1024, 512, 2048, 2, 7, 1024)  # n too big
+        assert not loop_supported(6, 1, 6, 512, 2048, 2, 7, 6)  # untileable M
+        assert not loop_supported(6, 64, 256, 512, 2048, 2, 7, 128)  # pos mismatch

@@ -188,6 +188,64 @@ def check_cons_grad_auto():
     _consensus_case(32, 0.0, jnp.bfloat16, 0.1, 2e-2, grad=True, bwd_impl="auto")
 
 
+@check("fused_loop_bf16_grad_parity")
+def check_fused_loop_grads():
+    """The hand-rolled whole-loop VJP (kernels/fused_loop.py) vs the
+    XLA-composed reference loop, in bf16 on real Mosaic: forward and every
+    cotangent (FFW weights, pos_emb, tokens, levels0)."""
+    from functools import partial
+
+    from glom_tpu.kernels.fused_loop import fused_glom_loop, loop_supported
+    from glom_tpu.models.core import contribution_divisor, update_step
+    from glom_tpu.ops.consensus import build_local_mask, consensus_attention
+    from glom_tpu.ops.ffw import init_grouped_ffw
+
+    L, B, n, d, side, iters = 6, 8, 256, 512, 16, 3
+    assert loop_supported(L, B, n, d, 4 * d, 2, iters, n)
+    k = jax.random.split(jax.random.PRNGKey(0), 5)
+    bu = _bf16_tree(init_grouped_ffw(k[0], L, d, 4))
+    td = _bf16_tree(init_grouped_ffw(k[1], L - 1, d, 4))
+    pos = jax.random.normal(k[2], (n, d), jnp.bfloat16)
+    tokens = jax.random.normal(k[3], (B, n, d), jnp.bfloat16)
+    lv0 = jax.random.normal(k[4], (L, B, n, d), jnp.bfloat16)
+
+    def loss_loop(*a):
+        return jnp.mean(
+            fused_glom_loop(*a, iters, side, 0.0, False, False).astype(
+                jnp.float32
+            )
+            ** 2
+        )
+
+    def loss_ref(bu_p, td_p, pos_, tokens_, lv0_):
+        class P:
+            bottom_up, top_down, pos_emb = bu_p, td_p, pos_
+
+        cons = partial(
+            consensus_attention,
+            attend_self=False,
+            local_mask=build_local_mask(side, 0.0),
+        )
+        levels = jnp.transpose(lv0_, (1, 2, 0, 3))
+        bottom = tokens_[:, :, None, :]
+        div = contribution_divisor(L)
+        for _ in range(iters):
+            levels = update_step(
+                P, levels, bottom, pos_[None, :, None, :], div,
+                consensus_fn=cons,
+            )
+        return jnp.mean(jnp.transpose(levels, (2, 0, 1, 3)).astype(jnp.float32) ** 2)
+
+    args = (bu, td, pos, tokens, lv0)
+    g1 = jax.jit(jax.grad(loss_loop, argnums=tuple(range(5))))(*args)
+    g2 = jax.jit(jax.grad(loss_ref, argnums=tuple(range(5))))(*args)
+    for a, b in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=0.1, atol=3e-3,
+        )
+
+
 @check("train_step_bf16_loss_decreases")
 def check_train():
     from glom_tpu.train.trainer import create_train_state, make_train_step
@@ -247,6 +305,7 @@ def main():
         check_cons_fwd_256, check_cons_fwd_1024,
         check_cons_grad_f32, check_cons_grad_bf16, check_cons_grad_bf16_r7,
         check_cons_grad_auto,
+        check_fused_loop_grads,
         check_train, check_train_cross_path,
     ):
         fn()
