@@ -410,7 +410,7 @@ def loop_supported(
     M = B * n
     tile = _pick_tile(M, d, f, itemsize)
     bt = _pick_bwd_tile(M, d, f, itemsize)
-    if tile is None or bt is None:
+    if iters < 1 or tile is None or bt is None:
         return False
     if d % 128 != 0 or f % 128 != 0 or n % 8 != 0 or L < 2:
         return False
@@ -542,11 +542,26 @@ def _loop_bwd(iters, side, radius, attend_self, interpret, res, g):
         dx_td = dx_td2.reshape(L - 1, B, n, d)
         dtok = dtok + dx_bu[0].astype(f32)
 
-    # Final combine at the loop entry: d(levels0) gathers all three streams
-    # (one XLA fused add pair, once per step, not per iteration).
-    dlv0 = dlv.astype(f32)
-    dlv0 = dlv0.at[: L - 1].add(dx_bu[1:].astype(f32))
-    dlv0 = dlv0.at[1:].add(dx_td.astype(f32))
+    # Final combine at the loop entry: d(levels0) gathers all three streams.
+    # Written as slice-adds + one concatenate (NOT .at[].add, which lowers
+    # to a slow TPU scatter-add): XLA fuses the slices into the adds, so
+    # each stream is read once and the result written once. The leading
+    # f32 cast keeps the 3-term middle sum single-rounded (fused into the
+    # adds; the final astype keeps the HBM write in the carry dtype).
+    if L > 2:
+        dlv0 = jnp.concatenate(
+            [
+                dlv[:1].astype(f32) + dx_bu[1:2],
+                dlv[1 : L - 1].astype(f32) + dx_bu[2:] + dx_td[: L - 2],
+                dlv[L - 1 :].astype(f32) + dx_td[L - 2 :],
+            ],
+            axis=0,
+        )
+    else:
+        dlv0 = jnp.concatenate(
+            [dlv[:1].astype(f32) + dx_bu[1:2], dlv[1:].astype(f32) + dx_td],
+            axis=0,
+        )
 
     def cast_grads(acc, p):
         return GroupedFFWParams(

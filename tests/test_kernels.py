@@ -604,6 +604,45 @@ class TestFusedLoop:
                 np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5
             )
 
+    def test_two_levels(self):
+        """L=2 exercises the final-combine else branch (no middle slice)."""
+        from glom_tpu.kernels.fused_loop import fused_glom_loop
+        from glom_tpu.ops.ffw import init_grouped_ffw
+
+        L, B, n, d = 2, 8, 16, 128
+        k = jax.random.split(jax.random.PRNGKey(7), 5)
+        args = (
+            init_grouped_ffw(k[0], L, d, 4),
+            init_grouped_ffw(k[1], L - 1, d, 4),
+            jax.random.normal(k[2], (n, d)),
+            jax.random.normal(k[3], (B, n, d)),
+            jax.random.normal(k[4], (L, B, n, d)),
+        )
+        old_L = type(self).L
+        type(self).L = L
+        try:
+            def loss_loop(*a):
+                return jnp.mean(
+                    fused_glom_loop(*a, 2, self.side, 0.0, False, True) ** 2
+                )
+
+            def loss_ref(*a):
+                return jnp.mean(self._ref_loop(*a, 2, 0.0, False) ** 2)
+
+            g1 = jax.grad(loss_loop, argnums=tuple(range(5)))(*args)
+            g2 = jax.grad(loss_ref, argnums=tuple(range(5)))(*args)
+        finally:
+            type(self).L = old_L
+        for a, b in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5
+            )
+
+    def test_zero_iters_not_dispatched(self):
+        from glom_tpu.kernels.fused_loop import loop_supported
+
+        assert not loop_supported(6, 64, 256, 512, 2048, 2, 0, 256)
+
     def test_dispatch_gate(self):
         """loop_supported must reject the shapes the kernels cannot tile."""
         from glom_tpu.kernels.fused_loop import loop_supported
