@@ -39,38 +39,46 @@ from glom_tpu.utils.timing import calibrated_chain_time
 
 def bench_variant(name, op, levels, bu, td, side, radius, repeats,
                   flops_mult=1):
-    def make_chain():
-        def multi(k):
-            def body(_, acc):
-                # genuinely data-dependent ~1e-9-scale coupling (an `acc*0`
-                # form could be folded, letting the compiler hoist the body)
-                out = op(levels + acc.astype(levels.dtype), bu, td,
-                         side=side, radius=radius)
-                # FULL-output reduction: a partial slice would let XLA
-                # dead-code-eliminate the unobserved rows/levels of the
-                # dense einsums (measured: "847 TF/s" dense at radius 7).
-                return jnp.sum(out).astype(jnp.float32) * 1e-9
+    # levels/bu/td ride as jit ARGUMENTS, not closure constants: closed-over
+    # arrays embed in the serialized MLIR, and batched long-row shapes
+    # (B=8, n=4096 -> 200MB+) break the remote-compile tunnel (HTTP 413).
+    def multi(lv, bu_, td_, k):
+        def body(_, acc):
+            # genuinely data-dependent ~1e-9-scale coupling (an `acc*0`
+            # form could be folded, letting the compiler hoist the body)
+            out = op(lv + acc.astype(lv.dtype), bu_, td_,
+                     side=side, radius=radius)
+            # FULL-output reduction: a partial slice would let XLA
+            # dead-code-eliminate the unobserved rows/levels of the
+            # dense einsums (measured: "847 TF/s" dense at radius 7).
+            return jnp.sum(out).astype(jnp.float32) * 1e-9
 
-            return jax.lax.fori_loop(0, k, body, jnp.float32(0.0))
+        return jax.lax.fori_loop(0, k, body, jnp.float32(0.0))
 
-        return jax.jit(multi)
+    multi_jit = jax.jit(multi)
 
     # calibrated_chain_time re-measures RTT right before the measured chain
     # (a per-n RTT taken minutes earlier would drift).
-    per_call = calibrated_chain_time(make_chain(), levels, repeats=repeats)
+    per_call = calibrated_chain_time(
+        lambda k: multi_jit(levels, bu, td, k), levels, repeats=repeats
+    )
     L, B, n, d = levels.shape
     # Dense-equivalent attention FLOPs (two n^2 contractions); for radius
     # runs this is the work the dense path still does and the fused kernel
     # skips, so fused radius throughput can exceed "peak" — that's the point.
     tflops_equiv = flops_mult * 4 * B * L * n * n * d / per_call / 1e12
-    return {"impl": name, "n": n, "radius": radius, "ms_per_call": round(per_call * 1e3, 3),
-            "dense_equiv_tflops": round(tflops_equiv, 2)}
+    rec = {"impl": name, "n": n, "radius": radius,
+           "ms_per_call": round(per_call * 1e3, 3),
+           "dense_equiv_tflops": round(tflops_equiv, 2)}
+    if B > 1:
+        rec["batch"] = B
+    return rec
 
 
-def main(only_sides=None):
+def main(only_sides=None, batch=1):
     chip = detect_chip()
     on_tpu = chip != "cpu"
-    L, B, d = 6, 1, 512
+    L, B, d = 6, batch, 512
     # side 16 = the flagship n=256 (anchors the dispatch crossover at the
     # config the train bench runs); side 96 -> n=9216, the past-the-old-cap
     # long-context point the streamed backward unlocked (dense grad at this
@@ -152,4 +160,9 @@ if __name__ == "__main__":
         "--sides", type=int, nargs="*", default=None,
         help="restrict to these grid sides (rerun specific rows)",
     )
-    main(ap.parse_args().sides)
+    ap.add_argument(
+        "--batch", type=int, default=1,
+        help="batch size B (the batched long-row regime record)",
+    )
+    args = ap.parse_args()
+    main(args.sides, batch=args.batch)

@@ -4,7 +4,11 @@ from glom_tpu.data.loaders import (
     npy_dataset,
 )
 from glom_tpu.data.prefetch import prefetch_to_device
-from glom_tpu.data.synthetic import gaussian_dataset, shapes_dataset
+from glom_tpu.data.synthetic import (
+    gaussian_dataset,
+    shapes_dataset,
+    write_shapes_dataset,
+)
 
 __all__ = [
     "file_dataset",
@@ -13,4 +17,5 @@ __all__ = [
     "npy_dataset",
     "prefetch_to_device",
     "shapes_dataset",
+    "write_shapes_dataset",
 ]

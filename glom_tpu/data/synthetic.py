@@ -62,3 +62,50 @@ def gaussian_dataset(
         yield rng.normal(size=(batch_size, 3, image_size, image_size)).astype(
             np.float32
         )
+
+
+def write_shapes_dataset(
+    out_dir: str,
+    num_images: int,
+    image_size: int,
+    *,
+    seed: int = 0,
+    fmt: str = "png",
+    shard_size: int = 512,
+) -> list:
+    """Render the seeded shapes distribution to DISK — the deterministic
+    on-disk dataset that backs the file-based input-pipeline record (the
+    environment has no downloadable datasets; the reference README trains
+    on real images from the user's own folder, ~:30-75).
+
+    fmt='png': one 8-bit RGB PNG per image (exercises the image-decode
+    loader, image_folder_dataset). fmt='npy': [shard_size, 3, H, W]
+    float32 shards (npy_dataset). Returns the list of file paths written.
+    """
+    import os
+
+    os.makedirs(out_dir, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    paths = []
+    if fmt == "png":
+        from PIL import Image
+
+        for i in range(num_images):
+            img = _draw_shapes(rng, image_size, 5)  # [3, H, W] in [-1, 1]
+            u8 = ((np.transpose(img, (1, 2, 0)) + 1.0) * 127.5).round()
+            u8 = np.clip(u8, 0, 255).astype(np.uint8)
+            p = os.path.join(out_dir, f"shape_{i:06d}.png")
+            Image.fromarray(u8).save(p)
+            paths.append(p)
+        return paths
+    if fmt == "npy":
+        for s in range(0, num_images, shard_size):
+            count = min(shard_size, num_images - s)
+            shard = np.stack(
+                [_draw_shapes(rng, image_size, 5) for _ in range(count)]
+            )
+            p = os.path.join(out_dir, f"shard_{s // shard_size:04d}.npy")
+            np.save(p, shard)
+            paths.append(p)
+        return paths
+    raise ValueError(f"fmt={fmt!r}: one of 'png', 'npy'")

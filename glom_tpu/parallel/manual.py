@@ -44,7 +44,6 @@ forward.
 
 from __future__ import annotations
 
-import warnings
 from functools import partial
 from typing import Optional
 
@@ -80,19 +79,17 @@ def _shard_consensus_fn(cfg: GlomConfig, seq: int, sp_strategy: str):
     'seq'-manual region. None means seq is unsharded and the caller should
     use the fused consensus+update kernel instead.
 
-    Strategy handling mirrors runtime.make_consensus_fn: unknown strategies
-    raise; impossible-halo and indivisible-ulysses fall back to ring WITH a
-    warning (ring is exact for any geometry)."""
-    from glom_tpu.parallel.runtime import SP_STRATEGIES
+    Resolution (auto + fallbacks + warnings) is runtime.effective_sp_strategy
+    — the single policy source; this is construction only. 'none' with a
+    sharded seq axis builds ring: the manual region's n-shards must
+    communicate, and ring is the exact default mechanism."""
+    from glom_tpu.parallel.runtime import effective_sp_strategy
 
-    if sp_strategy not in SP_STRATEGIES:
-        raise ValueError(
-            f"unknown SP strategy {sp_strategy!r}; one of {SP_STRATEGIES}"
-        )
+    sp_strategy = effective_sp_strategy(cfg, seq, sp_strategy)
     if seq == 1:
         return None
     radius = float(cfg.local_consensus_radius)
-    if sp_strategy == "ulysses" and cfg.levels % seq == 0:
+    if sp_strategy == "ulysses":
         from glom_tpu.ops.consensus import build_local_mask
         from glom_tpu.parallel.ulysses import ulysses_consensus_shard
 
@@ -102,25 +99,13 @@ def _shard_consensus_fn(cfg: GlomConfig, seq: int, sp_strategy: str):
             attend_self=cfg.consensus_self,
             local_mask=build_local_mask(cfg.num_patches_side, radius),
         )
-    if sp_strategy == "halo" and halo_supported(seq, cfg.num_patches_side, radius):
+    if sp_strategy == "halo":
         return partial(
             halo_consensus_shard,
             axis_name=SEQ_AXIS,
             attend_self=cfg.consensus_self,
             side=cfg.num_patches_side,
             radius=radius,
-        )
-    if sp_strategy == "halo":
-        warnings.warn(
-            f"halo consensus unsupported (radius={radius}, "
-            f"side={cfg.num_patches_side}, seq={seq}); falling back to ring",
-            stacklevel=3,
-        )
-    elif sp_strategy == "ulysses":
-        warnings.warn(
-            f"ulysses needs levels ({cfg.levels}) divisible by the seq axis "
-            f"({seq}); using ring (identical result, different collectives)",
-            stacklevel=3,
         )
     return partial(
         ring_consensus_shard,

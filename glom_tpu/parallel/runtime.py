@@ -50,14 +50,85 @@ from glom_tpu.train.trainer import (
 from glom_tpu.utils.config import GlomConfig, MeshConfig, TrainConfig
 from glom_tpu.utils.helpers import halo_supported
 
-SP_STRATEGIES = ("none", "ring", "ulysses", "halo")
+SP_STRATEGIES = ("none", "ring", "ulysses", "halo", "auto")
+
+
+def select_sp_strategy(cfg: GlomConfig, seq: int) -> str:
+    """Resolve sp_strategy='auto': pick the SP mechanism from the config's
+    geometry and the MEASURED ring-vs-Ulysses crossover
+    (results/sp_crossover.jsonl, v5e):
+
+      * local radius with one-hop-coverable shards -> halo (neighbor-row
+        exchange only; the cheapest exact form, by construction);
+      * global (or halo-impossible) small/mid n -> Ulysses when the levels
+        axis divides the seq axis: measured 4.2x over ring at n=256/seq=8,
+        2.0x at n=1024/seq=8, parity at n=1024/seq=2 (L plays the role of
+        heads — the all-to-all trades n-sharding for exact L-sharding);
+      * long rows -> ring: at n=4096 Ulysses loses 2.1x (each shard then
+        runs FULL-n attention on L/seq levels, and n^2 work dwarfs the
+        ring's ppermute overlap). Crossover encoded at n = 2048.
+    """
+    if seq <= 1:
+        return "none"
+    radius = float(cfg.local_consensus_radius)
+    if radius > 0 and halo_supported(seq, cfg.num_patches_side, radius):
+        return "halo"
+    if cfg.levels % seq == 0 and cfg.num_patches < 2048:
+        return "ulysses"
+    return "ring"
+
+
+def effective_sp_strategy(cfg: GlomConfig, seq: int, strategy: str) -> str:
+    """The strategy a config ACTUALLY runs — THE single source of the
+    resolution policy (both consensus-fn builders and the trainers' metric
+    logging call this, so a run can never train on a different collective
+    pattern than its records claim): resolves 'auto' through the selector
+    and applies the exactness fallbacks (impossible halo, indivisible
+    Ulysses -> ring, which is exact for any geometry). Downgrades of an
+    EXPLICITLY requested strategy warn; 'auto' resolves silently (picking
+    is its job). Idempotent: re-resolving an already-effective strategy is
+    a no-op, so the trainers' up-front resolve suppresses double warnings.
+    """
+    if strategy not in SP_STRATEGIES:
+        raise ValueError(
+            f"unknown SP strategy {strategy!r}; one of {SP_STRATEGIES}"
+        )
+    if strategy == "auto":
+        return select_sp_strategy(cfg, seq)
+    if seq <= 1:
+        return "none"
+    radius = float(cfg.local_consensus_radius)
+    if strategy == "halo" and not halo_supported(
+        seq, cfg.num_patches_side, radius
+    ):
+        # Halo is only the cheaper special case when one-hop neighbor rows
+        # cover the radius; fall back instead of crashing the config
+        # (BASELINE config 3: radius 7 on an 8-row grid, seq=2).
+        warnings.warn(
+            f"halo consensus unsupported (radius={radius}, "
+            f"side={cfg.num_patches_side}, seq={seq}); falling back to "
+            "ring consensus",
+            stacklevel=3,
+        )
+        return "ring"
+    if strategy == "ulysses" and cfg.levels % seq != 0:
+        warnings.warn(
+            f"ulysses needs levels ({cfg.levels}) divisible by the seq "
+            f"axis ({seq}); using ring (identical result, different "
+            "collectives)",
+            stacklevel=3,
+        )
+        return "ring"
+    return strategy
 
 
 def make_consensus_fn(
     mesh, cfg: GlomConfig, strategy: str, axis_name: str = "seq"
 ) -> Optional[ConsensusFn]:
     """Build the sequence-parallel consensus op for `strategy`, or None for
-    the dense/GSPMD default."""
+    the dense/GSPMD default. Resolution (auto + fallbacks) happens in
+    effective_sp_strategy — this is construction only."""
+    strategy = effective_sp_strategy(cfg, mesh.shape[axis_name], strategy)
     if strategy == "none":
         return None
     if strategy == "ring":
@@ -77,35 +148,13 @@ def make_consensus_fn(
             ),
             axis_name=axis_name,
         )
-    if strategy == "halo":
-        radius = float(cfg.local_consensus_radius)
-        if not halo_supported(mesh.shape[axis_name], cfg.num_patches_side, radius):
-            # Ring is exact for any radius (it carries the same masks); halo
-            # is only the cheaper special case when one-hop neighbor rows
-            # cover the radius. Fall back instead of crashing the config
-            # (BASELINE config 3: radius 7 on an 8-row grid, seq=2 -> 4 rows
-            # per shard < 7).
-            warnings.warn(
-                f"halo consensus unsupported (radius={radius}, "
-                f"side={cfg.num_patches_side}, seq={mesh.shape[axis_name]}); "
-                "falling back to ring consensus",
-                stacklevel=2,
-            )
-            return make_ring_consensus(
-                mesh,
-                attend_self=cfg.consensus_self,
-                side=cfg.num_patches_side,
-                radius=radius,
-                axis_name=axis_name,
-            )
-        return make_halo_consensus(
-            mesh,
-            attend_self=cfg.consensus_self,
-            side=cfg.num_patches_side,
-            radius=radius,
-            axis_name=axis_name,
-        )
-    raise ValueError(f"unknown SP strategy {strategy!r}; one of {SP_STRATEGIES}")
+    return make_halo_consensus(
+        mesh,
+        attend_self=cfg.consensus_self,
+        side=cfg.num_patches_side,
+        radius=float(cfg.local_consensus_radius),
+        axis_name=axis_name,
+    )
 
 
 class DistributedTrainer:
@@ -152,6 +201,12 @@ class DistributedTrainer:
         self.mesh_cfg = mesh_cfg
         self.mesh = make_mesh(mesh_cfg, devices)
         self.metrics_writer = metrics_writer
+        # Resolve 'auto' and the exactness fallbacks ONCE, pass the
+        # resolved mechanism everywhere, and report it in every metrics
+        # record — a run must not train on a different collective pattern
+        # than its logs claim (round-3 weak #6: the fallbacks only warned).
+        self.sp_strategy = effective_sp_strategy(cfg, mesh_cfg.seq, sp_strategy)
+        sp_strategy = self.sp_strategy
 
         # use_pallas routes through the fully-manual shard_map path (the
         # kernels are per-device-legal there), including hidden-axis TP
@@ -222,6 +277,8 @@ class DistributedTrainer:
         batch = jax.device_put(batch, self.batch_sharding)
         self.rng, step_rng = jax.random.split(self.rng)
         self.state, metrics = self._step(self.state, batch, step_rng)
+        metrics = dict(metrics)
+        metrics["sp_strategy"] = self.sp_strategy
         return metrics
 
     def step_fast(self, batch: np.ndarray):
@@ -229,6 +286,8 @@ class DistributedTrainer:
         batch = jax.device_put(batch, self.batch_sharding)
         self.rng, step_rng = jax.random.split(self.rng)
         self.state, metrics = self._step_fast(self.state, batch, step_rng)
+        metrics = dict(metrics)
+        metrics["sp_strategy"] = self.sp_strategy
         return metrics
 
     def fit(

@@ -195,7 +195,10 @@ def fit_loop(
         fn = step if (logging_step or step_fast is None) else step_fast
         metrics = fn(next(data))
         if logging_step:
-            metrics = {k: float(v) for k, v in metrics.items()}
+            metrics = {
+                k: (v if isinstance(v, str) else float(v))
+                for k, v in metrics.items()
+            }
             metrics["steps_per_sec"] = (i + 1) / (time.perf_counter() - t0)
             history.append(metrics)
             if metrics_writer is not None:

@@ -22,7 +22,7 @@ class Preset:
     model: GlomConfig
     train: TrainConfig
     mesh: MeshConfig
-    sp_strategy: str = "none"  # none | ring | ulysses | halo
+    sp_strategy: str = "none"  # none | ring | ulysses | halo | auto
 
     def scaled_to(self, num_devices: int) -> "Preset":
         """Shrink the mesh to fit `num_devices`. Data parallelism is the
@@ -96,7 +96,7 @@ _register(
 _register(
     Preset(
         name="imagenet64-local",
-        description="ImageNet-64 p8 L6 d512 radius7 — local-mask path (ring SP)",
+        description="ImageNet-64 p8 L6 d512 radius7 — local-mask path",
         model=GlomConfig(
             dim=512, levels=6, image_size=64, patch_size=8, local_consensus_radius=7
         ),
@@ -105,7 +105,12 @@ _register(
             compute_dtype="bfloat16", use_pallas=True, scan_unroll=True,
         ),
         mesh=MeshConfig(data=4, seq=2),
-        sp_strategy="ring",
+        # intent: local consensus. 'auto' resolves the mechanism: side 8 /
+        # seq 2 gives 4 rows per shard < radius 7, so halo is geometrically
+        # impossible; the selector then applies the global crossover and
+        # picks ULYSSES (L=6 divides seq=2, n=64 < 2048 — the small-n
+        # regime it measured fastest; the local mask rides along exactly).
+        sp_strategy="auto",
     )
 )
 
@@ -125,7 +130,9 @@ _register(
             compute_dtype="bfloat16", use_pallas=True, scan_unroll=True,
         ),
         mesh=MeshConfig(data=2, seq=4),
-        sp_strategy="halo",
+        # intent: local consensus. side 32 / seq 4 = 8 rows per shard >=
+        # radius 7, so 'auto' resolves to halo (one-hop neighbor exchange).
+        sp_strategy="auto",
     )
 )
 
@@ -167,7 +174,10 @@ _register(
             remat=True,
         ),
         mesh=MeshConfig(data=64, seq=2, model=2, num_slices=4),
-        sp_strategy="ring",
+        # intent: global consensus at n=256. 'auto' resolves to Ulysses
+        # (L=12 divides seq=2; measured 1.46x over ring at n=256/seq=2 —
+        # results/sp_crossover.jsonl).
+        sp_strategy="auto",
     )
 )
 

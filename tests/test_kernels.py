@@ -347,6 +347,61 @@ class TestFusedConsensusUpdate:
                 np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5
             )
 
+    @pytest.mark.parametrize("radius", [0.0, 3.0])
+    def test_grad_two_pass_fallback(self, radius, monkeypatch):
+        """Rows too long for the one-sweep kernel's resident dq block fall
+        back to the two-pass dq/dkv kernels — forced here by disabling the
+        one-sweep eligibility so both generations stay covered."""
+        from glom_tpu.kernels import consensus_update as cu
+
+        monkeypatch.setattr(cu, "_onesweep_ok", lambda *a: False)
+        L, B, side, d = 2, 1, 24, 128
+        n = side * side
+        levels, bu, td = self._rand(jax.random.PRNGKey(8), L, B, n, d)
+
+        def loss_fused(lv, b_, t_):
+            out = cu._fused(lv, b_, t_, side, radius, False, True, "blockwise")
+            return jnp.mean(out ** 2)
+
+        def loss_ref(lv, b_, t_):
+            out = cu._xla_reference(
+                lv, b_, t_, side=side, radius=radius, attend_self=False
+            )
+            return jnp.mean(out ** 2)
+
+        g1 = jax.grad(loss_fused, argnums=(0, 1, 2))(levels, bu, td)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(levels, bu, td)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5
+            )
+
+    def test_dense_stats_bwd_matches(self):
+        """The explicit stats-based dense backward (bwd_impl='dense'
+        through the custom_vjp) vs plain autodiff of the XLA reference."""
+        from glom_tpu.kernels.consensus_update import _fused, _xla_reference
+
+        L, B, side, d = 3, 2, 4, 128
+        n = side * side
+        levels, bu, td = self._rand(jax.random.PRNGKey(9), L, B, n, d)
+
+        def loss_fused(lv, b_, t_):
+            out = _fused(lv, b_, t_, side, 0.0, False, True, "dense")
+            return jnp.mean(out ** 2)
+
+        def loss_ref(lv, b_, t_):
+            out = _xla_reference(
+                lv, b_, t_, side=side, radius=0.0, attend_self=False
+            )
+            return jnp.mean(out ** 2)
+
+        g1 = jax.grad(loss_fused, argnums=(0, 1, 2))(levels, bu, td)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(levels, bu, td)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-5
+            )
+
     def test_streamed_forward_matches(self, monkeypatch):
         """The large-n streamed forward layout (j as a windowed inner grid
         axis, (m,l,acc) in scratch) must match the resident-row kernel and
@@ -411,8 +466,10 @@ class TestFusedConsensusUpdate:
             )
 
     def test_bwd_dispatch_predicate(self):
-        """The measured-crossover dispatch: global consensus stays dense
-        until the sim buffer would blow HBM; a truly-sparse local band goes
+        """The measured-crossover dispatch (results/longctx_bench.jsonl,
+        round 4): long global rows go to the ONE-SWEEP blockwise kernel
+        (wins from n=4096 up: 5.6 vs 7.2 ms at n=4096 B=1, 27.6 vs 30.5 at
+        n=9216); mid rows stay dense; a truly-sparse local band goes
         blockwise; forced sides are honored."""
         from glom_tpu.kernels.consensus_update import _use_blockwise_bwd
 
@@ -421,15 +478,16 @@ class TestFusedConsensusUpdate:
         assert _use_blockwise_bwd((6, 64, 256, 512), 16, 0.0, "auto")
         # small-batch inference-style at n=256 -> dense
         assert not _use_blockwise_bwd((6, 2, 256, 512), 16, 0.0, "auto")
-        # batched long-row global (unmeasured region): stays dense until
-        # the sim-buffer memory cap trips
+        # mid global rows: dense autodiff wins (0.281 vs 0.388 at n=1024)
         assert not _use_blockwise_bwd((6, 8, 1024, 512), 32, 0.0, "auto")
-        assert _use_blockwise_bwd((6, 8, 4096, 512), 64, 0.0, "auto")  # 6.4GB sim
-        # n=4096 global, small batch: sim fits -> dense (measured faster)
-        assert not _use_blockwise_bwd((6, 1, 4096, 512), 64, 0.0, "auto")
+        assert not _use_blockwise_bwd((6, 1, 1024, 512), 32, 0.0, "auto")
+        # long global rows (any batch): the one-sweep kernel wins
+        assert _use_blockwise_bwd((6, 1, 4096, 512), 64, 0.0, "auto")
+        assert _use_blockwise_bwd((6, 8, 4096, 512), 64, 0.0, "auto")
+        assert _use_blockwise_bwd((6, 1, 9216, 512), 96, 0.0, "auto")
         # n=4096, radius 7 on side 64: band covers <1/2 the row -> blockwise
         assert _use_blockwise_bwd((6, 1, 4096, 512), 64, 7.0, "auto")
-        # n=16384 global (side 128): sim buffer 2*L*B*n^2*4 > 2GB -> blockwise
+        # n=16384 global (side 128): one-sweep dq block still fits -> blockwise
         assert _use_blockwise_bwd((6, 1, 16384, 512), 128, 0.0, "auto")
         # forced
         assert _use_blockwise_bwd((6, 64, 256, 512), 16, 0.0, "blockwise")

@@ -235,3 +235,83 @@ class TestDistributedTrainer:
         tcfg = TrainConfig(batch_size=3)
         with pytest.raises(ValueError, match="divisible"):
             DistributedTrainer(CFG, tcfg, MeshConfig(data=2))
+
+
+class TestSpAutoSelector:
+    """sp_strategy='auto' encodes the measured ring-vs-Ulysses crossover
+    (results/sp_crossover.jsonl) + halo's geometric precondition, and the
+    effective mechanism is reported in the metrics stream."""
+
+    def test_measured_crossover(self):
+        from glom_tpu.parallel.runtime import select_sp_strategy
+
+        # Ulysses wins at small global n (measured 4.2x at n=256/seq=8)
+        cfg_small = GlomConfig(dim=64, levels=8, image_size=64, patch_size=4)
+        assert cfg_small.num_patches == 256
+        assert select_sp_strategy(cfg_small, 8) == "ulysses"
+        # ring wins at long rows (Ulysses loses 2.1x at n=4096/seq=4)
+        cfg_long = GlomConfig(dim=64, levels=8, image_size=256, patch_size=4)
+        assert cfg_long.num_patches == 4096
+        assert select_sp_strategy(cfg_long, 4) == "ring"
+        # local radius with one-hop coverage -> halo
+        cfg_halo = GlomConfig(
+            dim=64, levels=8, image_size=128, patch_size=4,
+            local_consensus_radius=7,
+        )  # side 32, seq 4 -> 8 rows/shard >= 7
+        assert select_sp_strategy(cfg_halo, 4) == "halo"
+        # same intent, halo impossible (seq 8 -> 4 rows < 7): mechanism
+        # falls to the global crossover (n=1024 -> ulysses at L%8==0)
+        assert select_sp_strategy(cfg_halo, 8) == "ulysses"
+        # indivisible levels forbid ulysses
+        cfg_indiv = GlomConfig(dim=64, levels=5, image_size=64, patch_size=4)
+        assert select_sp_strategy(cfg_indiv, 8) == "ring"
+        assert select_sp_strategy(cfg_small, 1) == "none"
+
+    def test_effective_resolves_fallbacks(self):
+        from glom_tpu.parallel.runtime import effective_sp_strategy
+
+        cfg = GlomConfig(
+            dim=16, levels=5, image_size=8, patch_size=2,
+            local_consensus_radius=3,
+        )  # side 4: seq 2 -> 2 rows < 3 -> halo impossible
+        assert effective_sp_strategy(cfg, 2, "halo") == "ring"
+        assert effective_sp_strategy(cfg, 2, "ulysses") == "ring"  # 5 % 2
+        assert effective_sp_strategy(cfg, 2, "ring") == "ring"
+        assert effective_sp_strategy(cfg, 1, "ring") == "none"
+        with pytest.raises(ValueError, match="unknown SP strategy"):
+            effective_sp_strategy(cfg, 2, "mystery")
+
+    def test_auto_trains_and_logs_effective_strategy(self):
+        """'auto' through the real trainer: matches single-device training
+        and every metrics record names the resolved mechanism (round-3
+        weak #6: silent fallbacks never surfaced in the metrics stream)."""
+        from glom_tpu.parallel.runtime import effective_sp_strategy
+
+        tcfg = TrainConfig(batch_size=4, learning_rate=1e-3, noise_std=0.3, seed=5)
+        expect = effective_sp_strategy(CFG, 2, "auto")
+        assert expect in ("ring", "ulysses")
+        single = Trainer(CFG, tcfg)
+        dist = DistributedTrainer(
+            CFG, tcfg, MeshConfig(data=2, seq=2, model=1), sp_strategy="auto"
+        )
+        assert dist.sp_strategy == expect
+        h1 = single.fit(shapes_dataset(4, CFG.image_size, seed=3), 2, log_every=1)
+        h2 = dist.fit(shapes_dataset(4, CFG.image_size, seed=3), 2, log_every=1)
+        for a, b in zip(h1, h2):
+            np.testing.assert_allclose(a["loss"], b["loss"], rtol=1e-3)
+            assert b["sp_strategy"] == expect
+
+    def test_manual_path_auto(self):
+        """'auto' on the manual fused shard_map path (use_pallas) resolves
+        and trains: the selector output feeds _shard_consensus_fn."""
+        tcfg = TrainConfig(
+            batch_size=4, learning_rate=1e-3, noise_std=0.3, seed=5,
+            use_pallas=True,
+        )
+        dist = DistributedTrainer(
+            CFG, tcfg, MeshConfig(data=2, seq=2, model=1), sp_strategy="auto"
+        )
+        assert dist.use_manual
+        h = dist.fit(shapes_dataset(4, CFG.image_size, seed=3), 2, log_every=1)
+        assert all(np.isfinite(m["loss"]) for m in h)
+        assert all(m["sp_strategy"] == dist.sp_strategy for m in h)

@@ -47,34 +47,45 @@ def test_preset_builds_and_steps_distributed(name):
     assert np.isfinite(float(metrics["loss"])), (name, metrics)
 
 
-def test_preset3_declares_ring():
+def test_preset3_resolves_exact_mechanism():
     """Radius 7 on an 8-row grid can never satisfy the one-hop halo
-    precondition (4 rows/shard < 7); the preset must declare the exact
-    fallback, not crash (round-1 ADVICE medium)."""
+    precondition (4 rows/shard < 7); the preset declares intent ('auto')
+    and the selector resolves an EXACT mechanism without crashing
+    (round-1 ADVICE medium; round-3 VERDICT #3: intent, not mechanism).
+    At n=64 global crossover, that mechanism is ulysses (L=6 % seq=2)."""
+    from glom_tpu.parallel.runtime import effective_sp_strategy
+
     p = get_preset("imagenet64-local")
-    assert p.sp_strategy == "ring"
+    assert p.sp_strategy == "auto"
+    assert effective_sp_strategy(p.model, p.mesh.seq, p.sp_strategy) == "ulysses"
 
 
 def test_halo_preset_keeps_halo_at_8_devices():
     """The long-context halo flagship (32x32 grid, radius 7, seq=4 -> 8 rows
-    per shard >= 7) must still use halo after scaled_to(8)."""
+    per shard >= 7) must still resolve to halo after scaled_to(8)."""
+    from glom_tpu.parallel.runtime import effective_sp_strategy
+
     p = get_preset("imagenet256-local").scaled_to(8)
-    assert p.sp_strategy == "halo"
     assert p.mesh.num_devices <= 8
+    assert effective_sp_strategy(p.model, p.mesh.seq, p.sp_strategy) == "halo"
 
 
 def test_scaled_to_falls_back_when_halo_breaks():
-    """Shrinking the mesh must re-check the halo precondition instead of
-    shipping a config that raises at trainer construction."""
+    """Shrinking the mesh must re-resolve the halo precondition instead of
+    shipping a config that raises at trainer construction: side=32 at
+    seq=8 gives 4 rows per shard < floor(radius)=7, and L=6 % 8 != 0
+    forbids ulysses too, so the exact mechanism is ring."""
     import glom_tpu.utils.presets as presets_mod
+    from glom_tpu.parallel.runtime import effective_sp_strategy
 
     base = get_preset("imagenet256-local")
-    # Force a finer seq sharding that breaks halo: side=32, seq=8 -> 4 rows
-    # per shard < floor(radius)=7.
     broken = dataclasses.replace(
         base, mesh=presets_mod.MeshConfig(data=1, seq=8, model=1)
+    ).scaled_to(8)
+    assert (
+        effective_sp_strategy(broken.model, broken.mesh.seq, broken.sp_strategy)
+        == "ring"
     )
-    assert broken.scaled_to(8).sp_strategy == "ring"
 
 
 class TestHybridMesh:
