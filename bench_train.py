@@ -47,17 +47,26 @@ def _train_iters(cfg: GlomConfig, tcfg: TrainConfig) -> int:
     return tcfg.recon_iter_index if tcfg.recon_iter_index is not None else T // 2 + 1
 
 
-def bench_preset_train_step(preset_name: str, batch_override=None):
+def bench_preset_train_step(preset_name: str, batch_override=None,
+                            mult_override=None):
     """Single-chip train-step measurement at an arbitrary preset's MODEL
     shape (e.g. imagenet224-pod: L=12/d=1024/bf16/remat) — the per-chip
     anchor the analytic pod scaling model (docs/PARALLELISM.md) multiplies
-    out. Chain length auto-calibrates (per-step cost varies by config)."""
+    out. Chain length auto-calibrates (per-step cost varies by config).
+
+    mult_override shrinks the FFW expansion: --mult 2 at the pod preset
+    runs the PER-TP-RANK FFW shard shape (f/mp = 2048 at the declared
+    model=2), where the working-set gate keeps the fused backward kernels
+    ON — the shape a pod chip actually executes, vs the full-f single-chip
+    shape that falls back to the XLA backward (the conservative anchor)."""
     from glom_tpu.utils.presets import get_preset
 
     chip = detect_chip()
     on_tpu = chip != "cpu"
     p = get_preset(preset_name)
     cfg = p.model
+    if mult_override is not None:
+        cfg = dataclasses.replace(cfg, mult=mult_override)
     batch = batch_override or (16 if on_tpu else 2)
     tcfg = dataclasses.replace(
         p.train,
@@ -107,6 +116,7 @@ def bench_preset_train_step(preset_name: str, batch_override=None):
                 "metric": (
                     f"train_step column_iters_per_sec_per_chip ({preset_name}"
                     f" single-chip: L={cfg.levels}, d={cfg.dim}, "
+                    f"f={cfg.dim * cfg.mult}, "
                     f"batch={batch}, {tcfg.compute_dtype}"
                     f"{', remat' if tcfg.remat else ''}"
                     f"{', pallas' if tcfg.use_pallas else ''}, {chip})"
@@ -124,11 +134,12 @@ def bench_train_step(batch_override=None):
     on_tpu = chip != "cpu"
     if on_tpu:
         cfg = GlomConfig(dim=512, levels=6, image_size=224, patch_size=14)
-        # Batch 64 amortizes the batch-independent per-step work (adam,
-        # grad-norm, cross-iteration dw adds): 3348 / 3525 / 3642 / 3673
-        # col-iters/s at batch 8 / 16 / 32 / 64 with the current kernels.
-        # (An earlier batch-32 rejection predated scan_unroll + the merged
-        # backward — see results/profiles/PROFILE.md.)
+        # Batch 64 stays the official point. Round-4 curve
+        # (results/batch_curve.jsonl): 3841 / 4183 / 4255 / 4306 / 3489 at
+        # 16 / 32 / 64 / 96 / 128 — batch 96 measures ~1% above 64 (inside
+        # the ~3% run-to-run band, i.e. statistically level), and 128
+        # falls off the whole-loop VJP's residual budget onto the scan
+        # path (use grad_accum=2 for effective 128).
         batch, repeats = batch_override or 64, 6
         # ~122 ms/step: k=9 gives ~1.1 s of device work per call, so the
         # ~100 ms tunnel RTT (measured and subtracted) bounds the error
@@ -252,10 +263,14 @@ if __name__ == "__main__":
         help="measure a preset's MODEL shape single-chip (e.g. imagenet224-pod)",
     )
     ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument(
+        "--mult", type=int, default=None,
+        help="FFW expansion override (--mult 2 = the pod's per-TP-rank f)",
+    )
     args = ap.parse_args()
     if args.loss_curve > 0:
         run_loss_curve(args.loss_curve, args.out)
     elif args.preset:
-        bench_preset_train_step(args.preset, args.batch)
+        bench_preset_train_step(args.preset, args.batch, args.mult)
     else:
         bench_train_step(args.batch)

@@ -77,8 +77,10 @@ _VMEM_32M = pltpu.CompilerParams(vmem_limit_bytes=32 * 1024 * 1024)
 # Per-iteration residual budget for the whole loop (saved ext carries +
 # both FFW pre-activations + consensus stats, times `iters`). Above this
 # the non-remat residual stack risks HBM exhaustion and the scan paths
-# (whose save-pre gates handle their own budgets) take over.
-_RESIDUAL_BUDGET = 9 * 1024 * 1024 * 1024
+# (whose save-pre gates handle their own budgets) take over. 10GB of a
+# v5e's 16GB: batch 96 at the flagship (9.0GB of residuals) stays on the
+# loop; batch 128 (12GB) falls back.
+_RESIDUAL_BUDGET = 10 * 1024 * 1024 * 1024
 
 
 def _ffw_fwd_ext(

@@ -467,6 +467,19 @@ def _pick_bwd_tile(
     return None
 
 
+
+def _bwd_compiler_params(tile_m: int, d: int, f: int, itemsize: int):
+    """Scoped-VMEM grant for the backward kernels, shared by the plain and
+    add-fold variants: the d=512-class resident set lands ~0.5MB over
+    Mosaic's default 16MB scope; d=1024-class shapes (the pod's per-TP-rank
+    f=2048) measure 75-78M of Mosaic stack at tile 512, so shapes past the
+    32MB model estimate get the 100MB grant (v5e: 128MB physical)."""
+    big = _bwd_ws(tile_m, d, f, itemsize) > 32 * 1024 * 1024
+    return pltpu.CompilerParams(
+        vmem_limit_bytes=(100 if big else 64) * 1024 * 1024
+    )
+
+
 def _fused_backward(params, x, g, *, tile_m: int, interpret: bool, pre=None):
     G, M, d = x.shape
     f = params.w1.shape[-1]
@@ -505,12 +518,7 @@ def _fused_backward(params, x, g, *, tile_m: int, interpret: bool, pre=None):
             pl.BlockSpec((1, f, d), lambda gi, m: (gi, 0, 0)),  # dw2
             pl.BlockSpec((1, 1, d), lambda gi, m: (gi, 0, 0)),  # db2
         ),
-        # The resident set (weights + dw accumulators + tiles + f-wide f32
-        # scratch) lands ~0.5MB over Mosaic's default 16MB scoped-vmem
-        # budget at d=512/f=2048; v5e has 128MB physical VMEM, so raise the
-        # scope rather than shrink the tile (TM=64 halves the dw matmuls'
-        # contraction efficiency).
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024),
+        compiler_params=_bwd_compiler_params(tile_m, d, f, x.dtype.itemsize),
         interpret=interpret,
     )(x, params.w1, second_in, params.w2, g)
 
@@ -559,7 +567,7 @@ def _fused_backward_add(params, x, a, pre, g, *, tile_m: int, interpret: bool):
             pl.BlockSpec((1, 1, d), lambda gi, m: (gi, 0, 0)),  # db2
             pl.BlockSpec((n, d), lambda gi, m: (0, 0)),  # da (whole-grid acc)
         ),
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024),
+        compiler_params=_bwd_compiler_params(tile_m, d, f, x.dtype.itemsize),
         interpret=interpret,
     )(x, a, params.w1, pre, params.w2, g)
 

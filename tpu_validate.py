@@ -23,6 +23,11 @@ import numpy as np
 RESULTS = []
 
 
+class _Skipped(Exception):
+    """Raise inside a check to record it as passed-but-skipped (e.g. a
+    multi-device check on a 1-chip environment)."""
+
+
 def check(name):
     def deco(fn):
         def run():
@@ -30,6 +35,9 @@ def check(name):
             try:
                 fn()
                 rec = {"check": name, "ok": True}
+            except _Skipped as e:
+                rec = {"check": name, "ok": True, "skipped": True,
+                       "reason": str(e)}
             except Exception as e:  # noqa: BLE001 - record and continue
                 rec = {"check": name, "ok": False, "error": f"{type(e).__name__}: {e}"[:300]}
             rec["seconds"] = round(time.time() - t0, 1)
@@ -246,6 +254,40 @@ def check_fused_loop_grads():
         )
 
 
+@check("tp_composition_megatron_psum")
+def check_tp_composition():
+    """TP x Pallas on REAL hardware: the manual-region Megatron psum
+    (parallel/manual.py) composed with the fused kernels, vs single-device
+    training from identical state/data. CPU-verified since round 3; this
+    runs it on silicon automatically in the first environment that shows
+    >= 2 devices (round-3 weak #5: the first unverified multi-chip seam).
+    On the current 1-chip tunnel it records 'skipped' and passes."""
+    if len(jax.devices()) < 2:
+        raise _Skipped("1 device visible; TP needs >= 2")
+    from glom_tpu.parallel import DistributedTrainer
+    from glom_tpu.train.trainer import Trainer
+    from glom_tpu.utils.config import GlomConfig, MeshConfig, TrainConfig
+
+    cfg = GlomConfig(dim=256, levels=4, image_size=32, patch_size=4)
+    tcfg = TrainConfig(batch_size=8, learning_rate=3e-4,
+                       compute_dtype="bfloat16", use_pallas=True)
+    single = Trainer(cfg, tcfg)
+    dist = DistributedTrainer(
+        cfg, tcfg, MeshConfig(data=1, seq=1, model=2), tp_axis="hidden"
+    )
+    assert dist.use_manual, "TP check fell off the manual fused path"
+    img = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(1), (8, 3, 32, 32), jnp.float32)
+    )
+    for i in range(4):
+        m1 = single.step(jnp.asarray(img))
+        m2 = dist.step(img)
+        rel = abs(float(m1["loss"]) - float(m2["loss"])) / max(
+            abs(float(m1["loss"])), 1e-9
+        )
+        assert rel < 5e-2, (i, float(m1["loss"]), float(m2["loss"]))
+
+
 @check("train_step_bf16_loss_decreases")
 def check_train():
     from glom_tpu.train.trainer import create_train_state, make_train_step
@@ -306,6 +348,7 @@ def main():
         check_cons_grad_f32, check_cons_grad_bf16, check_cons_grad_bf16_r7,
         check_cons_grad_auto,
         check_fused_loop_grads,
+        check_tp_composition,
         check_train, check_train_cross_path,
     ):
         fn()
@@ -315,6 +358,7 @@ def main():
         "device_kind": dev.device_kind,
         "jax": jax.__version__,
         "passed": sum(r["ok"] for r in RESULTS),
+        "skipped": sum(bool(r.get("skipped")) for r in RESULTS),
         "total": len(RESULTS),
     }
     print(json.dumps(summary), flush=True)
