@@ -55,6 +55,7 @@ from glom_tpu.kernels.consensus_update import (
     _SMALL_BWD_N,
     _consensus_update_kernel,
     _fit_tile_b,
+    _forward as _cons_forward,
     _pick_tile as _pick_cons_tile,
     _pick_tile_b as _pick_cons_tile_b,
     _small_bwd_math,
@@ -62,6 +63,8 @@ from glom_tpu.kernels.consensus_update import (
 from glom_tpu.kernels.grouped_mlp import (
     _WS_BUDGET,
     _bwd_ws,
+    _fused_forward,
+    _fused_forward_add,
     _mlp_bwd_tail,
     _mlp_kernel,
     _mlp_kernel_add,
@@ -92,18 +95,22 @@ def _ffw_fwd_ext(
     tile_m: int,
     interpret: bool,
     add: jnp.ndarray | None = None,
-    save_pre: bool = False,
 ):
     """Grouped-FFW forward reading group g's input from carry slot
-    g + offset — the index map IS the slice."""
+    g + offset — the index map IS the slice. Always saves the
+    pre-activation (the only caller is the training forward; the no-grad
+    primal uses grouped_mlp's plain forms instead)."""
     M, d = ext2.shape[1], ext2.shape[2]
     f = params.w1.shape[-1]
     grid = (G, M // tile_m)
-    out_shape = jax.ShapeDtypeStruct((G, M, d), ext2.dtype)
-    out_spec = pl.BlockSpec((1, tile_m, d), lambda g, m: (g, m, 0))
-    if save_pre:
-        out_shape = (out_shape, jax.ShapeDtypeStruct((G, M, f), ext2.dtype))
-        out_spec = (out_spec, pl.BlockSpec((1, tile_m, f), lambda g, m: (g, m, 0)))
+    out_shape = (
+        jax.ShapeDtypeStruct((G, M, d), ext2.dtype),
+        jax.ShapeDtypeStruct((G, M, f), ext2.dtype),
+    )
+    out_spec = (
+        pl.BlockSpec((1, tile_m, d), lambda g, m: (g, m, 0)),
+        pl.BlockSpec((1, tile_m, f), lambda g, m: (g, m, 0)),
+    )
     x_spec = pl.BlockSpec(
         (1, tile_m, d), lambda g, m, _o=offset: (g + _o, m, 0)
     )
@@ -259,12 +266,12 @@ def _cons_fwd_ext(
     radius: float,
     attend_self: bool,
     interpret: bool,
-    save_stats: bool,
 ):
     """Fused consensus+mean update on the slot carry: level g's q/k/v read
     slot g+1, and the output writes slots 1..L of a fresh [L+1] buffer
     (slot 0 is re-pinned to the tokens by the caller's in-place
-    dynamic_update_slice — the buffer's only other use)."""
+    dynamic_update_slice — the buffer's only other use). Always emits the
+    (m, l) stats — the only caller is the training forward."""
     Lp1, B, n, d = ext.shape
     L = Lp1 - 1
     tile_i = _pick_cons_tile(n)
@@ -287,12 +294,11 @@ def _cons_fwd_ext(
             (1, tile_b, tile_i, last), lambda g, b, i: (g, b, i, 0)
         )
 
-    out_shape = jax.ShapeDtypeStruct((Lp1, B, n, d), ext.dtype)
-    out_spec = lv_spec(d)
-    if save_stats:
-        stat_shape = jax.ShapeDtypeStruct((L, B, n, 1), jnp.float32)
-        out_shape = (out_shape, stat_shape, stat_shape)
-        out_spec = (out_spec, g_spec(1), g_spec(1))
+    stat_shape = jax.ShapeDtypeStruct((L, B, n, 1), jnp.float32)
+    out_shape = (
+        jax.ShapeDtypeStruct((Lp1, B, n, d), ext.dtype), stat_shape, stat_shape
+    )
+    out_spec = (lv_spec(d), g_spec(1), g_spec(1))
     return pl.pallas_call(
         partial(_consensus_update_kernel, **kw),
         out_shape=out_shape,
@@ -446,29 +452,36 @@ def fused_glom_loop(
     interpret: bool = False,
 ):
     """Run `iters` GLOM column updates and return the final level-major
-    [L, B, n, d] state. Primal path (no grad): the same kernels without
-    residual saves."""
+    [L, B, n, d] state.
+
+    PRIMAL path (this body; jax runs it only when NOT differentiating):
+    the plain level-major iteration with an [L] carry — the slot machinery
+    exists purely for the BACKWARD's benefit, and for pure forwards its
+    per-iteration slot-0 re-pin and final [1:] slice measured a ~2%
+    forward-bench tax (13.9k vs 14.2k col-iters/s). The [L+1]-slot form
+    lives in _loop_fwd, which runs under jax.vjp/grad."""
     L = levels0.shape[0]
     B, n, d = tokens.shape
-    ext = jnp.concatenate([tokens[None], levels0], axis=0)
-    ext2_shape = (L + 1, B * n, d)
-    tile_m = _pick_tile(B * n, d, bu_params.w1.shape[-1], tokens.dtype.itemsize)
+    M = B * n
+    tile_m = _pick_tile(M, d, bu_params.w1.shape[-1], tokens.dtype.itemsize)
+    lv = levels0
+    tokens_lm = tokens[None]
     for _ in range(iters):
-        ext2 = ext.reshape(ext2_shape)
-        bu = _ffw_fwd_ext(
-            bu_params, ext2, 0, L, tile_m=tile_m, interpret=interpret
+        bu_in = jnp.concatenate([tokens_lm, lv[:-1]], axis=0)
+        bu = _fused_forward(
+            bu_params, bu_in.reshape(L, M, d), tile_m=tile_m,
+            interpret=interpret,
         ).reshape(L, B, n, d)
-        td = _ffw_fwd_ext(
-            td_params, ext2, 2, L - 1, tile_m=tile_m, interpret=interpret,
-            add=pos_emb,
+        td = _fused_forward_add(
+            td_params, lv[1:].reshape(L - 1, M, d), pos_emb,
+            tile_m=tile_m, interpret=interpret,
         ).reshape(L - 1, B, n, d)
-        new_ext = _cons_fwd_ext(
-            ext, bu, td,
+        lv = _cons_forward(
+            lv, bu, td,
             side=side, radius=radius, attend_self=attend_self,
-            interpret=interpret, save_stats=False,
+            interpret=interpret,
         )
-        ext = jax.lax.dynamic_update_slice(new_ext, tokens[None], (0, 0, 0, 0))
-    return ext[1:]
+    return lv
 
 
 def _loop_fwd(
@@ -485,16 +498,15 @@ def _loop_fwd(
         ext2 = ext.reshape(ext2_shape)
         bu, pre_bu = _ffw_fwd_ext(
             bu_params, ext2, 0, L, tile_m=tile_m, interpret=interpret,
-            save_pre=True,
         )
         td, pre_td = _ffw_fwd_ext(
             td_params, ext2, 2, L - 1, tile_m=tile_m, interpret=interpret,
-            add=pos_emb, save_pre=True,
+            add=pos_emb,
         )
         new_ext, m, l = _cons_fwd_ext(
             ext, bu.reshape(L, B, n, d), td.reshape(L - 1, B, n, d),
             side=side, radius=radius, attend_self=attend_self,
-            interpret=interpret, save_stats=True,
+            interpret=interpret,
         )
         saved.append((ext, pre_bu, pre_td, m, l))
         ext = jax.lax.dynamic_update_slice(new_ext, tokens[None], (0, 0, 0, 0))

@@ -701,6 +701,19 @@ class TestFusedLoop:
 
         assert not loop_supported(6, 64, 256, 512, 2048, 2, 0, 256)
 
+    def test_primal_matches_vjp_forward(self):
+        """The no-grad primal (plain [L]-carry body) and the vjp forward
+        (the [L+1]-slot body) are different computations of the same math —
+        both must match the reference."""
+        from glom_tpu.kernels.fused_loop import fused_glom_loop
+
+        args = self._inputs()
+        primal = fused_glom_loop(*args, 3, self.side, 0.0, False, True)
+        ref = self._ref_loop(*args, 3, 0.0, False)
+        np.testing.assert_allclose(
+            np.asarray(primal), np.asarray(ref), rtol=2e-4, atol=2e-5
+        )
+
     def test_dispatch_gate(self):
         """loop_supported must reject the shapes the kernels cannot tile."""
         from glom_tpu.kernels.fused_loop import loop_supported
