@@ -90,9 +90,9 @@ _register(
 # 3. ImageNet-64, patch=8, levels=6, dim=512, local consensus window=7.
 # The 8x8 patch grid sharded seq=2 holds 4 rows per shard < floor(radius)=7,
 # so the one-hop halo precondition can NEVER hold for this geometry (and at
-# radius 7 on side 8 the mask barely masks anyway) — the exact SP form for
-# this config is the ring, which carries the same local-radius masks.
-# See `imagenet256-local` below for the config where halo actually pays.
+# radius 7 on side 8 the mask barely masks anyway) — an exact GLOBAL SP
+# form must stand in; which one is the selector's call (see sp_strategy
+# below). See `imagenet256-local` for the config where halo actually pays.
 _register(
     Preset(
         name="imagenet64-local",
