@@ -63,14 +63,27 @@ def main():
     chip = detect_chip()
     on_tpu = chip != "cpu"
     dtype = jnp.bfloat16 if on_tpu else jnp.float32
-    d, B = 512, 1
+    # (side, L, seq, d, B). Beyond the original d=512/L=8/B=1 grid, the
+    # shapes the auto-selector actually GOVERNS (round-4 missing #4 — the
+    # threshold must not be an extrapolation): the pod preset's
+    # d=1024/L=12, the imagenet64-local L=6 class, and BATCHED rows (the
+    # selector's working-set model claims b multiplies instance count on
+    # both sides without moving the per-instance spill point — these rows
+    # are that claim's check), at n spanning the modeled crossover
+    # (runtime.ulysses_preferred: per-instance sim working set n^2*4 vs
+    # VMEM).
     cases = (
-        [(16, 8, s) for s in (2, 4, 8)]      # n=256: the small-n/seq regime
-        + [(32, 8, s) for s in (2, 4, 8)]    # n=1024
-        + [(64, 8, s) for s in (2, 4)]       # n=4096: MXU well fed either way
-    ) if on_tpu else [(8, 4, 2)]
+        [(16, 8, s, 512, 1) for s in (2, 4, 8)]  # n=256: small-n/seq regime
+        + [(32, 8, s, 512, 1) for s in (2, 4, 8)]  # n=1024
+        + [(64, 8, s, 512, 1) for s in (2, 4)]  # n=4096: MXU fed either way
+        + [(16, 12, s, 1024, 1) for s in (2, 4)]  # pod shape, n=256
+        + [(32, 12, s, 1024, 1) for s in (2, 4)]  # pod shape, n=1024
+        + [(64, 12, 2, 1024, 1)]                  # pod shape, n=4096
+        + [(16, 6, 2, 512, 1), (32, 6, 2, 512, 1), (64, 6, 2, 512, 1)]
+        + [(32, 8, 2, 512, 8), (64, 8, 2, 512, 8)]  # batched: b-independence
+    ) if on_tpu else [(8, 4, 2, 64, 1)]
 
-    for side, L, seq in cases:
+    for side, L, seq, d, B in cases:
         n = side * side
         levels = jax.random.normal(
             jax.random.PRNGKey(side + seq), (B, n, L, d), dtype
@@ -101,7 +114,7 @@ def main():
             jax.jit(uly_chain), levels, repeats=4, calib_k=8, target_s=2.5
         )
         rec = {
-            "n": n, "L": L, "seq": seq, "d": d,
+            "n": n, "L": L, "seq": seq, "d": d, "B": B,
             "ring_compute_ms": round(t_ring * 1e3, 4),
             "ulysses_compute_ms": round(t_uly * 1e3, 4),
             "ulysses_speedup": round(t_ring / t_uly, 3),

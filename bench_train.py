@@ -137,9 +137,11 @@ def bench_train_step(batch_override=None):
         # Batch 64 stays the official point. Round-4 curve
         # (results/batch_curve.jsonl): 3841 / 4183 / 4255 / 4306 / 3489 at
         # 16 / 32 / 64 / 96 / 128 — batch 96 measures ~1% above 64 (inside
-        # the ~3% run-to-run band, i.e. statistically level), and 128
-        # falls off the whole-loop VJP's residual budget onto the scan
-        # path (use grad_accum=2 for effective 128).
+        # the ~3% run-to-run band, i.e. statistically level). Round 5:
+        # batch 128 no longer ships the 3489 scan-path regime —
+        # make_train_step auto-routes it through grad_accum=2 over
+        # batch-64 fused-loop microbatches (resolve_training_route); the
+        # 128 row needs re-measurement on the automatic path.
         batch, repeats = batch_override or 64, 6
         # ~122 ms/step: k=9 gives ~1.1 s of device work per call, so the
         # ~100 ms tunnel RTT (measured and subtracted) bounds the error

@@ -1249,7 +1249,9 @@ def _dense_bwd_budget() -> int:
     return _DENSE_SIM_LIMIT
 
 
-def _use_blockwise_bwd(levels_shape, side, radius, bwd_impl: str) -> bool:
+def _use_blockwise_bwd(
+    levels_shape, side, radius, bwd_impl: str, itemsize: int = 2
+) -> bool:
     """Measured (n, radius) crossover between the dense-recompute VJP and
     the blockwise backward kernels (results/longctx_bench.jsonl):
 
@@ -1262,6 +1264,10 @@ def _use_blockwise_bwd(levels_shape, side, radius, bwd_impl: str) -> bool:
         streaming rewrite removed the row-residency cap).
 
     bwd_impl forces a side ('blockwise' / 'dense') for tests and benches.
+    `itemsize` is the compute dtype's — callers on the training path pass
+    the real one so the n>=4096 one-sweep branch and _fused_fwd's
+    save_cons gate share one predicate (an f32 long row must not be
+    routed blockwise without its cons residual).
     """
     import os
     import warnings
@@ -1311,7 +1317,7 @@ def _use_blockwise_bwd(levels_shape, side, radius, bwd_impl: str) -> bool:
     # two-pass form LOST 38.8 vs 30.5 there). Below the crossover the
     # dense path keeps the mid-n global regime (0.281 vs 0.388 at n=1024
     # B=1). The HBM budget remains the hard gate for dense regardless.
-    if n >= 4096 and _onesweep_ok(B, n, d, 2):
+    if n >= 4096 and _onesweep_ok(B, n, d, itemsize):
         return True
     return 2 * L * B * n * n * 4 > _dense_bwd_budget()
 
@@ -1337,7 +1343,9 @@ def _fused_fwd(levels_lm, bu_lm, td_lm, side, radius, attend_self, interpret,
     bu/td are NOT residuals: their cotangent is g/div, values never
     needed."""
     L, B, n, d = levels_lm.shape
-    blockwise = _use_blockwise_bwd(levels_lm.shape, side, radius, bwd_impl)
+    blockwise = _use_blockwise_bwd(
+        levels_lm.shape, side, radius, bwd_impl, levels_lm.dtype.itemsize
+    )
     save_cons = (
         blockwise
         and n > _SMALL_BWD_N
@@ -1352,7 +1360,13 @@ def _fused_fwd(levels_lm, bu_lm, td_lm, side, radius, attend_self, interpret,
         out, m, l, cons = outs
     else:
         (out, m, l), cons = outs, None
-    return out, (levels_lm, m, l, cons)
+    # The backward-path decision is made HERE, once per trace, and rides
+    # the residual PYTREE STRUCTURE (an empty tuple vs None has no array
+    # leaves, so it stays static through the transpose): _dense_bwd_budget
+    # reads allocator state, and re-evaluating it in _fused_bwd could
+    # silently pick a different path than the one whose residuals were
+    # saved (advisor round 4).
+    return out, (levels_lm, m, l, cons, () if blockwise else None)
 
 
 def _fused_bwd(side, radius, attend_self, interpret, bwd_impl, res, g):
@@ -1360,13 +1374,14 @@ def _fused_bwd(side, radius, attend_self, interpret, bwd_impl, res, g):
     in the blockwise kernels (single-tile at n <= 512, one-sweep where the
     cons residual was saved, two-pass streamed otherwise — O(n) memory at
     any n) or through the explicit stats-based dense backward where that
-    measured faster — see _use_blockwise_bwd."""
+    measured faster — decided ONCE in _fused_fwd and carried in the
+    residual structure."""
     from glom_tpu.models.core import contribution_divisor  # lazy: no cycle
 
-    levels_lm, m, l, cons = res
+    levels_lm, m, l, cons, blockwise_flag = res
     L, B, n, d = levels_lm.shape
     f32 = jnp.float32
-    if _use_blockwise_bwd(levels_lm.shape, side, radius, bwd_impl):
+    if blockwise_flag is not None:
         # The kernels take the RAW cotangent, apply the divisor in-kernel
         # (from the level grid index), and emit the COMPLETE dlv in the
         # levels dtype — no divided/partial-sum copies of g hit HBM. The
@@ -1447,7 +1462,9 @@ def fused_consensus_update(
     if (
         not forced
         and n < 4096
-        and not _use_blockwise_bwd((L, B, n, d), side, radius, bwd_impl)
+        and not _use_blockwise_bwd(
+            (L, B, n, d), side, radius, bwd_impl, levels_lm.dtype.itemsize
+        )
     ):
         return _xla_reference(
             levels_lm, bu_lm, td_lm,

@@ -62,9 +62,12 @@ from glom_tpu.kernels.consensus_update import (
 )
 from glom_tpu.kernels.grouped_mlp import (
     _WS_BUDGET,
+    _bwd_compiler_params,
     _bwd_ws,
     _fused_forward,
     _fused_forward_add,
+    _mlp_bwd_kernel_saved,
+    _mlp_bwd_kernel_saved_add,
     _mlp_bwd_tail,
     _mlp_kernel,
     _mlp_kernel_add,
@@ -95,11 +98,14 @@ def _ffw_fwd_ext(
     tile_m: int,
     interpret: bool,
     add: jnp.ndarray | None = None,
+    save_pre: bool = True,
 ):
     """Grouped-FFW forward reading group g's input from carry slot
-    g + offset — the index map IS the slice. Always saves the
-    pre-activation (the only caller is the training forward; the no-grad
-    primal uses grouped_mlp's plain forms instead)."""
+    g + offset — the index map IS the slice. Saves the pre-activation by
+    default (the non-remat training forward); the REMAT forward passes
+    save_pre=False so the [G, M, f] pre never hits HBM — the backward
+    recomputes it per iteration via _pre_fwd_ext instead. (The no-grad
+    primal uses grouped_mlp's plain forms.) Returns (out, pre|None)."""
     M, d = ext2.shape[1], ext2.shape[2]
     f = params.w1.shape[-1]
     grid = (G, M // tile_m)
@@ -111,6 +117,8 @@ def _ffw_fwd_ext(
         pl.BlockSpec((1, tile_m, d), lambda g, m: (g, m, 0)),
         pl.BlockSpec((1, tile_m, f), lambda g, m: (g, m, 0)),
     )
+    if not save_pre:
+        out_shape, out_spec = out_shape[:1], out_spec[:1]
     x_spec = pl.BlockSpec(
         (1, tile_m, d), lambda g, m, _o=offset: (g + _o, m, 0)
     )
@@ -121,7 +129,7 @@ def _ffw_fwd_ext(
         pl.BlockSpec((1, 1, d), lambda g, m: (g, 0, 0)),  # b2
     ]
     if add is not None:
-        return pl.pallas_call(
+        out = pl.pallas_call(
             _mlp_kernel_add,
             out_shape=out_shape,
             grid=grid,
@@ -132,15 +140,82 @@ def _ffw_fwd_ext(
             interpret=interpret,
         )(ext2, add, params.w1, params.b1[:, None, :], params.w2,
           params.b2[:, None, :])
+    else:
+        out = pl.pallas_call(
+            _mlp_kernel,
+            out_shape=out_shape,
+            grid=grid,
+            in_specs=[x_spec] + w_specs,
+            out_specs=out_spec,
+            compiler_params=_VMEM_64M,
+            interpret=interpret,
+        )(ext2, params.w1, params.b1[:, None, :], params.w2,
+          params.b2[:, None, :])
+    return out if save_pre else (out[0], None)
+
+
+def _pre_kernel(x_ref, w1_ref, b1_ref, pre_ref):
+    """First-matmul-only recompute: pre = x @ w1 + b1 in the compute dtype —
+    bit-identical to the pre the training forward would have saved
+    (_mlp_kernel computes it with the same f32-accumulate dot + cast)."""
+    pre = jnp.dot(x_ref[0], w1_ref[0], preferred_element_type=jnp.float32)
+    pre = pre + b1_ref[0].astype(jnp.float32)
+    pre_ref[0] = pre.astype(x_ref.dtype)
+
+
+def _pre_add_kernel(x_ref, a_ref, w1_ref, b1_ref, pre_ref):
+    """_pre_kernel with the positional addend folded into the input load
+    (matches _mlp_kernel_add's pre exactly)."""
+    xa = _tiled_add(x_ref[0], a_ref[...]).astype(x_ref.dtype)
+    pre = jnp.dot(xa, w1_ref[0], preferred_element_type=jnp.float32)
+    pre = pre + b1_ref[0].astype(jnp.float32)
+    pre_ref[0] = pre.astype(xa.dtype)
+
+
+def _pre_fwd_ext(
+    params: GroupedFFWParams,
+    ext2: jnp.ndarray,  # [L+1, M, d] saved slot carry
+    offset: int,
+    G: int,
+    *,
+    tile_m: int,
+    interpret: bool,
+    add: jnp.ndarray | None = None,
+):
+    """REMAT-mode pre-activation recompute for one iteration: only the
+    first matmul re-runs (the second matmul's output never feeds the
+    backward — the consensus stats (m, l) are saved instead of recomputed),
+    so the remat tax is HALF the FFW forward, not a full forward re-run."""
+    M, d = ext2.shape[1], ext2.shape[2]
+    f = params.w1.shape[-1]
+    grid = (G, M // tile_m)
+    x_spec = pl.BlockSpec((1, tile_m, d), lambda g, m, _o=offset: (g + _o, m, 0))
+    w1_spec = pl.BlockSpec((1, d, f), lambda g, m: (g, 0, 0))
+    b1_spec = pl.BlockSpec((1, 1, f), lambda g, m: (g, 0, 0))
+    out_shape = jax.ShapeDtypeStruct((G, M, f), ext2.dtype)
+    out_spec = pl.BlockSpec((1, tile_m, f), lambda g, m: (g, m, 0))
+    if add is not None:
+        return pl.pallas_call(
+            _pre_add_kernel,
+            out_shape=out_shape,
+            grid=grid,
+            in_specs=[
+                x_spec, pl.BlockSpec(add.shape, lambda g, m: (0, 0)),
+                w1_spec, b1_spec,
+            ],
+            out_specs=out_spec,
+            compiler_params=_VMEM_64M,
+            interpret=interpret,
+        )(ext2, add, params.w1, params.b1[:, None, :])
     return pl.pallas_call(
-        _mlp_kernel,
+        _pre_kernel,
         out_shape=out_shape,
         grid=grid,
-        in_specs=[x_spec] + w_specs,
+        in_specs=[x_spec, w1_spec, b1_spec],
         out_specs=out_spec,
         compiler_params=_VMEM_64M,
         interpret=interpret,
-    )(ext2, params.w1, params.b1[:, None, :], params.w2, params.b2[:, None, :])
+    )(ext2, params.w1, params.b1[:, None, :])
 
 
 def _ffw_bwd_acc_kernel(
@@ -186,6 +261,18 @@ def _ffw_bwd_acc_add_kernel(
         da_ref[...] += da_step
 
 
+def _chain_ws_ok(bt: int, d: int, f: int, itemsize: int, n: int) -> bool:
+    """Can the accumulator-CHAINED backward kernels fit the working-set
+    budget? Chaining adds the incoming dw1/dw2 f32 blocks (2*d*f*4) and
+    the in+out da pair (n*d*8) to the per-op backward working set. At the
+    flagship (d=512, f=2048) that is ~34.5MB — fits; at the pod per-TP-rank
+    shape (d=1024, f=2048) it is ~58.7MB > the 48MB budget, so the loop
+    there runs the UNCHAINED variant (fresh per-iteration dw, XLA adds) —
+    the same per-op kernel footprint that measured 75-78M of Mosaic stack
+    under the 100MB grant on silicon."""
+    return _bwd_ws(bt, d, f, itemsize) + 2 * d * f * 4 + n * d * 8 <= _WS_BUDGET
+
+
 def _ffw_bwd_ext(
     params: GroupedFFWParams,
     ext2: jnp.ndarray,      # [L+1, M, d] saved carry (this iteration's input)
@@ -199,10 +286,17 @@ def _ffw_bwd_ext(
     interpret: bool,
     add: jnp.ndarray | None = None,
     da_in: jnp.ndarray | None = None,
+    chain: bool = True,
 ):
     """One iteration's FFW backward: x via slot-offset map, cotangent read
     directly off the full dmean buffer (the td call's G = L-1 grid IS the
-    [:L-1] slice), dw/db (and da) chained through incoming accumulators."""
+    [:L-1] slice), dw/db (and da) chained through incoming accumulators.
+
+    chain=False (shapes where _chain_ws_ok fails, e.g. the pod per-TP-rank
+    d=1024) runs the per-op saved-pre kernels with the SAME slot-offset /
+    direct-dmean specs — the concat/slice glue stays dead — and the
+    cross-iteration dw/da accumulation happens here in XLA adds instead of
+    in-kernel seeding. Returns the same (accumulated grads, dx, da)."""
     M, d = ext2.shape[1], ext2.shape[2]
     f = params.w1.shape[-1]
     f32 = jnp.float32
@@ -230,31 +324,64 @@ def _ffw_bwd_ext(
         pl.BlockSpec((1, f, d), lambda g, m: (g, 0, 0)),  # w2
         row_spec,  # g cotangent (dmean slots 0..G-1)
     ]
+    compiler_params = (
+        _VMEM_64M if chain
+        else _bwd_compiler_params(tile_m, d, f, ext2.dtype.itemsize)
+    )
     if add is not None:
         n = add.shape[0]
         a_spec = pl.BlockSpec(add.shape, lambda g, m: (0, 0))
         da_spec = pl.BlockSpec((n, d), lambda g, m: (0, 0))
+        if chain:
+            dx, dw1, db1, dw2, db2, da = pl.pallas_call(
+                _ffw_bwd_acc_add_kernel,
+                out_shape=out_shapes + (jax.ShapeDtypeStruct((n, d), f32),),
+                grid=grid,
+                in_specs=[common[0], a_spec] + common[1:] + acc_specs + [da_spec],
+                out_specs=out_specs + (da_spec,),
+                compiler_params=compiler_params,
+                interpret=interpret,
+            )(ext2, add, params.w1, pre, params.w2, gcot2,
+              acc.w1, acc.b1, acc.w2, acc.b2, da_in)
+            return GroupedFFWParams(dw1, db1, dw2, db2), dx, da
         dx, dw1, db1, dw2, db2, da = pl.pallas_call(
-            _ffw_bwd_acc_add_kernel,
+            _mlp_bwd_kernel_saved_add,
             out_shape=out_shapes + (jax.ShapeDtypeStruct((n, d), f32),),
             grid=grid,
-            in_specs=[common[0], a_spec] + common[1:] + acc_specs + [da_spec],
+            in_specs=[common[0], a_spec] + common[1:],
             out_specs=out_specs + (da_spec,),
-            compiler_params=_VMEM_64M,
+            compiler_params=compiler_params,
             interpret=interpret,
-        )(ext2, add, params.w1, pre, params.w2, gcot2,
-          acc.w1, acc.b1, acc.w2, acc.b2, da_in)
-        return GroupedFFWParams(dw1, db1, dw2, db2), dx, da
+        )(ext2, add, params.w1, pre, params.w2, gcot2)
+        fresh = GroupedFFWParams(dw1, db1, dw2, db2)
+        return (
+            jax.tree_util.tree_map(jnp.add, acc, fresh),
+            dx,
+            da_in + da,
+        )
+    if chain:
+        dx, dw1, db1, dw2, db2 = pl.pallas_call(
+            _ffw_bwd_acc_kernel,
+            out_shape=out_shapes,
+            grid=grid,
+            in_specs=common + acc_specs,
+            out_specs=out_specs,
+            compiler_params=compiler_params,
+            interpret=interpret,
+        )(ext2, params.w1, pre, params.w2, gcot2,
+          acc.w1, acc.b1, acc.w2, acc.b2)
+        return GroupedFFWParams(dw1, db1, dw2, db2), dx, None
     dx, dw1, db1, dw2, db2 = pl.pallas_call(
-        _ffw_bwd_acc_kernel,
+        _mlp_bwd_kernel_saved,
         out_shape=out_shapes,
         grid=grid,
-        in_specs=common + acc_specs,
+        in_specs=common,
         out_specs=out_specs,
-        compiler_params=_VMEM_64M,
+        compiler_params=compiler_params,
         interpret=interpret,
-    )(ext2, params.w1, pre, params.w2, gcot2, acc.w1, acc.b1, acc.w2, acc.b2)
-    return GroupedFFWParams(dw1, db1, dw2, db2), dx, None
+    )(ext2, params.w1, pre, params.w2, gcot2)
+    fresh = GroupedFFWParams(dw1, db1, dw2, db2)
+    return jax.tree_util.tree_map(jnp.add, acc, fresh), dx, None
 
 
 def _cons_fwd_ext(
@@ -411,10 +538,17 @@ def _cons_bwd_ext(
 
 def loop_supported(
     L: int, B: int, n: int, d: int, f: int, itemsize: int, iters: int,
-    pos_n: int,
+    pos_n: int, remat: bool = False,
 ) -> bool:
     """Static eligibility for the hand-rolled loop VJP (the flagship
-    training regime); callers fall back to the scan paths otherwise."""
+    training regime); callers fall back to the scan paths otherwise.
+
+    remat=True is the recompute-per-iteration mode (BASELINE config 5's
+    "ckpt over iters"): the residual stack drops the [G, M, f]
+    pre-activations — the dominant term, (2L-1)·M·f vs (L+1)·M·d, ~6x at
+    mult=4 — because the backward re-runs the FIRST FFW matmul per
+    iteration (_pre_fwd_ext); only the carries and the tiny consensus
+    stats are saved."""
     M = B * n
     tile = _pick_tile(M, d, f, itemsize)
     bt = _pick_bwd_tile(M, d, f, itemsize)
@@ -427,18 +561,23 @@ def loop_supported(
     # pos-emb fold constraints (the td kernels tile the addend per row tile)
     if pos_n != n or M % n or tile % n or bt % n:
         return False
-    # the accumulator-chained backward carries two extra resident dw blocks
-    if _bwd_ws(bt, d, f, itemsize) + 2 * d * f * 4 + n * d * 8 > _WS_BUDGET:
+    # the backward must fit EITHER accumulator-chained (the flagship
+    # configuration) or unchained (per-op footprint + the resident da —
+    # the pod per-TP-rank d=1024 shape; see _chain_ws_ok)
+    if not _chain_ws_ok(bt, d, f, itemsize, n) and (
+        _bwd_ws(bt, d, f, itemsize) + n * d * 4 > _WS_BUDGET
+    ):
         return False
     per_iter = (
         (L + 1) * M * d * itemsize          # saved carry
-        + (2 * L - 1) * M * f * itemsize    # both FFW pre-activations
         + 2 * L * M * 4                     # consensus stats
     )
+    if not remat:
+        per_iter += (2 * L - 1) * M * f * itemsize  # both FFW pre-activations
     return iters * per_iter <= _RESIDUAL_BUDGET
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+@partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
 def fused_glom_loop(
     bu_params: GroupedFFWParams,
     td_params: GroupedFFWParams,
@@ -450,6 +589,7 @@ def fused_glom_loop(
     radius: float,
     attend_self: bool,
     interpret: bool = False,
+    remat: bool = False,
 ):
     """Run `iters` GLOM column updates and return the final level-major
     [L, B, n, d] state.
@@ -459,7 +599,12 @@ def fused_glom_loop(
     exists purely for the BACKWARD's benefit, and for pure forwards its
     per-iteration slot-0 re-pin and final [1:] slice measured a ~2%
     forward-bench tax (13.9k vs 14.2k col-iters/s). The [L+1]-slot form
-    lives in _loop_fwd, which runs under jax.vjp/grad."""
+    lives in _loop_fwd, which runs under jax.vjp/grad.
+
+    remat=True (identical math; static) switches the VJP to
+    recompute-per-iteration: _loop_fwd saves only (carry, consensus stats)
+    and _loop_bwd re-runs the first FFW matmul per iteration — BASELINE
+    config 5's checkpoint-over-iters regime without the scan-path glue."""
     L = levels0.shape[0]
     B, n, d = tokens.shape
     M = B * n
@@ -486,7 +631,7 @@ def fused_glom_loop(
 
 def _loop_fwd(
     bu_params, td_params, pos_emb, tokens, levels0,
-    iters, side, radius, attend_self, interpret,
+    iters, side, radius, attend_self, interpret, remat=False,
 ):
     L = levels0.shape[0]
     B, n, d = tokens.shape
@@ -498,22 +643,26 @@ def _loop_fwd(
         ext2 = ext.reshape(ext2_shape)
         bu, pre_bu = _ffw_fwd_ext(
             bu_params, ext2, 0, L, tile_m=tile_m, interpret=interpret,
+            save_pre=not remat,
         )
         td, pre_td = _ffw_fwd_ext(
             td_params, ext2, 2, L - 1, tile_m=tile_m, interpret=interpret,
-            add=pos_emb,
+            add=pos_emb, save_pre=not remat,
         )
         new_ext, m, l = _cons_fwd_ext(
             ext, bu.reshape(L, B, n, d), td.reshape(L - 1, B, n, d),
             side=side, radius=radius, attend_self=attend_self,
             interpret=interpret,
         )
-        saved.append((ext, pre_bu, pre_td, m, l))
+        # Remat mode saves only the carry + the tiny [L, B, n, 1] stats;
+        # the pre-activations (the dominant residual) are recomputed per
+        # iteration in _loop_bwd via _pre_fwd_ext.
+        saved.append((ext, m, l) if remat else (ext, pre_bu, pre_td, m, l))
         ext = jax.lax.dynamic_update_slice(new_ext, tokens[None], (0, 0, 0, 0))
     return ext[1:], (bu_params, td_params, pos_emb, tuple(saved))
 
 
-def _loop_bwd(iters, side, radius, attend_self, interpret, res, g):
+def _loop_bwd(iters, side, radius, attend_self, interpret, remat, res, g):
     bu_params, td_params, pos_emb, saved = res
     L_, B, n, d = g.shape
     L = L_
@@ -535,8 +684,22 @@ def _loop_bwd(iters, side, radius, attend_self, interpret, res, g):
     dlv = g
     dx_bu = dx_td = None
 
+    tile_fwd = _pick_tile(M, d, f_bu, g.dtype.itemsize)
+    chain = _chain_ws_ok(bt, d, f_bu, g.dtype.itemsize, n)
+
     for t in reversed(range(iters)):
-        ext, pre_bu, pre_td, m, l = saved[t]
+        if remat:
+            ext, m, l = saved[t]
+            ext2_r = ext.reshape(L + 1, M, d)
+            pre_bu = _pre_fwd_ext(
+                bu_params, ext2_r, 0, L, tile_m=tile_fwd, interpret=interpret,
+            )
+            pre_td = _pre_fwd_ext(
+                td_params, ext2_r, 2, L - 1, tile_m=tile_fwd,
+                interpret=interpret, add=pos_emb,
+            )
+        else:
+            ext, pre_bu, pre_td, m, l = saved[t]
         dlv, dmean = _cons_bwd_ext(
             ext, m, l, dlv, dx_bu, dx_td,
             side=side, radius=radius, attend_self=attend_self,
@@ -547,10 +710,11 @@ def _loop_bwd(iters, side, radius, attend_self, interpret, res, g):
         acc_td, dx_td2, da = _ffw_bwd_ext(
             td_params, ext2, 2, L - 1, pre_td, dmean2, acc_td,
             tile_m=bt, interpret=interpret, add=pos_emb, da_in=da,
+            chain=chain,
         )
         acc_bu, dx_bu2, _ = _ffw_bwd_ext(
             bu_params, ext2, 0, L, pre_bu, dmean2, acc_bu,
-            tile_m=bt, interpret=interpret,
+            tile_m=bt, interpret=interpret, chain=chain,
         )
         dx_bu = dx_bu2.reshape(L, B, n, d)
         dx_td = dx_td2.reshape(L - 1, B, n, d)

@@ -38,6 +38,12 @@ ConsensusFn = Callable[[jnp.ndarray], jnp.ndarray]
 FFWFn = Callable[[GroupedFFWParams, jnp.ndarray], jnp.ndarray]
 
 
+def _on_tpu() -> bool:
+    """Seam for the dispatch policy's platform check (tests patch this to
+    exercise the TPU-side routing on the CPU mesh)."""
+    return jax.devices()[0].platform == "tpu"
+
+
 class GlomParams(NamedTuple):
     """Learnable state. Mirrors the reference module tree (SURVEY.md §3.1)."""
 
@@ -205,33 +211,90 @@ def glom_forward(
     return final
 
 
+def resolve_vjp_path(
+    cfg: GlomConfig,
+    b: int,
+    iters: int,
+    *,
+    remat: bool = False,
+    use_pallas: bool = False,
+    itemsize: int = 2,
+    custom_consensus: bool = False,
+    return_all: bool = False,
+    scan_only: bool = False,
+) -> str:
+    """THE single resolution source for which backward implementation a
+    training forward at these static shapes will use. Both the dispatch
+    (_use_fused_loop) and the trainers' metric logging call this, so a run
+    can never train on a different backward than its records claim (the
+    same discipline effective_sp_strategy applies to collectives).
+
+    Returns one of:
+      'fused_loop'     — the hand-rolled whole-loop VJP (kernels/fused_loop)
+      'scan_blockwise' — lax.scan forward, Pallas blockwise consensus bwd
+      'scan_dense'     — lax.scan forward, dense XLA/stats consensus bwd
+
+    scan_only=True excludes the fused loop regardless of eligibility — the
+    manual shard_map bodies (parallel/manual.py) scan the kernels directly
+    and never dispatch to the whole-loop VJP.
+    """
+    import os
+
+    from glom_tpu.kernels.consensus_update import _use_blockwise_bwd
+    from glom_tpu.kernels.fused_loop import loop_supported
+
+    n, d, L = cfg.num_patches, cfg.dim, cfg.levels
+    if not use_pallas or custom_consensus or not _on_tpu():
+        return "scan_dense"
+    env_auto = os.environ.get("GLOM_CONSENSUS_BWD", "auto") == "auto"
+    if (
+        not scan_only
+        and not return_all
+        and b >= 8
+        and env_auto
+        and loop_supported(
+            L, b, n, d, d * cfg.mult, itemsize, iters, n, remat
+        )
+    ):
+        return "fused_loop"
+    blockwise = _use_blockwise_bwd(
+        (L, b, n, d), cfg.num_patches_side,
+        float(cfg.local_consensus_radius), "auto", itemsize,
+    )
+    return "scan_blockwise" if blockwise else "scan_dense"
+
+
 def _use_fused_loop(
     params: GlomParams, cfg: GlomConfig, b: int, n: int, d: int,
     iters: int, levels_in, return_all: bool, remat: bool,
 ) -> bool:
     """Dispatch to the hand-rolled whole-loop VJP (kernels/fused_loop.py)
-    on the flagship training regime: TPU, no remat, final-state-only, the
+    on the flagship training regime: TPU, final-state-only, the
     single-tile consensus row, tileable FFW shapes, and the measured
     batched regime where the in-VMEM backward wins (B >= 8 — see
-    consensus_update._use_blockwise_bwd's crossover table). The
-    GLOM_CONSENSUS_BWD=dense override disables it so bench A/B comparisons
-    still reach the dense VJP."""
-    import os
+    consensus_update._use_blockwise_bwd's crossover table). remat=True
+    rides the loop too (round 5): the VJP's recompute-per-iteration mode
+    keeps the glue-free structure at BASELINE config 5's
+    checkpoint-over-iters regime. The GLOM_CONSENSUS_BWD=dense override
+    disables it so bench A/B comparisons still reach the dense VJP.
 
-    from glom_tpu.kernels.fused_loop import loop_supported
-
-    if return_all or remat or jax.devices()[0].platform != "tpu":
-        return False
-    # Any non-auto override pins the SCAN path so bench A/B comparisons
-    # measure the side they name (blockwise scan vs dense VJP), not the
-    # whole-loop VJP; _use_blockwise_bwd warns about invalid values.
-    if b < 8 or os.environ.get("GLOM_CONSENSUS_BWD", "auto") != "auto":
-        return False
+    Thin shape-consistency gate over resolve_vjp_path (the single
+    resolution source — the non-auto-env / b<8 / return_all policy lives
+    THERE): this checks only what requires the actual params and tokens
+    (dtype agreement, pos-emb/config coherence)."""
     if exists(levels_in) and levels_in.dtype != params.init_levels.dtype:
         return False
-    return loop_supported(
-        cfg.levels, b, n, d, params.bottom_up.w1.shape[-1],
-        params.init_levels.dtype.itemsize, iters, params.pos_emb.shape[0],
+    if (n, d) != (cfg.num_patches, cfg.dim) or params.pos_emb.shape[0] != n:
+        return False
+    if params.bottom_up.w1.shape[-1] != d * cfg.mult:
+        return False
+    return (
+        resolve_vjp_path(
+            cfg, b, iters, remat=remat, use_pallas=True,
+            itemsize=params.init_levels.dtype.itemsize,
+            return_all=return_all,
+        )
+        == "fused_loop"
     )
 
 
@@ -281,6 +344,7 @@ def _glom_forward_fused(
             params.bottom_up, params.top_down, params.pos_emb, tokens,
             levels_lm, iters, cfg.num_patches_side,
             float(cfg.local_consensus_radius), cfg.consensus_self, False,
+            remat,
         )
         return jnp.transpose(final, (1, 2, 0, 3))  # [b, n, L, d]
 

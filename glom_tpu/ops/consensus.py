@@ -48,21 +48,46 @@ def build_local_mask(num_patches_side: int, radius: float) -> Optional[np.ndarra
     return dist > radius
 
 
+def iota_local_mask(
+    n: int, side: int, radius: float
+) -> Optional[jnp.ndarray]:
+    """In-graph [n, n] radius mask (True = non-local) from broadcasted
+    iota — the device computes it inside the masking fusion, so no O(n^2)
+    host numpy buffer is built at trace time or embedded as an executable
+    constant (the reference's init-time meshgrid/cdist cost, reference
+    :42-52, which build_local_mask reproduces host-side). Same contract as
+    build_local_mask; used by the sharded paths where the mask would
+    otherwise be re-materialized per shard."""
+    if radius <= 0:
+        return None
+    idx = jnp.arange(n, dtype=jnp.int32)
+    hi, wi = idx // side, idx % side
+    dh = (hi[:, None] - hi[None, :]).astype(jnp.float32)
+    dw = (wi[:, None] - wi[None, :]).astype(jnp.float32)
+    return dh * dh + dw * dw > radius * radius
+
+
 def consensus_attention(
     levels: jnp.ndarray,
     *,
     attend_self: bool = False,
     local_mask: Optional[np.ndarray] = None,
+    side: Optional[int] = None,
+    radius: float = 0.0,
     compute_dtype=None,
 ) -> jnp.ndarray:
     """Dense consensus attention.
 
     levels: [b, n, L, d]  ->  [b, n, L, d]
     local_mask: optional [n, n] bool, True = masked out (non-local).
+    Alternatively pass (side, radius) to build the same mask in-graph from
+    iota (no host [n, n] buffer — see iota_local_mask).
     """
     if compute_dtype is not None:
         levels = levels.astype(compute_dtype)
     b, n, L, d = levels.shape
+    if local_mask is None and side is not None and radius > 0:
+        local_mask = iota_local_mask(n, side, radius)
     q = levels
     k = l2norm(levels, axis=-1)
     v = levels

@@ -90,14 +90,14 @@ def _shard_consensus_fn(cfg: GlomConfig, seq: int, sp_strategy: str):
         return None
     radius = float(cfg.local_consensus_radius)
     if sp_strategy == "ulysses":
-        from glom_tpu.ops.consensus import build_local_mask
         from glom_tpu.parallel.ulysses import ulysses_consensus_shard
 
         return partial(
             ulysses_consensus_shard,
             axis_name=SEQ_AXIS,
             attend_self=cfg.consensus_self,
-            local_mask=build_local_mask(cfg.num_patches_side, radius),
+            side=cfg.num_patches_side,
+            radius=radius,
         )
     if sp_strategy == "halo":
         return partial(

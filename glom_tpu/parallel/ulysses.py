@@ -29,11 +29,17 @@ def ulysses_consensus_shard(
     *,
     axis_name: str,
     attend_self: bool,
-    local_mask: Optional[np.ndarray],
+    side: Optional[int] = None,
+    radius: float = 0.0,
 ):
     """Per-shard body (under shard_map, n sharded over `axis_name`).
 
     x: [b, n_loc, L, d] -> [b, n_loc, L, d]; requires S | L.
+    The local-radius mask (side, radius) is computed IN-GRAPH from iota
+    inside the shard (ops.consensus.iota_local_mask) — no [n, n] host
+    buffer is built at trace time or embedded per-shard as a constant
+    (round-4 weak #5: the old local_mask= plumbing reintroduced the
+    reference's O(n^2) init cost, reference :42-52, on this path).
     """
     S = lax.axis_size(axis_name)
     L = x.shape[2]
@@ -41,7 +47,9 @@ def ulysses_consensus_shard(
         raise ValueError(f"Ulysses needs levels ({L}) divisible by mesh axis ({S})")
     # [b, n_loc, L, d] -> [b, n, L/S, d]: gather the patch axis, scatter levels
     y = lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
-    out = consensus_attention(y, attend_self=attend_self, local_mask=local_mask)
+    out = consensus_attention(
+        y, attend_self=attend_self, side=side, radius=radius
+    )
     # [b, n, L/S, d] -> [b, n_loc, L, d]
     return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
@@ -50,7 +58,8 @@ def make_ulysses_consensus(
     mesh,
     *,
     attend_self: bool,
-    local_mask: Optional[np.ndarray] = None,
+    side: Optional[int] = None,
+    radius: float = 0.0,
     axis_name: str = "seq",
 ):
     """Build a consensus_fn: [b, n, L, d] -> [b, n, L, d], n sharded over
@@ -59,7 +68,8 @@ def make_ulysses_consensus(
         ulysses_consensus_shard,
         axis_name=axis_name,
         attend_self=attend_self,
-        local_mask=local_mask,
+        side=side,
+        radius=radius,
     )
     return jax.shard_map(
         fn,

@@ -47,6 +47,38 @@ PEAK_FLOPS = {
 }
 
 
+def probe_device_count(timeout: float = 120.0) -> Optional[int]:
+    """Visible-device count via a THROWAWAY subprocess, or None when backend
+    init fails or hangs.
+
+    Never touches a backend in the calling process: a wedged TPU plugin makes
+    `jax.devices()` hang indefinitely (observed round 4: both driver artifacts
+    died in parent-process backend init before any framework code ran), and a
+    hang cannot be caught in-process. The subprocess inherits the caller's
+    env, so virtual-CPU-mesh setups (JAX_PLATFORMS=cpu +
+    --xla_force_host_platform_device_count=N) probe exactly what the caller
+    would see."""
+    import subprocess
+    import sys
+
+    code = "import jax; print('DEVCOUNT=%d' % len(jax.devices()))"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        return None
+    if proc.returncode != 0:
+        return None
+    for line in proc.stdout.splitlines():
+        if line.startswith("DEVCOUNT="):
+            return int(line.split("=", 1)[1])
+    return None
+
+
 def detect_chip(device=None) -> str:
     """Map jax device_kind to a PEAK_FLOPS key ('v5e' fallback with the
     benefit of the doubt going to the lowest-peak TPU)."""

@@ -723,3 +723,84 @@ class TestFusedLoop:
         assert not loop_supported(6, 64, 1024, 512, 2048, 2, 7, 1024)  # n too big
         assert not loop_supported(6, 1, 6, 512, 2048, 2, 7, 6)  # untileable M
         assert not loop_supported(6, 64, 256, 512, 2048, 2, 7, 128)  # pos mismatch
+
+    @pytest.mark.parametrize("radius", [0.0, 1.5])
+    def test_remat_matches_nonremat(self, radius):
+        """remat=True drops the pre-activation residuals and recomputes them
+        in the backward via the first-matmul-only kernel — the SAME
+        f32-accumulate dot + cast the forward would have saved, so every
+        cotangent must match the non-remat VJP bit-exactly."""
+        from glom_tpu.kernels.fused_loop import fused_glom_loop
+
+        args = self._inputs()
+
+        def loss(remat):
+            def f(*a):
+                return jnp.mean(
+                    fused_glom_loop(
+                        *a, 3, self.side, radius, False, True, remat
+                    )
+                    ** 2
+                )
+
+            return f
+
+        g0 = jax.grad(loss(False), argnums=tuple(range(5)))(*args)
+        g1 = jax.grad(loss(True), argnums=tuple(range(5)))(*args)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(g0), jax.tree_util.tree_leaves(g1)
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_unchained_backward_matches(self, monkeypatch):
+        """The unchained backward variant (pod per-TP-rank d=1024-class
+        shapes, where in-kernel accumulator chaining exceeds the
+        working-set budget) must produce the same cotangents as the
+        chained flagship variant — same kernels' math, the cross-iteration
+        dw/da accumulation just moves to XLA adds."""
+        from glom_tpu.kernels import fused_loop
+
+        args = self._inputs()
+
+        def loss(*a):
+            return jnp.mean(
+                fused_loop.fused_glom_loop(*a, 3, self.side, 0.0, False, True)
+                ** 2
+            )
+
+        g_chained = jax.grad(loss, argnums=tuple(range(5)))(*args)
+        monkeypatch.setattr(fused_loop, "_chain_ws_ok", lambda *a: False)
+        g_unchained = jax.grad(loss, argnums=tuple(range(5)))(*args)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(g_chained),
+            jax.tree_util.tree_leaves(g_unchained),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+            )
+
+    def test_pod_per_rank_shape_admitted(self):
+        """BASELINE config 5's per-TP-rank shape (L=12, d=1024, f/mp=2048,
+        batch 16, remat) must ride the fused loop via the unchained
+        backward — the regime round 4 left on the scan path."""
+        from glom_tpu.kernels.fused_loop import _chain_ws_ok, loop_supported
+
+        assert loop_supported(12, 16, 256, 1024, 2048, 2, 13, 256, remat=True)
+        # ...through the unchained variant specifically:
+        from glom_tpu.kernels.grouped_mlp import _pick_bwd_tile
+
+        bt = _pick_bwd_tile(16 * 256, 1024, 2048, 2)
+        assert bt is not None and not _chain_ws_ok(bt, 1024, 2048, 2, 256)
+        # the flagship stays on the (measured-faster) chained variant
+        bt_f = _pick_bwd_tile(64 * 256, 512, 2048, 2)
+        assert _chain_ws_ok(bt_f, 512, 2048, 2, 256)
+
+    def test_remat_admits_bigger_residuals(self):
+        """The remat residual stack (carry + stats only) fits shapes the
+        full stack cannot: flagship batch 128 x 12 iters is 20.6GB of
+        non-remat residuals (> the 10GB budget) but 2.8GB under remat —
+        BASELINE config 5's regime rides the fused loop now."""
+        from glom_tpu.kernels.fused_loop import loop_supported
+
+        assert not loop_supported(6, 128, 256, 512, 2048, 2, 12, 256)
+        assert loop_supported(6, 128, 256, 512, 2048, 2, 12, 256, remat=True)

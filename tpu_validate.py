@@ -254,6 +254,83 @@ def check_fused_loop_grads():
         )
 
 
+def _fused_loop_args(key=0):
+    from glom_tpu.ops.ffw import init_grouped_ffw
+
+    L, B, n, d = 6, 8, 256, 512
+    k = jax.random.split(jax.random.PRNGKey(key), 5)
+    return (
+        _bf16_tree(init_grouped_ffw(k[0], L, d, 4)),
+        _bf16_tree(init_grouped_ffw(k[1], L - 1, d, 4)),
+        jax.random.normal(k[2], (n, d), jnp.bfloat16),
+        jax.random.normal(k[3], (B, n, d), jnp.bfloat16),
+        jax.random.normal(k[4], (L, B, n, d), jnp.bfloat16),
+    )
+
+
+@check("fused_loop_primal_vs_vjp_forward")
+def check_fused_loop_primal_vs_vjp_forward():
+    """The no-grad primal (plain [L]-carry body) and the VJP forward (the
+    [L+1]-slot body) are SEPARATE computations of the same math, kept
+    equal only by tests (the 2% forward-bench split, fused_loop.py) — this
+    pins their parity on real Mosaic explicitly, not as a side effect of
+    the grad check (round-4 weak #4)."""
+    from glom_tpu.kernels.fused_loop import fused_glom_loop
+
+    args = _fused_loop_args()
+    primal = jax.jit(
+        lambda *a: fused_glom_loop(*a, 3, 16, 0.0, False, False)
+    )(*args)
+
+    def via_vjp(*a):
+        out, _ = jax.vjp(
+            lambda bu, td, pos, tok, lv: fused_glom_loop(
+                bu, td, pos, tok, lv, 3, 16, 0.0, False, False
+            ),
+            *a,
+        )
+        return out
+
+    vjp_fwd = jax.jit(via_vjp)(*args)
+    np.testing.assert_allclose(
+        np.asarray(primal, np.float32), np.asarray(vjp_fwd, np.float32),
+        rtol=2e-2, atol=2e-3,
+    )
+
+
+@check("fused_loop_remat_grad_parity")
+def check_fused_loop_remat_grads():
+    """remat=True (recompute-per-iteration backward, BASELINE config 5's
+    regime on the fused loop) vs remat=False on real Mosaic: the
+    recomputed pre-activations run the same f32-accumulate matmul the
+    forward would have saved, so the cotangents must agree tightly."""
+    from glom_tpu.kernels.fused_loop import fused_glom_loop, loop_supported
+
+    assert loop_supported(6, 8, 256, 512, 2048, 2, 3, 256, remat=True)
+    args = _fused_loop_args(1)
+
+    def loss(remat):
+        def f(*a):
+            return jnp.mean(
+                fused_glom_loop(*a, 3, 16, 0.0, False, False, remat).astype(
+                    jnp.float32
+                )
+                ** 2
+            )
+
+        return f
+
+    g0 = jax.jit(jax.grad(loss(False), argnums=tuple(range(5))))(*args)
+    g1 = jax.jit(jax.grad(loss(True), argnums=tuple(range(5))))(*args)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g0), jax.tree_util.tree_leaves(g1)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=1e-4, atol=1e-6,
+        )
+
+
 @check("tp_composition_megatron_psum")
 def check_tp_composition():
     """TP x Pallas on REAL hardware: the manual-region Megatron psum
@@ -348,6 +425,8 @@ def main():
         check_cons_grad_f32, check_cons_grad_bf16, check_cons_grad_bf16_r7,
         check_cons_grad_auto,
         check_fused_loop_grads,
+        check_fused_loop_primal_vs_vjp_forward,
+        check_fused_loop_remat_grads,
         check_tp_composition,
         check_train, check_train_cross_path,
     ):
