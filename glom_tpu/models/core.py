@@ -222,6 +222,7 @@ def resolve_vjp_path(
     custom_consensus: bool = False,
     return_all: bool = False,
     scan_only: bool = False,
+    assume_on_tpu: bool = False,
 ) -> str:
     """THE single resolution source for which backward implementation a
     training forward at these static shapes will use. Both the dispatch
@@ -235,8 +236,12 @@ def resolve_vjp_path(
       'scan_dense'     — lax.scan forward, dense XLA/stats consensus bwd
 
     scan_only=True excludes the fused loop regardless of eligibility — the
-    manual shard_map bodies (parallel/manual.py) scan the kernels directly
-    and never dispatch to the whole-loop VJP.
+    manual TP shard bodies (parallel/manual.py, mp > 1) scan the kernels
+    directly and never dispatch to the whole-loop VJP.
+
+    assume_on_tpu=True bypasses only the platform check (the CPU
+    interpret-mode shard tests drive the real dispatch policy — including
+    the GLOM_CONSENSUS_BWD gate — without hardware).
     """
     import os
 
@@ -244,7 +249,7 @@ def resolve_vjp_path(
     from glom_tpu.kernels.fused_loop import loop_supported
 
     n, d, L = cfg.num_patches, cfg.dim, cfg.levels
-    if not use_pallas or custom_consensus or not _on_tpu():
+    if not use_pallas or custom_consensus or not (assume_on_tpu or _on_tpu()):
         return "scan_dense"
     env_auto = os.environ.get("GLOM_CONSENSUS_BWD", "auto") == "auto"
     if (

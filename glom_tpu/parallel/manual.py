@@ -116,6 +116,28 @@ def _shard_consensus_fn(cfg: GlomConfig, seq: int, sp_strategy: str):
     )
 
 
+def _use_loop_vjp(
+    cfg: GlomConfig, b_loc: int, iters: int, remat: bool, dtype, interpret: bool
+) -> bool:
+    """Should this seq=1/mp=1 shard body dispatch to the whole-loop VJP
+    (kernels/fused_loop.py) instead of scanning the per-op kernels? This
+    is resolve_vjp_path — THE resolution source, including the
+    GLOM_CONSENSUS_BWD A/B gate — at the SHARD-LOCAL batch: a DP run must
+    get the same glue-free backward the single-chip flagship gets.
+    interpret=True (CPU shard_map tests) bypasses only the platform
+    check; the policy itself is never duplicated here."""
+    from glom_tpu.models.core import resolve_vjp_path
+
+    return (
+        resolve_vjp_path(
+            cfg, b_loc, iters,
+            remat=remat, use_pallas=True, itemsize=dtype.itemsize,
+            assume_on_tpu=interpret,
+        )
+        == "fused_loop"
+    )
+
+
 def _forward_local(
     glom_params,
     noised: jnp.ndarray,
@@ -130,11 +152,13 @@ def _forward_local(
     unroll: bool = False,
     levels0_lm: Optional[jnp.ndarray] = None,
     return_mode: str = "top",
+    interpret: bool = False,
 ) -> jnp.ndarray:
     """Per-shard forward: local batch, local patch band, local FFW hidden
     shard (level-major carry, Pallas FFWs; fused consensus+update kernel
-    when seq == 1). levels0_lm optionally carries in a [L, b_loc, n_loc, d]
-    initial state (the temporal API). return_mode:
+    when seq == 1, and the WHOLE-LOOP VJP when the shard-local shape
+    admits it — see _use_loop_vjp). levels0_lm optionally carries in a
+    [L, b_loc, n_loc, d] initial state (the temporal API). return_mode:
       'top'   — final top level [b_loc, n_loc, d] (the training loss path);
       'final' — full final carry [L, b_loc, n_loc, d];
       'all'   — all T+1 states [T+1, L, b_loc, n_loc, d] incl. the initial
@@ -199,6 +223,30 @@ def _forward_local(
             levels_lm = lax.pcast(levels_lm, vma, to="varying")
     divisor_lm = contribution_divisor(L, jnp.float32).reshape(L, 1, 1, 1)
 
+    # seq=1 / mp=1 shards with an admissible local shape take the
+    # hand-rolled whole-loop VJP — the same backward the single-chip
+    # flagship trains on (slot carry, chained/unchained accumulators,
+    # in-register cotangent combine) instead of the scan-autodiff path.
+    # Composes with the data-axis shard_map transpose exactly like the
+    # per-op custom_vjps: the loop emits per-shard cotangents; the params
+    # psum comes from the shard_map transpose of the replicated in_spec.
+    if (
+        consensus_shard is None
+        and mp == 1
+        and use_pallas
+        and return_mode in ("top", "final")
+        and _use_loop_vjp(cfg, b_loc, iters, remat, tokens_loc.dtype, interpret)
+    ):
+        from glom_tpu.kernels.fused_loop import fused_glom_loop
+
+        final = fused_glom_loop(
+            glom_params.bottom_up, glom_params.top_down, pos_loc,
+            tokens_loc, levels_lm, iters, cfg.num_patches_side,
+            float(cfg.local_consensus_radius), cfg.consensus_self,
+            interpret, remat,
+        )
+        return final if return_mode == "final" else final[-1]
+
     def body(carry, _):
         lv = carry
         bu_in = jnp.concatenate([tokens_lm, lv[:-1]], axis=0)
@@ -252,6 +300,7 @@ def make_manual_loss(
     tcfg: TrainConfig,
     *,
     sp_strategy: str = "none",
+    interpret: bool = False,
 ):
     """Build loss(params, img, noise) -> scalar: the whole computation one
     shard_map over (data, seq, model). Differentiable; the params cotangent
@@ -306,6 +355,7 @@ def make_manual_loss(
             remat=tcfg.remat,
             use_pallas=use_pallas,
             unroll=tcfg.scan_unroll,
+            interpret=interpret,
         )  # [b_loc, n_loc, d]
 
         # Reconstruction + MSE in PATCH space: identical pixel set to the
@@ -444,6 +494,7 @@ def make_manual_train_step(
     *,
     sp_strategy: str = "none",
     with_grad_norm: bool = True,
+    interpret: bool = False,
 ):
     """(state, img, rng) -> (state, metrics): the manual-region analog of
     train.trainer.make_train_step, same metrics contract (incl. the
@@ -462,7 +513,9 @@ def make_manual_train_step(
             f"microbatch {tcfg.batch_size // tcfg.grad_accum} not divisible "
             f"by data axis {mesh.shape[DATA_AXIS]}"
         )
-    loss_fn = make_manual_loss(mesh, cfg, tcfg, sp_strategy=sp_strategy)
+    loss_fn = make_manual_loss(
+        mesh, cfg, tcfg, sp_strategy=sp_strategy, interpret=interpret
+    )
 
     def train_step(state: TrainState, img: jnp.ndarray, rng: jax.Array):
         noise_rng = jax.random.fold_in(rng, state.step)

@@ -277,6 +277,10 @@ class DistributedTrainer:
             from glom_tpu.train.trainer import resolve_route_keys
 
             k, itemsize = resolve_route_keys(cfg, tcfg)
+            # seq=1/mp=1 manual shards dispatch to the whole-loop VJP at
+            # the shard-local batch when admissible (manual._use_loop_vjp
+            # makes the same resolve_vjp_path call) — the label must
+            # follow the dispatch; TP shards (mp>1) stay scan-only.
             self.vjp_path = resolve_vjp_path(
                 cfg,
                 tcfg.batch_size // tcfg.grad_accum // mesh_cfg.data,
@@ -284,7 +288,7 @@ class DistributedTrainer:
                 remat=tcfg.remat,
                 use_pallas=True,
                 itemsize=itemsize,
-                scan_only=True,
+                scan_only=mesh_cfg.model > 1,
             )
         else:
             self.vjp_path = None  # filled from make_train_step in build()

@@ -12,6 +12,7 @@ from glom_tpu.train.trainer import (
     create_train_state,
     default_optimizer,
     make_train_step,
+    resolve_training_route,
 )
 
 __all__ = [
@@ -26,4 +27,5 @@ __all__ = [
     "create_train_state",
     "default_optimizer",
     "make_train_step",
+    "resolve_training_route",
 ]

@@ -353,3 +353,76 @@ class TestPrefetch:
 
         with pytest.raises(ValueError, match="prefetch size"):
             prefetch_to_device(iter([]), size=0)
+
+
+class TestBackendProbe:
+    """probe_device_count (round-5 driver hardening): the wedged-backend
+    probe must NEVER raise and never initialize a backend in the calling
+    process — every failure mode maps to None so dryrun_multichip falls
+    through to the CPU re-exec and bench.py fails fast parseably."""
+
+    def test_parses_devcount(self, monkeypatch):
+        import subprocess as sp
+        from types import SimpleNamespace
+
+        from glom_tpu.utils import metrics
+
+        monkeypatch.setattr(
+            sp, "run",
+            lambda *a, **kw: SimpleNamespace(
+                returncode=0,
+                stdout="Platform warning...\nDEVCOUNT=8\n",
+                stderr="",
+            ),
+        )
+        assert metrics.probe_device_count() == 8
+
+    def test_live_probe_never_raises(self):
+        """Against the REAL image env (where a sitecustomize hook
+        pre-registers the TPU plugin): whatever the backend state — cpu
+        mesh, healthy TPU, or the wedged-init hang this helper exists
+        for — the call must return an int or None, never raise. (In the
+        wedged state it burns `timeout` in the subprocess and returns
+        None, which is exactly what routes dryrun_multichip to the CPU
+        re-exec.)"""
+        from glom_tpu.utils.metrics import probe_device_count
+
+        n = probe_device_count(timeout=45.0)
+        assert n is None or (isinstance(n, int) and n >= 1)
+
+    def test_hang_maps_to_none(self, monkeypatch):
+        import subprocess as sp
+
+        from glom_tpu.utils import metrics
+
+        def fake_run(*a, **kw):
+            raise sp.TimeoutExpired(cmd=a[0], timeout=kw.get("timeout"))
+
+        monkeypatch.setattr(sp, "run", fake_run)
+        assert metrics.probe_device_count(timeout=0.1) is None
+
+    def test_crash_maps_to_none(self, monkeypatch):
+        import subprocess as sp
+        from types import SimpleNamespace
+
+        from glom_tpu.utils import metrics
+
+        monkeypatch.setattr(
+            sp, "run",
+            lambda *a, **kw: SimpleNamespace(returncode=1, stdout="", stderr="boom"),
+        )
+        assert metrics.probe_device_count() is None
+
+    def test_garbage_output_maps_to_none(self, monkeypatch):
+        import subprocess as sp
+        from types import SimpleNamespace
+
+        from glom_tpu.utils import metrics
+
+        monkeypatch.setattr(
+            sp, "run",
+            lambda *a, **kw: SimpleNamespace(
+                returncode=0, stdout="some warning\n", stderr=""
+            ),
+        )
+        assert metrics.probe_device_count() is None
