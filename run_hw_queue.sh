@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# Round-5 hardware work queue: everything that needs the real TPU chip,
+# in priority order, each step logged and failure-isolated. The axon
+# tunnel was down for all of round 5's session — run this whole file the
+# moment `python -c "import jax; jax.devices()"` initializes again.
+#
+# Usage: bash run_hw_queue.sh        (from /root/repo; ~30-60 min total)
+set -u
+cd "$(dirname "$0")"
+mkdir -p results/hw_queue
+log() { echo "=== [$(date +%H:%M:%S)] $*"; }
+
+step() {  # step <name> <timeout_s> <cmd...>
+    local name=$1 to=$2; shift 2
+    log "START $name"
+    timeout "$to" "$@" 2>&1 | tee "results/hw_queue/${name}.log"
+    log "DONE $name (rc=${PIPESTATUS[0]})"
+}
+
+# 0. Gate: is the backend actually up? (bounded — never hangs)
+step probe 120 python -c "import jax; print(jax.devices())" || true
+grep -q "TpuDevice\|tpu" results/hw_queue/probe.log || {
+    log "backend still down; aborting queue"; exit 1; }
+
+# 1. Hardware parity first (15 checks incl. the new fused-loop
+#    primal-vs-VJP and remat-grad checks) — everything else is
+#    meaningless if these fail.
+step tpu_validate 2400 python -u tpu_validate.py
+
+# 2. The driver metric of record: fwd + train-step lines.
+step bench 2400 python -u bench.py
+
+# 3. Pod per-TP-rank anchor — round 4 measured 673 on the scan-path
+#    backward; the whole-loop VJP (remat mode, unchained dw) now covers
+#    this shape. Median of 3.
+for i in 1 2 3; do
+    step "pod_anchor_$i" 1800 python -u bench_train.py --preset imagenet224-pod --batch 16 --mult 2
+done
+
+# 4. Batch-128 point on the AUTO-ROUTED path (grad_accum=2 over
+#    batch-64 fused-loop microbatches; round-4 scan-path row was 3489 =
+#    0.96x vs baseline).
+for i in 1 2 3; do
+    step "batch128_$i" 1800 python -u bench_train.py --batch 128
+done
+
+# 5. SP crossover rows at the shapes the selector governs (pod
+#    d=1024/L=12, L=6 class, batched B=8) — appends to
+#    results/sp_crossover.jsonl; re-run the table-driven selector test
+#    afterwards.
+step sp_crossover 2400 python -u bench_sp_crossover.py
+
+# 6. FFW-backward scheduling sweep (the last ~7%: tile ladder at the
+#    chained-accumulator working set).
+step ffw_bwd_sched 2400 python -u scratch/ffw_bwd_sched_probe.py
+
+log "queue complete — paste numbers into results/profiles/PROFILE.md, "
+log "docs/PARALLELISM.md (pod anchor), results/batch_curve.jsonl, and"
+log "re-run: python -m pytest tests/test_parallel.py -q (selector table)"

@@ -795,6 +795,46 @@ class TestFusedLoop:
         bt_f = _pick_bwd_tile(64 * 256, 512, 2048, 2)
         assert _chain_ws_ok(bt_f, 512, 2048, 2, 256)
 
+    @pytest.mark.parametrize("radius", [0.0, 1.5])
+    def test_combined_grid_matches_split(self, monkeypatch, radius):
+        """GLOM_LOOP_GRID=combined (one 2L-1-group pallas_call per phase
+        per iteration instead of separate bu/td calls) is a pure grid
+        relayout: same per-group math, same accumulation order — loss and
+        every cotangent must match the split default to float-exactness,
+        in both the saved-pre and remat modes."""
+        from glom_tpu.kernels import fused_loop
+
+        args = self._inputs()
+
+        def loss(remat):
+            def f(*a):
+                return jnp.mean(
+                    fused_loop.fused_glom_loop(
+                        *a, 3, self.side, radius, False, True, remat
+                    )
+                    ** 2
+                )
+
+            return f
+
+        vg = lambda remat: jax.value_and_grad(
+            loss(remat), argnums=tuple(range(5))
+        )(*args)
+        l_split, g_split = vg(False)
+        monkeypatch.setenv("GLOM_LOOP_GRID", "combined")
+        l_comb, g_comb = vg(False)
+        l_comb_r, g_comb_r = vg(True)
+        np.testing.assert_allclose(float(l_split), float(l_comb), rtol=1e-6)
+        np.testing.assert_allclose(float(l_split), float(l_comb_r), rtol=1e-6)
+        for want in (g_comb, g_comb_r):
+            for a, b in zip(
+                jax.tree_util.tree_leaves(g_split),
+                jax.tree_util.tree_leaves(want),
+            ):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+                )
+
     def test_remat_admits_bigger_residuals(self):
         """The remat residual stack (carry + stats only) fits shapes the
         full stack cannot: flagship batch 128 x 12 iters is 20.6GB of
