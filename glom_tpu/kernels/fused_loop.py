@@ -275,9 +275,18 @@ def _grid_mode() -> str:
     results: the residual tuple LENGTH encodes the layout (4 = combined,
     5 = split, 3 = remat, whose recompute is layout-agnostic)."""
     import os
+    import warnings
 
     mode = os.environ.get("GLOM_LOOP_GRID", "split")
-    return mode if mode in ("split", "combined") else "split"
+    if mode not in ("split", "combined"):
+        # a typo in an A/B run must not silently measure split twice
+        warnings.warn(
+            f"GLOM_LOOP_GRID={mode!r} ignored (valid: split / combined); "
+            "using split",
+            stacklevel=3,
+        )
+        return "split"
+    return mode
 
 
 def _cat_params(td_params: GroupedFFWParams, bu_params: GroupedFFWParams):

@@ -10,11 +10,13 @@ cd "$(dirname "$0")"
 mkdir -p results/hw_queue
 log() { echo "=== [$(date +%H:%M:%S)] $*"; }
 
-step() {  # step <name> <timeout_s> <cmd...>
+step() {  # step <name> <timeout_s> <cmd...>; returns the command's rc
     local name=$1 to=$2; shift 2
     log "START $name"
     timeout "$to" "$@" 2>&1 | tee "results/hw_queue/${name}.log"
-    log "DONE $name (rc=${PIPESTATUS[0]})"
+    local rc=${PIPESTATUS[0]}
+    log "DONE $name (rc=$rc)"
+    return "$rc"
 }
 
 # 0. Gate: is the backend actually up? (bounded — never hangs)
@@ -22,10 +24,12 @@ step probe 120 python -c "import jax; print(jax.devices())" || true
 grep -q "TpuDevice\|tpu" results/hw_queue/probe.log || {
     log "backend still down; aborting queue"; exit 1; }
 
-# 1. Hardware parity first (15 checks incl. the new fused-loop
-#    primal-vs-VJP and remat-grad checks) — everything else is
-#    meaningless if these fail.
-step tpu_validate 2400 python -u tpu_validate.py
+# 1. Hardware parity first (16 checks incl. the new fused-loop
+#    primal-vs-VJP, remat-grad, and combined-grid checks) — the
+#    measurement steps below are meaningless if these fail, so a parity
+#    failure STOPS the queue here.
+step tpu_validate 2400 python -u tpu_validate.py || {
+    log "hardware parity FAILED — not measuring on broken kernels"; exit 1; }
 
 # 2. The driver metric of record: fwd + train-step lines.
 step bench 2400 python -u bench.py

@@ -820,6 +820,9 @@ class TestFusedLoop:
         vg = lambda remat: jax.value_and_grad(
             loss(remat), argnums=tuple(range(5))
         )(*args)
+        # pin the baseline: an exported GLOM_LOOP_GRID=combined in the
+        # developer's shell must not turn this into a self-comparison
+        monkeypatch.setenv("GLOM_LOOP_GRID", "split")
         l_split, g_split = vg(False)
         monkeypatch.setenv("GLOM_LOOP_GRID", "combined")
         l_comb, g_comb = vg(False)

@@ -350,12 +350,17 @@ def check_fused_loop_combined_grid():
             ** 2
         )
 
-    grad_fn = jax.jit(jax.value_and_grad(loss, argnums=tuple(range(5))))
-    l_split, g_split = grad_fn(*args)
     prior = os.environ.get("GLOM_LOOP_GRID")
-    os.environ["GLOM_LOOP_GRID"] = "combined"
     try:
-        # fresh jit: the knob is read at trace time
+        # BOTH arms pinned explicitly (fresh jits: the knob is read at
+        # trace time). Inheriting the env for the baseline would make the
+        # check a vacuous combined-vs-combined self-comparison whenever an
+        # operator exports GLOM_LOOP_GRID=combined for the whole run.
+        os.environ["GLOM_LOOP_GRID"] = "split"
+        l_split, g_split = jax.jit(
+            jax.value_and_grad(loss, argnums=tuple(range(5)))
+        )(*args)
+        os.environ["GLOM_LOOP_GRID"] = "combined"
         l_comb, g_comb = jax.jit(
             jax.value_and_grad(loss, argnums=tuple(range(5)))
         )(*args)
