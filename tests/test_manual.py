@@ -281,9 +281,10 @@ class TestShardFusedLoop:
         noise = jnp.asarray(rng.normal(size=(16, 3, 16, 16)), jnp.float32)
         return img, noise
 
-    def test_gate_engages_at_shard_shape(self):
+    def test_gate_engages_at_shard_shape(self, monkeypatch):
         from glom_tpu.parallel.manual import _use_loop_vjp
 
+        monkeypatch.delenv("GLOM_CONSENSUS_BWD", raising=False)
         assert _use_loop_vjp(
             self.LCFG, 8, 2, False, jnp.dtype(jnp.float32), True
         )
@@ -323,3 +324,27 @@ class TestShardFusedLoop:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5
             )
+
+    def test_distributed_trainer_label_follows_dispatch(self, monkeypatch):
+        """DistributedTrainer's vjp_path label must say fused_loop exactly
+        when the seq=1/mp=1 shard body would dispatch there (on TPU, at
+        the loop-supported shard shape) — the label and the dispatch share
+        resolve_vjp_path, so this pins the plumbing between them."""
+        from glom_tpu.models import core
+        from glom_tpu.parallel import DistributedTrainer
+
+        monkeypatch.setattr(core, "_on_tpu", lambda: True)
+        monkeypatch.delenv("GLOM_CONSENSUS_BWD", raising=False)
+        tr = DistributedTrainer(
+            self.LCFG, self.LTCFG, MeshConfig(data=2), sp_strategy="none"
+        )
+        assert tr.use_manual
+        assert tr.vjp_path == "fused_loop"
+        assert tr.grad_accum == 1
+        # TP shards never take the loop (scan_only=model>1): label must
+        # stay scan-side at the same otherwise-eligible config
+        tr_tp = DistributedTrainer(
+            self.LCFG, self.LTCFG, MeshConfig(data=2, model=2),
+            sp_strategy="none",
+        )
+        assert tr_tp.vjp_path.startswith("scan_")
