@@ -55,13 +55,23 @@ def probe_device_count(timeout: float = 120.0) -> Optional[int]:
     `jax.devices()` hang indefinitely (observed round 4: both driver artifacts
     died in parent-process backend init before any framework code ran), and a
     hang cannot be caught in-process. The subprocess inherits the caller's
-    env, so virtual-CPU-mesh setups (JAX_PLATFORMS=cpu +
+    env, and additionally applies JAX_PLATFORMS at the CONFIG level (this
+    image's sitecustomize hook pre-registers the TPU plugin and overrides
+    the env var, so env alone would still wedge the probe — same discovery
+    as tests/conftest.py and the dryrun re-exec bootstrap). So
+    virtual-CPU-mesh setups (JAX_PLATFORMS=cpu +
     --xla_force_host_platform_device_count=N) probe exactly what the caller
-    would see."""
+    intends, instantly."""
     import subprocess
     import sys
 
-    code = "import jax; print('DEVCOUNT=%d' % len(jax.devices()))"
+    code = (
+        "import os, jax\n"
+        "p = os.environ.get('JAX_PLATFORMS')\n"
+        "if p:\n"
+        "    jax.config.update('jax_platforms', p)\n"
+        "print('DEVCOUNT=%d' % len(jax.devices()))"
+    )
     try:
         proc = subprocess.run(
             [sys.executable, "-c", code],
