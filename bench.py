@@ -113,7 +113,7 @@ def _fail_fast_if_backend_down():
     cannot be caught in-process), so this harness always terminates quickly
     with a line the driver can parse — value 0 / vs_baseline 0 plus an
     explicit error field, never a traceback."""
-    from glom_tpu.utils.metrics import probe_device_count
+    from glom_tpu.utils.metrics import apply_env_platform, probe_device_count
 
     if probe_device_count(timeout=120.0) is None:
         print(
@@ -129,6 +129,10 @@ def _fail_fast_if_backend_down():
             )
         )
         raise SystemExit(0)
+    # A successful probe validated the platform JAX_PLATFORMS names (the
+    # probe honors it at config level); mirror it here so main() cannot
+    # initialize a different — possibly wedged — backend past the guard.
+    apply_env_platform()
 
 
 if __name__ == "__main__":

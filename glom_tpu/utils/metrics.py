@@ -15,6 +15,7 @@ vectors of one image.
 from __future__ import annotations
 
 import json
+import os
 import time
 from pathlib import Path
 from typing import Optional
@@ -45,6 +46,26 @@ PEAK_FLOPS = {
     "v4": 275e12,
     "cpu": 1e12,  # nominal, so MFU math never divides by zero off-TPU
 }
+
+
+def apply_env_platform() -> None:
+    """Mirror JAX_PLATFORMS into jax.config in THIS process (no-op when
+    unset or a backend is already live).
+
+    MUST be called before first backend use by every caller that trusts
+    probe_device_count's result: the probe subprocess honors the env var
+    at the config level (this image's sitecustomize hook overrides the
+    env var alone), so a caller that skips this would initialize a
+    different — possibly wedged — backend than the one the probe just
+    validated."""
+    import jax
+
+    p = os.environ.get("JAX_PLATFORMS")
+    if p:
+        try:
+            jax.config.update("jax_platforms", p)
+        except RuntimeError:
+            pass  # a backend is already live in this process
 
 
 def probe_device_count(timeout: float = 120.0) -> Optional[int]:
