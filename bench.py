@@ -57,6 +57,14 @@ def main():
         cfg = GlomConfig(dim=128, levels=4, image_size=32, patch_size=4)
         batch, iters, repeats = 4, 8, 2
         k_chain = 3
+        print(
+            json.dumps(
+                {
+                    "note": "TPU backend unavailable; measuring the labelled "
+                    "cpu-fallback config instead of recording a dead zero"
+                }
+            )
+        )
 
     params = init_glom(jax.random.PRNGKey(0), cfg)
     img = jax.random.normal(
@@ -94,7 +102,7 @@ def main():
                     f"column_iters_per_sec_per_chip (ImageNet-224, L=6, d=512, "
                     f"bf16 fwd, pallas, {chip})"
                     if on_tpu
-                    else "column_iters_per_sec_per_chip (cpu fallback cfg)"
+                    else "column_iters_per_sec_per_chip (cpu-fallback cfg)"
                 ),
                 "value": round(column_iters_per_sec, 2),
                 "unit": "column-iters/s/chip",
@@ -105,30 +113,37 @@ def main():
 
 
 def _fail_fast_if_backend_down():
-    """Emit ONE parseable JSON line and exit 0 when backend init fails/hangs.
+    """Never record a dead zero for a measurable host.
 
     Round 4's BENCH_r04.json recorded rc=1 with a raw traceback tail and
-    parsed=null because a wedged axon plugin blew up inside jax.devices().
-    The probe runs in a throwaway subprocess (a wedged plugin HANGS, which
-    cannot be caught in-process), so this harness always terminates quickly
-    with a line the driver can parse — value 0 / vs_baseline 0 plus an
-    explicit error field, never a traceback."""
+    parsed=null because a wedged axon plugin blew up inside jax.devices();
+    round 5's fail-fast guard then recorded value 0.0 — a parseable line,
+    but an empty bench trajectory. The probe runs in a throwaway
+    subprocess (a wedged plugin HANGS, which cannot be caught in-process);
+    when the default backend fails it, retry with JAX_PLATFORMS=cpu and —
+    if CPU initializes — fall through to the labelled "(cpu-fallback)"
+    measurement instead of emitting zero. Only when even the CPU backend
+    cannot initialize does the explicit UNMEASURED zero line remain."""
+    import os
+
     from glom_tpu.utils.metrics import apply_env_platform, probe_device_count
 
     if probe_device_count(timeout=120.0) is None:
-        print(
-            json.dumps(
-                {
-                    "metric": "train_step column_iters_per_sec_per_chip "
-                    "(UNMEASURED: jax backend init failed or hung)",
-                    "value": 0.0,
-                    "unit": "column-iters/s/chip",
-                    "vs_baseline": 0.0,
-                    "error": "backend-init-unavailable",
-                }
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        if probe_device_count(timeout=120.0) is None:
+            print(
+                json.dumps(
+                    {
+                        "metric": "train_step column_iters_per_sec_per_chip "
+                        "(UNMEASURED: jax backend init failed or hung)",
+                        "value": 0.0,
+                        "unit": "column-iters/s/chip",
+                        "vs_baseline": 0.0,
+                        "error": "backend-init-unavailable",
+                    }
+                )
             )
-        )
-        raise SystemExit(0)
+            raise SystemExit(0)
     # A successful probe validated the platform JAX_PLATFORMS names (the
     # probe honors it at config level); mirror it here so main() cannot
     # initialize a different — possibly wedged — backend past the guard.

@@ -194,6 +194,19 @@ def bench_train_step(batch_override=None):
 
     column_iters_per_sec = batch * k_iters / per_step
     measured_mfu = mfu(cfg, column_iters_per_sec, chip=chip, backward=True)
+
+    # Static per-replica live-bytes for the benched state, plus the ZeRO
+    # comm model at the flagship dp=8 topology this single-chip number
+    # anchors (pure analytics — identical with or without a chip): the
+    # allreduce-vs-(reduce-scatter + all-gather) wire bytes the dp8 run
+    # would move per step at zero_stage 0 vs 1.
+    from glom_tpu.utils.metrics import comm_volume_model, live_bytes_model
+
+    mem = live_bytes_model(
+        state.params, state.opt_state, axis_sizes={},
+        param_specs=None, opt_specs=None, grad_specs=None,
+    )
+    wire = mem["params_bytes_per_replica"]
     print(
         json.dumps(
             {
@@ -201,7 +214,8 @@ def bench_train_step(batch_override=None):
                     f"train_step column_iters_per_sec_per_chip (ImageNet-224, "
                     f"L=6, d=512, bf16 fwd+bwd+adam, pallas, {chip})"
                     if on_tpu
-                    else "train_step column_iters_per_sec_per_chip (cpu fallback cfg)"
+                    else "train_step column_iters_per_sec_per_chip "
+                    "(cpu-fallback cfg)"
                 ),
                 "value": round(column_iters_per_sec, 2),
                 "unit": "column-iters/s/chip",
@@ -212,6 +226,14 @@ def bench_train_step(batch_override=None):
                 # 0.96x scan path it used to silently measure
                 "vjp_path": step_fn.vjp_path,
                 "grad_accum": step_fn.grad_accum,
+                "zero_stage": 0,  # single chip: dp=1 resolves to 0
+                **mem,
+                "comm_dp8_zero0_bytes_per_step": comm_volume_model(
+                    wire, wire, 8, 0
+                )["comm_bytes_per_step"],
+                "comm_dp8_zero1_bytes_per_step": comm_volume_model(
+                    wire, wire, 8, 1
+                )["comm_bytes_per_step"],
             }
         )
     )

@@ -118,6 +118,15 @@ class Glom:
         sig = (iters, return_all)
         if self.mesh is not None and self.use_pallas:
             return self._manual_forward(iters, return_all)
+        if self.mesh is not None and self.mesh.shape.get("seq", 1) > 1:
+            from glom_tpu.utils.compat import HAS_PARTIAL_MANUAL
+
+            if not HAS_PARTIAL_MANUAL:
+                # Old-jax fallback (see compat.py): the GSPMD forward would
+                # nest a partial-manual consensus shard_map it cannot
+                # partition; the fully-manual region runs the same bodies
+                # (with the plain-XLA ops when use_pallas is off).
+                return self._manual_forward(iters, return_all)
         if sig not in self._jitted:
             consensus_fn = None
             if self.mesh is not None:
@@ -176,7 +185,7 @@ class Glom:
                     iters=iters,
                     sp_strategy=self.sp_strategy,
                     compute_dtype=self.compute_dtype,
-                    use_pallas=True,
+                    use_pallas=self.use_pallas,
                     return_all=return_all,
                     with_levels=with_levels,
                     remat=self.remat,

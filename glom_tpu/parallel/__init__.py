@@ -20,6 +20,7 @@ from glom_tpu.parallel.halo import make_halo_consensus
 from glom_tpu.parallel.manual import (
     make_manual_loss,
     make_manual_train_step,
+    make_manual_zero_train_step,
     manual_supported,
 )
 from glom_tpu.parallel.mesh import initialize_multihost, make_mesh
@@ -37,6 +38,8 @@ from glom_tpu.parallel.sharding import (
     levels_spec,
     opt_state_specs,
     to_named,
+    zero_param_specs,
+    zero_shard_axis,
 )
 from glom_tpu.parallel.ulysses import make_ulysses_consensus
 
@@ -44,6 +47,7 @@ __all__ = [
     "make_halo_consensus",
     "make_manual_loss",
     "make_manual_train_step",
+    "make_manual_zero_train_step",
     "manual_supported",
     "initialize_multihost",
     "make_mesh",
@@ -57,6 +61,8 @@ __all__ = [
     "glom_param_specs",
     "levels_spec",
     "opt_state_specs",
+    "zero_param_specs",
+    "zero_shard_axis",
     "to_named",
     "make_ulysses_consensus",
 ]

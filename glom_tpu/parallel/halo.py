@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from glom_tpu.parallel.ring import NEG_MAX, _block_sim_masks
+from glom_tpu.utils.compat import array_vma, axis_size, pcast_varying, shard_map
 from glom_tpu.utils.helpers import halo_supported, l2norm
 
 
@@ -41,7 +42,7 @@ def halo_consensus_shard(
 ) -> jnp.ndarray:
     """Per-shard body (under shard_map; n sharded over `axis_name` in
     row-major row bands). x: [b, n_loc, L, d] -> [b, n_loc, L, d]."""
-    S = lax.axis_size(axis_name)
+    S = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, n_loc, L, d = x.shape
     n_total = n_loc * S
@@ -129,7 +130,7 @@ def make_halo_consensus(
         side=side,
         radius=radius,
     )
-    return jax.shard_map(
+    return shard_map(
         fn,
         mesh=mesh,
         in_specs=jax.sharding.PartitionSpec(None, axis_name, None, None),

@@ -60,6 +60,7 @@ from glom_tpu.parallel.ring import ring_consensus_shard
 from glom_tpu.train.objectives import DenoiseParams, default_recon_index
 from glom_tpu.train.trainer import TrainState
 from glom_tpu.utils.config import GlomConfig, TrainConfig
+from glom_tpu.utils.compat import array_vma, pcast_varying, shard_map
 from glom_tpu.utils.helpers import halo_supported
 
 DATA_AXIS = "data"
@@ -218,9 +219,9 @@ def _forward_local(
         # ring.py). Under check_vma=False the vma set is empty and pcast
         # must not run. (A carried-in levels0 is already sharded input —
         # already varying — and must NOT be pcast.)
-        vma = tuple(jax.typeof(tokens_loc).vma)
+        vma = array_vma(tokens_loc)
         if vma:
-            levels_lm = lax.pcast(levels_lm, vma, to="varying")
+            levels_lm = pcast_varying(levels_lm, vma)
     divisor_lm = contribution_divisor(L, jnp.float32).reshape(L, 1, 1, 1)
 
     # seq=1 / mp=1 shards with an admissible local shape take the
@@ -294,7 +295,7 @@ def _forward_local(
     return final[-1]  # top level, [b_loc, n_loc, d]
 
 
-def make_manual_loss(
+def _build_local_loss(
     mesh,
     cfg: GlomConfig,
     tcfg: TrainConfig,
@@ -302,10 +303,13 @@ def make_manual_loss(
     sp_strategy: str = "none",
     interpret: bool = False,
 ):
-    """Build loss(params, img, noise) -> scalar: the whole computation one
-    shard_map over (data, seq, model). Differentiable; the params cotangent
-    psum (the DP gradient all-reduce) comes from the shard_map transpose,
-    and the TP psum on the FFW output is written by hand in the body."""
+    """The per-shard loss body both manual train steps share: returns
+    (local_loss, seq, mp) where local_loss(params, img, noise) -> scalar is
+    the mean over the LOCAL batch band (pmean'd over 'seq' so every data
+    replica holds its full-image loss, NOT yet reduced over 'data').
+    make_manual_loss pmeans it over 'data' and lets the shard_map
+    transpose emit the grad psum; the ZeRO step differentiates it directly
+    inside the region and writes its own reduce-scatter instead."""
     seq = mesh.shape[SEQ_AXIS]
     mp = mesh.shape.get(MODEL_AXIS, 1)
     T = tcfg.iters if tcfg.iters is not None else cfg.default_iters
@@ -369,19 +373,45 @@ def make_manual_loss(
             target, seq_idx * n_loc, n_loc, axis=1
         )
         local_mse = jnp.mean((target_loc - recon) ** 2)
-        return lax.pmean(local_mse, (DATA_AXIS, SEQ_AXIS))
+        return lax.pmean(local_mse, SEQ_AXIS)
 
-    batch_spec = P(DATA_AXIS)  # [b, c, H, W]; replicated over seq (sliced in-body)
+    return loss_body, seq, mp
+
+
+def _manual_param_spec(mp: int):
+    """in/out param spec for the manual regions: pre-sharded over 'model'
+    on the hidden axis when TP is on (the same layout DistributedTrainer
+    device_puts — sharding.denoise_param_specs — so no resharding at the
+    boundary), replicated otherwise."""
     if mp > 1:
-        # TP: the FFW weights arrive pre-sharded over 'model' on their
-        # hidden axis — the same layout DistributedTrainer device_puts
-        # (sharding.denoise_param_specs), so no resharding at the boundary.
         from glom_tpu.parallel.sharding import denoise_param_specs
 
-        param_spec = denoise_param_specs("hidden")
-    else:
-        param_spec = P()
-    return jax.shard_map(
+        return denoise_param_specs("hidden")
+    return P()
+
+
+def make_manual_loss(
+    mesh,
+    cfg: GlomConfig,
+    tcfg: TrainConfig,
+    *,
+    sp_strategy: str = "none",
+    interpret: bool = False,
+):
+    """Build loss(params, img, noise) -> scalar: the whole computation one
+    shard_map over (data, seq, model). Differentiable; the params cotangent
+    psum (the DP gradient all-reduce) comes from the shard_map transpose,
+    and the TP psum on the FFW output is written by hand in the body."""
+    local_loss, seq, mp = _build_local_loss(
+        mesh, cfg, tcfg, sp_strategy=sp_strategy, interpret=interpret
+    )
+
+    def loss_body(params: DenoiseParams, img: jnp.ndarray, noise: jnp.ndarray):
+        return lax.pmean(local_loss(params, img, noise), DATA_AXIS)
+
+    batch_spec = P(DATA_AXIS)  # [b, c, H, W]; replicated over seq (sliced in-body)
+    param_spec = _manual_param_spec(mp)
+    return shard_map(
         loss_body,
         mesh=mesh,
         in_specs=(param_spec, batch_spec, batch_spec),
@@ -470,14 +500,14 @@ def make_manual_forward(
     out_spec = P(None, DATA_AXIS, SEQ_AXIS) if return_all else lv_spec
 
     if with_levels:
-        return jax.shard_map(
+        return shard_map(
             fwd_body,
             mesh=mesh,
             in_specs=(param_spec, batch_spec, lv_spec),
             out_specs=out_spec,
             check_vma=False,
         )
-    return jax.shard_map(
+    return shard_map(
         lambda p, img: fwd_body(p, img, None),
         mesh=mesh,
         in_specs=(param_spec, batch_spec),
@@ -534,5 +564,212 @@ def make_manual_train_step(
         if with_grad_norm:
             metrics["grad_norm"] = optax.global_norm(grads)
         return TrainState(params, opt_state, state.step + 1), metrics
+
+    return train_step
+
+
+def _zero_shard_axes(zero_pspecs):
+    """Param-shaped tree of shard-axis indices from the ZeRO spec tree:
+    the position 'data' occupies in each leaf's PartitionSpec, or -1 for
+    leaves that stay replicated (no dp-divisible free axis). -1 rather
+    than None so the tree keeps its leaves under tree_map."""
+
+    def axis_of(spec):
+        for i, entry in enumerate(tuple(spec)):
+            names = entry if isinstance(entry, tuple) else (entry,)
+            if DATA_AXIS in names:
+                return i
+        return -1
+
+    return jax.tree_util.tree_map(
+        axis_of, zero_pspecs, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
+def make_manual_zero_train_step(
+    mesh,
+    cfg: GlomConfig,
+    tcfg: TrainConfig,
+    optimizer: optax.GradientTransformation,
+    *,
+    zero_stage: int,
+    zero_pspecs,
+    opt_pspecs,
+    sp_strategy: str = "none",
+    with_grad_norm: bool = True,
+    interpret: bool = False,
+    quantized_reduce: bool = None,
+):
+    """The EXPLICIT form of the ZeRO weight update (the GSPMD form lives in
+    train.trainer.make_train_step): one shard_map over (data, seq, model)
+    in which every collective of the schedule is written out, so the wire
+    pattern is inspectable in the jaxpr rather than inferred from GSPMD:
+
+      1. value_and_grad of the LOCAL loss inside the region — no shard_map
+         transpose, hence no automatic grad psum to fight;
+      2. `lax.psum` of the cotangents over 'seq' (params are replicated
+         over the patch bands, each band contributes a partial);
+      3. `lax.psum_scatter(..., scatter_dimension=leaf's zero axis,
+         tiled=True) / dp` over 'data' — THE reduce-scatter: each replica
+         leaves the reduction holding exactly its owned 1/dp shard
+         (leaves with no dp-divisible axis take a plain pmean and stay
+         replicated);
+      4. optimizer.update on the shard triple (grad shard, moment shard
+         from the sharded-in opt state, param shard sliced at
+         axis_index('data') * shard_size — the ownership partition);
+      5. `lax.all_gather(..., tiled=True)` of the updated shards over
+         'data' back to the replicated params the next forward reads.
+
+    Stage 2 moves step 3 inside the microbatch scan so the accumulator
+    only ever holds the owned shard. tcfg.quantized_reduce inserts the
+    EQuARX-style int8 wire emulation on each leaf's LOCAL contribution
+    before it enters the reduction (one quantization hop).
+
+    Requires model == 1: composing the ownership partition with TP-sharded
+    weight shards is routed to the GSPMD form by DistributedTrainer."""
+    if mesh.shape.get(MODEL_AXIS, 1) > 1:
+        raise ValueError(
+            "manual ZeRO step supports model == 1; the GSPMD path handles "
+            "ZeRO x TP composition"
+        )
+    if tcfg.grad_accum < 1 or tcfg.batch_size % tcfg.grad_accum != 0:
+        raise ValueError(
+            f"grad_accum={tcfg.grad_accum} must divide batch_size="
+            f"{tcfg.batch_size}"
+        )
+    dp = mesh.shape[DATA_AXIS]
+    accum = tcfg.grad_accum
+    if (tcfg.batch_size // accum) % dp != 0:
+        raise ValueError(
+            f"microbatch {tcfg.batch_size // accum} not divisible "
+            f"by data axis {dp}"
+        )
+    local_loss, seq, mp = _build_local_loss(
+        mesh, cfg, tcfg, sp_strategy=sp_strategy, interpret=interpret
+    )
+    shard_axes = _zero_shard_axes(zero_pspecs)
+    quantized = (
+        bool(tcfg.quantized_reduce)
+        if quantized_reduce is None
+        else quantized_reduce
+    )
+
+    def reduce_scatter_leaf(g, ax):
+        if seq > 1:
+            g = lax.psum(g, SEQ_AXIS)
+        if quantized:
+            from glom_tpu.parallel.quantized import quantize_dequantize
+
+            g = quantize_dequantize(g)
+        if ax < 0:
+            return lax.pmean(g, DATA_AXIS)
+        return (
+            lax.psum_scatter(g, DATA_AXIS, scatter_dimension=ax, tiled=True)
+            / dp
+        )
+
+    def reduce_scatter_tree(grads):
+        return jax.tree_util.tree_map(reduce_scatter_leaf, grads, shard_axes)
+
+    def shard_zeros(p, ax):
+        if ax < 0:
+            return jnp.zeros_like(p)
+        shape = list(p.shape)
+        shape[ax] //= dp
+        return jnp.zeros(shape, p.dtype)
+
+    def slice_shard(p, ax):
+        if ax < 0:
+            return p
+        size = p.shape[ax] // dp
+        return lax.dynamic_slice_in_dim(
+            p, lax.axis_index(DATA_AXIS) * size, size, axis=ax
+        )
+
+    def gather_shard(p_shard, ax):
+        if ax < 0:
+            return p_shard
+        return lax.all_gather(p_shard, DATA_AXIS, axis=ax, tiled=True)
+
+    def sharded_grad_norm(g_shards):
+        # sum-of-squares decomposes over the ownership partition: psum the
+        # scattered leaves' local sums over 'data', count replicated leaves
+        # once (identical on every replica).
+        sq_scattered = jnp.zeros((), jnp.float32)
+        sq_replicated = jnp.zeros((), jnp.float32)
+        for g, ax in zip(
+            jax.tree_util.tree_leaves(g_shards),
+            jax.tree_util.tree_leaves(shard_axes),
+        ):
+            s = jnp.sum(jnp.square(g.astype(jnp.float32)))
+            if ax < 0:
+                sq_replicated = sq_replicated + s
+            else:
+                sq_scattered = sq_scattered + s
+        return jnp.sqrt(lax.psum(sq_scattered, DATA_AXIS) + sq_replicated)
+
+    def update_body(params, opt_state, img, noise):
+        if accum > 1:
+            # trainer.accumulate_grads on the LOCAL band — the strided
+            # grouping applies per shard exactly as it does globally
+            # (b_loc % accum == 0 is guaranteed by the checks above, so
+            # local row j of microbatch i is global row k*b_loc + j with
+            # the same i = j % accum). ZeRO-2 rides its stage-2 hook:
+            # scatter each microbatch BEFORE accumulating, zeros at the
+            # owned-shard shapes, so the buffer never holds a full leaf.
+            from glom_tpu.train.trainer import accumulate_grads
+
+            gkw = (
+                dict(
+                    grad_transform=reduce_scatter_tree,
+                    grad_init=lambda: jax.tree_util.tree_map(
+                        shard_zeros, params, shard_axes
+                    ),
+                )
+                if zero_stage >= 2
+                else {}
+            )
+            loss_loc, grads = accumulate_grads(
+                local_loss, params, img, noise, accum, **gkw
+            )
+            g_shards = grads if zero_stage >= 2 else reduce_scatter_tree(grads)
+        else:
+            loss_loc, grads = jax.value_and_grad(local_loss)(params, img, noise)
+            g_shards = reduce_scatter_tree(grads)
+
+        p_shards = jax.tree_util.tree_map(slice_shard, params, shard_axes)
+        updates, new_opt = optimizer.update(g_shards, opt_state, p_shards)
+        new_p_shards = optax.apply_updates(p_shards, updates)
+        new_params = jax.tree_util.tree_map(
+            gather_shard, new_p_shards, shard_axes
+        )
+        loss = lax.pmean(loss_loc, DATA_AXIS)
+        gnorm = (
+            sharded_grad_norm(g_shards)
+            if with_grad_norm
+            else jnp.zeros((), jnp.float32)
+        )
+        return new_params, new_opt, loss, gnorm
+
+    batch_spec = P(DATA_AXIS)
+    param_spec = _manual_param_spec(mp)
+    update_sm = shard_map(
+        update_body,
+        mesh=mesh,
+        in_specs=(param_spec, opt_pspecs, batch_spec, batch_spec),
+        out_specs=(param_spec, opt_pspecs, P(), P()),
+        check_vma=False,
+    )
+
+    def train_step(state: TrainState, img: jnp.ndarray, rng: jax.Array):
+        noise_rng = jax.random.fold_in(rng, state.step)
+        noise = tcfg.noise_std * jax.random.normal(noise_rng, img.shape, img.dtype)
+        new_params, new_opt, loss, gnorm = update_sm(
+            state.params, state.opt_state, img, noise
+        )
+        metrics = {"loss": loss, "step": state.step}
+        if with_grad_norm:
+            metrics["grad_norm"] = gnorm
+        return TrainState(new_params, new_opt, state.step + 1), metrics
 
     return train_step

@@ -1,0 +1,70 @@
+"""EQuARX-style block-scaled int8 quantized all-reduce — EMULATION.
+
+EQuARX (arXiv:2506.17615) quantizes all-reduce payloads inside XLA's
+collective pipeline: each hop of the ring carries int8 blocks plus one
+scale per block, dequantizing to accumulate. That lives in the compiler;
+from JAX the honest reachable form is wire-emulation: block-quantize the
+gradient to int8, dequantize, and hand the result to the (full-precision)
+reduction collective. This models exactly ONE quantization hop — the
+dominant error term of the real scheme for small replica counts — and lets
+the framework measure the accuracy cost and price the 4x wire-bytes saving
+(utils/metrics.comm_volume_model) before the compiler hook exists.
+
+EXPERIMENTAL: quantization changes gradient numerics (bounded below);
+gated behind TrainConfig.quantized_reduce, never on by default, and the
+flag is stamped into every metrics record so no run can silently train on
+quantized gradients.
+
+Error bound (locked by tests/test_zero.py): symmetric per-block max-abs
+scaling with round-to-nearest gives |x - dq(q(x))| <= max|block| / (2*127)
+per element — zero blocks are exact (scale guard), and the bound is tight
+at the block maximum.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# 128 f32 elements share one f32 scale: 1/128 metadata overhead on the
+# wire, and a block is small enough that one outlier only poisons 127
+# neighbors' resolution (the EQuARX block-scaling argument).
+DEFAULT_BLOCK = 128
+INT8_MAX = 127.0
+
+
+def block_quantize_int8(x: jnp.ndarray, block: int = DEFAULT_BLOCK):
+    """x (any shape) -> (q int8 [nb, block], scales f32 [nb, 1], n_pad).
+
+    Flattens, zero-pads to a whole number of blocks, and quantizes each
+    block symmetrically by its max-abs. All-zero blocks get scale 1 so the
+    round trip is exact (0/1 -> 0 -> 0) with no divide-by-zero."""
+    flat = x.astype(jnp.float32).reshape(-1)
+    n = flat.shape[0]
+    n_pad = (-n) % block
+    flat = jnp.pad(flat, (0, n_pad))
+    blocks = flat.reshape(-1, block)
+    absmax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
+    scales = jnp.where(absmax > 0, absmax / INT8_MAX, 1.0)
+    q = jnp.clip(jnp.round(blocks / scales), -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    return q, scales, n_pad
+
+
+def block_dequantize_int8(q, scales, n_pad: int, shape, dtype) -> jnp.ndarray:
+    flat = (q.astype(jnp.float32) * scales).reshape(-1)
+    n = flat.shape[0] - n_pad
+    return flat[:n].reshape(shape).astype(dtype)
+
+
+def quantize_dequantize(x: jnp.ndarray, block: int = DEFAULT_BLOCK) -> jnp.ndarray:
+    """One wire-quantization hop: what a tensor looks like after riding the
+    quantized collective once. Applied to gradients pre-reduction when
+    TrainConfig.quantized_reduce is set."""
+    q, scales, n_pad = block_quantize_int8(x, block)
+    return block_dequantize_int8(q, scales, n_pad, x.shape, x.dtype)
+
+
+def quantized_wire_bytes(num_elements: int, block: int = DEFAULT_BLOCK) -> int:
+    """Payload bytes on the wire for one quantized tensor: int8 values plus
+    one f32 scale per block (vs num_elements * 4 for f32)."""
+    nb = -(-num_elements // block)
+    return num_elements + nb * 4

@@ -38,13 +38,17 @@ from glom_tpu.parallel.sharding import (
     denoise_param_specs,
     opt_state_specs,
     to_named,
+    zero_param_specs,
 )
 from glom_tpu.parallel.ulysses import make_ulysses_consensus
 from glom_tpu.train.trainer import (
     TrainState,
+    ZeroShardings,
     create_train_state,
     fit_loop,
     make_train_step,
+    resolve_quantized_reduce,
+    resolve_zero_stage,
 )
 from glom_tpu.utils.config import GlomConfig, MeshConfig, TrainConfig
 from glom_tpu.utils.helpers import halo_supported
@@ -243,6 +247,16 @@ class DistributedTrainer:
         # (Megatron psum hand-written in the manual body). Only the
         # EP-style 'levels' TP stays GSPMD-only.
         self.use_manual = bool(tcfg.use_pallas)
+        from glom_tpu.utils.compat import HAS_PARTIAL_MANUAL
+
+        if not self.use_manual and mesh_cfg.seq > 1 and not HAS_PARTIAL_MANUAL:
+            # Old-jax fallback: the GSPMD step would nest a partial-manual
+            # consensus shard_map (manual 'seq', auto 'data'/'model'),
+            # which that jax line cannot partition (see compat.py). The
+            # fully-manual region runs the identical per-shard bodies with
+            # every collective explicit, so SP configs route there; with
+            # use_pallas=False it composes the plain-XLA ops.
+            self.use_manual = True
         if self.use_manual and not manual_supported(self.mesh, tp_axis):
             warnings.warn(
                 "use_pallas=True with tp_axis='levels': the manual fused path "
@@ -296,22 +310,89 @@ class DistributedTrainer:
         key = jax.random.PRNGKey(tcfg.seed)
         self.rng, init_key = jax.random.split(key)
 
+        # ZeRO resolution (single source: resolve_zero_stage) BEFORE the
+        # state layout is built — the stage decides the optimizer-state
+        # sharding the train state is device_put into.
+        self.zero_stage = resolve_zero_stage(tcfg, mesh_cfg.data)
+        self.quantized_reduce = resolve_quantized_reduce(tcfg, mesh_cfg.data)
+        if (
+            self.zero_stage >= 1
+            and self.use_manual
+            and mesh_cfg.model > 1
+        ):
+            # The explicit manual ZeRO region does not compose the
+            # ownership partition with TP-sharded weight shards; the GSPMD
+            # form does, but mixing per-step paths would desync state
+            # layout from step fn. Degrade loudly.
+            warnings.warn(
+                "zero_stage >= 1 on the manual (use_pallas) path supports "
+                "model == 1 only; running this mesh with zero_stage=0 "
+                "(replicated optimizer state)",
+                stacklevel=2,
+            )
+            self.zero_stage = 0
+        if self.quantized_reduce and self.use_manual and self.zero_stage == 0:
+            # The plain manual step's DP grad reduction is the shard_map
+            # transpose psum — there is no hook to quantize each local
+            # contribution before it (the manual ZeRO step has one, and
+            # the GSPMD step emulates the receive side). Degrade loudly
+            # rather than stamp an emulation that didn't run.
+            warnings.warn(
+                "quantized_reduce on the manual path requires zero_stage "
+                ">= 1 (the explicit reduce-scatter carries the emulation "
+                "hook); running with exact f32 reduction",
+                stacklevel=2,
+            )
+            self.quantized_reduce = False
+
         # Host-side init, then device_put into the sharded layout. (At true
         # pod scale you would jit the init with out_shardings instead; this
         # keeps the init path simple and testable.)
         state, self.optimizer = create_train_state(init_key, cfg, tcfg, optimizer)
         pspecs = denoise_param_specs(tp_axis)
+        if self.zero_stage >= 1:
+            # Optimizer moments live 1/dp per replica on each leaf's
+            # zero_shard_axis; global SHAPES are unchanged, so checkpoints
+            # restore across zero_stage / dp changes (test_resilience).
+            zpspecs = zero_param_specs(state.params, mesh_cfg.data, tp_axis)
+            opt_specs = opt_state_specs(state.opt_state, zpspecs)
+        else:
+            zpspecs = None
+            opt_specs = opt_state_specs(state.opt_state, pspecs)
         state_specs = TrainState(
             params=pspecs,
-            opt_state=opt_state_specs(state.opt_state, pspecs),
+            opt_state=opt_specs,
             step=P(),
         )
         self.state_shardings = to_named(self.mesh, state_specs)
         self.batch_sharding = NamedSharding(self.mesh, batch_spec())
         self.state = jax.device_put(state, self.state_shardings)
+        self.zero_shardings = (
+            None
+            if zpspecs is None
+            else ZeroShardings(
+                grads=to_named(self.mesh, zpspecs),
+                params=self.state_shardings.params,
+            )
+        )
+        abstract_state = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), state
+        )
 
         def build(with_grad_norm):
-            if self.use_manual:
+            if self.use_manual and self.zero_stage >= 1:
+                from glom_tpu.parallel.manual import make_manual_zero_train_step
+
+                fn = make_manual_zero_train_step(
+                    self.mesh, cfg, tcfg, self.optimizer,
+                    zero_stage=self.zero_stage,
+                    zero_pspecs=zpspecs,
+                    opt_pspecs=opt_specs,
+                    sp_strategy=sp_strategy,
+                    with_grad_norm=with_grad_norm,
+                    quantized_reduce=self.quantized_reduce,
+                )
+            elif self.use_manual:
                 fn = make_manual_train_step(
                     self.mesh, cfg, tcfg, self.optimizer,
                     sp_strategy=sp_strategy, with_grad_norm=with_grad_norm,
@@ -320,6 +401,9 @@ class DistributedTrainer:
                 fn = make_train_step(
                     cfg, tcfg, self.optimizer, consensus_fn=consensus_fn,
                     with_grad_norm=with_grad_norm,
+                    zero_stage=self.zero_stage,
+                    zero_shardings=self.zero_shardings,
+                    quantized_reduce=self.quantized_reduce,
                 )
                 # A GSPMD SP consensus_fn means the backward runs the
                 # sharded op's transpose — same label as the manual SP
@@ -338,6 +422,48 @@ class DistributedTrainer:
         self._step = build(True)
         self._step_fast = build(False)
 
+        # Static observability record, computed AFTER build() so the
+        # comm-volume model prices the grad_accum the step actually runs
+        # (GSPMD auto-accum can raise it). Pure analytics over abstract
+        # shapes — recorded identically with or without a chip.
+        from glom_tpu.utils.metrics import (
+            comm_volume_model,
+            live_bytes_model,
+            tree_bytes_per_replica,
+        )
+
+        axis_sizes = dict(zip(self.mesh_cfg.axis_names, self.mesh_cfg.shape))
+        grad_specs = (
+            zpspecs if (self.zero_stage >= 2 and zpspecs is not None) else pspecs
+        )
+        mem = live_bytes_model(
+            abstract_state.params,
+            abstract_state.opt_state,
+            axis_sizes=axis_sizes,
+            param_specs=pspecs,
+            opt_specs=opt_specs,
+            grad_specs=grad_specs,
+        )
+        # Wire payload for the DP gradient path: the full (data-replicated)
+        # grad bytes each replica contributes — model/seq sharding already
+        # divided out, 'data' not (that division is what the collective does).
+        wire_bytes = tree_bytes_per_replica(
+            abstract_state.params, pspecs, axis_sizes
+        )
+        self._static_record = {
+            "zero_stage": self.zero_stage,
+            "quantized_reduce": self.quantized_reduce,
+            **mem,
+            **comm_volume_model(
+                wire_bytes,
+                wire_bytes,
+                self.mesh_cfg.data,
+                self.zero_stage,
+                quantized=self.quantized_reduce,
+                grad_accum=self.grad_accum,
+            ),
+        }
+
     def step(self, batch: np.ndarray):
         # device_put on the host array shards directly host->devices in one
         # transfer (no staging of the full batch on device 0 first); a no-op
@@ -354,6 +480,7 @@ class DistributedTrainer:
         metrics["sp_strategy"] = self.sp_strategy
         metrics["vjp_path"] = self.vjp_path
         metrics["grad_accum"] = self.grad_accum
+        metrics.update(self._static_record)
         return metrics
 
     def step_fast(self, batch: np.ndarray):

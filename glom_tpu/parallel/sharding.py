@@ -21,6 +21,7 @@ from __future__ import annotations
 from typing import Any
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
@@ -77,6 +78,50 @@ def batch_spec() -> P:
 def levels_spec() -> P:
     """[b, n, L, d] column state: batch on 'data', patch axis on 'seq'."""
     return P("data", "seq", None, None)
+
+
+def zero_shard_axis(shape, base_spec: P, dp: int):
+    """The axis a ZeRO update shards over 'data' for one param-shaped leaf:
+    the LARGEST free axis (not already taken by the base TP spec) whose
+    global dim divides by dp. None when no axis qualifies — that leaf's
+    optimizer state stays replicated (and the memory model reports the
+    achieved, not the ideal, savings). Largest-first maximizes the bytes
+    actually sharded: at d=1024/mult=4 the hidden axis f=4096 shards even
+    when 'model' took a different axis."""
+    if dp <= 1:
+        return None
+    entries = tuple(base_spec) + (None,) * (len(shape) - len(tuple(base_spec)))
+    best = None
+    for ax, dim in enumerate(shape):
+        if entries[ax] is None and dim % dp == 0:
+            if best is None or dim > shape[best]:
+                best = ax
+    return best
+
+
+def _zero_leaf_spec(shape, base_spec: P, dp: int) -> P:
+    ax = zero_shard_axis(shape, base_spec, dp)
+    if ax is None:
+        return base_spec
+    entries = list(tuple(base_spec) + (None,) * (len(shape) - len(tuple(base_spec))))
+    entries[ax] = "data"
+    return P(*entries)
+
+
+def zero_param_specs(params: DenoiseParams, dp: int, tp_axis: str = "hidden") -> Any:
+    """Param-shaped spec tree for the ZeRO-sharded layout: the base TP
+    layout with 'data' added per leaf on its zero_shard_axis. Used for the
+    optimizer-state moments, the reduce-scattered gradients, and the
+    transient updates — everything that is param-shaped but owned 1/dp per
+    replica. Params themselves keep the base (data-replicated) layout; the
+    all-gather after the shard update is what restores it."""
+    base = denoise_param_specs(tp_axis)
+    return jax.tree_util.tree_map(
+        lambda spec, arr: _zero_leaf_spec(np.shape(arr), spec, dp),
+        base,
+        params,
+        is_leaf=lambda x: isinstance(x, P),
+    )
 
 
 def opt_state_specs(abstract_opt_state: Any, param_specs: DenoiseParams) -> Any:

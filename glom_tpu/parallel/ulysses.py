@@ -21,6 +21,7 @@ import jax
 import numpy as np
 from jax import lax
 
+from glom_tpu.utils.compat import array_vma, axis_size, pcast_varying, shard_map
 from glom_tpu.ops.consensus import consensus_attention
 
 
@@ -41,7 +42,7 @@ def ulysses_consensus_shard(
     (round-4 weak #5: the old local_mask= plumbing reintroduced the
     reference's O(n^2) init cost, reference :42-52, on this path).
     """
-    S = lax.axis_size(axis_name)
+    S = axis_size(axis_name)
     L = x.shape[2]
     if L % S != 0:
         raise ValueError(f"Ulysses needs levels ({L}) divisible by mesh axis ({S})")
@@ -71,7 +72,7 @@ def make_ulysses_consensus(
         side=side,
         radius=radius,
     )
-    return jax.shard_map(
+    return shard_map(
         fn,
         mesh=mesh,
         in_specs=jax.sharding.PartitionSpec(None, axis_name, None, None),

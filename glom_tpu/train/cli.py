@@ -44,6 +44,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="split each batch into A microbatches, accumulate grads, one "
         "optimizer update (peak activation memory of one microbatch)",
     )
+    p.add_argument(
+        "--zero-stage", type=int, choices=[0, 1, 2], default=None,
+        help="ZeRO sharded weight update over the 'data' mesh axis: 1 "
+        "shards optimizer state (reduce-scatter grads, all-gather params), "
+        "2 also shards the grad accumulator; dp=1 resolves to 0 "
+        "(docs/PARALLELISM.md, ZeRO section)",
+    )
+    p.add_argument(
+        "--quantized-reduce", action="store_true",
+        help="EXPERIMENTAL int8 block-scaled quantized-reduce emulation "
+        "(EQuARX-style; changes gradient numerics ~1e-2 rel)",
+    )
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--data", choices=["shapes", "gaussian"], default="shapes")
     p.add_argument(
@@ -114,6 +126,10 @@ def main(argv=None) -> int:
         overrides["warmup_steps"] = args.warmup_steps
     if args.grad_accum is not None:
         overrides["grad_accum"] = args.grad_accum
+    if args.zero_stage is not None:
+        overrides["zero_stage"] = args.zero_stage
+    if args.quantized_reduce:
+        overrides["quantized_reduce"] = True
     if args.seed is not None:
         overrides["seed"] = args.seed
     if overrides:

@@ -85,15 +85,27 @@ def make_lr_schedule(tcfg: TrainConfig):
     )
 
 
-def accumulate_grads(loss_fn, params, img, noise, accum: int):
-    """Exact microbatch gradient accumulation shared by the single-device
-    and manual-shard_map train steps: STRIDED split (microbatch i takes
-    rows i, i+accum, ...) so a batch sharded over a 'data' mesh axis keeps
-    every microbatch row-local to its shard (a contiguous split would
+def accumulate_grads(loss_fn, params, img, noise, accum: int,
+                     grad_transform=None, grad_init=None):
+    """Exact microbatch gradient accumulation shared by the single-device,
+    GSPMD, and manual-shard_map train steps: STRIDED split (microbatch i
+    takes rows i, i+accum, ...) so a batch sharded over a 'data' mesh axis
+    keeps every microbatch row-local to its shard (a contiguous split would
     reshuffle half the batch across devices on every scan step); the
     accumulated sum over all examples is invariant to the grouping, so
     loss/grads equal the full-batch values exactly (mean of microbatch
-    means). Returns (loss, grads)."""
+    means). Returns (loss, grads).
+
+    grad_transform/grad_init are the ZeRO stage-2 hook — the scatter must
+    happen per microbatch so the accumulation buffer only ever holds the
+    1/dp owned shard (the sum over microbatches commutes with the linear
+    scatter, so the math is still exact):
+      * GSPMD step: transform = with_sharding_constraint to the
+        data-sharded layout (XLA lowers to a per-microbatch
+        reduce-scatter); init = zeros under the same constraint.
+      * manual ZeRO step: transform = the explicit psum_scatter tree;
+        init = zeros at the 1/dp shard shapes (the carry must match the
+        transformed gradients, which is why init is a separate hook)."""
     imgs = img.reshape(-1, accum, *img.shape[1:]).swapaxes(0, 1)
     noises = noise.reshape(-1, accum, *noise.shape[1:]).swapaxes(0, 1)
 
@@ -101,9 +113,15 @@ def accumulate_grads(loss_fn, params, img, noise, accum: int):
         acc_l, acc_g = carry
         mi, mn = xs
         l, g = jax.value_and_grad(loss_fn)(params, mi, mn)
+        if grad_transform is not None:
+            g = grad_transform(g)
         return (acc_l + l, jax.tree_util.tree_map(jnp.add, acc_g, g)), None
 
-    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    zeros = (
+        grad_init()
+        if grad_init is not None
+        else jax.tree_util.tree_map(jnp.zeros_like, params)
+    )
     (loss_sum, grads_sum), _ = jax.lax.scan(
         micro, (jnp.zeros((), jnp.float32), zeros), (imgs, noises)
     )
@@ -158,6 +176,43 @@ def resolve_training_route(
     return accum, path
 
 
+def resolve_zero_stage(tcfg: TrainConfig, dp: int) -> int:
+    """Effective ZeRO stage for this run — THE single resolution source
+    (same discipline as resolve_vjp_path / effective_sp_strategy: both
+    trainer paths call this once and stamp its output into every metrics
+    record, so a run can never shard differently than its logs claim).
+    dp == 1 has nothing to shard and resolves to 0 silently, mirroring
+    seq <= 1 resolving sp_strategy to 'none'."""
+    if tcfg.zero_stage not in (0, 1, 2):
+        raise ValueError(
+            f"zero_stage={tcfg.zero_stage!r}: must be 0 (replicated), "
+            "1 (sharded optimizer state), or 2 (+ sharded grad accumulator)"
+        )
+    if dp <= 1:
+        return 0
+    return tcfg.zero_stage
+
+
+def resolve_quantized_reduce(tcfg: TrainConfig, dp: int) -> bool:
+    """Effective quantized-reduce flag — same single-source discipline as
+    resolve_zero_stage: dp == 1 has no cross-replica reduction to emulate
+    a wire hop on, so the flag resolves OFF (quantizing there would
+    degrade gradients ~1e-2 rel for nothing while the comm counters
+    correctly read zero). The resolved value is what the trainers apply
+    AND stamp, so a record can never claim an emulation that didn't run."""
+    return bool(tcfg.quantized_reduce) and dp > 1
+
+
+class ZeroShardings(NamedTuple):
+    """The two NamedSharding trees the GSPMD ZeRO step constrains with:
+    `grads` (param-shaped, 'data'-sharded on each leaf's zero_shard_axis —
+    the reduce-scatter layout, also the optimizer-moment layout) and
+    `params` (the base data-replicated layout the all-gather restores)."""
+
+    grads: Any
+    params: Any
+
+
 def default_optimizer(tcfg: TrainConfig) -> optax.GradientTransformation:
     lr = make_lr_schedule(tcfg)
     if tcfg.weight_decay > 0:
@@ -172,6 +227,9 @@ def make_train_step(
     *,
     consensus_fn: Optional[ConsensusFn] = None,
     with_grad_norm: bool = True,
+    zero_stage: int = 0,
+    zero_shardings: Optional[ZeroShardings] = None,
+    quantized_reduce: Optional[bool] = None,
 ) -> Callable[[TrainState, jnp.ndarray, jax.Array], Tuple[TrainState, dict]]:
     """Build the pure train step. Noise is generated ON DEVICE from the rng
     (no host->device transfer of noise tensors).
@@ -179,7 +237,29 @@ def make_train_step(
     with_grad_norm=False omits the grad-norm metric: optax.global_norm is
     a full extra sweep over every gradient buffer, pure observability —
     the fit loops compile BOTH variants and run the fast one on
-    non-logging steps (the sustained-throughput step)."""
+    non-logging steps (the sustained-throughput step).
+
+    zero_stage >= 1 with zero_shardings runs the GSPMD form of the ZeRO
+    weight update (Xu et al. 2020): gradients are constrained to the
+    data-sharded layout before optimizer.update — XLA lowers the DP
+    reduction to a reduce-scatter instead of an allreduce — the update
+    (reading the 1/dp optimizer-moment shard the state carries) computes
+    only on the owned shard, and the updated params are constrained back
+    to the replicated layout, which lowers to the all-gather. Stage 2
+    additionally pushes the constraint inside the microbatch accumulation
+    so the grad buffer itself lives sharded.
+
+    quantized_reduce (None -> resolve from tcfg; trainers pass the
+    resolve_quantized_reduce output) inserts the EQuARX-style int8
+    wire-hop emulation. NOTE the GSPMD asymmetry vs the manual step: in
+    SPMD tracing there is no per-replica gradient-contribution tensor
+    (the compiler inserts the cross-replica reduction wherever the
+    partitioner places it), so the hop here applies to the REDUCED
+    gradient — the receive side of the wire — whereas the manual region
+    quantizes each replica's local contribution before its explicit
+    psum_scatter (the more faithful send-side form). Both are one
+    quantization hop; comm_volume_model prices the hypothetical real
+    quantized collective, not the emulation's op placement."""
     if tcfg.compute_dtype not in ("float32", "bfloat16"):
         raise ValueError(
             f"compute_dtype={tcfg.compute_dtype!r}: must be 'float32' or 'bfloat16'"
@@ -196,6 +276,11 @@ def make_train_step(
     # .vjp_path), and logged by the trainers next to sp_strategy.
     grad_accum, vjp_path = resolve_training_route(
         cfg, tcfg, custom_consensus=consensus_fn is not None
+    )
+    quantized = (
+        bool(tcfg.quantized_reduce)
+        if quantized_reduce is None
+        else quantized_reduce
     )
 
     def loss_of(params, img, noise):
@@ -218,13 +303,39 @@ def make_train_step(
         noise = tcfg.noise_std * jax.random.normal(noise_rng, img.shape, img.dtype)
 
         if grad_accum > 1:
+            if zero_stage >= 2 and zero_shardings is not None:
+                constrain = lambda g: jax.lax.with_sharding_constraint(
+                    g, zero_shardings.grads
+                )
+                gkw = dict(
+                    grad_transform=constrain,
+                    grad_init=lambda: constrain(
+                        jax.tree_util.tree_map(jnp.zeros_like, state.params)
+                    ),
+                )
+            else:
+                gkw = {}
             loss, grads = accumulate_grads(
-                loss_of, state.params, img, noise, grad_accum
+                loss_of, state.params, img, noise, grad_accum, **gkw
             )
         else:
             loss, grads = jax.value_and_grad(loss_of)(state.params, img, noise)
+        if quantized:
+            from glom_tpu.parallel.quantized import quantize_dequantize
+
+            grads = jax.tree_util.tree_map(quantize_dequantize, grads)
+        if zero_stage >= 1 and zero_shardings is not None:
+            # Reduce-scatter: the cross-replica grad reduction lands each
+            # leaf already split on its zero_shard_axis.
+            grads = jax.lax.with_sharding_constraint(grads, zero_shardings.grads)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
+        if zero_stage >= 1 and zero_shardings is not None:
+            # All-gather the updated shards back to the replicated layout
+            # the next forward reads.
+            params = jax.lax.with_sharding_constraint(
+                params, zero_shardings.params
+            )
         metrics = {"loss": loss, "step": state.step}
         if with_grad_norm:
             metrics["grad_norm"] = optax.global_norm(grads)
@@ -289,13 +400,41 @@ class Trainer:
         key = jax.random.PRNGKey(tcfg.seed)
         self.rng, init_key = jax.random.split(key)
         self.state, self.optimizer = create_train_state(init_key, cfg, tcfg, optimizer)
-        step_fn = make_train_step(cfg, tcfg, self.optimizer, consensus_fn=consensus_fn)
+        # Single device: dp == 1, so ZeRO resolves to 0 (validating the
+        # configured value), quantized_reduce resolves OFF (no wire to
+        # emulate a hop on), and the live-bytes model reports the fully
+        # replicated layout with zero collective traffic — the baseline
+        # row the distributed records are compared against.
+        self.zero_stage = resolve_zero_stage(tcfg, 1)
+        self.quantized_reduce = resolve_quantized_reduce(tcfg, 1)
+        step_fn = make_train_step(
+            cfg, tcfg, self.optimizer, consensus_fn=consensus_fn,
+            quantized_reduce=self.quantized_reduce,
+        )
         self.vjp_path = step_fn.vjp_path
         self.grad_accum = step_fn.grad_accum
+        from glom_tpu.utils.metrics import comm_volume_model, live_bytes_model
+
+        mem = live_bytes_model(
+            self.state.params, self.state.opt_state, axis_sizes={},
+            param_specs=None, opt_specs=None, grad_specs=None,
+        )
+        self._static_record = {
+            "zero_stage": self.zero_stage,
+            "quantized_reduce": self.quantized_reduce,
+            **mem,
+            **comm_volume_model(
+                mem["grads_bytes_per_replica"],
+                mem["params_bytes_per_replica"],
+                1,
+                self.zero_stage,
+            ),
+        }
         self._step = jax.jit(step_fn, donate_argnums=(0,))
         fast_fn = make_train_step(
             cfg, tcfg, self.optimizer,
             consensus_fn=consensus_fn, with_grad_norm=False,
+            quantized_reduce=self.quantized_reduce,
         )
         self._step_fast = jax.jit(fast_fn, donate_argnums=(0,))
         self.metrics_writer = metrics_writer
@@ -307,6 +446,7 @@ class Trainer:
         metrics = dict(metrics)
         metrics["vjp_path"] = self.vjp_path
         metrics["grad_accum"] = self.grad_accum
+        metrics.update(self._static_record)
         return metrics
 
     def step(self, batch) -> dict:

@@ -1,0 +1,89 @@
+"""JAX version-compatibility shims — the ONE module that owns them.
+
+The codebase is written against the current jax API line (jax.shard_map,
+vma-typed arrays via jax.typeof/lax.pcast, pltpu.CompilerParams); CI pins
+that line. Some execution images ship the older 0.4.x line where those
+names do not exist (shard_map lives in jax.experimental, check_vma is
+spelled check_rep, there is no vma type system at all, and the Pallas
+compiler-params class is TPUCompilerParams). Every call site routes
+through here so the rest of the tree reads as current-API code and the
+fallbacks live in exactly one place.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+_HAS_NEW_SHARD_MAP = hasattr(jax, "shard_map")
+if not _HAS_NEW_SHARD_MAP:  # pragma: no cover - exercised only on old jax
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+# Partial-manual shard_map (manual over one axis, auto over the rest)
+# nested inside a GSPMD-sharded jit is only sound on the current jax line:
+# the 0.4.x lowering emits a PartitionId instruction the SPMD partitioner
+# rejects ("meaning is ambiguous") whenever an auto axis is real (>1).
+# Callers that would build that composition route to the fully-manual
+# region instead when this is False.
+HAS_PARTIAL_MANUAL = _HAS_NEW_SHARD_MAP
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True,
+              axis_names=None):
+    """jax.shard_map, with check_vma mapped to the old check_rep kwarg and
+    the partial-manual axis_names set mapped to the old complementary
+    `auto` set (where the rep checker must be off — it predates partial
+    manual and rejects it)."""
+    if _HAS_NEW_SHARD_MAP:
+        kw = {} if axis_names is None else {"axis_names": axis_names}
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma, **kw,
+        )
+    kw = {}
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if auto:
+            kw["auto"] = auto
+            check_vma = False
+    return _old_shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma, **kw,
+    )
+
+
+def axis_size(axis_name: str) -> int:
+    """lax.axis_size(name) inside a manual region; the old line spells it
+    jax.core.axis_frame(name).size (still a static python int)."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    import jax.core as jcore  # pragma: no cover - old jax
+
+    frame = jcore.axis_frame(axis_name)
+    return frame if isinstance(frame, int) else frame.size
+
+
+def array_vma(x) -> tuple:
+    """tuple(jax.typeof(x).vma); () where the vma type system doesn't
+    exist (old jax, or check_vma=False regions — both need no pcast)."""
+    try:
+        return tuple(jax.typeof(x).vma)
+    except AttributeError:
+        return ()
+
+
+def pcast_varying(x, vma: tuple):
+    """lax.pcast(x, vma, to='varying'); identity when vma is empty or
+    pcast is unavailable (no vma checker to satisfy in either case)."""
+    if not vma or not hasattr(lax, "pcast"):
+        return x
+    return lax.pcast(x, vma, to="varying")
+
+
+def install_pallas_tpu_compat() -> None:
+    """Alias pltpu.CompilerParams to the old TPUCompilerParams name when
+    only the latter exists. Import-time no-op on current jax."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    if not hasattr(pltpu, "CompilerParams"):  # pragma: no cover - old jax
+        pltpu.CompilerParams = pltpu.TPUCompilerParams

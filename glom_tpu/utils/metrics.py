@@ -143,6 +143,120 @@ def mfu(
     return column_iters_per_sec * f / PEAK_FLOPS[chip]
 
 
+def _spec_divisor(spec, axis_sizes: dict) -> int:
+    """How many ways a PartitionSpec splits a leaf: the product of the mesh
+    axis sizes it names (axis entries may be a name or a tuple of names)."""
+    div = 1
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        for name in names:
+            div *= int(axis_sizes.get(name, 1))
+    return div
+
+
+def tree_bytes_per_replica(tree, spec_tree, axis_sizes: dict) -> int:
+    """Live bytes of a pytree PER REPLICA under a PartitionSpec tree: each
+    leaf's global bytes divided by the ways its spec splits it. Pure
+    analytics — works from abstract shapes, no device needed (the
+    "recorded even when no chip is available" contract)."""
+    import jax
+    import numpy as np
+    from jax.sharding import PartitionSpec
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    specs = (
+        [None] * len(leaves)  # spec_tree=None: fully replicated
+        if spec_tree is None
+        else treedef.flatten_up_to(spec_tree)
+    )
+    total = 0
+    for leaf, spec in zip(leaves, specs):
+        nbytes = int(np.prod(np.shape(leaf))) * np.dtype(leaf.dtype).itemsize
+        if isinstance(spec, PartitionSpec):
+            nbytes //= _spec_divisor(spec, axis_sizes)
+        total += nbytes
+    return total
+
+
+def live_bytes_model(
+    params,
+    opt_state,
+    *,
+    axis_sizes: dict,
+    param_specs,
+    opt_specs,
+    grad_specs,
+) -> dict:
+    """Per-replica live-bytes for the three train-state tenants the ZeRO
+    stages trade between: params (always gathered for the forward), the
+    gradient buffer (full at stage<=1, 1/dp shard at stage 2), and the
+    optimizer moments (1/dp shard at stage>=1). Spec trees are the SAME
+    objects the trainers shard with, so the report can never drift from
+    the layout actually trained."""
+    return {
+        "params_bytes_per_replica": tree_bytes_per_replica(
+            params, param_specs, axis_sizes
+        ),
+        "grads_bytes_per_replica": tree_bytes_per_replica(
+            params, grad_specs, axis_sizes
+        ),
+        "opt_bytes_per_replica": tree_bytes_per_replica(
+            opt_state, opt_specs, axis_sizes
+        ),
+    }
+
+
+def comm_volume_model(
+    grad_bytes: int,
+    param_bytes: int,
+    dp: int,
+    zero_stage: int,
+    *,
+    quantized: bool = False,
+    grad_accum: int = 1,
+) -> dict:
+    """Per-replica per-step collective wire bytes of the gradient/update
+    path (ring-algorithm costs; SP/TP collectives are priced separately in
+    docs/PARALLELISM.md since they depend on activation shapes):
+
+      stage 0 — one allreduce of the full gradient: 2*(dp-1)/dp * G
+      stage 1 — reduce-scatter G + all-gather P: (dp-1)/dp * (G + P)
+      stage 2 — the reduce-scatter happens once PER MICROBATCH (that is
+                what keeps the accumulator sharded): (dp-1)/dp *
+                (accum * G + P)
+
+    Quantized reduce carries the gradient payload as int8 + block scales
+    (~G/4 + G/512); the param all-gather stays f32 (EQuARX quantizes the
+    reduce, not the weights)."""
+    from glom_tpu.parallel.quantized import DEFAULT_BLOCK
+
+    if dp <= 1:
+        return {
+            "comm_reduce_bytes_per_step": 0,
+            "comm_gather_bytes_per_step": 0,
+            "comm_bytes_per_step": 0,
+        }
+    frac = (dp - 1) / dp
+    wire_grad = grad_bytes
+    if quantized:
+        elems = grad_bytes // 4
+        wire_grad = elems + (-(-elems // DEFAULT_BLOCK)) * 4
+    if zero_stage == 0:
+        reduce_bytes = int(2 * frac * wire_grad)
+        gather_bytes = 0
+    else:
+        n_scatters = grad_accum if zero_stage >= 2 else 1
+        reduce_bytes = int(frac * wire_grad * n_scatters)
+        gather_bytes = int(frac * param_bytes)
+    return {
+        "comm_reduce_bytes_per_step": reduce_bytes,
+        "comm_gather_bytes_per_step": gather_bytes,
+        "comm_bytes_per_step": reduce_bytes + gather_bytes,
+    }
+
+
 class MetricsWriter:
     """Append-only JSONL metrics log, one dict per line, with wall time.
 

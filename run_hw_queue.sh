@@ -58,6 +58,22 @@ step sp_crossover 2400 python -u bench_sp_crossover.py
 #    chained-accumulator working set).
 step ffw_bwd_sched 2400 python -u scratch/ffw_bwd_sched_probe.py
 
+# 7. ZeRO weight-update A/Bs (this round's distributed-optimizer PR):
+#    zero_stage 0 vs 1 vs 2-with-accum, and quantized vs f32 reduce, at
+#    dp = all visible devices. With today's single-chip tunnel the script
+#    self-downgrades to the labelled virtual-CPU mesh (ratio + analytics
+#    only) — the rows price for real the first window a SLICE answers;
+#    at dp>=8, expect zero1 ~= zero0 step time (same total wire bytes,
+#    (dp-1)/dp*(G+P) vs 2(dp-1)/dp*G) with opt-state HBM down ~dp x.
+for i in 1 2 3; do
+    step "zero_ab_$i" 1800 python -u bench_zero.py
+done
+
+# 8. Pod-shape ZeRO anchor: the per-TP-rank single-chip anchor (step 3)
+#    re-run with sharded-update analytics stamped on the record — pairs
+#    with the dp=64 pod projection in docs/PARALLELISM.md (ZeRO section).
+step pod_zero_record 1800 python -u bench_train.py --preset imagenet224-pod --batch 16 --mult 2
+
 log "queue complete — paste numbers into results/profiles/PROFILE.md, "
-log "docs/PARALLELISM.md (pod anchor), results/batch_curve.jsonl, and"
-log "re-run: python -m pytest tests/test_parallel.py -q (selector table)"
+log "docs/PARALLELISM.md (pod anchor + ZeRO table), results/batch_curve.jsonl,"
+log "and re-run: python -m pytest tests/test_parallel.py tests/test_zero.py -q"

@@ -8,11 +8,18 @@ hardware (SURVEY.md §4d). Must run before any test module imports jax.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# PIN the 8-device virtual platform unconditionally — replacing any
+# pre-existing xla_force_host_platform_device_count, not just appending
+# when absent: an inherited =1 from the environment would silently turn
+# every multi-device test into a skip/failure on a fresh checkout.
+_flags = [
+    f
+    for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f
+]
+os.environ["XLA_FLAGS"] = " ".join(
+    _flags + ["--xla_force_host_platform_device_count=8"]
+)
 # Determinism and precision: CPU tests compare against a float64 numpy oracle.
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 

@@ -186,6 +186,8 @@ class TestCLI:
         "from glom_tpu.train.cli import main; import sys;"
     )
 
+    @pytest.mark.slow  # full train/ckpt/resume subprocess ride (~40 s);
+    # tier-1 keeps the distributed + parity CLI smokes, CI runs this one
     def test_end_to_end_smoke(self, tmp_path):
         """Drive the CLI as a subprocess on CPU: train, checkpoint, resume."""
         env_snippet = self.ENV_SNIPPET

@@ -724,7 +724,11 @@ class TestFusedLoop:
         assert not loop_supported(6, 1, 6, 512, 2048, 2, 7, 6)  # untileable M
         assert not loop_supported(6, 64, 256, 512, 2048, 2, 7, 128)  # pos mismatch
 
-    @pytest.mark.parametrize("radius", [0.0, 1.5])
+    # The local-mask radius exercises the identical remat machinery on a
+    # different mask — slow-marked for the tier-1 budget; CI runs it.
+    @pytest.mark.parametrize(
+        "radius", [0.0, pytest.param(1.5, marks=pytest.mark.slow)]
+    )
     def test_remat_matches_nonremat(self, radius):
         """remat=True drops the pre-activation residuals and recomputes them
         in the backward via the first-matmul-only kernel — the SAME
@@ -795,7 +799,11 @@ class TestFusedLoop:
         bt_f = _pick_bwd_tile(64 * 256, 512, 2048, 2)
         assert _chain_ws_ok(bt_f, 512, 2048, 2, 256)
 
-    @pytest.mark.parametrize("radius", [0.0, 1.5])
+    # Same grid-relayout check on the local mask — slow-marked for the
+    # tier-1 budget; CI runs it.
+    @pytest.mark.parametrize(
+        "radius", [0.0, pytest.param(1.5, marks=pytest.mark.slow)]
+    )
     def test_combined_grid_matches_split(self, monkeypatch, radius):
         """GLOM_LOOP_GRID=combined (one 2L-1-group pallas_call per phase
         per iteration instead of separate bu/td calls) is a pure grid
