@@ -32,12 +32,11 @@ tail line:
   {"metric": "train_step ...", "value": N, "unit": ..., "vs_baseline": N}
 """
 
-import json
-
 import jax
 import jax.numpy as jnp
 
 from glom_tpu.models.core import glom_forward, init_glom
+from glom_tpu.telemetry.sinks import emit
 from glom_tpu.utils.config import GlomConfig
 from glom_tpu.utils.metrics import detect_chip, mfu
 from glom_tpu.utils.timing import best_fetch_time, measure_rtt
@@ -57,13 +56,12 @@ def main():
         cfg = GlomConfig(dim=128, levels=4, image_size=32, patch_size=4)
         batch, iters, repeats = 4, 8, 2
         k_chain = 3
-        print(
-            json.dumps(
-                {
-                    "note": "TPU backend unavailable; measuring the labelled "
-                    "cpu-fallback config instead of recording a dead zero"
-                }
-            )
+        emit(
+            {
+                "note": "TPU backend unavailable; measuring the labelled "
+                "cpu-fallback config instead of recording a dead zero"
+            },
+            kind="note",
         )
 
     params = init_glom(jax.random.PRNGKey(0), cfg)
@@ -95,20 +93,18 @@ def main():
 
     column_iters_per_sec = batch * iters / per_forward
     measured_mfu = mfu(cfg, column_iters_per_sec, chip=chip)
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"column_iters_per_sec_per_chip (ImageNet-224, L=6, d=512, "
-                    f"bf16 fwd, pallas, {chip})"
-                    if on_tpu
-                    else "column_iters_per_sec_per_chip (cpu-fallback cfg)"
-                ),
-                "value": round(column_iters_per_sec, 2),
-                "unit": "column-iters/s/chip",
-                "vs_baseline": round(measured_mfu / 0.70, 4),
-            }
-        )
+    emit(
+        {
+            "metric": (
+                f"column_iters_per_sec_per_chip (ImageNet-224, L=6, d=512, "
+                f"bf16 fwd, pallas, {chip})"
+                if on_tpu
+                else "column_iters_per_sec_per_chip (cpu-fallback cfg)"
+            ),
+            "value": round(column_iters_per_sec, 2),
+            "unit": "column-iters/s/chip",
+            "vs_baseline": round(measured_mfu / 0.70, 4),
+        }
     )
 
 
@@ -118,30 +114,37 @@ def _fail_fast_if_backend_down():
     Round 4's BENCH_r04.json recorded rc=1 with a raw traceback tail and
     parsed=null because a wedged axon plugin blew up inside jax.devices();
     round 5's fail-fast guard then recorded value 0.0 — a parseable line,
-    but an empty bench trajectory. The probe runs in a throwaway
-    subprocess (a wedged plugin HANGS, which cannot be caught in-process);
-    when the default backend fails it, retry with JAX_PLATFORMS=cpu and —
-    if CPU initializes — fall through to the labelled "(cpu-fallback)"
-    measurement instead of emitting zero. Only when even the CPU backend
-    cannot initialize does the explicit UNMEASURED zero line remain."""
+    but an empty bench trajectory. The probes now ride the telemetry
+    watchdog (telemetry/watchdog.py): each runs in a throwaway subprocess
+    (a wedged plugin HANGS, which cannot be caught in-process), every
+    state transition is stamped as a schema-versioned watchdog event, and
+    the watchdog stays globally registered so every subsequent bench line
+    carries the backend state. When the default backend fails, retry with
+    JAX_PLATFORMS=cpu and — if CPU initializes — fall through to the
+    labelled "(cpu-fallback)" measurement instead of emitting zero. Only
+    when even the CPU backend cannot initialize does the explicit
+    UNMEASURED zero line remain — now carrying the full outage timeline
+    instead of a bare error string."""
     import os
 
-    from glom_tpu.utils.metrics import apply_env_platform, probe_device_count
+    from glom_tpu.telemetry.watchdog import BackendWatchdog, set_global_watchdog
+    from glom_tpu.utils.metrics import apply_env_platform
 
-    if probe_device_count(timeout=120.0) is None:
+    wd = BackendWatchdog(probe_timeout=120.0)
+    set_global_watchdog(wd)
+    if wd.probe_once() == "down":
         os.environ["JAX_PLATFORMS"] = "cpu"
-        if probe_device_count(timeout=120.0) is None:
-            print(
-                json.dumps(
-                    {
-                        "metric": "train_step column_iters_per_sec_per_chip "
-                        "(UNMEASURED: jax backend init failed or hung)",
-                        "value": 0.0,
-                        "unit": "column-iters/s/chip",
-                        "vs_baseline": 0.0,
-                        "error": "backend-init-unavailable",
-                    }
-                )
+        if wd.probe_once() == "down":
+            emit(
+                {
+                    "metric": "train_step column_iters_per_sec_per_chip "
+                    "(UNMEASURED: jax backend init failed or hung)",
+                    "value": 0.0,
+                    "unit": "column-iters/s/chip",
+                    "vs_baseline": 0.0,
+                    "error": "backend-init-unavailable",
+                    "watchdog_timeline": wd.timeline(),
+                }
             )
             raise SystemExit(0)
     # A successful probe validated the platform JAX_PLATFORMS names (the

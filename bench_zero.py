@@ -6,9 +6,18 @@ Four arms, one JSON line each (the queue's pricing rows):
   zero1        — reduce-scatter grads -> owned-shard update -> param all-gather
   zero2_accum  — stage 2 with grad_accum=2 (the sharded-accumulator case; it
                  differs from stage 1 only under accumulation)
-  zero0_quant  — stage 0 with the EQuARX-style int8 block-scaled reduce
-                 emulation (prices the quant/dequant compute; the wire saving
-                 itself needs the real XLA collective hook)
+  zero1_quant  — stage 1 with the EQuARX-style int8 block-scaled reduce
+                 emulation (prices the quant/dequant compute and stamps the
+                 in-graph quantization-error probe; the wire saving itself
+                 needs the real XLA collective hook). Stage 1, not 0: the
+                 manual path's quantization hook lives on the explicit
+                 reduce-scatter — stage 0's transpose-psum has no hook and
+                 resolves the flag off (loudly), so a stage-0 quant arm
+                 would measure nothing.
+
+Every arm runs telemetry_level="scalars", so each row carries the MEASURED
+collective wire bytes of the schedule it ran next to the modeled ones, and
+the measured-vs-modeled drift (telemetry/counters.py).
 
 Every line carries the static observability record the trainers stamp
 (zero_stage, per-replica live bytes, per-step comm-volume model), so the
@@ -26,19 +35,24 @@ honest even through the tunnel's fixed RTT (unlike the absolute numbers,
 which bench.py's chained-loop methodology owns).
 """
 
-import json
 import os
 import time
 
 
 def _bootstrap_platform() -> None:
-    """Pick the platform BEFORE any in-process backend init: probe in a
-    throwaway subprocess (a wedged TPU plugin hangs init — round-4/5 axon
-    outage), and when fewer than 2 devices answer, force a virtual
-    8-device CPU mesh so the A/B always has replicas to shard across."""
-    from glom_tpu.utils.metrics import apply_env_platform, probe_device_count
+    """Pick the platform BEFORE any in-process backend init: probe via the
+    telemetry watchdog's throwaway subprocess (a wedged TPU plugin hangs
+    init — round-4/5 axon outage), register it globally so every arm's
+    record stamps the backend state, and when fewer than 2 devices answer,
+    force a virtual 8-device CPU mesh so the A/B always has replicas to
+    shard across."""
+    from glom_tpu.telemetry.watchdog import BackendWatchdog, set_global_watchdog
+    from glom_tpu.utils.metrics import apply_env_platform
 
-    n = probe_device_count(timeout=120.0)
+    wd = BackendWatchdog(probe_timeout=120.0)
+    set_global_watchdog(wd)
+    wd.probe_once()
+    n = wd.record()["backend_devices"]
     if n is None or n < 2:
         os.environ["JAX_PLATFORMS"] = "cpu"
         flags = " ".join(
@@ -49,6 +63,10 @@ def _bootstrap_platform() -> None:
         os.environ["XLA_FLAGS"] = (
             f"{flags} --xla_force_host_platform_device_count=8".strip()
         )
+        # Re-probe the forced-CPU platform: the watchdog stays globally
+        # registered, and a stale 'down' from the wedged-TPU probe would
+        # stamp every live cpu-fallback pricing row as backend-down.
+        wd.probe_once()
     apply_env_platform()
 
 
@@ -75,6 +93,7 @@ def main() -> None:
 
     from glom_tpu.data import gaussian_dataset
     from glom_tpu.parallel import DistributedTrainer
+    from glom_tpu.telemetry.sinks import emit
     from glom_tpu.utils.config import GlomConfig, MeshConfig, TrainConfig
     from glom_tpu.utils.metrics import detect_chip, mfu
 
@@ -83,6 +102,9 @@ def main() -> None:
     dp = len(jax.devices())
     if on_tpu:
         # Flagship BASELINE config 4 at its declared dp topology.
+        # telemetry_level="scalars" on every arm: the records must carry
+        # the MEASURED collective bytes + model drift (the uniform in-graph
+        # cost rides all four arms identically, so the A/B ratio is clean).
         cfg = GlomConfig(dim=512, levels=6, image_size=224, patch_size=14)
         per_replica_batch, k, repeats = 4, 8, 3
         base = TrainConfig(
@@ -90,27 +112,30 @@ def main() -> None:
             learning_rate=1e-3,
             compute_dtype="bfloat16",
             use_pallas=True,  # manual shard_map path: explicit psum_scatter
+            telemetry_level="scalars",
         )
     else:
         cfg = GlomConfig(dim=64, levels=4, image_size=16, patch_size=4)
         per_replica_batch, k, repeats = 2, 4, 2
-        base = TrainConfig(batch_size=per_replica_batch * dp, learning_rate=1e-3)
-        print(
-            json.dumps(
-                {
-                    "note": "TPU slice unavailable; ZeRO A/B on the virtual "
-                    f"{dp}-device CPU mesh (cpu-fallback) — ratios and "
-                    "live-bytes/comm analytics are the signal, not "
-                    "absolute times"
-                }
-            )
+        base = TrainConfig(
+            batch_size=per_replica_batch * dp, learning_rate=1e-3,
+            use_pallas=True, telemetry_level="scalars",
+        )
+        emit(
+            {
+                "note": "TPU slice unavailable; ZeRO A/B on the virtual "
+                f"{dp}-device CPU mesh (cpu-fallback) — ratios and "
+                "live-bytes/comm analytics are the signal, not "
+                "absolute times"
+            },
+            kind="note",
         )
 
     arms = [
         ("zero0", dict(zero_stage=0)),
         ("zero1", dict(zero_stage=1)),
         ("zero2_accum", dict(zero_stage=2, grad_accum=2)),
-        ("zero0_quant", dict(zero_stage=0, quantized_reduce=True)),
+        ("zero1_quant", dict(zero_stage=1, quantized_reduce=True)),
     ]
     times = {}
     for name, overrides in arms:
@@ -122,21 +147,19 @@ def main() -> None:
         iters = cfg.default_iters
         col_per_sec = tcfg.batch_size * iters / per_step / dp
         label = f"dp={dp}, {chip}" if on_tpu else f"dp={dp}, cpu-fallback"
-        print(
-            json.dumps(
-                {
-                    "metric": f"zero_ab {name} train_step "
-                    f"column_iters_per_sec_per_chip ({label})",
-                    "value": round(col_per_sec, 2),
-                    "unit": "column-iters/s/chip",
-                    "step_time_s": round(per_step, 5),
-                    "vs_zero0": round(times["zero0"] / per_step, 4),
-                    "mfu": round(
-                        mfu(cfg, col_per_sec, chip=chip, backward=True), 4
-                    ),
-                    **trainer._static_record,
-                }
-            )
+        emit(
+            {
+                "metric": f"zero_ab {name} train_step "
+                f"column_iters_per_sec_per_chip ({label})",
+                "value": round(col_per_sec, 2),
+                "unit": "column-iters/s/chip",
+                "step_time_s": round(per_step, 5),
+                "vs_zero0": round(times["zero0"] / per_step, 4),
+                "mfu": round(
+                    mfu(cfg, col_per_sec, chip=chip, backward=True), 4
+                ),
+                **trainer._static_record,
+            }
         )
 
 

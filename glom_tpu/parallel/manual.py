@@ -57,8 +57,10 @@ from glom_tpu.models.core import contribution_divisor
 from glom_tpu.ops.patch import image_to_tokens, patchify
 from glom_tpu.parallel.halo import halo_consensus_shard
 from glom_tpu.parallel.ring import ring_consensus_shard
+from glom_tpu.telemetry import counters as tele_counters
+from glom_tpu.telemetry import diagnostics as diag
 from glom_tpu.train.objectives import DenoiseParams, default_recon_index
-from glom_tpu.train.trainer import TrainState
+from glom_tpu.train.trainer import TrainState, pinned_grad_accum
 from glom_tpu.utils.config import GlomConfig, TrainConfig
 from glom_tpu.utils.compat import array_vma, pcast_varying, shard_map
 from glom_tpu.utils.helpers import halo_supported
@@ -528,21 +530,24 @@ def make_manual_train_step(
 ):
     """(state, img, rng) -> (state, metrics): the manual-region analog of
     train.trainer.make_train_step, same metrics contract (incl. the
-    with_grad_norm fast variant for non-logging steps)."""
+    with_grad_norm fast variant for non-logging steps, and the telemetry
+    scalars + NaN/Inf guard at tcfg.telemetry_level != "off" — "full"
+    degrades to "scalars" here, see resolve_telemetry_level)."""
     if tcfg.compute_dtype not in ("float32", "bfloat16"):
         raise ValueError(
             f"compute_dtype={tcfg.compute_dtype!r}: must be 'float32' or 'bfloat16'"
         )
-    if tcfg.grad_accum < 1 or tcfg.batch_size % tcfg.grad_accum != 0:
+    accum = pinned_grad_accum(tcfg)
+    if tcfg.batch_size % accum != 0:
         raise ValueError(
-            f"grad_accum={tcfg.grad_accum} must divide batch_size="
-            f"{tcfg.batch_size}"
+            f"grad_accum={accum} must divide batch_size={tcfg.batch_size}"
         )
-    if (tcfg.batch_size // tcfg.grad_accum) % mesh.shape[DATA_AXIS] != 0:
+    if (tcfg.batch_size // accum) % mesh.shape[DATA_AXIS] != 0:
         raise ValueError(
-            f"microbatch {tcfg.batch_size // tcfg.grad_accum} not divisible "
+            f"microbatch {tcfg.batch_size // accum} not divisible "
             f"by data axis {mesh.shape[DATA_AXIS]}"
         )
+    level = diag.resolve_telemetry_level(tcfg, supports_full=False)
     loss_fn = make_manual_loss(
         mesh, cfg, tcfg, sp_strategy=sp_strategy, interpret=interpret
     )
@@ -550,19 +555,38 @@ def make_manual_train_step(
     def train_step(state: TrainState, img: jnp.ndarray, rng: jax.Array):
         noise_rng = jax.random.fold_in(rng, state.step)
         noise = tcfg.noise_std * jax.random.normal(noise_rng, img.shape, img.dtype)
-        if tcfg.grad_accum > 1:
+        if accum > 1:
             from glom_tpu.train.trainer import accumulate_grads
 
             loss, grads = accumulate_grads(
-                loss_fn, state.params, img, noise, tcfg.grad_accum
+                loss_fn, state.params, img, noise, accum
             )
         else:
             loss, grads = jax.value_and_grad(loss_fn)(state.params, img, noise)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         metrics = {"loss": loss, "step": state.step}
+        if with_grad_norm or level != "off":
+            grad_norm = optax.global_norm(grads)
         if with_grad_norm:
-            metrics["grad_norm"] = optax.global_norm(grads)
+            metrics["grad_norm"] = grad_norm
+        if level != "off":
+            # The grads/updates here are full replicated trees (the
+            # shard_map transpose already reduced them), so the scalar
+            # taps and the guard run OUTSIDE the manual region — same
+            # fused-reduction cost as the GSPMD step's.
+            taps = diag.scalar_taps(
+                loss=loss, grad_norm=grad_norm, updates=updates, params=params
+            )
+            nonfinite = taps.pop("nonfinite")
+            if tcfg.nonfinite_policy == "skip":
+                params = diag.guard_update(nonfinite, params, state.params)
+                opt_state = diag.guard_update(
+                    nonfinite, opt_state, state.opt_state
+                )
+                metrics["skipped_nonfinite"] = nonfinite.astype(jnp.int32)
+            metrics.update(taps)
+            metrics["nonfinite_step"] = nonfinite.astype(jnp.int32)
         return TrainState(params, opt_state, state.step + 1), metrics
 
     return train_step
@@ -632,13 +656,12 @@ def make_manual_zero_train_step(
             "manual ZeRO step supports model == 1; the GSPMD path handles "
             "ZeRO x TP composition"
         )
-    if tcfg.grad_accum < 1 or tcfg.batch_size % tcfg.grad_accum != 0:
+    accum = pinned_grad_accum(tcfg)
+    if tcfg.batch_size % accum != 0:
         raise ValueError(
-            f"grad_accum={tcfg.grad_accum} must divide batch_size="
-            f"{tcfg.batch_size}"
+            f"grad_accum={accum} must divide batch_size={tcfg.batch_size}"
         )
     dp = mesh.shape[DATA_AXIS]
-    accum = tcfg.grad_accum
     if (tcfg.batch_size // accum) % dp != 0:
         raise ValueError(
             f"microbatch {tcfg.batch_size // accum} not divisible "
@@ -653,23 +676,78 @@ def make_manual_zero_train_step(
         if quantized_reduce is None
         else quantized_reduce
     )
+    level = diag.resolve_telemetry_level(tcfg, supports_full=False)
 
-    def reduce_scatter_leaf(g, ax):
-        if seq > 1:
-            g = lax.psum(g, SEQ_AXIS)
-        if quantized:
-            from glom_tpu.parallel.quantized import quantize_dequantize
+    # The explicit collective pipeline, split so the telemetry hooks land
+    # between its stages: seq pre-reduction -> one quantization wire hop
+    # (with the error probe when it sees the FULL tree) -> per-leaf
+    # scatter/pmean. Every site reports its measured per-replica ring wire
+    # bytes to telemetry.counters (recorded once, at trace time, inside
+    # DistributedTrainer's counting eval_shape — see counters.recording).
 
-            g = quantize_dequantize(g)
+    def seq_reduce(grads):
+        if seq <= 1:
+            return grads
+
+        def leaf(g):
+            tele_counters.record_collective(
+                "reduce", tele_counters.ring_allreduce_bytes(g, seq)
+            )
+            return lax.psum(g, SEQ_AXIS)
+
+        return jax.tree_util.tree_map(leaf, grads)
+
+    def quantize_tree(grads):
+        from glom_tpu.parallel.quantized import quantize_dequantize
+
+        return jax.tree_util.tree_map(quantize_dequantize, grads)
+
+    def scatter_leaf(g, ax):
         if ax < 0:
+            # No dp-divisible axis: the leaf stays replicated via a full
+            # allreduce — a schedule detail comm_volume_model does NOT
+            # price (it treats all of G as scattered), so the measured
+            # counter is what keeps the drift honest.
+            tele_counters.record_collective(
+                "reduce",
+                tele_counters.ring_reduce_scatter_bytes(
+                    g, dp, quantized=quantized
+                ) * 2,
+            )
             return lax.pmean(g, DATA_AXIS)
+        tele_counters.record_collective(
+            "reduce",
+            tele_counters.ring_reduce_scatter_bytes(g, dp, quantized=quantized),
+        )
         return (
             lax.psum_scatter(g, DATA_AXIS, scatter_dimension=ax, tiled=True)
             / dp
         )
 
+    def reduce_full(grads):
+        """The whole-tree form (non-accumulated / post-accumulation):
+        returns (g_shards, quant_rel_err or None)."""
+        grads = seq_reduce(grads)
+        qerr = None
+        if quantized:
+            dq = quantize_tree(grads)
+            if level != "off":
+                qerr = diag.quantization_error(grads, dq)
+            grads = dq
+        return (
+            jax.tree_util.tree_map(scatter_leaf, grads, shard_axes),
+            qerr,
+        )
+
     def reduce_scatter_tree(grads):
-        return jax.tree_util.tree_map(reduce_scatter_leaf, grads, shard_axes)
+        """The per-microbatch stage-2 hook: same pipeline, no probe (the
+        hook's contract is tree -> tree; the per-microbatch error never
+        sees the full accumulated gradient, so stamping it would claim a
+        measurement that wasn't made)."""
+        grads = seq_reduce(grads)
+        if quantized:
+            grads = quantize_tree(grads)
+        return jax.tree_util.tree_map(scatter_leaf, grads, shard_axes)
 
     def shard_zeros(p, ax):
         if ax < 0:
@@ -689,6 +767,9 @@ def make_manual_zero_train_step(
     def gather_shard(p_shard, ax):
         if ax < 0:
             return p_shard
+        tele_counters.record_collective(
+            "gather", tele_counters.ring_all_gather_bytes(p_shard, dp)
+        )
         return lax.all_gather(p_shard, DATA_AXIS, axis=ax, tiled=True)
 
     def sharded_grad_norm(g_shards):
@@ -708,7 +789,15 @@ def make_manual_zero_train_step(
                 sq_scattered = sq_scattered + s
         return jnp.sqrt(lax.psum(sq_scattered, DATA_AXIS) + sq_replicated)
 
+    # The quant-error probe exists only where the hop sees the full
+    # accumulated gradient (reduce_full); the stage-2-with-accum corner
+    # quantizes per microbatch inside the scan and stamps no error.
+    probe_quant = (
+        quantized and level != "off" and not (zero_stage >= 2 and accum > 1)
+    )
+
     def update_body(params, opt_state, img, noise):
+        qerr = None
         if accum > 1:
             # trainer.accumulate_grads on the LOCAL band — the strided
             # grouping applies per shard exactly as it does globally
@@ -719,9 +808,15 @@ def make_manual_zero_train_step(
             # owned-shard shapes, so the buffer never holds a full leaf.
             from glom_tpu.train.trainer import accumulate_grads
 
+            def scatter_microbatch(g):
+                # One trace, `accum` executions: scale the measured
+                # counters so they price the whole step's wire traffic.
+                with tele_counters.scaled(accum):
+                    return reduce_scatter_tree(g)
+
             gkw = (
                 dict(
-                    grad_transform=reduce_scatter_tree,
+                    grad_transform=scatter_microbatch,
                     grad_init=lambda: jax.tree_util.tree_map(
                         shard_zeros, params, shard_axes
                     ),
@@ -732,10 +827,13 @@ def make_manual_zero_train_step(
             loss_loc, grads = accumulate_grads(
                 local_loss, params, img, noise, accum, **gkw
             )
-            g_shards = grads if zero_stage >= 2 else reduce_scatter_tree(grads)
+            if zero_stage >= 2:
+                g_shards = grads
+            else:
+                g_shards, qerr = reduce_full(grads)
         else:
             loss_loc, grads = jax.value_and_grad(local_loss)(params, img, noise)
-            g_shards = reduce_scatter_tree(grads)
+            g_shards, qerr = reduce_full(grads)
 
         p_shards = jax.tree_util.tree_map(slice_shard, params, shard_axes)
         updates, new_opt = optimizer.update(g_shards, opt_state, p_shards)
@@ -744,32 +842,60 @@ def make_manual_zero_train_step(
             gather_shard, new_p_shards, shard_axes
         )
         loss = lax.pmean(loss_loc, DATA_AXIS)
-        gnorm = (
-            sharded_grad_norm(g_shards)
-            if with_grad_norm
-            else jnp.zeros((), jnp.float32)
-        )
-        return new_params, new_opt, loss, gnorm
+        metrics = {"loss": loss}
+        if with_grad_norm or level != "off":
+            # grad_norm is part of the scalars bundle on every path (it is
+            # computed for the guard anyway): the fast-variant record must
+            # carry the same keys here as on the GSPMD/manual steps.
+            gnorm = sharded_grad_norm(g_shards)
+            metrics["grad_norm"] = gnorm
+        if level != "off":
+            # In-region telemetry on the sharded triple: update norm via
+            # the same ownership-partition decomposition as the grad norm;
+            # param norm on the gathered (replicated) tree is collective-
+            # free. The guard's where() runs on the gathered params and
+            # the sharded opt state alike — the non-finite flag is built
+            # from psum'd scalars, so it is replica-invariant.
+            from glom_tpu.telemetry.diagnostics import nonfinite_flag
+
+            metrics["update_norm"] = sharded_grad_norm(updates)
+            metrics["param_norm"] = optax.global_norm(new_params)
+            nonfinite = nonfinite_flag(loss, gnorm)
+            if tcfg.nonfinite_policy == "skip":
+                new_params = diag.guard_update(nonfinite, new_params, params)
+                new_opt = diag.guard_update(nonfinite, new_opt, opt_state)
+                metrics["skipped_nonfinite"] = nonfinite.astype(jnp.int32)
+            metrics["nonfinite_step"] = nonfinite.astype(jnp.int32)
+            if probe_quant:
+                metrics["quant_rel_err"] = qerr
+        return new_params, new_opt, metrics
 
     batch_spec = P(DATA_AXIS)
     param_spec = _manual_param_spec(mp)
+    metric_keys = ["loss"]
+    if with_grad_norm or level != "off":
+        metric_keys.append("grad_norm")
+    if level != "off":
+        metric_keys += ["update_norm", "param_norm", "nonfinite_step"]
+        if tcfg.nonfinite_policy == "skip":
+            metric_keys.append("skipped_nonfinite")
+        if probe_quant:
+            metric_keys.append("quant_rel_err")
     update_sm = shard_map(
         update_body,
         mesh=mesh,
         in_specs=(param_spec, opt_pspecs, batch_spec, batch_spec),
-        out_specs=(param_spec, opt_pspecs, P(), P()),
+        out_specs=(param_spec, opt_pspecs, {k: P() for k in metric_keys}),
         check_vma=False,
     )
 
     def train_step(state: TrainState, img: jnp.ndarray, rng: jax.Array):
         noise_rng = jax.random.fold_in(rng, state.step)
         noise = tcfg.noise_std * jax.random.normal(noise_rng, img.shape, img.dtype)
-        new_params, new_opt, loss, gnorm = update_sm(
+        new_params, new_opt, metrics = update_sm(
             state.params, state.opt_state, img, noise
         )
-        metrics = {"loss": loss, "step": state.step}
-        if with_grad_norm:
-            metrics["grad_norm"] = gnorm
+        metrics = dict(metrics, step=state.step)
         return TrainState(new_params, new_opt, state.step + 1), metrics
 
     return train_step

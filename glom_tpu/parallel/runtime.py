@@ -41,12 +41,14 @@ from glom_tpu.parallel.sharding import (
     zero_param_specs,
 )
 from glom_tpu.parallel.ulysses import make_ulysses_consensus
+from glom_tpu.telemetry import diagnostics as diag
 from glom_tpu.train.trainer import (
     TrainState,
     ZeroShardings,
     create_train_state,
     fit_loop,
     make_train_step,
+    pinned_grad_accum,
     resolve_quantized_reduce,
     resolve_zero_stage,
 )
@@ -84,8 +86,12 @@ _ULYSSES_SIM_BUDGET = 16 * 1024 * 1024
 
 def ulysses_preferred(n: int) -> bool:
     """True when Ulysses' full-row similarity block is VMEM-scale (see the
-    working-set model above) — the measured ring/Ulysses crossover."""
-    return n * n * 4 <= _ULYSSES_SIM_BUDGET
+    working-set model above) — the measured ring/Ulysses crossover.
+    STRICT inequality: the committed table brackets the flip between
+    n=1024 and n=4096, so the exactly-at-budget point n=2048 (16MB) is
+    UNMEASURED — auto-selection keeps the prior ring behavior there until
+    an sp_crossover row for n=2048 lands (ADVICE round 5, low)."""
+    return n * n * 4 < _ULYSSES_SIM_BUDGET
 
 
 def select_sp_strategy(cfg: GlomConfig, seq: int) -> str:
@@ -215,15 +221,16 @@ class DistributedTrainer:
             raise ValueError(
                 f"batch {tcfg.batch_size} not divisible by data axis {mesh_cfg.data}"
             )
+        accum_base = pinned_grad_accum(tcfg)
         if (
-            tcfg.grad_accum > 1
-            and (tcfg.batch_size // tcfg.grad_accum) % mesh_cfg.data != 0
+            accum_base > 1
+            and (tcfg.batch_size // accum_base) % mesh_cfg.data != 0
         ):
             # Both step paths (GSPMD and manual) scan over microbatches;
             # an indivisible microbatch would silently pad/idle devices.
             raise ValueError(
-                f"microbatch {tcfg.batch_size // tcfg.grad_accum} "
-                f"(batch {tcfg.batch_size} / grad_accum {tcfg.grad_accum}) "
+                f"microbatch {tcfg.batch_size // accum_base} "
+                f"(batch {tcfg.batch_size} / grad_accum {accum_base}) "
                 f"not divisible by data axis {mesh_cfg.data}"
             )
         if cfg.num_patches % mesh_cfg.seq != 0:
@@ -276,6 +283,20 @@ class DistributedTrainer:
             None if self.use_manual else make_consensus_fn(self.mesh, cfg, sp_strategy)
         )
 
+        # Telemetry level resolution ONCE the step path is known (same
+        # discipline as sp_strategy: the stamped level is the resolved
+        # one). The manual shard_map path has no aux channel for "full" —
+        # degrade loudly here, then pass the RESOLVED level down so the
+        # step builders' re-resolve is a silent no-op.
+        self.telemetry_level = diag.resolve_telemetry_level(
+            tcfg, supports_full=not self.use_manual
+        )
+        if self.telemetry_level != tcfg.telemetry_level:
+            tcfg = dataclasses.replace(
+                tcfg, telemetry_level=self.telemetry_level
+            )
+            self.tcfg = tcfg
+
         # Resolve the backward path for the metric records (round-4 weak
         # #3: the vjp dispatch must be as visible as the SP strategy). The
         # manual shard_map bodies never reach the whole-loop VJP; with a
@@ -283,7 +304,7 @@ class DistributedTrainer:
         # collective op's own transpose — labeled 'scan_sharded'
         # consistently on both paths (the mechanism itself is in
         # sp_strategy).
-        self.grad_accum = tcfg.grad_accum
+        self.grad_accum = accum_base
         if self.use_manual and mesh_cfg.seq > 1:
             self.vjp_path = "scan_sharded"
         elif self.use_manual:
@@ -297,7 +318,7 @@ class DistributedTrainer:
             # follow the dispatch; TP shards (mp>1) stay scan-only.
             self.vjp_path = resolve_vjp_path(
                 cfg,
-                tcfg.batch_size // tcfg.grad_accum // mesh_cfg.data,
+                tcfg.batch_size // accum_base // mesh_cfg.data,
                 k,
                 remat=tcfg.remat,
                 use_pallas=True,
@@ -398,12 +419,18 @@ class DistributedTrainer:
                     sp_strategy=sp_strategy, with_grad_norm=with_grad_norm,
                 )
             else:
+                # scan_only: the whole-loop Pallas custom_vjp has no GSPMD
+                # partitioning rule, so this build must neither dispatch
+                # it nor auto-split the batch chasing it — the single-chip
+                # routing heuristics would otherwise evaluate against the
+                # GLOBAL batch here (ADVICE round 5, medium).
                 fn = make_train_step(
                     cfg, tcfg, self.optimizer, consensus_fn=consensus_fn,
                     with_grad_norm=with_grad_norm,
                     zero_stage=self.zero_stage,
                     zero_shardings=self.zero_shardings,
                     quantized_reduce=self.quantized_reduce,
+                    scan_only=True,
                 )
                 # A GSPMD SP consensus_fn means the backward runs the
                 # sharded op's transpose — same label as the manual SP
@@ -412,6 +439,7 @@ class DistributedTrainer:
                     "scan_sharded" if consensus_fn is not None else fn.vjp_path
                 )
                 self.grad_accum = fn.grad_accum
+            self._raw_step = fn
             return jax.jit(
                 fn,
                 in_shardings=(self.state_shardings, self.batch_sharding, None),
@@ -421,6 +449,9 @@ class DistributedTrainer:
 
         self._step = build(True)
         self._step_fast = build(False)
+        # Persistent across fit() calls: span 2+ of a checkpointed run is
+        # warm, and its first steps are steady-state samples, not compiles.
+        self._compile_tracker = set()
 
         # Static observability record, computed AFTER build() so the
         # comm-volume model prices the grad_accum the step actually runs
@@ -453,6 +484,7 @@ class DistributedTrainer:
         self._static_record = {
             "zero_stage": self.zero_stage,
             "quantized_reduce": self.quantized_reduce,
+            "telemetry_level": self.telemetry_level,
             **mem,
             **comm_volume_model(
                 wire_bytes,
@@ -463,6 +495,42 @@ class DistributedTrainer:
                 grad_accum=self.grad_accum,
             ),
         }
+
+        # MEASURED collective counters (telemetry/counters.py): one
+        # abstract trace of the step with the recording context active —
+        # the manual ZeRO path's explicit psum/psum_scatter/all_gather
+        # sites report their actual per-replica ring wire bytes, and the
+        # measured-vs-modeled drift is stamped on every record (the model
+        # silently diverging from the emitted collectives is itself the
+        # bug telemetry exists to catch). Gated on telemetry_level (the
+        # extra trace is not free) and on the path that HAS explicit
+        # sites; GSPMD steps carry the model only.
+        if (
+            self.telemetry_level != "off"
+            and self.use_manual
+            and self.zero_stage >= 1
+        ):
+            from glom_tpu.telemetry.counters import (
+                CollectiveCounters,
+                comm_drift,
+                recording,
+            )
+
+            counters = CollectiveCounters()
+            abstract_batch = jax.ShapeDtypeStruct(
+                (tcfg.batch_size, cfg.channels, cfg.image_size, cfg.image_size),
+                jnp.float32,
+            )
+            with recording(counters):
+                jax.eval_shape(
+                    self._raw_step, abstract_state, abstract_batch,
+                    jax.random.PRNGKey(0),
+                )
+            measured = counters.totals()
+            self._static_record.update(measured)
+            self._static_record.update(
+                comm_drift(measured, self._static_record)
+            )
 
     def step(self, batch: np.ndarray):
         # device_put on the host array shards directly host->devices in one
@@ -475,12 +543,16 @@ class DistributedTrainer:
 
     def _annotate(self, metrics) -> dict:
         """Static routing facts attached OUTSIDE jit (strings can't ride
-        the compiled metrics dict) — same record shape as Trainer's."""
+        the compiled metrics dict) — same record shape as Trainer's,
+        including the watchdog backend state."""
+        from glom_tpu.telemetry.watchdog import backend_record
+
         metrics = dict(metrics)
         metrics["sp_strategy"] = self.sp_strategy
         metrics["vjp_path"] = self.vjp_path
         metrics["grad_accum"] = self.grad_accum
         metrics.update(self._static_record)
+        metrics.update(backend_record())
         return metrics
 
     def step_fast(self, batch: np.ndarray):
@@ -519,4 +591,5 @@ class DistributedTrainer:
             log_every=log_every,
             metrics_writer=self.metrics_writer,
             step_fast=self.step_fast,
+            compile_tracker=self._compile_tracker,
         )

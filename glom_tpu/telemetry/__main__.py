@@ -1,0 +1,10 @@
+"""`python -m glom_tpu.telemetry FILE...` — lint JSONL logs against the
+versioned event schema (the clean entry point; `-m ...telemetry.schema`
+works too but trips runpy's already-imported warning)."""
+
+import sys
+
+from glom_tpu.telemetry.schema import main
+
+if __name__ == "__main__":
+    sys.exit(main())

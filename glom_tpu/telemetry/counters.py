@@ -1,0 +1,159 @@
+"""Measured collective counters for the manual shard_map path.
+
+`utils/metrics.comm_volume_model` PRICES the gradient/update wire schedule
+from top-level aggregates (G, P, dp, stage). This module MEASURES it: the
+explicit collectives in `parallel/manual.py` (the seq-psum, the ZeRO
+psum_scatter / pmean, the param all-gather) report their per-replica ring
+wire bytes from the ACTUAL arrays at each call site while the step traces,
+so aggregation decisions the model cannot see — leaves with no dp-divisible
+axis falling back to a replicated allreduce, the seq-axis pre-reduction,
+per-microbatch scatters — show up as measured-vs-modeled drift, which is
+itself a stamped metric (`comm_model_drift`).
+
+Recording is trace-time: collective shapes are static, so one abstract
+trace (jax.eval_shape in DistributedTrainer) captures exactly what every
+compiled step will move. Counters record only inside a `recording(...)`
+context — re-traces of the same step (the with/without-grad-norm jit pair)
+cannot double-count.
+
+Wire formulas (ring algorithms, matching comm_volume_model's pricing):
+  psum (allreduce)   2*(k-1)/k * B      B = local payload bytes
+  psum_scatter       (k-1)/k   * B
+  pmean fallback     2*(k-1)/k * B      (replicated leaf: full allreduce)
+  all_gather         (k-1)     * B_sh   B_sh = per-shard bytes
+Quantized-reduce arms price the REDUCE payload at the int8+scales wire
+size (`quantized_wire_bytes`) — the same hypothetical-real-collective
+convention the model uses; the gather stays f32 (EQuARX quantizes the
+reduce, not the weights).
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import List
+
+
+class CollectiveCounters:
+    """Accumulated per-replica per-step wire bytes by collective kind."""
+
+    def __init__(self):
+        self.reduce_bytes = 0  # psum + psum_scatter + pmean (gradient path)
+        self.gather_bytes = 0  # all_gather (param path)
+        self.n_reduce = 0
+        self.n_gather = 0
+
+    def record(self, kind: str, wire_bytes: int) -> None:
+        if kind == "gather":
+            self.gather_bytes += int(wire_bytes)
+            self.n_gather += 1
+        else:
+            self.reduce_bytes += int(wire_bytes)
+            self.n_reduce += 1
+
+    def totals(self) -> dict:
+        """The stamped record fields (measured counterpart of
+        comm_volume_model's comm_*_bytes_per_step keys)."""
+        return {
+            "comm_measured_reduce_bytes_per_step": self.reduce_bytes,
+            "comm_measured_gather_bytes_per_step": self.gather_bytes,
+            "comm_measured_bytes_per_step": self.reduce_bytes + self.gather_bytes,
+            "comm_measured_collective_count": self.n_reduce + self.n_gather,
+        }
+
+
+_local = threading.local()
+
+
+def _stack() -> List[CollectiveCounters]:
+    if not hasattr(_local, "stack"):
+        _local.stack = []
+    return _local.stack
+
+
+@contextmanager
+def recording(counters: CollectiveCounters):
+    """Activate `counters` for collectives recorded on THIS thread (tracing
+    is single-threaded per step; thread-local keeps parallel test runs
+    honest)."""
+    _stack().append(counters)
+    try:
+        yield counters
+    finally:
+        _stack().pop()
+
+
+def _scale() -> int:
+    return getattr(_local, "scale", 1)
+
+
+@contextmanager
+def scaled(k: int):
+    """Multiply recorded bytes by `k` inside this context: a collective
+    site inside a lax.scan body TRACES once but EXECUTES per iteration —
+    the stage-2 per-microbatch reduce-scatter hook wraps itself in
+    scaled(grad_accum) so the measured count prices every execution, not
+    the single trace."""
+    prev = _scale()
+    _local.scale = prev * int(k)
+    try:
+        yield
+    finally:
+        _local.scale = prev
+
+
+def record_collective(kind: str, wire_bytes: int) -> None:
+    """Called from the instrumented collective sites in parallel/manual.py.
+    No-op unless a recording() context is active — the sites stay free to
+    trace/retrace without double-counting."""
+    scale = _scale()
+    for c in _stack():
+        c.record(kind, wire_bytes * scale)
+
+
+# -- wire-byte helpers for the instrumented sites --------------------------
+
+
+def _nbytes(x) -> int:
+    import numpy as np
+
+    size = 1
+    for s in x.shape:
+        size *= int(s)
+    return size * np.dtype(x.dtype).itemsize
+
+
+def ring_allreduce_bytes(x, k: int) -> int:
+    return int(2 * (k - 1) / k * _nbytes(x)) if k > 1 else 0
+
+
+def ring_reduce_scatter_bytes(x, k: int, *, quantized: bool = False) -> int:
+    if k <= 1:
+        return 0
+    nbytes = _nbytes(x)
+    if quantized:
+        from glom_tpu.parallel.quantized import quantized_wire_bytes
+
+        # f32 elements -> int8 payload + per-block scales (the wire the
+        # real quantized collective would carry).
+        nbytes = quantized_wire_bytes(nbytes // 4)
+    return int((k - 1) / k * nbytes)
+
+
+def ring_all_gather_bytes(x_shard, k: int) -> int:
+    return int((k - 1) * _nbytes(x_shard)) if k > 1 else 0
+
+
+def comm_drift(measured: dict, modeled: dict) -> dict:
+    """Measured-vs-modeled reconciliation, itself a stamped metric: the
+    relative drift of total per-step wire bytes ((measured - modeled) /
+    modeled). A model that stops matching the collectives a step actually
+    emits is a silent-pricing bug — stamping the drift on every record is
+    what makes it impossible to miss."""
+    meas = measured.get("comm_measured_bytes_per_step", 0)
+    model = modeled.get("comm_bytes_per_step", 0)
+    if model <= 0:
+        drift = 0.0 if meas == 0 else float("inf")
+    else:
+        drift = (meas - model) / model
+    return {"comm_model_drift": round(drift, 6) if drift != float("inf") else 1e9}

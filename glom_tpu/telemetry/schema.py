@@ -1,0 +1,197 @@
+"""Versioned JSONL event schema — the one record contract every sink speaks.
+
+Rounds 4-5 went blind because each evidence trail had its own ad-hoc shape
+(driver-parsed bench lines, MetricsWriter dicts, a shell watcher.log): when
+the backend wedged there was no machine-checkable stream to reconstruct the
+outage from. This module is the fix's foundation: every record any part of
+the framework writes — trainer metrics, bench lines, watchdog transitions,
+anomaly events — carries `schema_version` and a `kind`, and validates
+against the field contract below. `python -m glom_tpu.telemetry.schema
+FILE...` lints any log (JSON lines mixed with shell noise are fine; noise
+is skipped, stamped records must validate) — run_hw_queue.sh and CI both
+call it on bench output.
+
+Versioning: SCHEMA_VERSION bumps on any breaking field change; readers
+accept records with version <= theirs. Pure stdlib — importable from
+conftest-less subprocesses and the hw queue without touching jax.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Iterable, List, Optional, Tuple
+
+SCHEMA_VERSION = 1
+
+_NUM = (int, float)
+_STR = (str,)
+
+# kind -> {required field: allowed JSON types}. Extra fields are always
+# allowed (records grow; the schema pins the load-bearing core).
+KINDS = {
+    # One optimizer step's metrics (trainer fit loops).
+    "train_step": {"step": _NUM, "loss": _NUM},
+    # One benchmark measurement (bench*.py; the driver tail-parses these).
+    "bench": {"metric": _STR, "value": _NUM, "unit": _STR},
+    # A backend-liveness state transition (telemetry/watchdog.py).
+    "watchdog": {"backend_state": _STR, "t": _NUM},
+    # Something went wrong inside a run (NaN/Inf guard, skip-step, ...).
+    "anomaly": {"step": _NUM, "reason": _STR},
+    # End-of-run rollups (loss-curve summaries etc.).
+    "summary": {},
+    # Free-text context lines (e.g. bench cpu-fallback notes).
+    "note": {"note": _STR},
+}
+
+WATCHDOG_STATES = ("unknown", "up", "down", "flapping")
+
+
+class SchemaError(ValueError):
+    pass
+
+
+def infer_kind(rec: dict) -> str:
+    """Best-effort kind for legacy records written before stamping."""
+    if "backend_state" in rec and ("t" in rec or "event" in rec):
+        return "watchdog"
+    if "metric" in rec and "value" in rec:
+        return "bench"
+    if "reason" in rec and "step" in rec:
+        return "anomaly"
+    if "note" in rec:
+        return "note"
+    if "summary" in rec:
+        return "summary"
+    if "loss" in rec or "step" in rec:
+        return "train_step"
+    return "summary"
+
+
+def stamp(rec: dict, kind: Optional[str] = None) -> dict:
+    """Return a copy of `rec` carrying schema_version + kind (idempotent:
+    existing stamps are preserved, so double-stamping through nested sinks
+    cannot relabel a record)."""
+    out = dict(rec)
+    out.setdefault("schema_version", SCHEMA_VERSION)
+    out.setdefault("kind", kind if kind is not None else infer_kind(rec))
+    return out
+
+
+def validate_record(rec: object) -> List[str]:
+    """Errors for one decoded record; empty list = valid."""
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not an object"]
+    errs = []
+    v = rec.get("schema_version")
+    if not isinstance(v, int) or isinstance(v, bool):
+        errs.append(f"schema_version {v!r} is not an int")
+    elif not 1 <= v <= SCHEMA_VERSION:
+        errs.append(f"schema_version {v} outside 1..{SCHEMA_VERSION}")
+    kind = rec.get("kind")
+    if kind not in KINDS:
+        errs.append(f"kind {kind!r} not one of {sorted(KINDS)}")
+        return errs
+    for field, types in KINDS[kind].items():
+        if field not in rec:
+            errs.append(f"{kind} record missing required field {field!r}")
+        elif not isinstance(rec[field], types) or isinstance(rec[field], bool):
+            errs.append(
+                f"{kind}.{field} is {type(rec[field]).__name__}, "
+                f"expected {'/'.join(t.__name__ for t in types)}"
+            )
+    if kind == "watchdog" and rec.get("backend_state") not in WATCHDOG_STATES:
+        errs.append(
+            f"watchdog.backend_state {rec.get('backend_state')!r} not one "
+            f"of {WATCHDOG_STATES}"
+        )
+    try:
+        json.dumps(rec)
+    except (TypeError, ValueError) as e:
+        errs.append(f"record is not JSON-serializable: {e}")
+    return errs
+
+
+def assert_valid(rec: dict) -> dict:
+    errs = validate_record(rec)
+    if errs:
+        raise SchemaError("; ".join(errs))
+    return rec
+
+
+def iter_json_lines(lines: Iterable[str]) -> Iterable[Tuple[int, dict]]:
+    """(lineno, record) for every line that parses as a JSON object —
+    shell noise, timestamps, and tracebacks interleaved in hw-queue logs
+    are skipped, not errors."""
+    for i, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict):
+            yield i, rec
+
+
+def lint_stream(
+    lines: Iterable[str],
+    *,
+    require_stamp: bool = True,
+    require_records: bool = True,
+) -> List[str]:
+    """Validate every JSON record in a log stream. require_stamp=True (the
+    CI mode) also fails records that never got a schema_version — the
+    whole point is that no sink writes unstamped rows anymore.
+    require_records=True additionally fails a stream with NO JSON records
+    at all (an empty bench log is the round-5 'empty evidence trajectory'
+    regression); the queue's mixed-log sweep passes False, since probe /
+    tpu_validate logs legitimately contain no JSON."""
+    errors = []
+    n = 0
+    for lineno, rec in iter_json_lines(lines):
+        n += 1
+        if "schema_version" not in rec and not require_stamp:
+            continue
+        for e in validate_record(rec):
+            errors.append(f"line {lineno}: {e}")
+    if n == 0 and require_records:
+        errors.append("no JSON records found")
+    return errors
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m glom_tpu.telemetry.schema",
+        description="Lint JSONL telemetry/bench logs against the event schema",
+    )
+    ap.add_argument("paths", nargs="+")
+    ap.add_argument(
+        "--allow-unstamped", action="store_true",
+        help="skip records without schema_version instead of failing them; "
+        "also tolerates files with no JSON records at all (the hw-queue "
+        "sweep over mixed shell logs)",
+    )
+    args = ap.parse_args(argv)
+    rc = 0
+    for path in args.paths:
+        with open(path) as fh:
+            errs = lint_stream(
+                fh,
+                require_stamp=not args.allow_unstamped,
+                require_records=not args.allow_unstamped,
+            )
+        if errs:
+            rc = 1
+            for e in errs:
+                print(f"{path}: {e}", file=sys.stderr)
+        else:
+            print(f"{path}: OK")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,94 @@
+"""Structured sinks: step-time histograms and the stamped bench emitter.
+
+Two host-side pieces that complete the telemetry loop:
+
+  * StepTimeStats — wall-clock per-step durations with the COMPILE step
+    split out (the first step of a jitted loop is trace+compile; folding
+    it into steady-state percentiles made round 4's "slow step" reports
+    unreadable). Logging records carry p50/p95/max of steady state plus
+    the compile time, so a step-time regression and a compile-time
+    regression are separately attributable. Dispatch is async under jax —
+    non-logging steps measure enqueue time, logging steps (which fetch the
+    metrics) absorb the device sync, so p95/max bound the true step time
+    while p50 tracks dispatch; docs/OBSERVABILITY.md spells out the
+    reading. Pure host arithmetic: nanoseconds per step of overhead.
+
+  * emit() — the benches' print(json.dumps(...)) replacement: stamps
+    schema_version/kind and the current watchdog backend state on the
+    record, so driver-parsed bench lines, trainer JSONL, and hw-queue rows
+    are one schema (`python -m glom_tpu.telemetry.schema` lints them all).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import List, Optional
+
+from glom_tpu.telemetry import schema, watchdog
+
+
+class StepTimeStats:
+    """Streaming per-step wall-time stats with compile split out.
+
+    observe(dt, is_compile=None): is_compile=None (standalone use) treats
+    the FIRST observation as the compile step; fit_loop passes it
+    explicitly per jit variant — BOTH the fast step's first call and the
+    logging step's first call are trace+compile, and a multi-second
+    compile landing in the steady-state samples would make p95/max
+    unreadable. compile_time_s accumulates (total seconds spent
+    compiling); the samples hold only steady-state steps."""
+
+    def __init__(self, max_samples: int = 4096):
+        self.compile_time_s: Optional[float] = None
+        self._samples: List[float] = []
+        self._max = max_samples
+        self._count = 0
+        self._running_max = 0.0
+
+    def observe(self, dt_s: float, is_compile: Optional[bool] = None) -> None:
+        if is_compile is None:
+            is_compile = self.compile_time_s is None
+        if is_compile:
+            self.compile_time_s = (self.compile_time_s or 0.0) + dt_s
+            return
+        self._count += 1
+        self._running_max = max(self._running_max, dt_s)
+        if len(self._samples) < self._max:
+            self._samples.append(dt_s)
+        else:
+            # Reservoir-free decimation: keep every other sample once full
+            # (percentiles stay representative, memory stays bounded).
+            self._samples = self._samples[::2]
+            self._max = max(self._max, 2 * len(self._samples))
+            self._samples.append(dt_s)
+
+    @staticmethod
+    def _quantile(sorted_samples: List[float], q: float) -> float:
+        if not sorted_samples:
+            return 0.0
+        idx = min(len(sorted_samples) - 1, int(q * (len(sorted_samples) - 1) + 0.5))
+        return sorted_samples[idx]
+
+    def summary(self) -> dict:
+        """The stamped histogram fields (milliseconds; compile in s)."""
+        s = sorted(self._samples)
+        return {
+            "compile_time_s": round(self.compile_time_s or 0.0, 4),
+            "step_time_p50_ms": round(1e3 * self._quantile(s, 0.50), 3),
+            "step_time_p95_ms": round(1e3 * self._quantile(s, 0.95), 3),
+            "step_time_max_ms": round(1e3 * self._running_max, 3),
+            "steps_timed": self._count,
+        }
+
+
+def emit(rec: dict, kind: str = "bench", stream=None) -> dict:
+    """Stamp (schema_version, kind, watchdog backend state) and print one
+    JSON line. Returns the stamped record (benches reuse it for totals).
+    Keys already present win — a bench that carries its own backend
+    timeline is not overwritten."""
+    stamped = schema.stamp(rec, kind=kind)
+    for k, v in watchdog.backend_record().items():
+        stamped.setdefault(k, v)
+    print(json.dumps(stamped), file=stream or sys.stdout, flush=True)
+    return stamped

@@ -1,0 +1,223 @@
+"""Backend-liveness watchdog: the shell `watcher.log` promoted to a module.
+
+Round 5's TPU tunnel flapped down for ~60 seconds mid-session and nothing
+structured recorded it — the only evidence was a hand-tailed shell log, so
+the driver's "backend-init-unavailable" records carried no outage timeline.
+This heartbeat wraps `probe_device_count` (the throwaway-subprocess probe
+that survives a WEDGED plugin — in-process `jax.devices()` hangs forever in
+that state) and stamps every state transition as a schema-versioned
+"watchdog" event into the same JSONL stream the trainer/bench records ride.
+
+States: unknown -> up/down on the first probe; up <-> down on changes; and
+`flapping` when >= flap_threshold transitions land inside flap_window_s
+(the round-5 signature: a backend that answers, dies, answers again — worse
+than plainly down, because half your queue steps dispatch into the gap).
+
+A process-global watchdog (set_global_watchdog) lets every sink stamp the
+current backend state without threading a handle through every call:
+`backend_record()` is what trainers/benches merge into their records.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional
+
+from glom_tpu.telemetry import schema
+
+STATES = schema.WATCHDOG_STATES  # ("unknown", "up", "down", "flapping")
+
+
+def _default_probe(timeout: float) -> Optional[int]:
+    # Deferred import: utils.metrics is the probe's home and imports this
+    # package for record stamping — a top-level import would cycle.
+    from glom_tpu.utils.metrics import probe_device_count
+
+    return probe_device_count(timeout=timeout)
+
+
+class BackendWatchdog:
+    """Heartbeat over the backend-init probe with transition stamping.
+
+    `probe(timeout) -> Optional[int]` returns the visible device count or
+    None (init failed/hung). `writer` (anything with .write(dict), e.g.
+    MetricsWriter) receives one stamped "watchdog" event per transition;
+    the full timeline is also kept in memory for end-of-run records.
+    start() runs probes from a daemon thread every interval_s; probe_once()
+    is the synchronous form the benches use as their fail-fast gate.
+    """
+
+    def __init__(
+        self,
+        *,
+        interval_s: float = 60.0,
+        probe: Optional[Callable[[float], Optional[int]]] = None,
+        probe_timeout: float = 120.0,
+        writer=None,
+        flap_window_s: float = 600.0,
+        flap_threshold: int = 3,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if flap_threshold < 2:
+            raise ValueError("flap_threshold must be >= 2 (a single "
+                             "transition is just up or down)")
+        self.interval_s = interval_s
+        self._probe = probe if probe is not None else _default_probe
+        self.probe_timeout = probe_timeout
+        self.writer = writer
+        self.flap_window_s = flap_window_s
+        self.flap_threshold = flap_threshold
+        self._clock = clock
+        self._t0 = clock()
+        self._lock = threading.Lock()
+        self._state = "unknown"
+        self._devices: Optional[int] = None
+        self._transitions = 0
+        self._transition_times: deque = deque()
+        self._timeline: List[dict] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._probes = 0
+
+    # -- state machine ----------------------------------------------------
+
+    def probe_once(self) -> str:
+        """Run one probe, update the state machine, stamp any transition."""
+        n = self._probe(self.probe_timeout)
+        with self._lock:
+            self._probes += 1
+            self._devices = n
+            raw = "up" if n is not None and n >= 1 else "down"
+            prev = self._state
+            prev_raw = "up" if prev in ("up", "flapping") else prev
+            now = self._clock() - self._t0
+            if raw != prev_raw:
+                self._transitions += 1
+                self._transition_times.append(now)
+                while (
+                    self._transition_times
+                    and now - self._transition_times[0] > self.flap_window_s
+                ):
+                    self._transition_times.popleft()
+                flapping = (
+                    prev != "unknown"
+                    and len(self._transition_times) >= self.flap_threshold
+                )
+                new = "flapping" if flapping and raw == "up" else raw
+                self._record_transition(prev, new, now)
+                self._state = new
+            elif self._state == "flapping" and not self._transition_times:
+                # Flap window drained with no new transitions: settled.
+                self._record_transition("flapping", "up", now)
+                self._state = "up"
+            else:
+                # Re-confirmations age the flap window.
+                while (
+                    self._transition_times
+                    and now - self._transition_times[0] > self.flap_window_s
+                ):
+                    self._transition_times.popleft()
+            return self._state
+
+    def _record_transition(self, prev: str, new: str, t: float) -> None:
+        event = schema.stamp(
+            {
+                "t": round(t, 3),
+                "wall_time_s": round(time.time(), 3),
+                "event": "backend_transition",
+                "prev_state": prev,
+                "backend_state": new,
+                "backend_devices": self._devices,
+                "transitions": self._transitions,
+            },
+            kind="watchdog",
+        )
+        self._timeline.append(event)
+        if self.writer is not None:
+            self.writer.write(event)
+
+    # -- heartbeat thread -------------------------------------------------
+
+    def start(self) -> "BackendWatchdog":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.probe_once()
+                except Exception:
+                    pass  # the watchdog must never take the run down
+                self._stop.wait(self.interval_s)
+
+        self._thread = threading.Thread(
+            target=loop, name="glom-backend-watchdog", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # -- reads ------------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def timeline(self) -> List[dict]:
+        with self._lock:
+            return list(self._timeline)
+
+    def record(self) -> dict:
+        """The fields every metrics/bench record stamps."""
+        with self._lock:
+            return {
+                "backend_state": self._state,
+                "backend_devices": self._devices,
+                "backend_transitions": self._transitions,
+            }
+
+
+# -- process-global registration ------------------------------------------
+
+_GLOBAL: Optional[BackendWatchdog] = None
+
+
+def set_global_watchdog(wd: Optional[BackendWatchdog]) -> None:
+    global _GLOBAL
+    _GLOBAL = wd
+
+
+def get_global_watchdog() -> Optional[BackendWatchdog]:
+    return _GLOBAL
+
+
+def _inprocess_backend_live() -> bool:
+    """Has THIS process already initialized a jax backend successfully?
+    (Private-API peek with a safe fallback: a live in-process backend is
+    the one case where 'up' is certain without spawning a probe.)"""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(getattr(xla_bridge, "_backends", None))
+    except Exception:
+        return False
+
+
+def backend_record() -> dict:
+    """Watchdog fields for a metrics record: the global watchdog's state
+    when one is registered; otherwise 'up' iff a backend is already live
+    in-process (a trainer mid-step IS the liveness proof), else 'unknown'
+    — never a guess."""
+    wd = get_global_watchdog()
+    if wd is not None:
+        return wd.record()
+    return {"backend_state": "up" if _inprocess_backend_live() else "unknown"}

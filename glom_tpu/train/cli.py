@@ -56,6 +56,23 @@ def build_parser() -> argparse.ArgumentParser:
         help="EXPERIMENTAL int8 block-scaled quantized-reduce emulation "
         "(EQuARX-style; changes gradient numerics ~1e-2 rel)",
     )
+    p.add_argument(
+        "--telemetry-level", choices=["off", "scalars", "full"], default=None,
+        help="in-graph diagnostics depth (docs/OBSERVABILITY.md): scalars "
+        "= grad/update/param norms + NaN/Inf guard inside the jitted step; "
+        "full adds per-level consensus agreement (GSPMD/single-device)",
+    )
+    p.add_argument(
+        "--nonfinite-policy", choices=["skip", "warn"], default=None,
+        help="what the NaN/Inf guard does (telemetry on): skip drops the "
+        "poisoned update in-graph, warn applies it and flags the record",
+    )
+    p.add_argument(
+        "--watchdog-interval", type=float, default=0.0, metavar="SECONDS",
+        help="backend-liveness heartbeat: probe backend init in a throwaway "
+        "subprocess every N seconds, stamping up/down/flapping transitions "
+        "into the metrics stream (0 = off)",
+    )
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--data", choices=["shapes", "gaussian"], default="shapes")
     p.add_argument(
@@ -99,8 +116,6 @@ def main(argv=None) -> int:
     if args.debug_nans:
         jax.config.update("jax_debug_nans", True)
 
-    from glom_tpu.data import gaussian_dataset, shapes_dataset
-    from glom_tpu.train import Trainer
     from glom_tpu.utils.metrics import MetricsWriter
     from glom_tpu.utils.presets import get_preset
 
@@ -130,6 +145,10 @@ def main(argv=None) -> int:
         overrides["zero_stage"] = args.zero_stage
     if args.quantized_reduce:
         overrides["quantized_reduce"] = True
+    if args.telemetry_level is not None:
+        overrides["telemetry_level"] = args.telemetry_level
+    if args.nonfinite_policy is not None:
+        overrides["nonfinite_policy"] = args.nonfinite_policy
     if args.seed is not None:
         overrides["seed"] = args.seed
     if overrides:
@@ -139,6 +158,41 @@ def main(argv=None) -> int:
     writer = MetricsWriter(
         args.metrics_file, echo=True, tensorboard_dir=args.tensorboard
     )
+
+    # Backend-liveness heartbeat: transitions (up/down/flapping — round
+    # 5's 60-second flap went unrecorded) land in the SAME stream as the
+    # training records, and every record stamps the current state via the
+    # global registration.
+    wd = None
+    if args.watchdog_interval > 0:
+        from glom_tpu.telemetry.watchdog import (
+            BackendWatchdog,
+            set_global_watchdog,
+        )
+
+        wd = BackendWatchdog(
+            interval_s=args.watchdog_interval, writer=writer
+        )
+        set_global_watchdog(wd)
+        wd.start()
+    # EVERYTHING past the heartbeat start runs under its try/finally: a
+    # setup failure (bad --data-dir, preset error, trainer build) must not
+    # leak a probing daemon thread into in-process callers (tests, CI).
+    try:
+        return _train_body(args, preset, cfg, tcfg, writer)
+    finally:
+        if wd is not None:
+            wd.stop()
+            # Unregister too: a stopped watchdog's last probed state would
+            # otherwise stay frozen on every later record an in-process
+            # caller (tests, CI) writes in this process.
+            set_global_watchdog(None)
+
+
+def _train_body(args, preset, cfg, tcfg, writer) -> int:
+    from glom_tpu.data import gaussian_dataset, shapes_dataset
+    from glom_tpu.train import Trainer
+
     if args.data_dir is not None:
         from glom_tpu.data import file_dataset
 

@@ -68,9 +68,15 @@ def denoise_loss(
     consensus_fn: Optional[ConsensusFn] = None,
     use_pallas: bool = False,
     unroll: bool = False,
+    with_diagnostics: bool = False,
 ) -> jnp.ndarray:
     """MSE between the clean image and the reconstruction from the noised
-    image's top level at iteration `recon_index`."""
+    image's top level at iteration `recon_index`.
+
+    with_diagnostics=True (telemetry_level="full") returns (loss, aux)
+    where aux carries per-level consensus-agreement stats computed from
+    the SAME final state the loss already materializes — one extra [L]
+    reduction, no second forward (telemetry/diagnostics.level_agreement)."""
     T = iters if iters is not None else cfg.default_iters
     k = recon_index if recon_index is not None else default_recon_index(T)
     if not 1 <= k <= T:
@@ -93,7 +99,15 @@ def denoise_loss(
         recon = tokens_to_image(
             params.to_pixels, top.astype(img.dtype), cfg.patch_size, cfg.image_size
         )
-    return jnp.mean((img - recon) ** 2)
+    loss = jnp.mean((img - recon) ** 2)
+    if with_diagnostics:
+        from glom_tpu.telemetry.diagnostics import level_agreement
+
+        # Stop-gradient: the agreement stat is observability, not a term
+        # of the objective — it must not leak into the backward.
+        aux = {"level_agreement": level_agreement(jax.lax.stop_gradient(final))}
+        return loss, aux
+    return loss
 
 
 def reconstruct(

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import optax
 
 from glom_tpu.models.core import ConsensusFn, resolve_vjp_path
+from glom_tpu.telemetry import diagnostics as diag
 from glom_tpu.train.objectives import (
     DenoiseParams,
     default_recon_index,
@@ -85,8 +86,21 @@ def make_lr_schedule(tcfg: TrainConfig):
     )
 
 
+def pinned_grad_accum(tcfg: TrainConfig) -> int:
+    """The microbatch count an EXPLICIT TrainConfig.grad_accum pins, or the
+    single-pass base (1) when None — None is the auto-routing sentinel and
+    only resolve_training_route may raise it. THE single None-resolution
+    source: every numeric use of tcfg.grad_accum (validation, manual-path
+    scans, comm pricing) goes through here so an explicit user value is
+    never silently overridden (ADVICE round 5)."""
+    accum = 1 if tcfg.grad_accum is None else tcfg.grad_accum
+    if accum < 1:
+        raise ValueError(f"grad_accum={tcfg.grad_accum} must be >= 1 or None")
+    return accum
+
+
 def accumulate_grads(loss_fn, params, img, noise, accum: int,
-                     grad_transform=None, grad_init=None):
+                     grad_transform=None, grad_init=None, has_aux=False):
     """Exact microbatch gradient accumulation shared by the single-device,
     GSPMD, and manual-shard_map train steps: STRIDED split (microbatch i
     takes rows i, i+accum, ...) so a batch sharded over a 'data' mesh axis
@@ -105,29 +119,53 @@ def accumulate_grads(loss_fn, params, img, noise, accum: int,
         reduce-scatter); init = zeros under the same constraint.
       * manual ZeRO step: transform = the explicit psum_scatter tree;
         init = zeros at the 1/dp shard shapes (the carry must match the
-        transformed gradients, which is why init is a separate hook)."""
+        transformed gradients, which is why init is a separate hook).
+
+    has_aux=True mirrors jax.value_and_grad(has_aux=True): loss_fn returns
+    (loss, aux) and the call returns ((loss, aux_mean), grads) — the
+    telemetry "full" diagnostics ride the microbatch scan as a mean over
+    microbatches (every aux stat here is itself a mean, so the grouping
+    invariance argument above applies to it too)."""
     imgs = img.reshape(-1, accum, *img.shape[1:]).swapaxes(0, 1)
     noises = noise.reshape(-1, accum, *noise.shape[1:]).swapaxes(0, 1)
 
     def micro(carry, xs):
-        acc_l, acc_g = carry
+        acc_l, acc_aux, acc_g = carry
         mi, mn = xs
-        l, g = jax.value_and_grad(loss_fn)(params, mi, mn)
+        if has_aux:
+            (l, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, mi, mn
+            )
+            acc_aux = jax.tree_util.tree_map(jnp.add, acc_aux, aux)
+        else:
+            l, g = jax.value_and_grad(loss_fn)(params, mi, mn)
         if grad_transform is not None:
             g = grad_transform(g)
-        return (acc_l + l, jax.tree_util.tree_map(jnp.add, acc_g, g)), None
+        return (acc_l + l, acc_aux, jax.tree_util.tree_map(jnp.add, acc_g, g)), None
 
     zeros = (
         grad_init()
         if grad_init is not None
         else jax.tree_util.tree_map(jnp.zeros_like, params)
     )
-    (loss_sum, grads_sum), _ = jax.lax.scan(
-        micro, (jnp.zeros((), jnp.float32), zeros), (imgs, noises)
+    if has_aux:
+        # Abstract-eval one microbatch for the aux accumulator's shapes
+        # (the carry must be built before the scan body ever runs).
+        _, aux_shape = jax.eval_shape(loss_fn, params, imgs[0], noises[0])
+        aux_zeros = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), aux_shape
+        )
+    else:
+        aux_zeros = ()
+    (loss_sum, aux_sum, grads_sum), _ = jax.lax.scan(
+        micro, (jnp.zeros((), jnp.float32), aux_zeros, zeros), (imgs, noises)
     )
-    return loss_sum / accum, jax.tree_util.tree_map(
-        lambda t: t / accum, grads_sum
-    )
+    loss = loss_sum / accum
+    grads = jax.tree_util.tree_map(lambda t: t / accum, grads_sum)
+    if has_aux:
+        aux = jax.tree_util.tree_map(lambda t: t / accum, aux_sum)
+        return (loss, aux), grads
+    return loss, grads
 
 
 def resolve_route_keys(cfg: GlomConfig, tcfg: TrainConfig) -> Tuple[int, int]:
@@ -146,28 +184,45 @@ def resolve_route_keys(cfg: GlomConfig, tcfg: TrainConfig) -> Tuple[int, int]:
 
 
 def resolve_training_route(
-    cfg: GlomConfig, tcfg: TrainConfig, *, custom_consensus: bool = False
+    cfg: GlomConfig,
+    tcfg: TrainConfig,
+    *,
+    custom_consensus: bool = False,
+    scan_only: bool = False,
 ) -> Tuple[int, str]:
     """Effective (grad_accum, vjp_path) for this training config.
 
     The framework must never hand out a below-baseline regime it knows how
     to beat (round-4 batch-128 measured 0.96x vs baseline on the scan path
     while grad_accum=2 over batch-64 microbatches rides the fused-loop VJP
-    at 1.17x): when the user left grad_accum=1 and the full batch misses
+    at 1.17x): when grad_accum is None (auto) and the full batch misses
     the fused loop, try power-of-two microbatch splits and take the first
     that lands on it — the accumulation is exact (accumulate_grads), so
-    this changes the schedule, never the math. An EXPLICIT grad_accum > 1
-    is always honored as given."""
+    this changes the schedule, never the math. An EXPLICIT grad_accum —
+    INCLUDING 1 — is always honored as given (1 is the supported opt-out
+    for the single-pass full-batch step; ADVICE round 5).
+
+    scan_only=True (the GSPMD DistributedTrainer build) excludes the fused
+    loop AND the auto-split that exists only to reach it: the whole-loop
+    Pallas custom_vjp has no partitioning rule, so dispatching it on
+    GSPMD-sharded arrays — which the auto-split's single-chip heuristics
+    evaluated against the GLOBAL batch could do — is a compile failure or
+    full-replication OOM, not a speedup."""
     k, itemsize = resolve_route_keys(cfg, tcfg)
     kw = dict(
         remat=tcfg.remat,
         use_pallas=tcfg.use_pallas,
         itemsize=itemsize,
         custom_consensus=custom_consensus,
+        scan_only=scan_only,
     )
-    accum = tcfg.grad_accum
+    accum = pinned_grad_accum(tcfg)
     path = resolve_vjp_path(cfg, tcfg.batch_size // accum, k, **kw)
-    if accum == 1 and path != "fused_loop":
+    if (
+        tcfg.grad_accum is None
+        and not scan_only
+        and path != "fused_loop"
+    ):
         a = 2
         while a <= 16 and tcfg.batch_size % a == 0 and tcfg.batch_size // a >= 8:
             if resolve_vjp_path(cfg, tcfg.batch_size // a, k, **kw) == "fused_loop":
@@ -230,6 +285,7 @@ def make_train_step(
     zero_stage: int = 0,
     zero_shardings: Optional[ZeroShardings] = None,
     quantized_reduce: Optional[bool] = None,
+    scan_only: bool = False,
 ) -> Callable[[TrainState, jnp.ndarray, jax.Array], Tuple[TrainState, dict]]:
     """Build the pure train step. Noise is generated ON DEVICE from the rng
     (no host->device transfer of noise tensors).
@@ -259,12 +315,23 @@ def make_train_step(
     quantizes each replica's local contribution before its explicit
     psum_scatter (the more faithful send-side form). Both are one
     quantization hop; comm_volume_model prices the hypothetical real
-    quantized collective, not the emulation's op placement."""
+    quantized collective, not the emulation's op placement.
+
+    scan_only=True (the GSPMD DistributedTrainer build) keeps both the
+    fused-loop dispatch AND the auto grad-accum off this step — the Pallas
+    whole-loop custom_vjp is illegal on GSPMD-sharded arrays.
+
+    tcfg.telemetry_level != "off" adds the in-graph diagnostics
+    (telemetry/diagnostics.py): grad/update/param norms and the NaN/Inf
+    guard on EVERY variant including the fast one (a guard that only runs
+    on logging steps misses 9 of every 10 anomalies), plus per-level
+    consensus agreement and the quantization-error probe at "full"."""
     if tcfg.compute_dtype not in ("float32", "bfloat16"):
         raise ValueError(
             f"compute_dtype={tcfg.compute_dtype!r}: must be 'float32' or 'bfloat16'"
         )
-    if tcfg.grad_accum < 1 or tcfg.batch_size % tcfg.grad_accum != 0:
+    pinned = pinned_grad_accum(tcfg)
+    if tcfg.batch_size % pinned != 0:
         raise ValueError(
             f"grad_accum={tcfg.grad_accum} must divide batch_size="
             f"{tcfg.batch_size}"
@@ -275,13 +342,16 @@ def make_train_step(
     # the decision is static, exposed on the returned fn (.grad_accum /
     # .vjp_path), and logged by the trainers next to sp_strategy.
     grad_accum, vjp_path = resolve_training_route(
-        cfg, tcfg, custom_consensus=consensus_fn is not None
+        cfg, tcfg, custom_consensus=consensus_fn is not None,
+        scan_only=scan_only,
     )
     quantized = (
         bool(tcfg.quantized_reduce)
         if quantized_reduce is None
         else quantized_reduce
     )
+    level = diag.resolve_telemetry_level(tcfg)
+    full = level == "full"
 
     def loss_of(params, img, noise):
         return denoise_loss(
@@ -296,6 +366,7 @@ def make_train_step(
             consensus_fn=consensus_fn,
             use_pallas=tcfg.use_pallas,
             unroll=tcfg.scan_unroll,
+            with_diagnostics=full,
         )
 
     def train_step(state: TrainState, img: jnp.ndarray, rng: jax.Array):
@@ -316,14 +387,27 @@ def make_train_step(
             else:
                 gkw = {}
             loss, grads = accumulate_grads(
-                loss_of, state.params, img, noise, grad_accum, **gkw
+                loss_of, state.params, img, noise, grad_accum,
+                has_aux=full, **gkw
             )
         else:
-            loss, grads = jax.value_and_grad(loss_of)(state.params, img, noise)
+            loss, grads = jax.value_and_grad(loss_of, has_aux=full)(
+                state.params, img, noise
+            )
+        aux = None
+        if full:
+            loss, aux = loss
+        metrics = {}
         if quantized:
             from glom_tpu.parallel.quantized import quantize_dequantize
 
-            grads = jax.tree_util.tree_map(quantize_dequantize, grads)
+            dq = jax.tree_util.tree_map(quantize_dequantize, grads)
+            if level != "off":
+                # EQuARX wire-hop accuracy probe: what one quantized ride
+                # cost THIS step's gradient, on the record next to the
+                # loss it perturbs.
+                metrics["quant_rel_err"] = diag.quantization_error(grads, dq)
+            grads = dq
         if zero_stage >= 1 and zero_shardings is not None:
             # Reduce-scatter: the cross-replica grad reduction lands each
             # leaf already split on its zero_shard_axis.
@@ -336,9 +420,29 @@ def make_train_step(
             params = jax.lax.with_sharding_constraint(
                 params, zero_shardings.params
             )
-        metrics = {"loss": loss, "step": state.step}
+        metrics.update({"loss": loss, "step": state.step})
+        if with_grad_norm or level != "off":
+            grad_norm = optax.global_norm(grads)
         if with_grad_norm:
-            metrics["grad_norm"] = optax.global_norm(grads)
+            metrics["grad_norm"] = grad_norm
+        if level != "off":
+            taps = diag.scalar_taps(
+                loss=loss, grad_norm=grad_norm, updates=updates, params=params
+            )
+            nonfinite = taps.pop("nonfinite")
+            if tcfg.nonfinite_policy == "skip":
+                # Drop the poisoned update in-graph: params AND optimizer
+                # state keep their previous values; the step counter still
+                # advances so schedules/logs stay aligned.
+                params = diag.guard_update(nonfinite, params, state.params)
+                opt_state = diag.guard_update(
+                    nonfinite, opt_state, state.opt_state
+                )
+                metrics["skipped_nonfinite"] = nonfinite.astype(jnp.int32)
+            metrics.update(taps)
+            metrics["nonfinite_step"] = nonfinite.astype(jnp.int32)
+            if full and aux is not None:
+                metrics["level_agreement"] = aux["level_agreement"]
         return TrainState(params, opt_state, state.step + 1), metrics
 
     # Static routing facts for the trainers' metric records (strings can't
@@ -346,6 +450,14 @@ def make_train_step(
     train_step.grad_accum = grad_accum
     train_step.vjp_path = vjp_path
     return train_step
+
+
+def _jsonable(v):
+    """Metrics-record value -> JSON scalar (strings/bools/None pass
+    through; device scalars fetch)."""
+    if v is None or isinstance(v, (str, bool)):
+        return v
+    return float(v)
 
 
 def fit_loop(
@@ -356,26 +468,89 @@ def fit_loop(
     log_every: int = 10,
     metrics_writer=None,
     step_fast: Optional[Callable[[Any], dict]] = None,
+    compile_tracker: Optional[set] = None,
 ) -> list[dict]:
     """Shared training loop: pull batches, step, log every `log_every`.
     Used by both the single-device Trainer and the DistributedTrainer.
     step_fast (when given) runs the non-logging iterations — the variant
-    without observability-only work (grad-norm sweep)."""
+    without observability-only work (grad-norm sweep).
+
+    Every logging record is a schema-stamped "train_step" event carrying
+    the step-time histogram (compile split out per jit variant — see
+    sinks.StepTimeStats for the async-dispatch reading of p50 vs p95); a
+    step flagged non-finite by the in-graph guard emits a structured
+    "anomaly" event into the metrics stream at the next logging step. The
+    flags of NON-logging steps are kept as device scalars and fetched
+    only at the log boundary (by then they are long computed, so the
+    fetch adds no pipeline stall and every incident is reported — not
+    just the ones landing on a logging step). The returned history stays
+    homogeneous train_step records (consumers index loss/steps_per_sec);
+    anomaly events go to the writer only.
+
+    compile_tracker: pass a PERSISTENT set when calling fit_loop more than
+    once over the same jitted steps (the trainers do — fit() per
+    checkpoint span): the jit cache is warm in span 2+, and a fresh
+    tracker would mislabel each span's first steps as compiles, faking a
+    compile_time_s and dropping real samples from the percentiles."""
+    from glom_tpu.telemetry import schema
+    from glom_tpu.telemetry.sinks import StepTimeStats
+
     history = []
+    stats = StepTimeStats()
+    # Which jit variant's compile step was seen, keyed by role (bound
+    # methods get fresh ids per access, so identity keys wouldn't survive
+    # a second fit() call even with a shared tracker).
+    compiled = compile_tracker if compile_tracker is not None else set()
+    pending_flags = []  # (step index, device-scalar nonfinite flag)
     t0 = time.perf_counter()
     for i in range(num_steps):
         logging_step = (i + 1) % log_every == 0 or i == num_steps - 1
-        fn = step if (logging_step or step_fast is None) else step_fast
-        metrics = fn(next(data))
+        use_full = logging_step or step_fast is None
+        fn = step if use_full else step_fast
+        key = "step" if use_full else "step_fast"
+        first_call = key not in compiled
+        compiled.add(key)
+        # Pull the batch BEFORE the timer: host data-generation time is a
+        # data-pipeline signal, not step time — folding it in would make a
+        # loader stall read as a step/compile regression on every record.
+        batch = next(data)
+        t_step = time.perf_counter()
+        metrics = fn(batch)
+        # Each jit variant's first call is trace+compile — both the fast
+        # step's (iteration 0) and the logging step's (first log boundary)
+        # — and must not pollute the steady-state percentiles.
+        stats.observe(time.perf_counter() - t_step, is_compile=first_call)
+        if "nonfinite_step" in metrics and not logging_step:
+            pending_flags.append((i, metrics["nonfinite_step"]))
         if logging_step:
-            metrics = {
-                k: (v if isinstance(v, str) else float(v))
-                for k, v in metrics.items()
-            }
+            metrics = diag.split_level_agreement(metrics)
+            metrics = {k: _jsonable(v) for k, v in metrics.items()}
             metrics["steps_per_sec"] = (i + 1) / (time.perf_counter() - t0)
-            history.append(metrics)
+            metrics.update(stats.summary())
+            rec = schema.stamp(metrics, kind="train_step")
+            history.append(rec)
             if metrics_writer is not None:
-                metrics_writer.write(metrics)
+                metrics_writer.write(rec)
+            flagged = [k for k, v in pending_flags if float(v)]
+            pending_flags = []
+            if rec.get("nonfinite_step"):
+                flagged.append(i)
+            if flagged and metrics_writer is not None:
+                anomaly = schema.stamp(
+                    {
+                        "step": rec.get("step", float(i)),
+                        "reason": "nonfinite_loss_or_grad",
+                        "policy": (
+                            "skip" if "skipped_nonfinite" in rec else "warn"
+                        ),
+                        "count": len(flagged),
+                        "flagged_iterations": flagged,
+                        "loss": rec.get("loss"),
+                        "grad_norm": rec.get("grad_norm"),
+                    },
+                    kind="anomaly",
+                )
+                metrics_writer.write(anomaly)
     return history
 
 
@@ -407,6 +582,7 @@ class Trainer:
         # row the distributed records are compared against.
         self.zero_stage = resolve_zero_stage(tcfg, 1)
         self.quantized_reduce = resolve_quantized_reduce(tcfg, 1)
+        self.telemetry_level = diag.resolve_telemetry_level(tcfg)
         step_fn = make_train_step(
             cfg, tcfg, self.optimizer, consensus_fn=consensus_fn,
             quantized_reduce=self.quantized_reduce,
@@ -422,6 +598,7 @@ class Trainer:
         self._static_record = {
             "zero_stage": self.zero_stage,
             "quantized_reduce": self.quantized_reduce,
+            "telemetry_level": self.telemetry_level,
             **mem,
             **comm_volume_model(
                 mem["grads_bytes_per_replica"],
@@ -438,15 +615,23 @@ class Trainer:
         )
         self._step_fast = jax.jit(fast_fn, donate_argnums=(0,))
         self.metrics_writer = metrics_writer
+        # Persistent across fit() calls: span 2+ of a checkpointed run is
+        # warm, and its first steps are steady-state samples, not compiles.
+        self._compile_tracker = set()
 
     def _annotate(self, metrics) -> dict:
         """Static routing facts, attached OUTSIDE jit (strings can't ride
         the compiled metrics dict) — a run's records must name the backward
-        it actually used (same discipline as sp_strategy)."""
+        it actually used (same discipline as sp_strategy). Watchdog backend
+        state rides every record too (a dict read; the probe itself lives
+        in the global watchdog, not here)."""
+        from glom_tpu.telemetry.watchdog import backend_record
+
         metrics = dict(metrics)
         metrics["vjp_path"] = self.vjp_path
         metrics["grad_accum"] = self.grad_accum
         metrics.update(self._static_record)
+        metrics.update(backend_record())
         return metrics
 
     def step(self, batch) -> dict:
@@ -490,4 +675,5 @@ class Trainer:
             log_every=log_every,
             metrics_writer=self.metrics_writer,
             step_fast=self.step_fast,
+            compile_tracker=self._compile_tracker,
         )

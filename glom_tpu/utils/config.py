@@ -115,7 +115,12 @@ class TrainConfig:
     # scan value_and_grad over them accumulating gradients, ONE optimizer
     # update — trains an effective batch grad_accum x larger than what
     # fits in HBM at once (batch_size must divide evenly).
-    grad_accum: int = 1
+    # None (the default) = AUTO-ROUTE: resolve_training_route may split the
+    # batch when exact accumulation recovers the fused-loop VJP. An explicit
+    # value — INCLUDING 1 — is pinned and never overridden, so a user who
+    # wants the single-pass full-batch step (memory/latency A/B) sets
+    # grad_accum=1 (docs/PARALLELISM.md, "Opting out of auto grad-accum").
+    grad_accum: Optional[int] = None
     noise_std: float = 1.0
     # Which stacked iteration's top level feeds the reconstruction head.
     # Reference README uses index 7 for L=6/T=12 (mid-iteration top level).
@@ -146,6 +151,27 @@ class TrainConfig:
     # quantizes inside XLA's collective; that needs a compiler hook).
     # Changes numerics (~1e-2 relative on gradients); never on by default.
     quantized_reduce: bool = False
+    # Telemetry depth (glom_tpu/telemetry, docs/OBSERVABILITY.md):
+    #   "off"     — no in-graph diagnostics beyond the loss (the sustained-
+    #               throughput default; static analytics still stamped);
+    #   "scalars" — per-step grad/update/param norms + a NaN/Inf guard,
+    #               computed INSIDE the jitted step (one fused reduction),
+    #               plus measured collective counters on the manual path;
+    #   "full"    — scalars + per-level consensus-agreement stats (GSPMD /
+    #               single-device paths; the manual shard_map path degrades
+    #               to "scalars" loudly — the resolved level is stamped).
+    # Resolution is telemetry.diagnostics.resolve_telemetry_level — the
+    # single source both trainers stamp into every metrics record.
+    telemetry_level: str = "off"
+    # What the NaN/Inf guard does when a step produces a non-finite loss or
+    # gradient (active only when telemetry_level != "off"):
+    #   "skip" — the update is dropped in-graph (params/opt state keep
+    #            their previous values; the step counter still advances)
+    #            and the record carries skipped_nonfinite=1;
+    #   "warn" — the update is applied as-is, the record just flags it.
+    # Either way fit_loop emits a structured "anomaly" event at the next
+    # logging step.
+    nonfinite_policy: str = "skip"
     # Unroll the T-iteration scan into straight-line code. Removes the
     # residual-stack dynamic-slice bookkeeping scan autodiff pays per
     # iteration (~3-5% step time at the flagship config on v5e, measured

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 from pathlib import Path
 from typing import Optional
@@ -260,6 +261,12 @@ def comm_volume_model(
 class MetricsWriter:
     """Append-only JSONL metrics log, one dict per line, with wall time.
 
+    Every record is stamped with the versioned event schema
+    (glom_tpu/telemetry/schema.py: schema_version + kind, inferred when
+    the caller didn't stamp) — trainer metrics, watchdog transitions, and
+    bench rows all validate against the same contract, which is what lets
+    `python -m glom_tpu.telemetry.schema` lint any artifact of record.
+
     `tensorboard_dir` additionally mirrors numeric scalars to TensorBoard
     via clu.metric_writers (XProf/TensorBoard is the stack's native UI);
     records carrying a `step` key are written at that step, others at an
@@ -272,10 +279,16 @@ class MetricsWriter:
         echo: bool = True,
         tensorboard_dir: Optional[str] = None,
     ):
+        import threading
+
         self.path = Path(path) if path else None
         self.echo = echo
         self._t0 = time.time()
         self._seq = 0
+        # The watchdog heartbeat thread writes transition events into the
+        # same stream as the training loop's records — serialize writes
+        # so no JSONL row can interleave mid-line.
+        self._lock = threading.Lock()
         if self.path:
             self.path.parent.mkdir(parents=True, exist_ok=True)
             self._fh = self.path.open("a")
@@ -293,23 +306,30 @@ class MetricsWriter:
             self._tb = metric_writers.SummaryWriter(tensorboard_dir)
 
     def write(self, metrics: dict):
-        rec = {"wall_time": round(time.time() - self._t0, 3), **metrics}
+        from glom_tpu.telemetry import schema
+
+        rec = schema.stamp({"wall_time": round(time.time() - self._t0, 3), **metrics})
         line = json.dumps(rec)
-        if self._fh:
-            self._fh.write(line + "\n")
-            self._fh.flush()
-        if self.echo:
-            print(line)
+        with self._lock:
+            if self._fh:
+                self._fh.write(line + "\n")
+                self._fh.flush()
+            if self.echo:
+                sys.stdout.write(line + "\n")
+                sys.stdout.flush()
         if self._tb is not None:
             scalars = {
                 k: float(v)
                 for k, v in rec.items()
-                if isinstance(v, (int, float)) and not isinstance(v, bool)
+                if isinstance(v, (int, float))
+                and not isinstance(v, bool)
+                and k != "schema_version"  # constant stamp, not a signal
             }
-            step = int(scalars.pop("step", self._seq))
-            self._seq = step + 1
-            if scalars:
-                self._tb.write_scalars(step, scalars)
+            with self._lock:
+                step = int(scalars.pop("step", self._seq))
+                self._seq = step + 1
+                if scalars:
+                    self._tb.write_scalars(step, scalars)
 
     def close(self):
         if self._fh:

@@ -74,6 +74,20 @@ done
 #    with the dp=64 pod projection in docs/PARALLELISM.md (ZeRO section).
 step pod_zero_record 1800 python -u bench_train.py --preset imagenet224-pod --batch 16 --mult 2
 
+# 9. Telemetry overhead A/B on the real chip (the < 2% per-step bar for
+#    telemetry_level=scalars; docs/OBSERVABILITY.md) — if this exceeds
+#    budget on hardware, the scalars bundle needs a diet before the
+#    always-on rollout.
+step telemetry_ab 1800 python -u bench_train.py --telemetry-ab
+
+# 10. Schema lint: every JSON row this queue produced must validate
+#     against the versioned event schema (glom_tpu/telemetry/schema.py).
+#     Shell noise in the logs is skipped; --allow-unstamped because the
+#     sp_crossover/scratch harnesses still emit legacy unstamped rows —
+#     the bench.py/bench_train.py/bench_zero.py rows are all stamped and
+#     validate strictly (CI enforces that on every push).
+step schema_lint 300 python -m glom_tpu.telemetry --allow-unstamped results/hw_queue/*.log
+
 log "queue complete — paste numbers into results/profiles/PROFILE.md, "
 log "docs/PARALLELISM.md (pod anchor + ZeRO table), results/batch_curve.jsonl,"
 log "and re-run: python -m pytest tests/test_parallel.py tests/test_zero.py -q"

@@ -379,6 +379,10 @@ class TestBackendProbe:
         )
         assert metrics.probe_device_count() == 8
 
+    @pytest.mark.slow  # spawns a REAL backend-init subprocess: in the
+    # wedged-TPU image it burns the full 45 s timeout on every run, and
+    # even healthy CI pays a backend cold-start; the monkeypatched
+    # failure-mode tests below keep every code path in tier-1
     def test_live_probe_never_raises(self):
         """Against the REAL image env (where a sitecustomize hook
         pre-registers the TPU plugin): whatever the backend state — cpu

@@ -131,6 +131,18 @@ class TestUlyssesConsensus:
             checked += 1
         assert checked >= 4  # the table must actually constrain the model
 
+    def test_selector_boundary_n2048_keeps_ring(self):
+        """The exactly-at-budget point n=2048 (n^2*4 = 16MB) is UNMEASURED
+        — the committed table brackets the flip between n=1024 and n=4096
+        — so the predicate must stay STRICT and keep the prior ring
+        behavior there until an sp_crossover row for 2048 lands (ADVICE
+        round 5, low: `<=` silently flipped the unmeasured boundary)."""
+        from glom_tpu.parallel.runtime import ulysses_preferred
+
+        assert ulysses_preferred(1024)        # measured: Ulysses side
+        assert not ulysses_preferred(2048)    # unmeasured boundary: ring
+        assert not ulysses_preferred(4096)    # measured: ring side
+
 
 class TestHaloConsensus:
     def test_matches_dense_local(self):

@@ -183,6 +183,53 @@ class TestVjpRouting:
         accum, path = resolve_training_route(self.FLAGSHIP, tcfg)
         assert accum == 4 and path == "fused_loop"
 
+    def test_explicit_accum_one_is_pinned(self, on_tpu):
+        """grad_accum=1 EXPLICIT is the supported auto-routing opt-out
+        (ADVICE round 5): batch 128 with pinned accum=1 must ship the
+        single-pass scan step, NOT the auto-split that None (the default)
+        would route to."""
+        import dataclasses
+
+        from glom_tpu.train.trainer import resolve_training_route
+
+        auto = TrainConfig(
+            batch_size=128, use_pallas=True, compute_dtype="bfloat16"
+        )
+        assert auto.grad_accum is None  # the default IS the auto sentinel
+        assert resolve_training_route(self.FLAGSHIP, auto) == (2, "fused_loop")
+        pinned = dataclasses.replace(auto, grad_accum=1)
+        accum, path = resolve_training_route(self.FLAGSHIP, pinned)
+        assert accum == 1 and path.startswith("scan_")
+
+    def test_scan_only_excludes_fused_loop_and_auto_accum(self, on_tpu):
+        """The GSPMD DistributedTrainer build passes scan_only=True
+        (ADVICE round 5, medium): the whole-loop Pallas custom_vjp has no
+        GSPMD partitioning rule, so the sharded step must neither resolve
+        to it nor auto-split the global batch chasing it — even at shapes
+        where the single-chip heuristics WOULD fuse."""
+        from glom_tpu.train.trainer import (
+            create_train_state,
+            make_train_step,
+            resolve_training_route,
+        )
+
+        tcfg = TrainConfig(
+            batch_size=128, use_pallas=True, compute_dtype="bfloat16"
+        )
+        # sanity: without scan_only this shape auto-routes to the loop
+        assert resolve_training_route(self.FLAGSHIP, tcfg) == (2, "fused_loop")
+        accum, path = resolve_training_route(
+            self.FLAGSHIP, tcfg, scan_only=True
+        )
+        assert accum == 1 and path.startswith("scan_")
+        # and the built step fn (no arrays materialized) reports the same
+        _, opt = create_train_state(
+            jax.random.PRNGKey(0), CFG, TrainConfig(batch_size=4, iters=2,
+                                                    recon_iter_index=2)
+        )
+        step = make_train_step(self.FLAGSHIP, tcfg, opt, scan_only=True)
+        assert step.grad_accum == 1 and step.vjp_path.startswith("scan_")
+
     def test_trainer_metrics_carry_route(self):
         """Off-TPU everything resolves to scan_dense — but the route must
         still be stamped into every step's metrics next to the loss."""
