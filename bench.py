@@ -108,56 +108,49 @@ def main():
     )
 
 
-def _fail_fast_if_backend_down():
-    """Never record a dead zero for a measurable host.
-
-    Round 4's BENCH_r04.json recorded rc=1 with a raw traceback tail and
-    parsed=null because a wedged axon plugin blew up inside jax.devices();
-    round 5's fail-fast guard then recorded value 0.0 — a parseable line,
-    but an empty bench trajectory. The probes now ride the telemetry
-    watchdog (telemetry/watchdog.py): each runs in a throwaway subprocess
-    (a wedged plugin HANGS, which cannot be caught in-process), every
-    state transition is stamped as a schema-versioned watchdog event, and
-    the watchdog stays globally registered so every subsequent bench line
-    carries the backend state. When the default backend fails, retry with
-    JAX_PLATFORMS=cpu and — if CPU initializes — fall through to the
-    labelled "(cpu-fallback)" measurement instead of emitting zero. Only
-    when even the CPU backend cannot initialize does the explicit
-    UNMEASURED zero line remain — now carrying the full outage timeline
-    instead of a bare error string."""
-    import os
-
-    from glom_tpu.telemetry.watchdog import BackendWatchdog, set_global_watchdog
-    from glom_tpu.utils.metrics import apply_env_platform
-
-    wd = BackendWatchdog(probe_timeout=120.0)
-    set_global_watchdog(wd)
-    if wd.probe_once() == "down":
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        if wd.probe_once() == "down":
-            emit(
-                {
-                    "metric": "train_step column_iters_per_sec_per_chip "
-                    "(UNMEASURED: jax backend init failed or hung)",
-                    "value": 0.0,
-                    "unit": "column-iters/s/chip",
-                    "vs_baseline": 0.0,
-                    "error": "backend-init-unavailable",
-                    "watchdog_timeline": wd.timeline(),
-                }
-            )
-            raise SystemExit(0)
-    # A successful probe validated the platform JAX_PLATFORMS names (the
-    # probe honors it at config level); mirror it here so main() cannot
-    # initialize a different — possibly wedged — backend past the guard.
-    apply_env_platform()
-
-
 if __name__ == "__main__":
-    _fail_fast_if_backend_down()
-    main()
-    # The train-step metric is the one BASELINE.md names (>=70% MFU is a
-    # TRAINING bar); print it last so the driver's tail-parse records it.
-    from bench_train import bench_train_step
+    # Never record a dead zero for a measurable host. Round 4's
+    # BENCH_r04.json recorded rc=1 with a raw traceback tail; round 5's
+    # fail-fast guard then recorded value 0.0 — a parseable line, but an
+    # empty bench trajectory that downstream tooling ingested as a real
+    # zero. bench_bootstrap (telemetry/sinks.py) probes through the
+    # watchdog (throwaway subprocess — a wedged plugin hangs in-process),
+    # downgrades to the labelled CPU fallback when the default platform is
+    # down, and on total failure emits ONE schema-v2 "error" record
+    # (value null + the outage timeline) that the compare gate treats as
+    # MISSING, not zero.
+    import argparse
 
-    bench_train_step()
+    from glom_tpu.telemetry.sinks import bench_bootstrap, emit as _emit
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--trace-dir", default=None, metavar="DIR",
+        help="capture an XProf trace of the measured chains into DIR "
+        "(whole-measurement window; the chained fori_loop has no per-step "
+        "boundary to cut at)",
+    )
+    args = ap.parse_args()
+    if not bench_bootstrap("train_step column_iters_per_sec_per_chip"):
+        raise SystemExit(0)
+
+    def _run():
+        main()
+        # The train-step metric is the one BASELINE.md names (>=70% MFU is
+        # a TRAINING bar); print it last so the driver's tail-parse
+        # records it.
+        from bench_train import bench_train_step
+
+        bench_train_step()
+
+    if args.trace_dir:
+        from glom_tpu.tracing.capture import trace
+
+        with trace(args.trace_dir):
+            _run()
+        _emit(
+            {"note": "xla-trace captured", "trace_dir": args.trace_dir},
+            kind="note",
+        )
+    else:
+        _run()

@@ -22,8 +22,11 @@ per-op = (t_chain - t_rtt) / k with an auto-calibrated chain length — see
 glom_tpu/utils/timing.py), except the chain length adapts per variant
 because op costs here span µs..ms.
 
-Writes one JSON line per measurement to stdout and appends them to
-results/longctx_bench.jsonl.
+Writes one schema-stamped JSON line per measurement to stdout (kind
+"bench"; failed rows — OOM, compile errors — are kind "error" with value
+null, never a fake number) and appends them to results/longctx_bench.jsonl.
+Every row carries the watchdog backend state (bench_bootstrap registers it
+before any backend touch).
 """
 
 import argparse
@@ -33,6 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from glom_tpu.kernels.consensus_update import _xla_reference, fused_consensus_update
+from glom_tpu.telemetry.sinks import emit
 from glom_tpu.utils.metrics import detect_chip
 from glom_tpu.utils.timing import calibrated_chain_time
 
@@ -137,21 +141,34 @@ def main(only_sides=None, batch=1):
         ]
         for radius in (0.0, 7.0):
             for name, op, mult in variants:
+                label = (
+                    f"longctx {name} (n={side * side}, radius={radius:g}, "
+                    f"B={B}, {chip})"
+                )
                 try:
                     rec = bench_variant(
                         name, op, levels, bu, td, side, radius, repeats,
                         flops_mult=mult,
                     )
+                    rec.update(
+                        metric=label, value=rec["ms_per_call"], unit="ms/call"
+                    )
+                    kind = "bench"
                 except Exception as e:  # noqa: BLE001 - record OOM/compile fails
-                    rec = {"impl": name, "n": side * side, "radius": radius,
+                    # An unmeasurable row is an "error" record with value
+                    # null — the compare gate reads it as MISSING, never as
+                    # a zero or an infinitely-fast kernel.
+                    rec = {"metric": label, "value": None, "unit": "ms/call",
+                           "impl": name, "n": side * side, "radius": radius,
                            "error": f"{type(e).__name__}: {e}"[:200]}
+                    kind = "error"
                 rec["chip"] = chip
-                print(json.dumps(rec))
+                stamped = emit(rec, kind=kind)
                 if on_tpu:
                     # append-as-you-go: a tunnel hiccup mid-run must not
                     # lose the completed measurements
                     with open("results/longctx_bench.jsonl", "a") as f:
-                        f.write(json.dumps(rec) + "\n")
+                        f.write(json.dumps(stamped) + "\n")
 
 
 if __name__ == "__main__":
@@ -164,5 +181,21 @@ if __name__ == "__main__":
         "--batch", type=int, default=1,
         help="batch size B (the batched long-row regime record)",
     )
+    ap.add_argument(
+        "--trace-dir", default=None, metavar="DIR",
+        help="capture an XProf trace of the measured chains into DIR",
+    )
     args = ap.parse_args()
-    main(args.sides, batch=args.batch)
+    from glom_tpu.telemetry.sinks import bench_bootstrap
+
+    if not bench_bootstrap("longctx consensus ms_per_call", "ms/call"):
+        raise SystemExit(0)
+    if args.trace_dir:
+        from glom_tpu.tracing.capture import trace
+
+        with trace(args.trace_dir):
+            main(args.sides, batch=args.batch)
+        emit({"note": "xla-trace captured", "trace_dir": args.trace_dir},
+             kind="note")
+    else:
+        main(args.sides, batch=args.batch)

@@ -15,7 +15,8 @@ side of the claim, which is the mechanism behind it:
 Total device FLOPs are identical (2 * n^2/seq * L * d per einsum either
 way); the difference is pure matmul granularity + online-softmax
 overhead — measured here per (n, seq, L) on the real chip, bf16, B=1.
-Appends JSONL rows to results/sp_crossover.jsonl.
+Appends schema-stamped JSONL rows (kind "bench", watchdog backend state
+riding every row via bench_bootstrap) to results/sp_crossover.jsonl.
 """
 
 import json
@@ -25,6 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from glom_tpu.ops.consensus import consensus_attention
+from glom_tpu.telemetry.sinks import emit
 from glom_tpu.utils.helpers import l2norm
 from glom_tpu.utils.metrics import detect_chip
 from glom_tpu.utils.timing import calibrated_chain_time
@@ -114,17 +116,43 @@ def main():
             jax.jit(uly_chain), levels, repeats=4, calib_k=8, target_s=2.5
         )
         rec = {
+            "metric": (
+                f"sp_crossover ulysses_speedup (n={n}, L={L}, seq={seq}, "
+                f"d={d}, B={B}, {chip})"
+            ),
+            "value": round(t_ring / t_uly, 3),
+            "unit": "x",
             "n": n, "L": L, "seq": seq, "d": d, "B": B,
             "ring_compute_ms": round(t_ring * 1e3, 4),
             "ulysses_compute_ms": round(t_uly * 1e3, 4),
             "ulysses_speedup": round(t_ring / t_uly, 3),
             "chip": chip,
         }
-        print(json.dumps(rec))
+        stamped = emit(rec)
         if on_tpu:
             with open("results/sp_crossover.jsonl", "a") as f:
-                f.write(json.dumps(rec) + "\n")
+                f.write(json.dumps(stamped) + "\n")
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    from glom_tpu.telemetry.sinks import bench_bootstrap
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--trace-dir", default=None, metavar="DIR",
+        help="capture an XProf trace of the measured chains into DIR",
+    )
+    args = ap.parse_args()
+    if not bench_bootstrap("sp_crossover ulysses_speedup", "x"):
+        raise SystemExit(0)
+    if args.trace_dir:
+        from glom_tpu.tracing.capture import trace
+
+        with trace(args.trace_dir):
+            main()
+        emit({"note": "xla-trace captured", "trace_dir": args.trace_dir},
+             kind="note")
+    else:
+        main()

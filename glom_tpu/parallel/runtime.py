@@ -532,6 +532,10 @@ class DistributedTrainer:
                 comm_drift(measured, self._static_record)
             )
 
+        from glom_tpu.tracing.memory import model_live_bytes_total
+
+        self._model_live_bytes = model_live_bytes_total(self._static_record)
+
     def step(self, batch: np.ndarray):
         # device_put on the host array shards directly host->devices in one
         # transfer (no staging of the full batch on device 0 first); a no-op
@@ -562,6 +566,17 @@ class DistributedTrainer:
         self.state, metrics = self._step_fast(self.state, batch, step_rng)
         return self._annotate(metrics)
 
+    def _memory_record(self) -> dict:
+        """Live HBM watermarks (device 0 of the mesh) reconciled against
+        the analytic PER-REPLICA live-bytes model — the measured
+        counterpart of the `*_bytes_per_replica` keys, same discipline as
+        the collective counters' comm_model_drift."""
+        from glom_tpu.tracing.memory import memory_record
+
+        return memory_record(
+            self._model_live_bytes, device=self.mesh.devices.flat[0]
+        )
+
     def fit(
         self,
         data: Iterator,
@@ -569,6 +584,7 @@ class DistributedTrainer:
         *,
         log_every: int = 10,
         prefetch: int = 0,
+        trace_capture=None,
     ) -> list[dict]:
         """prefetch > 0 stages that many upcoming batches SHARDED on their
         target devices from a background thread (the step's device_put then
@@ -592,4 +608,6 @@ class DistributedTrainer:
             metrics_writer=self.metrics_writer,
             step_fast=self.step_fast,
             compile_tracker=self._compile_tracker,
+            trace_capture=trace_capture,
+            memory_probe=self._memory_record,
         )

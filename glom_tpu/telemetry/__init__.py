@@ -11,6 +11,7 @@ backend state) without a human tailing logs. Import surface:
     counters     — measured collective wire bytes (manual shard_map path)
     sinks        — step-time histograms, stamped bench emitter
     watchdog     — backend-liveness heartbeat + state machine
+    compare      — bench-trajectory regression gate (compare BASE NEW)
 
 Re-exports are LAZY (PEP 562, same pattern as glom_tpu/__init__):
 diagnostics imports jax, and the lint entry point
@@ -36,7 +37,7 @@ _EXPORTS = {
     "get_global_watchdog": "watchdog",
     "set_global_watchdog": "watchdog",
 }
-_SUBMODULES = ("counters", "diagnostics", "schema", "sinks", "watchdog")
+_SUBMODULES = ("compare", "counters", "diagnostics", "schema", "sinks", "watchdog")
 
 __all__ = sorted([*_EXPORTS, *_SUBMODULES])
 

@@ -1,10 +1,26 @@
-"""`python -m glom_tpu.telemetry FILE...` — lint JSONL logs against the
-versioned event schema (the clean entry point; `-m ...telemetry.schema`
-works too but trips runpy's already-imported warning)."""
+"""`python -m glom_tpu.telemetry ...` — the telemetry CLI.
+
+Two subcommands sharing one entry point (both pure stdlib — they must run
+in a jax-broken environment, the exact wedged-image scenario they exist
+for):
+
+    python -m glom_tpu.telemetry FILE...            lint JSONL logs against
+                                                    the versioned schema
+    python -m glom_tpu.telemetry compare BASE NEW   bench-trajectory
+                                                    regression gate
+
+(`-m ...telemetry.schema` / `-m ...telemetry.compare` work too but trip
+runpy's already-imported warning.)
+"""
 
 import sys
 
-from glom_tpu.telemetry.schema import main
-
 if __name__ == "__main__":
-    sys.exit(main())
+    argv = sys.argv[1:]
+    if argv and argv[0] == "compare":
+        from glom_tpu.telemetry.compare import main as compare_main
+
+        sys.exit(compare_main(argv[1:]))
+    from glom_tpu.telemetry.schema import main
+
+    sys.exit(main(argv))

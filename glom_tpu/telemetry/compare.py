@@ -1,0 +1,246 @@
+"""Bench-trajectory regression gate: `python -m glom_tpu.telemetry compare`.
+
+Rounds 4-5 polluted the bench trajectory with `value: 0.0` UNMEASURED rows
+— any naive base-vs-new diff read them as a 100% regression (or, worse, a
+recovery *from* zero as an infinite speedup). This gate compares two bench
+logs the way the trajectory should be read:
+
+  * records match by their full `metric` label (the label names the regime
+    — config, chip, path — so cross-regime rows never compare);
+  * repeated measurements of one metric collapse to the BEST value on each
+    side (min-of-noise on both sides, the same convention the benches'
+    min-over-repeats timing uses), so run-to-run jitter cannot
+    manufacture a regression by itself;
+  * direction comes from the unit: rates ("/s", "x") regress DOWN, costs
+    ("ms", "percent", "bytes", seconds) regress UP;
+  * UNMEASURED rows — kind "error", `value: null`, or a non-numeric value
+    — are MISSING, never zero: reported, excluded from the verdict;
+  * the verdict is noise-aware: only a relative change beyond --threshold
+    (default 5%, ~2x the chained-timing error bound in utils/timing.py)
+    in the regressing direction fails the gate.
+
+Exit code: 1 when any regression beyond threshold survives, else 0 —
+run_hw_queue.sh wires it after the bench steps so a slow row cannot land
+silently. Pure stdlib, like the linter.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from glom_tpu.telemetry import schema
+
+# Unit substrings that mark a LOWER-is-better (cost) metric; anything else
+# — including the north-star "column-iters/s/chip" and speedup ratios "x"
+# — is a rate, where lower is the regression.
+_COST_UNIT_TOKENS = ("ms", "percent", "bytes", "second")
+_COST_METRIC_TOKENS = ("overhead", "time", "latency")
+
+
+def lower_is_better(metric: str, unit: str) -> bool:
+    unit = unit.lower()
+    if "/s" in unit or unit == "x":
+        return False
+    if any(tok in unit for tok in _COST_UNIT_TOKENS) or unit == "s":
+        return True
+    return any(tok in metric.lower() for tok in _COST_METRIC_TOKENS)
+
+
+def _is_measured(rec: dict) -> bool:
+    v = rec.get("value")
+    return (
+        rec.get("kind") != "error"
+        and isinstance(v, (int, float))
+        and not isinstance(v, bool)
+    )
+
+
+def load_bench_records(lines) -> Tuple[Dict[str, dict], Dict[str, dict]]:
+    """(measured, unmeasured) bench rows keyed by metric label. Repeated
+    measured rows keep EVERY value (collapsed to best at compare time);
+    shell noise and non-bench kinds are skipped like the linter skips
+    them. Legacy `value: 0.0` rows carrying an `error` field are the
+    round-5 dead zeros — classified unmeasured, never ingested."""
+    measured: Dict[str, dict] = {}
+    unmeasured: Dict[str, dict] = {}
+    for _, rec in schema.iter_json_lines(lines):
+        metric = rec.get("metric")
+        if not isinstance(metric, str):
+            continue
+        kind = rec.get("kind", schema.infer_kind(rec))
+        if kind not in ("bench", "error"):
+            continue
+        dead_zero = rec.get("value") in (0, 0.0) and "error" in rec
+        if _is_measured(rec) and not dead_zero:
+            slot = measured.setdefault(metric, {"rec": rec, "values": []})
+            slot["values"].append(float(rec["value"]))
+        else:
+            unmeasured[metric] = rec
+    return measured, unmeasured
+
+
+def _best(values: List[float], lower_better: bool) -> float:
+    return min(values) if lower_better else max(values)
+
+
+def compare_records(
+    base_measured: Dict[str, dict],
+    base_unmeasured: Dict[str, dict],
+    new_measured: Dict[str, dict],
+    new_unmeasured: Dict[str, dict],
+    *,
+    threshold: float = 0.05,
+) -> List[dict]:
+    """One result dict per metric seen on either side, worst first."""
+    results = []
+    for metric in sorted(set(base_measured) | set(base_unmeasured)):
+        base = base_measured.get(metric)
+        if base is None:
+            # Unmeasured in BASE: nothing to regress against.
+            status = (
+                "unmeasured-both" if metric not in new_measured else "recovered"
+            )
+            rec = new_measured.get(metric)
+            new_v = None
+            if rec is not None:
+                lb = lower_is_better(metric, rec["rec"].get("unit", ""))
+                new_v = _best(rec["values"], lb)
+            results.append(
+                {"metric": metric, "status": status, "new": new_v}
+            )
+            continue
+        unit = base["rec"].get("unit", "")
+        lb = lower_is_better(metric, unit)
+        base_v = _best(base["values"], lb)
+        new = new_measured.get(metric)
+        if new is None:
+            results.append(
+                {
+                    "metric": metric,
+                    "status": (
+                        "unmeasured-in-new"
+                        if metric in new_unmeasured
+                        else "missing-in-new"
+                    ),
+                    "base": base_v,
+                    "error": new_unmeasured.get(metric, {}).get("error"),
+                }
+            )
+            continue
+        new_v = _best(new["values"], lb)
+        if base_v == 0:
+            rel = 0.0 if new_v == 0 else float("inf")
+        else:
+            rel = (new_v - base_v) / abs(base_v)
+        regressed = rel > threshold if lb else rel < -threshold
+        improved = rel < -threshold if lb else rel > threshold
+        results.append(
+            {
+                "metric": metric,
+                "status": (
+                    "regression"
+                    if regressed
+                    else "improvement" if improved else "ok"
+                ),
+                "base": base_v,
+                "new": new_v,
+                "rel_change": round(rel, 4) if rel != float("inf") else 1e9,
+                "unit": unit,
+                "lower_is_better": lb,
+            }
+        )
+    for metric in sorted(set(new_measured) - set(base_measured) - set(base_unmeasured)):
+        rec = new_measured[metric]
+        lb = lower_is_better(metric, rec["rec"].get("unit", ""))
+        results.append(
+            {
+                "metric": metric,
+                "status": "new-metric",
+                "new": _best(rec["values"], lb),
+            }
+        )
+    # A brand-new metric that ALSO failed to measure (first run of a new
+    # bench OOMing, say) must still appear in the report — omitting it
+    # would hide that a measurement was attempted at all.
+    for metric in sorted(
+        set(new_unmeasured)
+        - set(base_measured) - set(base_unmeasured) - set(new_measured)
+    ):
+        results.append(
+            {
+                "metric": metric,
+                "status": "unmeasured-new-only",
+                "error": new_unmeasured[metric].get("error"),
+            }
+        )
+    order = {"regression": 0, "missing-in-new": 1, "unmeasured-in-new": 2}
+    results.sort(key=lambda r: (order.get(r["status"], 3), r["metric"]))
+    return results
+
+
+def compare_files(base_path: str, new_path: str, *, threshold: float = 0.05):
+    with open(base_path) as fh:
+        bm, bu = load_bench_records(fh)
+    with open(new_path) as fh:
+        nm, nu = load_bench_records(fh)
+    return compare_records(bm, bu, nm, nu, threshold=threshold)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m glom_tpu.telemetry compare",
+        description="Noise-aware bench-trajectory regression gate "
+        "(UNMEASURED rows are missing, never zero)",
+    )
+    ap.add_argument("base", help="baseline bench JSONL/log")
+    ap.add_argument("new", help="candidate bench JSONL/log")
+    ap.add_argument(
+        "--threshold", type=float, default=0.05, metavar="FRAC",
+        help="relative change beyond which a move in the regressing "
+        "direction fails the gate (default 0.05)",
+    )
+    ap.add_argument(
+        "--fail-on-missing", action="store_true",
+        help="also exit nonzero when a baseline metric is absent from NEW "
+        "entirely (UNMEASURED rows still only warn — they are missing by "
+        "design, not silently dropped)",
+    )
+    args = ap.parse_args(argv)
+    results = compare_files(args.base, args.new, threshold=args.threshold)
+
+    counts: Dict[str, int] = {}
+    for r in results:
+        counts[r["status"]] = counts.get(r["status"], 0) + 1
+        tag = r["status"].upper().replace("-", "_")
+        if r["status"] in ("regression", "improvement", "ok"):
+            arrow = f"{r['base']:g} -> {r['new']:g} ({100 * r['rel_change']:+.1f}%)"
+            print(f"{tag:<16} {r['metric']}: {arrow}", file=sys.stderr)
+        else:
+            detail = r.get("error") or ""
+            print(f"{tag:<16} {r['metric']} {detail}".rstrip(), file=sys.stderr)
+
+    summary = schema.stamp(
+        {
+            "summary": True,
+            "comparison": {"base": args.base, "new": args.new},
+            "threshold": args.threshold,
+            "metrics_compared": counts.get("regression", 0)
+            + counts.get("improvement", 0)
+            + counts.get("ok", 0),
+            **{f"n_{k.replace('-', '_')}": v for k, v in sorted(counts.items())},
+        },
+        kind="summary",
+    )
+    print(json.dumps(summary))
+    failed = counts.get("regression", 0) > 0 or (
+        args.fail_on_missing and counts.get("missing-in-new", 0) > 0
+    )
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

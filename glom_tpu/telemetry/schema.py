@@ -22,7 +22,10 @@ import json
 import sys
 from typing import Iterable, List, Optional, Tuple
 
-SCHEMA_VERSION = 1
+# v2 added the "span" kind (host-side tracing, glom_tpu/tracing/spans.py)
+# and the "error" kind (UNMEASURED bench rows: value null + a machine-
+# readable error string, so trajectory tooling never ingests dead zeros).
+SCHEMA_VERSION = 2
 
 _NUM = (int, float)
 _STR = (str,)
@@ -42,6 +45,13 @@ KINDS = {
     "summary": {},
     # Free-text context lines (e.g. bench cpu-fallback notes).
     "note": {"note": _STR},
+    # A timed host-side span (glom_tpu/tracing/spans.py): dur_s is the
+    # (total) seconds attributed to `name`.
+    "span": {"name": _STR, "dur_s": _NUM},
+    # A measurement that could NOT be taken (backend down, OOM): carries
+    # `value: null` — NEVER 0.0 — plus the error string; the compare gate
+    # and trajectory tooling treat these as missing, not zero.
+    "error": {"error": _STR},
 }
 
 WATCHDOG_STATES = ("unknown", "up", "down", "flapping")
@@ -55,6 +65,13 @@ def infer_kind(rec: dict) -> str:
     """Best-effort kind for legacy records written before stamping."""
     if "backend_state" in rec and ("t" in rec or "event" in rec):
         return "watchdog"
+    if "name" in rec and "dur_s" in rec:
+        return "span"
+    if "error" in rec and not isinstance(rec.get("value"), _NUM):
+        # An UNMEASURED row (value null/absent + error string) is an
+        # "error" record; a MEASURED row that merely carries an error
+        # context field still infers by its numeric value below.
+        return "error"
     if "metric" in rec and "value" in rec:
         return "bench"
     if "reason" in rec and "step" in rec:

@@ -17,6 +17,14 @@ Two host-side pieces that complete the telemetry loop:
     schema_version/kind and the current watchdog backend state on the
     record, so driver-parsed bench lines, trainer JSONL, and hw-queue rows
     are one schema (`python -m glom_tpu.telemetry.schema` lints them all).
+
+  * bench_bootstrap() — the shared fail-fast gate every bench entrypoint
+    runs before touching a backend: probe through the watchdog (throwaway
+    subprocess — a wedged plugin HANGS in-process init), register it
+    globally so every subsequent record stamps backend_state, fall back to
+    CPU when the default platform is down, and when even CPU cannot
+    initialize emit ONE schema-v2 "error" record with `value: null` —
+    never the round-5 dead zero the trajectory tooling then ingested.
 """
 
 from __future__ import annotations
@@ -90,5 +98,56 @@ def emit(rec: dict, kind: str = "bench", stream=None) -> dict:
     stamped = schema.stamp(rec, kind=kind)
     for k, v in watchdog.backend_record().items():
         stamped.setdefault(k, v)
+    from glom_tpu.tracing.flight import observe_event
+
+    observe_event(stamped)
     print(json.dumps(stamped), file=stream or sys.stdout, flush=True)
     return stamped
+
+
+def bench_bootstrap(
+    metric: str,
+    unit: str = "column-iters/s/chip",
+    *,
+    probe_timeout: float = 120.0,
+) -> bool:
+    """Fail-fast backend gate for bench entrypoints. Returns True when a
+    backend (the default platform, or the CPU fallback it downgrades to)
+    is measurable; on total failure emits the UNMEASURED record — kind
+    "error", `value: null` (NEVER 0.0: round 5's zero rows polluted the
+    bench trajectory, and `python -m glom_tpu.telemetry compare` treats
+    these as missing) with the full watchdog outage timeline — and returns
+    False. The watchdog stays registered either way, so every line the
+    bench then emits carries the backend state."""
+    import os
+
+    from glom_tpu.telemetry.watchdog import BackendWatchdog, set_global_watchdog
+    from glom_tpu.utils.metrics import apply_env_platform
+
+    wd = BackendWatchdog(probe_timeout=probe_timeout)
+    set_global_watchdog(wd)
+    if wd.probe_once() == "down":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        if wd.probe_once() == "down":
+            # The metric label stays the BARE one the measured rows carry:
+            # the compare gate matches rows by label, and a decorated
+            # label would make the outage read as a vanished metric
+            # instead of an UNMEASURED one. The error field carries the
+            # machine-readable cause.
+            emit(
+                {
+                    "metric": metric,
+                    "value": None,
+                    "unit": unit,
+                    "error": "backend-init-unavailable",
+                    "note": "UNMEASURED: jax backend init failed or hung",
+                    "watchdog_timeline": wd.timeline(),
+                },
+                kind="error",
+            )
+            return False
+    # A successful probe validated the platform JAX_PLATFORMS names (the
+    # probe honors it at config level); mirror it here so the bench cannot
+    # initialize a different — possibly wedged — backend past the gate.
+    apply_env_platform()
+    return True

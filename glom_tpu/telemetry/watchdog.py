@@ -137,6 +137,14 @@ class BackendWatchdog:
         self._timeline.append(event)
         if self.writer is not None:
             self.writer.write(event)
+        else:
+            # No writer: feed the global flight recorder directly so a
+            # down transition still triggers the postmortem dump. (With a
+            # writer, MetricsWriter.write already forwards the event —
+            # feeding both would double-buffer it.)
+            from glom_tpu.tracing.flight import observe_event
+
+            observe_event(event)
 
     # -- heartbeat thread -------------------------------------------------
 

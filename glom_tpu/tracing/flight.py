@@ -1,0 +1,212 @@
+"""Crash flight recorder: the last N telemetry events, saved at the moment
+of death.
+
+Rounds 4-5 ended with `backend-init-unavailable` records and nothing else —
+no record of what the final steps looked like before the backend wedged.
+The flight recorder is the bounded postmortem buffer every long-running
+system keeps: a ring of the last `capacity` schema-stamped events (steps,
+spans, watchdog transitions, anomalies) fed by the sinks that already see
+every record (MetricsWriter, sinks.emit, the fit loop), dumped to
+`flight_<ts>.jsonl` when something dies:
+
+    * a watchdog "down" transition lands in the stream,
+    * an anomaly storm (>= storm_threshold "anomaly" events inside
+      storm_window_s — the NaN-cascade signature),
+    * SIGTERM / interpreter exit (install_process_hooks; the preemption
+      path on TPU pods),
+    * an unhandled exception inside fit_loop (trainer.py calls
+      dump_flight_recorder before re-raising).
+
+Dumps are plain JSONL: a stamped "note" header (trigger, event count,
+context) followed by the buffered events in arrival order, each carrying a
+monotonic `flight_seq` — `python -m glom_tpu.telemetry flight_*.jsonl`
+lints a dump like any other log, and CI does. Pure stdlib, thread-safe,
+and observe() never raises into the caller: the recorder must keep working
+in exactly the broken states it exists to document.
+
+Registration mirrors the watchdog's process-global pattern: sinks call
+`observe_event(rec)` (a no-op until `set_global_flight_recorder`), so no
+handle threading is needed.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from pathlib import Path
+from typing import Callable, List, Optional
+
+
+class FlightRecorder:
+    """Bounded ring of stamped telemetry events + triggered JSONL dumps."""
+
+    def __init__(
+        self,
+        dump_dir: str,
+        capacity: int = 256,
+        *,
+        storm_threshold: int = 3,
+        storm_window_s: float = 60.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if capacity < 1:
+            raise ValueError(f"capacity={capacity} must be >= 1")
+        if storm_threshold < 1:
+            raise ValueError(f"storm_threshold={storm_threshold} must be >= 1")
+        self.dump_dir = Path(dump_dir)
+        self.capacity = capacity
+        self.storm_threshold = storm_threshold
+        self.storm_window_s = storm_window_s
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._buf: deque = deque(maxlen=capacity)
+        self._seq = 0
+        self._last_dump_seq = 0
+        self._anomaly_times: deque = deque()
+        self.dumps: List[str] = []  # paths written, oldest first
+
+    # -- feed --------------------------------------------------------------
+
+    def observe(self, rec: dict) -> None:
+        """Buffer one stamped event; fire a dump when it is a trigger.
+        Never raises — a postmortem buffer that can crash the run it
+        documents is worse than none."""
+        try:
+            trigger = None
+            with self._lock:
+                self._seq += 1
+                self._buf.append({**rec, "flight_seq": self._seq})
+                kind = rec.get("kind")
+                if kind == "watchdog" and rec.get("backend_state") == "down":
+                    trigger = "backend-down"
+                elif kind == "anomaly":
+                    now = self._clock()
+                    self._anomaly_times.append(now)
+                    while (
+                        self._anomaly_times
+                        and now - self._anomaly_times[0] > self.storm_window_s
+                    ):
+                        self._anomaly_times.popleft()
+                    if len(self._anomaly_times) >= self.storm_threshold:
+                        trigger = "anomaly-storm"
+                        self._anomaly_times.clear()
+            if trigger is not None:
+                self.dump(trigger)
+        except Exception:
+            pass
+
+    # Writer protocol: a FlightRecorder can sit anywhere a MetricsWriter
+    # can (e.g. as a BackendWatchdog's writer).
+    write = observe
+
+    # -- dump --------------------------------------------------------------
+
+    def dump(self, trigger: str, *, context: Optional[dict] = None) -> Optional[str]:
+        """Write the buffered events to flight_<ts>_<seq>.jsonl; returns the
+        path, or None when nothing new arrived since the last dump (the
+        atexit hook after a triggered dump must not write an empty twin)."""
+        from glom_tpu.telemetry import schema
+
+        with self._lock:
+            if self._seq == self._last_dump_seq:
+                return None
+            events = list(self._buf)
+            self._last_dump_seq = self._seq
+            self.dump_dir.mkdir(parents=True, exist_ok=True)
+            ts = time.strftime("%Y%m%d_%H%M%S")
+            path = self.dump_dir / f"flight_{ts}_{self._seq:06d}.jsonl"
+            header = schema.stamp(
+                {
+                    "note": "flight-recorder dump",
+                    "trigger": trigger,
+                    "n_events": len(events),
+                    "capacity": self.capacity,
+                    "wall_time_s": round(time.time(), 3),
+                    **(context or {}),
+                },
+                kind="note",
+            )
+            with open(path, "w") as fh:
+                fh.write(json.dumps(header, default=str) + "\n")
+                for e in events:
+                    fh.write(json.dumps(e, default=str) + "\n")
+            self.dumps.append(str(path))
+            return str(path)
+
+    # -- process hooks -----------------------------------------------------
+
+    def install_process_hooks(self, *, sigterm: bool = True, on_exit: bool = True):
+        """Dump on SIGTERM (the pod-preemption path) and at interpreter
+        exit. SIGTERM chains any previously installed handler; installing
+        from a non-main thread (where signal.signal raises) skips the
+        signal hook silently. Returns self."""
+        if on_exit:
+            import atexit
+
+            atexit.register(self._dump_atexit)
+        if sigterm:
+            import signal
+
+            try:
+                prev = signal.getsignal(signal.SIGTERM)
+
+                def _handler(signum, frame):
+                    self.dump("sigterm")
+                    if callable(prev):
+                        prev(signum, frame)
+                    elif prev is signal.SIG_IGN:
+                        # The host intentionally ignored SIGTERM; dumping
+                        # must not convert 'ignored' into 'terminated'.
+                        return
+                    else:
+                        raise SystemExit(128 + signum)
+
+                signal.signal(signal.SIGTERM, _handler)
+            except ValueError:
+                pass
+        return self
+
+    def _dump_atexit(self) -> None:
+        try:
+            self.dump("atexit")
+        except Exception:
+            pass
+
+
+# -- process-global registration (same pattern as the watchdog) ------------
+
+_GLOBAL: Optional[FlightRecorder] = None
+
+
+def set_global_flight_recorder(fr: Optional[FlightRecorder]) -> None:
+    global _GLOBAL
+    _GLOBAL = fr
+
+
+def get_global_flight_recorder() -> Optional[FlightRecorder]:
+    return _GLOBAL
+
+
+def observe_event(rec: dict) -> None:
+    """Feed one stamped event to the global recorder; no-op without one.
+    Called by MetricsWriter.write, sinks.emit, the fit loop, and watchdog
+    transitions — the places every telemetry record already flows through."""
+    fr = _GLOBAL
+    if fr is not None:
+        fr.observe(rec)
+
+
+def dump_flight_recorder(
+    trigger: str, *, context: Optional[dict] = None
+) -> Optional[str]:
+    """Force a dump of the global recorder (the fit-loop exception path);
+    no-op without one. Never raises."""
+    fr = _GLOBAL
+    if fr is None:
+        return None
+    try:
+        return fr.dump(trigger, context=context)
+    except Exception:
+        return None

@@ -1,0 +1,93 @@
+"""HBM accounting: live device watermarks reconciled against the model.
+
+`utils/metrics.live_bytes_model` PRICES the train state's live bytes from
+abstract shapes (the "recorded even with no chip" contract). This module
+MEASURES the other side: the runtime's allocator stats
+(`device.memory_stats()` — bytes_in_use / peak_bytes_in_use / bytes_limit
+on TPU backends) — and stamps the reconciliation between the two on every
+logging record, the same measured-vs-modeled discipline as PR 2's
+collective counters (`comm_model_drift`):
+
+    hbm_model_drift = (hbm_bytes_in_use - model_live_bytes) / model_live_bytes
+
+Reading it: the analytic model prices the train-state tenants only (params
++ grad buffer + optimizer moments), so between steps the drift ≈ the
+allocator's overhead + anything else resident; DURING a step the gap to
+`hbm_peak_bytes` is the activation working set — which is why both
+watermarks ride the record. A drift that grows step over step is a leak;
+a peak near `hbm_bytes_limit` explains the next OOM before it happens.
+
+Every function here degrades to {} instead of raising: CPU backends return
+no stats (memory_stats() is None), and memory accounting must never be the
+thing that takes a run down.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# memory_stats key -> stamped record field. Allocator key names vary by
+# backend/runtime version; only the ones present are stamped.
+_STAT_FIELDS = (
+    ("bytes_in_use", "hbm_bytes_in_use"),
+    ("peak_bytes_in_use", "hbm_peak_bytes"),
+    ("bytes_limit", "hbm_bytes_limit"),
+    ("largest_free_block_bytes", "hbm_largest_free_block_bytes"),
+)
+
+
+def device_memory_stats(device=None) -> Optional[dict]:
+    """Raw allocator stats for `device` (default: first local device), or
+    None when the backend has none (CPU) or jax itself is unavailable."""
+    try:
+        if device is None:
+            import jax
+
+            device = jax.local_devices()[0]
+        stats = device.memory_stats()
+    except Exception:
+        return None
+    return stats or None
+
+
+def hbm_watermarks(device=None) -> dict:
+    """The stamped watermark fields, or {} when the backend reports none."""
+    stats = device_memory_stats(device)
+    if not stats:
+        return {}
+    out = {}
+    for src, dst in _STAT_FIELDS:
+        v = stats.get(src)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[dst] = int(v)
+    return out
+
+
+def memory_record(model_live_bytes: Optional[int] = None, device=None) -> dict:
+    """Watermarks + model reconciliation for a metrics record. Never
+    raises; {} when the backend has no allocator stats (the CPU fallback —
+    the analytic model keys on the record are then the only memory story,
+    exactly as before)."""
+    try:
+        out = hbm_watermarks(device)
+    except Exception:  # pragma: no cover - hbm_watermarks already guards
+        return {}
+    if not out:
+        return {}
+    if model_live_bytes and model_live_bytes > 0 and "hbm_bytes_in_use" in out:
+        out["hbm_model_live_bytes"] = int(model_live_bytes)
+        out["hbm_model_drift"] = round(
+            (out["hbm_bytes_in_use"] - model_live_bytes) / model_live_bytes, 6
+        )
+    return out
+
+
+def model_live_bytes_total(static_record: dict) -> int:
+    """The analytic live-bytes total the drift reconciles against: the
+    three train-state tenants the trainers already stamp (live_bytes_model
+    keys in their _static_record)."""
+    return int(
+        static_record.get("params_bytes_per_replica", 0)
+        + static_record.get("grads_bytes_per_replica", 0)
+        + static_record.get("opt_bytes_per_replica", 0)
+    )

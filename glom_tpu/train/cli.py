@@ -94,7 +94,33 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=100)
     p.add_argument("--resume", action="store_true", help="resume from latest ckpt")
-    p.add_argument("--profile-dir", default=None, help="capture an XProf trace")
+    p.add_argument(
+        "--profile-dir", default=None,
+        help="capture an XProf trace of the WHOLE run (for step-windowed "
+        "capture use --trace-steps)",
+    )
+    p.add_argument(
+        "--trace-steps", default=None, metavar="A:B",
+        help="programmatic XLA capture: open jax.profiler.start_trace "
+        "right before global step A and close it after step B (inclusive; "
+        "a bare 'A' captures one step). Window metadata is stamped into "
+        "the metrics stream; view with tensorboard --logdir <trace dir>",
+    )
+    p.add_argument(
+        "--trace-dir", default="/tmp/glom_tpu_trace", metavar="DIR",
+        help="where --trace-steps writes the XProf trace",
+    )
+    p.add_argument(
+        "--flight-recorder", default=None, metavar="DIR",
+        help="crash flight recorder: keep a ring of the last "
+        "--flight-events telemetry events and dump flight_<ts>.jsonl into "
+        "DIR on backend-down, anomaly storm, SIGTERM/exit, or an "
+        "unhandled training-loop exception (docs/OBSERVABILITY.md)",
+    )
+    p.add_argument(
+        "--flight-events", type=int, default=256, metavar="N",
+        help="flight-recorder ring capacity (default 256)",
+    )
     p.add_argument(
         "--distributed",
         action="store_true",
@@ -163,6 +189,23 @@ def main(argv=None) -> int:
     # 5's 60-second flap went unrecorded) land in the SAME stream as the
     # training records, and every record stamps the current state via the
     # global registration.
+    # Crash flight recorder FIRST: even a setup failure (bad --data-dir,
+    # preset error) then leaves a postmortem trail of whatever telemetry
+    # preceded it. The atexit/SIGTERM hooks stay installed for the process
+    # lifetime (dump() is a no-op when nothing new arrived); the GLOBAL
+    # registration is cleared on the way out so in-process callers (tests,
+    # CI) don't keep feeding a dead run's buffer.
+    fr = None
+    if args.flight_recorder:
+        from glom_tpu.tracing.flight import (
+            FlightRecorder,
+            set_global_flight_recorder,
+        )
+
+        fr = FlightRecorder(args.flight_recorder, capacity=args.flight_events)
+        fr.install_process_hooks()
+        set_global_flight_recorder(fr)
+
     wd = None
     if args.watchdog_interval > 0:
         from glom_tpu.telemetry.watchdog import (
@@ -187,6 +230,13 @@ def main(argv=None) -> int:
             # otherwise stay frozen on every later record an in-process
             # caller (tests, CI) writes in this process.
             set_global_watchdog(None)
+        if fr is not None:
+            # Final dump before unregistering: the in-process caller path
+            # never reaches the atexit hook with the buffer still global.
+            fr.dump("run-end")
+            from glom_tpu.tracing.flight import set_global_flight_recorder
+
+            set_global_flight_recorder(None)
 
 
 def _train_body(args, preset, cfg, tcfg, writer) -> int:
@@ -269,6 +319,26 @@ def _train_body(args, preset, cfg, tcfg, writer) -> int:
             sharding=getattr(trainer, "batch_sharding", None),
         )
 
+    # Step-windowed XLA capture: ONE TraceCapture across every checkpoint
+    # span (its step counter is global to the run), closed in the finally
+    # so a crash or a window past --steps can't leak a profiler session.
+    cap = None
+    if args.trace_steps and args.profile_dir:
+        # jax allows one active trace: the step window opening inside the
+        # whole-run trace would RuntimeError mid-training — reject up
+        # front instead.
+        raise SystemExit(
+            "--profile-dir (whole-run trace) and --trace-steps (step "
+            "window) are mutually exclusive — jax runs one profile at a "
+            "time; pick one"
+        )
+    if args.trace_steps:
+        from glom_tpu.tracing.capture import TraceCapture
+
+        cap = TraceCapture.parse(
+            args.trace_steps, args.trace_dir, writer=writer
+        )
+
     def run(steps):
         remaining = steps - start_step
         if remaining <= 0:
@@ -277,20 +347,27 @@ def _train_body(args, preset, cfg, tcfg, writer) -> int:
         done = 0
         while done < remaining:
             span = min(args.checkpoint_every, remaining - done) if ckpt else remaining
-            trainer.fit(data, num_steps=span, log_every=args.log_every)
+            trainer.fit(
+                data, num_steps=span, log_every=args.log_every,
+                trace_capture=cap,
+            )
             done += span
             if ckpt:
                 ckpt.save(start_step + done, trainer.state)
         if ckpt:
             ckpt.wait()
 
-    if args.profile_dir:
-        from glom_tpu.utils.profiling import trace
+    try:
+        if args.profile_dir:
+            from glom_tpu.utils.profiling import trace
 
-        with trace(args.profile_dir):
+            with trace(args.profile_dir):
+                run(args.steps)
+        else:
             run(args.steps)
-    else:
-        run(args.steps)
+    finally:
+        if cap is not None:
+            cap.close()
     return 0
 
 

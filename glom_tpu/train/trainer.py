@@ -469,6 +469,8 @@ def fit_loop(
     metrics_writer=None,
     step_fast: Optional[Callable[[Any], dict]] = None,
     compile_tracker: Optional[set] = None,
+    trace_capture=None,
+    memory_probe: Optional[Callable[[], dict]] = None,
 ) -> list[dict]:
     """Shared training loop: pull batches, step, log every `log_every`.
     Used by both the single-device Trainer and the DistributedTrainer.
@@ -491,51 +493,94 @@ def fit_loop(
     once over the same jitted steps (the trainers do — fit() per
     checkpoint span): the jit cache is warm in span 2+, and a fresh
     tracker would mislabel each span's first steps as compiles, faking a
-    compile_time_s and dropping real samples from the percentiles."""
+    compile_time_s and dropping real samples from the percentiles.
+
+    Tracing hooks (glom_tpu/tracing/, docs/OBSERVABILITY.md):
+      * host spans — host_data_next / host_step_dispatch / host_log_fetch
+        are aggregated per phase between logging steps (SpanAggregator:
+        dict arithmetic, <1% of the CPU bench step by bench_train.py
+        --span-ab) and drained as one "span" record per phase into the
+        metrics stream at each log boundary;
+      * trace_capture — a tracing.capture.TraceCapture whose [A, B] step
+        window this loop advances (the capture's counter persists across
+        fit() calls; the CALLER owns close());
+      * memory_probe — called at logging steps; its dict (HBM watermarks
+        + model drift, tracing.memory.memory_record) rides the record;
+      * flight recorder — every record this loop produces reaches the
+        global recorder (via MetricsWriter.write, or directly when no
+        writer is attached), and an unhandled exception dumps the buffer
+        (`fit-loop-exception`) before re-raising — the crash postmortem
+        rounds 4-5 never had."""
     from glom_tpu.telemetry import schema
     from glom_tpu.telemetry.sinks import StepTimeStats
+    from glom_tpu.tracing import flight
+    from glom_tpu.tracing.spans import SpanAggregator, span
 
     history = []
     stats = StepTimeStats()
+    spans = SpanAggregator()
     # Which jit variant's compile step was seen, keyed by role (bound
     # methods get fresh ids per access, so identity keys wouldn't survive
     # a second fit() call even with a shared tracker).
     compiled = compile_tracker if compile_tracker is not None else set()
     pending_flags = []  # (step index, device-scalar nonfinite flag)
     t0 = time.perf_counter()
-    for i in range(num_steps):
-        logging_step = (i + 1) % log_every == 0 or i == num_steps - 1
-        use_full = logging_step or step_fast is None
-        fn = step if use_full else step_fast
-        key = "step" if use_full else "step_fast"
-        first_call = key not in compiled
-        compiled.add(key)
-        # Pull the batch BEFORE the timer: host data-generation time is a
-        # data-pipeline signal, not step time — folding it in would make a
-        # loader stall read as a step/compile regression on every record.
-        batch = next(data)
-        t_step = time.perf_counter()
-        metrics = fn(batch)
-        # Each jit variant's first call is trace+compile — both the fast
-        # step's (iteration 0) and the logging step's (first log boundary)
-        # — and must not pollute the steady-state percentiles.
-        stats.observe(time.perf_counter() - t_step, is_compile=first_call)
-        if "nonfinite_step" in metrics and not logging_step:
-            pending_flags.append((i, metrics["nonfinite_step"]))
-        if logging_step:
-            metrics = diag.split_level_agreement(metrics)
-            metrics = {k: _jsonable(v) for k, v in metrics.items()}
+    i = -1
+    try:
+        for i in range(num_steps):
+            logging_step = (i + 1) % log_every == 0 or i == num_steps - 1
+            use_full = logging_step or step_fast is None
+            fn = step if use_full else step_fast
+            key = "step" if use_full else "step_fast"
+            first_call = key not in compiled
+            compiled.add(key)
+            # Pull the batch BEFORE the timer: host data-generation time is
+            # a data-pipeline signal, not step time — folding it in would
+            # make a loader stall read as a step/compile regression on
+            # every record.
+            with span("host_data_next", aggregator=spans):
+                batch = next(data)
+            t_step = time.perf_counter()
+            with span("host_step_dispatch", aggregator=spans):
+                if trace_capture is not None:
+                    with trace_capture.unit():
+                        metrics = fn(batch)
+                else:
+                    metrics = fn(batch)
+            # Each jit variant's first call is trace+compile — both the
+            # fast step's (iteration 0) and the logging step's (first log
+            # boundary) — and must not pollute the steady-state
+            # percentiles.
+            stats.observe(time.perf_counter() - t_step, is_compile=first_call)
+            if "nonfinite_step" in metrics and not logging_step:
+                pending_flags.append((i, metrics["nonfinite_step"]))
+            if not logging_step:
+                continue
+            with span("host_log_fetch", aggregator=spans):
+                metrics = diag.split_level_agreement(metrics)
+                metrics = {k: _jsonable(v) for k, v in metrics.items()}
             metrics["steps_per_sec"] = (i + 1) / (time.perf_counter() - t0)
             metrics.update(stats.summary())
+            if memory_probe is not None:
+                metrics.update(memory_probe() or {})
             rec = schema.stamp(metrics, kind="train_step")
             history.append(rec)
             if metrics_writer is not None:
                 metrics_writer.write(rec)
+            else:
+                # No writer: feed the flight recorder directly so a crash
+                # in a writerless run still has a postmortem trail.
+                flight.observe_event(rec)
+            for srec in spans.records(extra={"step": rec.get("step", float(i))}):
+                if metrics_writer is not None:
+                    metrics_writer.write(srec)
+                else:
+                    flight.observe_event(srec)
             flagged = [k for k, v in pending_flags if float(v)]
             pending_flags = []
             if rec.get("nonfinite_step"):
                 flagged.append(i)
-            if flagged and metrics_writer is not None:
+            if flagged:
                 anomaly = schema.stamp(
                     {
                         "step": rec.get("step", float(i)),
@@ -550,7 +595,22 @@ def fit_loop(
                     },
                     kind="anomaly",
                 )
-                metrics_writer.write(anomaly)
+                if metrics_writer is not None:
+                    metrics_writer.write(anomaly)
+                else:
+                    flight.observe_event(anomaly)
+    except BaseException as e:
+        # The postmortem the crash would otherwise take with it: dump the
+        # last-N event buffer (no-op without a global recorder), then
+        # re-raise unchanged.
+        flight.dump_flight_recorder(
+            "fit-loop-exception",
+            context={
+                "exception": f"{type(e).__name__}: {e}"[:300],
+                "at_iteration": i,
+            },
+        )
+        raise
     return history
 
 
@@ -607,6 +667,9 @@ class Trainer:
                 self.zero_stage,
             ),
         }
+        from glom_tpu.tracing.memory import model_live_bytes_total
+
+        self._model_live_bytes = model_live_bytes_total(self._static_record)
         self._step = jax.jit(step_fn, donate_argnums=(0,))
         fast_fn = make_train_step(
             cfg, tcfg, self.optimizer,
@@ -646,6 +709,14 @@ class Trainer:
         self.state, metrics = self._step_fast(self.state, batch, step_rng)
         return self._annotate(metrics)
 
+    def _memory_record(self) -> dict:
+        """Live HBM watermarks reconciled against the analytic live-bytes
+        model (tracing/memory.py) — {} on backends with no allocator stats
+        (the CPU fallback). fit_loop stamps this on every logging record."""
+        from glom_tpu.tracing.memory import memory_record
+
+        return memory_record(self._model_live_bytes)
+
     def fit(
         self,
         data: Iterator[jnp.ndarray],
@@ -653,6 +724,7 @@ class Trainer:
         *,
         log_every: int = 10,
         prefetch: int = 0,
+        trace_capture=None,
     ) -> list[dict]:
         """Run `num_steps` updates pulling [b, c, H, W] batches from `data`.
         prefetch > 0 stages that many upcoming batches on device from a
@@ -676,4 +748,6 @@ class Trainer:
             metrics_writer=self.metrics_writer,
             step_fast=self.step_fast,
             compile_tracker=self._compile_tracker,
+            trace_capture=trace_capture,
+            memory_probe=self._memory_record,
         )

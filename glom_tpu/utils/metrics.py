@@ -307,8 +307,12 @@ class MetricsWriter:
 
     def write(self, metrics: dict):
         from glom_tpu.telemetry import schema
+        from glom_tpu.tracing.flight import observe_event
 
         rec = schema.stamp({"wall_time": round(time.time() - self._t0, 3), **metrics})
+        # Every record of record also lands in the crash flight recorder's
+        # ring buffer (no-op until one is registered globally).
+        observe_event(rec)
         line = json.dumps(rec)
         with self._lock:
             if self._fh:

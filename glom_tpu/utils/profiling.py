@@ -1,90 +1,15 @@
-"""Profiling / tracing (SURVEY.md §5: absent in the reference).
+"""Back-compat shim: the profiling stub grew into `glom_tpu/tracing/`.
 
-The phase structure inside the scan body is already annotated with
-jax.named_scope (bottom_up / top_down / consensus / mean_update in
-models/core.py), so XProf/TensorBoard traces group by phase out of the box.
-This module adds the capture plumbing and an MFU report built on the
-analytic FLOP model (utils/metrics.py).
+Everything this module used to define lives there now — spans, the
+step-windowed TraceCapture, HBM accounting, and the flight recorder are
+the new surface (docs/OBSERVABILITY.md). The original names keep working
+from here:
+
+    trace / start_server / annotate  -> glom_tpu.tracing.capture
+    perf_report / StepTimer          -> glom_tpu.tracing.report
 """
 
-from __future__ import annotations
+from glom_tpu.tracing.capture import annotate, start_server, trace
+from glom_tpu.tracing.report import StepTimer, perf_report
 
-import contextlib
-import time
-from typing import Optional
-
-import jax
-
-from glom_tpu.utils.config import GlomConfig
-from glom_tpu.utils.metrics import flops_per_column_iter, mfu
-
-
-@contextlib.contextmanager
-def trace(log_dir: str = "/tmp/glom_tpu_trace"):
-    """Capture a profiler trace of the enclosed block.
-
-    View with: tensorboard --logdir <log_dir>  (or xprof).
-    """
-    jax.profiler.start_trace(log_dir)
-    try:
-        yield log_dir
-    finally:
-        jax.profiler.stop_trace()
-
-
-def start_server(port: int = 9999):
-    """On-demand profiling: connect TensorBoard's profile tab to this port
-    while training runs (the 'attach to a live job' workflow)."""
-    return jax.profiler.start_server(port)
-
-
-def annotate(name: str):
-    """Trace annotation decorator for host-side phases (data loading, eval)."""
-
-    def deco(fn):
-        return jax.profiler.annotate_function(fn, name=name)
-
-    return deco
-
-
-def perf_report(
-    cfg: GlomConfig,
-    *,
-    column_iters_per_sec: float,
-    chip: str = "v5e",
-    num_chips: int = 1,
-    backward: bool = False,
-) -> dict:
-    """Assemble the north-star metrics dict from a measured rate."""
-    return {
-        "column_iters_per_sec_per_chip": column_iters_per_sec / num_chips,
-        "flops_per_column_iter": flops_per_column_iter(cfg),
-        "mfu": mfu(
-            cfg, column_iters_per_sec / num_chips, chip=chip, backward=backward
-        ),
-        "chip": chip,
-        "num_chips": num_chips,
-    }
-
-
-class StepTimer:
-    """Rolling wall-clock step timer that syncs on a supplied scalar, for
-    platforms where block_until_ready is unreliable (see bench.py)."""
-
-    def __init__(self):
-        self._t0: Optional[float] = None
-        self.history: list[float] = []
-
-    def start(self):
-        self._t0 = time.perf_counter()
-
-    def stop(self, sync_scalar=None) -> float:
-        if sync_scalar is not None:
-            float(sync_scalar)  # host fetch = real synchronization
-        dt = time.perf_counter() - self._t0
-        self.history.append(dt)
-        return dt
-
-    @property
-    def best(self) -> float:
-        return min(self.history)
+__all__ = ["StepTimer", "annotate", "perf_report", "start_server", "trace"]

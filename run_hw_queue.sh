@@ -80,13 +80,41 @@ step pod_zero_record 1800 python -u bench_train.py --preset imagenet224-pod --ba
 #    always-on rollout.
 step telemetry_ab 1800 python -u bench_train.py --telemetry-ab
 
+# 9b. Span-overhead bar (< 1% per-step for the fit loop's host spans) and
+#     the per-preset memory table with MEASURED HBM watermarks — the
+#     analytic live-bytes model finally reconciled against a real
+#     allocator (docs/OBSERVABILITY.md, HBM accounting).
+step span_ab 900 python -u bench_train.py --span-ab
+step memory_table 900 python -u bench_train.py --memory-table
+
+# 9c. One step-windowed XLA trace of the flagship loss-curve path (steps
+#     20:24, past compile) for the XProf phase breakdown — trace dir is
+#     stamped into the log's note records.
+step trace_capture 1800 python -u bench_train.py --loss-curve 30 \
+    --out results/hw_queue/trace_curve.jsonl \
+    --trace-steps 20:24 --trace-dir results/hw_queue/xla_trace
+
 # 10. Schema lint: every JSON row this queue produced must validate
 #     against the versioned event schema (glom_tpu/telemetry/schema.py).
 #     Shell noise in the logs is skipped; --allow-unstamped because the
-#     sp_crossover/scratch harnesses still emit legacy unstamped rows —
-#     the bench.py/bench_train.py/bench_zero.py rows are all stamped and
-#     validate strictly (CI enforces that on every push).
+#     scratch harnesses still emit legacy unstamped rows — the
+#     bench*.py rows (incl. longctx/sp_crossover since PR 3) are all
+#     stamped and validate strictly (CI enforces that on every push).
 step schema_lint 300 python -m glom_tpu.telemetry --allow-unstamped results/hw_queue/*.log
+
+# 11. Bench-trajectory regression gate: this queue's metric-of-record rows
+#     vs the last committed good trajectory. UNMEASURED rows are MISSING,
+#     never zero (the round-5 pollution this gate exists to end); a
+#     beyond-noise regression fails the queue loudly. On pass, the fresh
+#     rows become the next baseline.
+if [ -f results/bench_baseline.jsonl ]; then
+    step bench_compare 300 python -m glom_tpu.telemetry compare \
+        results/bench_baseline.jsonl results/hw_queue/bench.log || {
+        log "bench trajectory REGRESSION (results/hw_queue/bench_compare.log)"
+        exit 1
+    }
+fi
+grep -ah '^{' results/hw_queue/bench.log > results/bench_baseline.jsonl 2>/dev/null || true
 
 log "queue complete — paste numbers into results/profiles/PROFILE.md, "
 log "docs/PARALLELISM.md (pod anchor + ZeRO table), results/batch_curve.jsonl,"

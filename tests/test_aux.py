@@ -209,7 +209,10 @@ class TestCLI:
         )
         assert r.returncode == 0, r.stderr[-2000:]
         lines = [json.loads(l) for l in metrics.read_text().splitlines()]
-        assert lines and all(np.isfinite(m["loss"]) for m in lines)
+        # The stream carries span rollups next to the step records since
+        # PR 3 — consumers select by kind (the schema contract).
+        steps = [m for m in lines if m.get("kind") == "train_step"]
+        assert steps and all(np.isfinite(m["loss"]) for m in steps)
 
         r2 = subprocess.run(
             [
@@ -248,7 +251,8 @@ class TestCLI:
         assert r.returncode == 0, r.stderr[-2000:]
         assert "mesh" in r.stderr  # the mesh banner printed
         lines = [json.loads(l) for l in metrics.read_text().splitlines()]
-        assert lines and all(np.isfinite(m["loss"]) for m in lines)
+        steps = [m for m in lines if m.get("kind") == "train_step"]
+        assert steps and all(np.isfinite(m["loss"]) for m in steps)
 
     def test_check_parity_smoke(self):
         """--check-parity runs sharded-vs-single and exits 0 when the loss

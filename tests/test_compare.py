@@ -1,0 +1,237 @@
+"""Bench-trajectory compare gate tests (telemetry/compare.py) + the v2
+schema kinds it rides on. Pure stdlib paths — no jax, no compiles."""
+
+import json
+
+import pytest
+
+from glom_tpu.telemetry import schema
+from glom_tpu.telemetry.compare import (
+    compare_files,
+    compare_records,
+    load_bench_records,
+    lower_is_better,
+    main as compare_main,
+)
+
+FIXTURE_BASE = "tests/fixtures/bench_base.jsonl"
+FIXTURE_NEW = "tests/fixtures/bench_new.jsonl"
+
+
+def bench(metric, value, unit="column-iters/s/chip", **kw):
+    return json.dumps(
+        schema.stamp({"metric": metric, "value": value, "unit": unit, **kw},
+                     kind="bench")
+    )
+
+
+def error_row(metric, err="backend-init-unavailable", unit="column-iters/s/chip"):
+    return json.dumps(
+        schema.stamp({"metric": metric, "value": None, "unit": unit,
+                      "error": err}, kind="error")
+    )
+
+
+def run(base_lines, new_lines, threshold=0.05):
+    bm, bu = load_bench_records(base_lines)
+    nm, nu = load_bench_records(new_lines)
+    return compare_records(bm, bu, nm, nu, threshold=threshold)
+
+
+class TestSchemaV2Kinds:
+    def test_span_and_error_kinds_validate(self):
+        span = schema.stamp({"name": "host_data_next", "dur_s": 0.5},
+                            kind="span")
+        err = schema.stamp(
+            {"metric": "m", "value": None, "error": "backend-init-unavailable"},
+            kind="error",
+        )
+        assert span["schema_version"] == schema.SCHEMA_VERSION == 2
+        assert schema.validate_record(span) == []
+        assert schema.validate_record(err) == []
+        # missing required fields are rejected
+        assert schema.validate_record(
+            {"kind": "span", "schema_version": 2, "name": "x"}) != []
+        assert schema.validate_record(
+            {"kind": "error", "schema_version": 2, "value": None}) != []
+
+    def test_version_1_records_still_validate(self):
+        old = {"kind": "bench", "schema_version": 1, "metric": "m",
+               "value": 1.0, "unit": "u"}
+        assert schema.validate_record(old) == []
+
+    def test_infer_kind_for_new_shapes(self):
+        assert schema.infer_kind({"name": "s", "dur_s": 1.0}) == "span"
+        assert schema.infer_kind(
+            {"metric": "m", "value": None, "error": "down"}) == "error"
+        # a MEASURED row with an error context field stays a bench row
+        assert schema.infer_kind(
+            {"metric": "m", "value": 3.0, "error": "retried-once"}) == "bench"
+
+
+class TestDirection:
+    def test_rates_regress_down_costs_regress_up(self):
+        assert not lower_is_better("train_step cips", "column-iters/s/chip")
+        assert not lower_is_better("sp_crossover speedup", "x")
+        assert lower_is_better("telemetry overhead", "percent")
+        assert lower_is_better("longctx fused", "ms/call")
+        assert lower_is_better("live_bytes_model_total", "bytes")
+        assert lower_is_better("span_overhead thing", "percent")
+
+
+class TestLoad:
+    def test_skips_noise_and_classifies_unmeasured(self):
+        lines = [
+            "=== shell noise\n",
+            bench("m1", 10.0),
+            error_row("m2"),
+            json.dumps(schema.stamp({"note": "ctx"}, kind="note")),
+            # legacy round-5 dead zero: value 0.0 + error field
+            json.dumps({"metric": "m3", "value": 0.0, "vs_baseline": 0.0,
+                        "error": "backend-init-unavailable"}),
+        ]
+        measured, unmeasured = load_bench_records(lines)
+        assert list(measured) == ["m1"]
+        assert set(unmeasured) == {"m2", "m3"}
+
+    def test_repeats_collapse_to_best(self):
+        lines = [bench("m", v) for v in (10.0, 12.0, 11.0)]
+        results = run(lines, [bench("m", 11.9)])
+        (r,) = results
+        # best-of-base is 12.0 (higher-better): 11.9 is inside noise
+        assert r["base"] == 12.0
+        assert r["status"] == "ok"
+
+
+class TestVerdicts:
+    def test_regression_beyond_threshold(self):
+        (r,) = run([bench("m", 100.0)], [bench("m", 90.0)])
+        assert r["status"] == "regression"
+        assert r["rel_change"] == pytest.approx(-0.1)
+
+    def test_within_noise_is_ok(self):
+        (r,) = run([bench("m", 100.0)], [bench("m", 96.0)])
+        assert r["status"] == "ok"
+
+    def test_improvement(self):
+        (r,) = run([bench("m", 100.0)], [bench("m", 120.0)])
+        assert r["status"] == "improvement"
+
+    def test_lower_is_better_flips_direction(self):
+        (r,) = run(
+            [bench("overhead", 1.0, unit="percent")],
+            [bench("overhead", 1.5, unit="percent")],
+        )
+        assert r["status"] == "regression"
+        (r,) = run(
+            [bench("overhead", 1.5, unit="percent")],
+            [bench("overhead", 1.0, unit="percent")],
+        )
+        assert r["status"] == "improvement"
+
+    def test_unmeasured_is_missing_never_zero(self):
+        """THE round-5 fix: an UNMEASURED row must neither read as a 100%
+        regression (value->0) nor fail the gate."""
+        results = run([bench("m", 100.0)], [error_row("m")])
+        (r,) = results
+        assert r["status"] == "unmeasured-in-new"
+        assert r["error"] == "backend-init-unavailable"
+        assert "rel_change" not in r
+
+    def test_legacy_dead_zero_in_new_is_missing(self):
+        legacy = json.dumps({"metric": "m", "value": 0.0,
+                             "error": "backend-init-unavailable"})
+        (r,) = run([bench("m", 100.0)], [legacy])
+        assert r["status"] == "unmeasured-in-new"
+
+    def test_recovery_from_unmeasured_base(self):
+        (r,) = run([error_row("m")], [bench("m", 50.0)])
+        assert r["status"] == "recovered"
+        assert r["new"] == 50.0
+
+    def test_recovered_cost_metric_reports_best_repeat(self):
+        # lower-is-better recovery: report the benches' best-of-repeats
+        # (min), not the worst.
+        (r,) = run(
+            [error_row("m", unit="ms/call")],
+            [bench("m", 15.0, unit="ms/call"), bench("m", 12.0, unit="ms/call")],
+        )
+        assert r["status"] == "recovered"
+        assert r["new"] == 12.0
+
+    def test_new_only_unmeasured_row_is_reported(self):
+        # A brand-new bench that failed on its first run must still show
+        # up in the report (it would otherwise silently vanish).
+        results = run([bench("a", 1.0)], [bench("a", 1.0), error_row("b")])
+        by = {r["metric"]: r for r in results}
+        assert by["b"]["status"] == "unmeasured-new-only"
+        assert by["b"]["error"] == "backend-init-unavailable"
+
+    def test_bootstrap_error_row_matches_measured_label(self, tmp_path, capsys):
+        """THE label contract: bench_bootstrap's UNMEASURED row carries
+        the bare metric label, so an outage compares as
+        'unmeasured-in-new' against the measured baseline — not as a
+        vanished metric."""
+        from unittest import mock
+
+        from glom_tpu.telemetry import sinks
+
+        wd = mock.Mock()
+        wd.probe_once.return_value = "down"
+        wd.timeline.return_value = []
+        wd.record.return_value = {"backend_state": "down"}
+        with mock.patch(
+            "glom_tpu.telemetry.watchdog.BackendWatchdog", return_value=wd
+        ), mock.patch.dict("os.environ", {}, clear=False):
+            try:
+                assert sinks.bench_bootstrap("my_metric", "u") is False
+            finally:
+                from glom_tpu.telemetry.watchdog import set_global_watchdog
+
+                set_global_watchdog(None)
+        row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert row["kind"] == "error" and row["value"] is None
+        assert row["metric"] == "my_metric"  # bare — matches measured rows
+        (r,) = run([bench("my_metric", 5.0, unit="u")], [json.dumps(row)])
+        assert r["status"] == "unmeasured-in-new"
+
+    def test_new_metric_reported(self):
+        results = run([bench("a", 1.0)], [bench("a", 1.0), bench("b", 2.0)])
+        by = {r["metric"]: r for r in results}
+        assert by["b"]["status"] == "new-metric"
+
+    def test_threshold_is_configurable(self):
+        (r,) = run([bench("m", 100.0)], [bench("m", 90.0)], threshold=0.2)
+        assert r["status"] == "ok"
+
+
+class TestCli:
+    def test_fixture_pair_fails_the_gate(self, capsys):
+        """The committed CI fixture pair: one regression, one improvement,
+        one UNMEASURED — the gate must exit nonzero (the regression) while
+        the unmeasured row stays a warning."""
+        rc = compare_main([FIXTURE_BASE, FIXTURE_NEW])
+        assert rc == 1
+        out = capsys.readouterr()
+        summary = json.loads(out.out.strip().splitlines()[-1])
+        assert summary["kind"] == "summary"
+        assert summary["n_regression"] == 1
+        assert summary["n_improvement"] == 1
+        assert summary["n_unmeasured_in_new"] == 1
+        assert schema.validate_record(summary) == []
+
+    def test_self_compare_passes(self, capsys):
+        assert compare_main([FIXTURE_BASE, FIXTURE_BASE]) == 0
+
+    def test_fail_on_missing_flag(self, tmp_path, capsys):
+        base = tmp_path / "b.jsonl"
+        new = tmp_path / "n.jsonl"
+        base.write_text(bench("gone", 5.0) + "\n")
+        new.write_text(bench("other", 5.0) + "\n")
+        assert compare_main([str(base), str(new)]) == 0
+        assert compare_main([str(base), str(new), "--fail-on-missing"]) == 1
+
+    def test_compare_files_roundtrip(self):
+        results = compare_files(FIXTURE_BASE, FIXTURE_NEW)
+        statuses = {r["status"] for r in results}
+        assert "regression" in statuses and "unmeasured-in-new" in statuses

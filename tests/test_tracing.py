@@ -1,0 +1,550 @@
+"""Tracing subsystem tests: spans, step-windowed XLA capture, HBM
+accounting, the crash flight recorder (including the induced-crash
+acceptance path: dump -> schema lint -> event ordering), and the
+utils/profiling.py compat shim.
+
+Deliberately host-side: every test here uses fake step functions / fake
+devices / a monkeypatched jax.profiler, so the module adds no jit compiles
+to the tier-1 budget and runs without a profiler backend — which is the
+spans' and flight recorder's own contract.
+"""
+
+import json
+
+import jax.numpy as jnp
+import pytest
+
+from glom_tpu.telemetry import schema
+from glom_tpu.tracing import capture as cap_mod
+from glom_tpu.tracing.capture import TraceCapture, parse_trace_steps
+from glom_tpu.tracing.flight import (
+    FlightRecorder,
+    dump_flight_recorder,
+    observe_event,
+    set_global_flight_recorder,
+)
+from glom_tpu.tracing.memory import (
+    hbm_watermarks,
+    memory_record,
+    model_live_bytes_total,
+)
+from glom_tpu.tracing.spans import SpanAggregator, current_span, span
+
+
+class ListWriter:
+    def __init__(self):
+        self.records = []
+
+    def write(self, rec):
+        self.records.append(rec)
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_recorder():
+    """No test may leak a global flight recorder into the rest of the
+    suite (every sink in the process feeds it)."""
+    yield
+    set_global_flight_recorder(None)
+
+
+class TestSpans:
+    def test_span_emits_stamped_event(self):
+        w = ListWriter()
+        with span("host_data_next", writer=w, step=3):
+            pass
+        (rec,) = w.records
+        assert rec["kind"] == "span"
+        assert rec["name"] == "host_data_next"
+        assert rec["dur_s"] >= 0
+        assert rec["depth"] == 0
+        assert rec["step"] == 3
+        assert schema.validate_record(rec) == [], rec
+
+    def test_span_nesting_tracks_parent_and_depth(self):
+        w = ListWriter()
+        with span("outer", writer=w):
+            assert current_span() == "outer"
+            with span("inner", writer=w):
+                assert current_span() == "inner"
+        assert current_span() is None
+        inner, outer = w.records  # inner closes first
+        assert inner["name"] == "inner"
+        assert inner["parent"] == "outer"
+        assert inner["depth"] == 1
+        assert outer["depth"] == 0
+        assert "parent" not in outer
+
+    def test_span_reraises_and_still_records(self):
+        agg = SpanAggregator()
+        with pytest.raises(RuntimeError):
+            with span("x", aggregator=agg):
+                raise RuntimeError("boom")
+        assert current_span() is None
+        (rec,) = agg.records()
+        assert rec["count"] == 1
+
+    def test_aggregator_rollup_and_reset(self):
+        agg = SpanAggregator()
+        for dur in (0.01, 0.02, 0.03):
+            agg.observe("host_step_dispatch", dur)
+        agg.observe("host_data_next", 0.5)
+        recs = agg.records(extra={"step": 7.0})
+        by_name = {r["name"]: r for r in recs}
+        d = by_name["host_step_dispatch"]
+        assert d["count"] == 3
+        assert d["dur_s"] == pytest.approx(0.06, abs=1e-6)
+        assert d["max_ms"] == pytest.approx(30.0, abs=0.01)
+        assert d["mean_ms"] == pytest.approx(20.0, abs=0.01)
+        assert d["step"] == 7.0
+        for r in recs:
+            assert schema.validate_record(r) == [], r
+        # drained: the next logging boundary starts fresh
+        assert agg.records() == []
+
+
+class FakeProfiler:
+    """Stand-in for jax.profiler: records start/stop calls, no backend."""
+
+    def __init__(self):
+        self.calls = []
+
+    def start_trace(self, log_dir):
+        self.calls.append(("start", log_dir))
+
+    def stop_trace(self):
+        self.calls.append(("stop", None))
+
+    class StepTraceAnnotation:
+        def __init__(self, name, **kw):
+            pass
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+
+@pytest.fixture
+def fake_profiler(monkeypatch):
+    import jax
+
+    prof = FakeProfiler()
+    monkeypatch.setattr(jax, "profiler", prof)
+    return prof
+
+
+class TestTraceCapture:
+    def test_parse_specs(self):
+        assert parse_trace_steps("3:5") == (3, 5)
+        assert parse_trace_steps("7") == (7, 7)
+        for bad in ("5:3", "-1:2", "a:b", "1:2:3", ""):
+            with pytest.raises(ValueError):
+                parse_trace_steps(bad)
+
+    def test_window_opens_and_closes_at_bounds(self, fake_profiler):
+        w = ListWriter()
+        cap = TraceCapture.parse("2:4", "/tmp/tr", writer=w)
+        seen = []
+        for _ in range(7):
+            with cap.unit() as i:
+                seen.append((i, cap._active))
+        assert seen == [
+            (0, False), (1, False), (2, True), (3, True), (4, True),
+            (5, False), (6, False),
+        ]
+        assert fake_profiler.calls == [("start", "/tmp/tr"), ("stop", None)]
+        start, stop = w.records
+        assert start["note"] == "xla-trace-start"
+        assert start["first_step"] == 2
+        assert stop["note"] == "xla-trace-stop"
+        assert stop["steps_captured"] == 3
+        assert stop["last_step"] == 4
+        for r in w.records:
+            assert schema.validate_record(r) == [], r
+
+    def test_counter_spans_multiple_fit_calls(self, fake_profiler):
+        # The CLI's checkpoint-span pattern: one capture across fit calls.
+        cap = TraceCapture.parse("3:4", "/tmp/tr", writer=ListWriter())
+        for _ in range(2):  # span 1: units 0,1
+            with cap.unit():
+                pass
+        assert not fake_profiler.calls
+        for _ in range(3):  # span 2: units 2,3,4 — window 3:4 inside it
+            with cap.unit():
+                pass
+        assert fake_profiler.calls == [("start", "/tmp/tr"), ("stop", None)]
+
+    def test_close_truncates_open_window(self, fake_profiler):
+        w = ListWriter()
+        cap = TraceCapture.parse("1:100", "/tmp/tr", writer=w)
+        for _ in range(3):
+            with cap.unit():
+                pass
+        assert cap._active
+        cap.close()
+        cap.close()  # idempotent
+        assert fake_profiler.calls == [("start", "/tmp/tr"), ("stop", None)]
+        assert w.records[-1]["reason"] == "truncated-by-close"
+        # a closed capture never reopens
+        with cap.unit():
+            pass
+        assert len(fake_profiler.calls) == 2
+
+
+class FakeDevice:
+    def __init__(self, stats):
+        self._stats = stats
+
+    def memory_stats(self):
+        return self._stats
+
+
+class TestMemory:
+    STATS = {
+        "bytes_in_use": 1100,
+        "peak_bytes_in_use": 2000,
+        "bytes_limit": 4000,
+    }
+
+    def test_watermarks_from_device_stats(self):
+        wm = hbm_watermarks(FakeDevice(self.STATS))
+        assert wm == {
+            "hbm_bytes_in_use": 1100,
+            "hbm_peak_bytes": 2000,
+            "hbm_bytes_limit": 4000,
+        }
+
+    def test_no_stats_degrades_to_empty(self):
+        assert hbm_watermarks(FakeDevice(None)) == {}
+        assert memory_record(1000, device=FakeDevice(None)) == {}
+        # CPU backend (the test platform) has no allocator stats either:
+        # the probe the trainers install must stay a silent no-op there.
+        assert memory_record(1000) == {}
+
+    def test_drift_reconciles_against_model(self):
+        rec = memory_record(1000, device=FakeDevice(self.STATS))
+        assert rec["hbm_model_live_bytes"] == 1000
+        assert rec["hbm_model_drift"] == pytest.approx(0.1)
+        # no model -> watermarks only
+        rec = memory_record(None, device=FakeDevice(self.STATS))
+        assert "hbm_model_drift" not in rec
+        assert rec["hbm_bytes_in_use"] == 1100
+
+    def test_model_total_from_static_record(self):
+        static = {
+            "params_bytes_per_replica": 10,
+            "grads_bytes_per_replica": 20,
+            "opt_bytes_per_replica": 30,
+            "comm_bytes_per_step": 999,  # not a tenant
+        }
+        assert model_live_bytes_total(static) == 60
+
+    def test_raising_device_never_raises(self):
+        class Broken:
+            def memory_stats(self):
+                raise RuntimeError("plugin wedged")
+
+        assert memory_record(100, device=Broken()) == {}
+
+
+def _step_rec(i):
+    return schema.stamp({"step": float(i), "loss": 1.0 / (i + 1)},
+                        kind="train_step")
+
+
+class TestFlightRecorder:
+    def test_ring_keeps_last_n_in_order(self, tmp_path):
+        fr = FlightRecorder(tmp_path, capacity=5)
+        for i in range(12):
+            fr.observe(_step_rec(i))
+        path = fr.dump("manual")
+        lines = [json.loads(l) for l in open(path)]
+        header, events = lines[0], lines[1:]
+        assert header["kind"] == "note"
+        assert header["trigger"] == "manual"
+        assert header["n_events"] == 5
+        assert [e["step"] for e in events] == [7.0, 8.0, 9.0, 10.0, 11.0]
+        seqs = [e["flight_seq"] for e in events]
+        assert seqs == sorted(seqs)
+        assert schema.lint_stream(open(path)) == []
+
+    def test_dump_skips_when_nothing_new(self, tmp_path):
+        fr = FlightRecorder(tmp_path, capacity=4)
+        fr.observe(_step_rec(0))
+        assert fr.dump("one") is not None
+        assert fr.dump("atexit") is None  # no new events since
+        fr.observe(_step_rec(1))
+        assert fr.dump("two") is not None
+        assert len(fr.dumps) == 2
+
+    def test_watchdog_down_triggers_dump(self, tmp_path):
+        """The acceptance path: steps flow, the backend watchdog forces a
+        'down' transition through the shared writer, and the dump holds
+        the last N step + watchdog events in arrival order and passes the
+        schema linter."""
+        from glom_tpu.telemetry.watchdog import BackendWatchdog
+        from glom_tpu.utils.metrics import MetricsWriter
+
+        fr = FlightRecorder(tmp_path / "flight", capacity=8)
+        set_global_flight_recorder(fr)
+        writer = MetricsWriter(str(tmp_path / "m.jsonl"), echo=False)
+        for i in range(4):
+            writer.write({"step": float(i), "loss": 0.5})
+        probes = iter([8, None])
+        wd = BackendWatchdog(probe=lambda t: next(probes), writer=writer)
+        assert wd.probe_once() == "up"
+        assert wd.probe_once() == "down"
+        assert len(fr.dumps) == 1, "down transition must dump exactly once"
+        lines = [json.loads(l) for l in open(fr.dumps[0])]
+        assert lines[0]["trigger"] == "backend-down"
+        kinds = [l["kind"] for l in lines[1:]]
+        assert kinds == ["train_step"] * 4 + ["watchdog"] * 2
+        assert [l["backend_state"] for l in lines[-2:]] == ["up", "down"]
+        seqs = [l["flight_seq"] for l in lines[1:]]
+        assert seqs == sorted(seqs)
+        assert schema.lint_stream(open(fr.dumps[0])) == []
+
+    def test_writerless_watchdog_feeds_global_recorder(self, tmp_path):
+        from glom_tpu.telemetry.watchdog import BackendWatchdog
+
+        fr = FlightRecorder(tmp_path, capacity=8)
+        set_global_flight_recorder(fr)
+        wd = BackendWatchdog(probe=lambda t: None)  # no writer
+        wd.probe_once()
+        assert len(fr.dumps) == 1  # unknown -> down dumps immediately
+
+    def test_anomaly_storm_triggers_dump(self, tmp_path):
+        t = [0.0]
+        fr = FlightRecorder(
+            tmp_path, capacity=16, storm_threshold=3, storm_window_s=60.0,
+            clock=lambda: t[0],
+        )
+        anomaly = schema.stamp({"step": 1.0, "reason": "nonfinite"},
+                               kind="anomaly")
+        fr.observe(anomaly)
+        t[0] += 100.0  # outside the window: the counter must have aged out
+        fr.observe(anomaly)
+        assert fr.dumps == []
+        fr.observe(anomaly)
+        fr.observe(anomaly)  # 3 inside one window -> storm
+        assert len(fr.dumps) == 1
+        header = json.loads(open(fr.dumps[0]).readline())
+        assert header["trigger"] == "anomaly-storm"
+
+    def test_observe_never_raises(self, tmp_path, monkeypatch):
+        fr = FlightRecorder(tmp_path, capacity=2)
+        monkeypatch.setattr(
+            FlightRecorder, "dump",
+            lambda self, *a, **k: (_ for _ in ()).throw(OSError("disk full")),
+        )
+        # trigger event with a broken dump: swallowed, run survives
+        fr.observe(schema.stamp(
+            {"backend_state": "down", "t": 1.0}, kind="watchdog"
+        ))
+
+    def test_global_helpers_are_noops_without_recorder(self):
+        observe_event({"kind": "note", "note": "x"})
+        assert dump_flight_recorder("whatever") is None
+
+    def test_metrics_writer_and_emit_feed_global_recorder(self, tmp_path, capsys):
+        from glom_tpu.telemetry.sinks import emit
+        from glom_tpu.utils.metrics import MetricsWriter
+
+        fr = FlightRecorder(tmp_path, capacity=8)
+        set_global_flight_recorder(fr)
+        w = MetricsWriter(str(tmp_path / "m.jsonl"), echo=False)
+        w.write({"step": 0, "loss": 1.0})
+        emit({"metric": "m", "value": 1.0, "unit": "u"})
+        capsys.readouterr()
+        path = fr.dump("check")
+        kinds = [json.loads(l)["kind"] for l in open(path)][1:]
+        assert kinds == ["train_step", "bench"]
+
+    def test_fit_loop_exception_dumps_postmortem(self, tmp_path):
+        """Induced crash inside fit_loop (acceptance criterion): the dump
+        exists, names the exception, holds the preceding step records in
+        order, and passes the schema linter; the exception re-raises."""
+        from glom_tpu.train.trainer import fit_loop
+        from glom_tpu.utils.metrics import MetricsWriter
+
+        fr = FlightRecorder(tmp_path / "flight", capacity=16)
+        set_global_flight_recorder(fr)
+        writer = MetricsWriter(str(tmp_path / "m.jsonl"), echo=False)
+        calls = [0]
+
+        def fake_step(batch):
+            calls[0] += 1
+            if calls[0] == 4:
+                raise RuntimeError("induced crash")
+            return {"loss": 0.5, "step": float(calls[0] - 1)}
+
+        def data():
+            while True:
+                yield None
+
+        with pytest.raises(RuntimeError, match="induced crash"):
+            fit_loop(fake_step, data(), 10, log_every=1,
+                     metrics_writer=writer)
+        assert len(fr.dumps) == 1
+        lines = [json.loads(l) for l in open(fr.dumps[0])]
+        header = lines[0]
+        assert header["trigger"] == "fit-loop-exception"
+        assert "RuntimeError: induced crash" in header["exception"]
+        assert header["at_iteration"] == 3
+        steps = [l["step"] for l in lines[1:] if l["kind"] == "train_step"]
+        assert steps == [0.0, 1.0, 2.0]
+        assert schema.lint_stream(open(fr.dumps[0])) == []
+
+    def test_fit_loop_writerless_still_feeds_recorder(self, tmp_path):
+        from glom_tpu.train.trainer import fit_loop
+
+        fr = FlightRecorder(tmp_path, capacity=16)
+        set_global_flight_recorder(fr)
+
+        def fake_step(batch):
+            return {"loss": 0.5, "step": 0.0}
+
+        fit_loop(fake_step, iter(lambda: None, 1), 2, log_every=1)
+        path = fr.dump("check")
+        kinds = [json.loads(l)["kind"] for l in open(path)][1:]
+        assert "train_step" in kinds and "span" in kinds
+
+    def test_sigterm_hook_dumps(self, tmp_path):
+        import os
+        import signal
+
+        fr = FlightRecorder(tmp_path, capacity=4)
+        fr.observe(_step_rec(0))
+        prev = signal.getsignal(signal.SIGTERM)
+        try:
+            fr.install_process_hooks(on_exit=False)
+            with pytest.raises(SystemExit):
+                os.kill(os.getpid(), signal.SIGTERM)
+            assert len(fr.dumps) == 1
+            assert json.loads(open(fr.dumps[0]).readline())["trigger"] == "sigterm"
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+
+    def test_sigterm_hook_preserves_ignored_disposition(self, tmp_path):
+        # A host that set SIG_IGN must stay alive through SIGTERM — the
+        # hook dumps and returns instead of converting ignore into exit.
+        import os
+        import signal
+
+        fr = FlightRecorder(tmp_path, capacity=4)
+        fr.observe(_step_rec(0))
+        prev = signal.getsignal(signal.SIGTERM)
+        try:
+            signal.signal(signal.SIGTERM, signal.SIG_IGN)
+            fr.install_process_hooks(on_exit=False)
+            os.kill(os.getpid(), signal.SIGTERM)  # must NOT raise
+            assert len(fr.dumps) == 1
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+
+    def test_capacity_validation(self, tmp_path):
+        with pytest.raises(ValueError):
+            FlightRecorder(tmp_path, capacity=0)
+        with pytest.raises(ValueError):
+            FlightRecorder(tmp_path, storm_threshold=0)
+
+
+class TestFitLoopTracingHooks:
+    """fit_loop's span/memory/trace plumbing on a fake step — no compiles."""
+
+    def _data(self):
+        while True:
+            yield None
+
+    def test_logging_records_carry_spans_and_memory(self, tmp_path):
+        from glom_tpu.train.trainer import fit_loop
+        from glom_tpu.utils.metrics import MetricsWriter
+
+        path = tmp_path / "m.jsonl"
+        writer = MetricsWriter(str(path), echo=False)
+        n = [0]
+
+        def fake_step(batch):
+            n[0] += 1
+            return {"loss": 1.0, "step": float(n[0] - 1)}
+
+        probe = lambda: {"hbm_bytes_in_use": 123, "hbm_model_drift": 0.01}
+        history = fit_loop(
+            fake_step, self._data(), 4, log_every=2,
+            metrics_writer=writer, memory_probe=probe,
+        )
+        assert all(r["hbm_bytes_in_use"] == 123 for r in history)
+        recs = [json.loads(l) for l in path.read_text().splitlines()]
+        span_recs = [r for r in recs if r["kind"] == "span"]
+        names = {r["name"] for r in span_recs}
+        assert {"host_data_next", "host_step_dispatch", "host_log_fetch"} <= names
+        # two logging boundaries -> each phase drained twice
+        assert sum(r["name"] == "host_data_next" for r in span_recs) == 2
+        # the rollup covers every step since the previous boundary
+        first = next(r for r in span_recs if r["name"] == "host_data_next")
+        assert first["count"] == 2
+        for r in recs:
+            assert schema.validate_record(r) == [], r
+        # history itself stays homogeneous train_step records
+        assert all(r["kind"] == "train_step" for r in history)
+
+    def test_trace_capture_advances_per_step(self, fake_profiler, tmp_path):
+        from glom_tpu.train.trainer import fit_loop
+
+        cap = TraceCapture.parse("1:2", "/tmp/tr", writer=ListWriter())
+        fit_loop(lambda b: {"loss": 1.0, "step": 0.0}, self._data(), 4,
+                 log_every=4, trace_capture=cap)
+        assert fake_profiler.calls == [("start", "/tmp/tr"), ("stop", None)]
+        assert cap._count == 4
+
+
+class TestProfilingShim:
+    def test_reexports_are_the_tracing_objects(self):
+        from glom_tpu import tracing
+        from glom_tpu.utils import profiling
+
+        assert profiling.trace is tracing.capture.trace
+        assert profiling.start_server is tracing.capture.start_server
+        assert profiling.annotate is tracing.capture.annotate
+        assert profiling.perf_report is tracing.report.perf_report
+        assert profiling.StepTimer is tracing.report.StepTimer
+
+    def test_trace_context_manager_drives_profiler(self, fake_profiler):
+        from glom_tpu.utils.profiling import trace
+
+        with trace("/tmp/shimtrace") as d:
+            assert d == "/tmp/shimtrace"
+        assert fake_profiler.calls == [("start", "/tmp/shimtrace"),
+                                       ("stop", None)]
+        # stop must run on exception too (no leaked profiler session)
+        with pytest.raises(RuntimeError):
+            with trace("/tmp/shimtrace2"):
+                raise RuntimeError("boom")
+        assert fake_profiler.calls[-1] == ("stop", None)
+
+    def test_perf_report_math(self):
+        from glom_tpu.utils.config import GlomConfig
+        from glom_tpu.utils.metrics import flops_per_column_iter, mfu
+        from glom_tpu.utils.profiling import perf_report
+
+        cfg = GlomConfig(dim=16, levels=3, image_size=8, patch_size=2)
+        rep = perf_report(
+            cfg, column_iters_per_sec=1000.0, chip="cpu", num_chips=2,
+            backward=True,
+        )
+        assert rep["column_iters_per_sec_per_chip"] == 500.0
+        assert rep["flops_per_column_iter"] == flops_per_column_iter(cfg)
+        assert rep["mfu"] == mfu(cfg, 500.0, chip="cpu", backward=True)
+        assert rep["num_chips"] == 2
+
+    def test_step_timer_best(self):
+        from glom_tpu.utils.profiling import StepTimer
+
+        t = StepTimer()
+        for _ in range(3):
+            t.start()
+            t.stop(sync_scalar=jnp.float32(1.0))
+        assert len(t.history) == 3
+        assert t.best == min(t.history)
+        assert t.best >= 0
