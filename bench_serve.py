@@ -1,0 +1,285 @@
+"""Serving benchmark: offered-load sweep -> latency percentiles + throughput.
+
+The training benches measure steady-state step time; serving is judged on
+the LATENCY DISTRIBUTION under load — p50 is what the median user feels,
+p95/p99 are what the SLO is written against, and throughput is what the
+fleet bill is written against. This harness drives the real stack
+(InferenceEngine + DynamicBatcher, glom_tpu/serve) end to end:
+
+  1. AOT warmup of every bucket (compile time per bucket on the record —
+     the cliff warmup exists to remove);
+  2. a closed-loop ceiling measurement: back-to-back full-bucket
+     dispatches -> max sustainable requests/sec;
+  3. an open-loop offered-load sweep at fractions of that ceiling:
+     requests submitted at the offered rate through the batcher, per-
+     request latency collected from tickets -> p50/p95/p99 + achieved
+     throughput per load point (StepTimeStats percentiles);
+  4. with iters="auto": the early-exit iteration histogram — how many
+     column updates requests ACTUALLY ran vs the fixed budget — as a
+     schema-v3 "serve" record plus the mean-iters bench row.
+
+Rows ride sinks.emit / bench_bootstrap like every other bench: UNMEASURED
+is an "error" record with value null (never a dead zero), every row stamps
+the watchdog backend state, and the output lints with
+`python -m glom_tpu.telemetry FILE` and gates with `... compare`
+(run_hw_queue.sh serve steps).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def run_sweep(cfg, scfg, label: str, *, n_requests: int, load_fracs,
+              ceiling_repeats: int) -> None:
+    import numpy as np
+
+    from glom_tpu.serve.batcher import DynamicBatcher, ShedError
+    from glom_tpu.serve.engine import InferenceEngine
+    from glom_tpu.telemetry.sinks import StepTimeStats, emit
+
+    engine = InferenceEngine(cfg, scfg)
+    for bucket, dt in engine.warmup().items():
+        emit(
+            {"event": "warmup", "bucket": bucket,
+             "compile_time_s": round(dt, 4), "config": label},
+            kind="serve",
+        )
+
+    top = max(scfg.buckets)
+    rng = np.random.default_rng(0)
+    imgs = rng.normal(size=(top, cfg.channels, cfg.image_size, cfg.image_size)
+                      ).astype(np.float32)
+
+    # 2. Closed-loop ceiling: back-to-back full buckets, min over repeats
+    # (jitter only ever slows things down — bench.py's convention).
+    per_batch = min(
+        engine.infer(imgs, n_valid=top).latency_s
+        for _ in range(ceiling_repeats)
+    )
+    ceiling = top / per_batch
+    emit(
+        {
+            "metric": f"serve_throughput_ceiling ({label})",
+            "value": round(ceiling, 2),
+            "unit": "req/s",
+            "bucket": top,
+            "batch_latency_ms": round(1e3 * per_batch, 3),
+        }
+    )
+
+    # 3. Open-loop offered-load sweep through the batcher.
+    for frac in load_fracs:
+        rate = max(ceiling * frac, 1e-6)
+        stats = StepTimeStats()
+        stats.observe(0.0, is_compile=True)  # no compile phase here
+        served = shed = 0
+        t0 = time.perf_counter()
+        with DynamicBatcher(engine) as batcher:
+            tickets = []
+            for i in range(n_requests):
+                target = t0 + i / rate
+                delay = target - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                try:
+                    tickets.append(batcher.submit(imgs[i % top]))
+                except ShedError:
+                    shed += 1
+            for t in tickets:
+                try:
+                    _, _, latency_s = t.result(timeout=600.0)
+                except Exception:
+                    shed += 1
+                    continue
+                served += 1
+                stats.observe(latency_s, is_compile=False)
+        wall = time.perf_counter() - t0
+        s = stats.summary()
+        base = f"load={frac:.2f}x, {label}"
+        if served == 0:
+            # Every request shed or failed: these rows are UNMEASURED —
+            # kind "error", value null — never the 0.0ms/0rps dead zeros
+            # the compare gate would read as a massive improvement.
+            for name, unit in (
+                (f"serve_p50_latency ({base})", "ms"),
+                (f"serve_p95_latency ({base})", "ms"),
+                (f"serve_p99_latency ({base})", "ms"),
+                (f"serve_throughput ({base})", "req/s"),
+            ):
+                emit(
+                    {
+                        "metric": name,
+                        "value": None,
+                        "unit": unit,
+                        "error": "no-requests-served",
+                        "note": f"UNMEASURED: all {n_requests} requests "
+                        f"shed or failed ({shed} shed)",
+                    },
+                    kind="error",
+                )
+            emit(dict(batcher.summary_record(), config=base), kind="serve")
+            continue
+        emit(
+            {
+                "metric": f"serve_p50_latency ({base})",
+                "value": s["step_time_p50_ms"],
+                "unit": "ms",
+                "offered_rps": round(rate, 2),
+                "served": served,
+                "shed": shed,
+            }
+        )
+        emit(
+            {
+                "metric": f"serve_p95_latency ({base})",
+                "value": s["step_time_p95_ms"],
+                "unit": "ms",
+            }
+        )
+        emit(
+            {
+                "metric": f"serve_p99_latency ({base})",
+                "value": s["step_time_p99_ms"],
+                "unit": "ms",
+            }
+        )
+        emit(
+            {
+                "metric": f"serve_throughput ({base})",
+                "value": round(served / wall, 2) if wall > 0 else 0.0,
+                "unit": "req/s",
+            }
+        )
+        # The batcher's own evidence: dispatch mix + iteration histogram.
+        emit(dict(batcher.summary_record(), config=base), kind="serve")
+
+    # 4. Early-exit accounting (only meaningful on the auto route).
+    # Genuinely closed-loop: submit in windows no larger than half the
+    # queue and drain each window before the next, so --requests beyond
+    # queue_depth cannot overrun the bounded queue; a failed request
+    # drops one sample, never the histogram rows the gate expects.
+    if engine.iters_key == "auto":
+        iters = []
+        window = max(1, min(scfg.queue_depth // 2, 32))
+        with DynamicBatcher(engine) as batcher:
+            for start in range(0, n_requests, window):
+                tickets = []
+                for i in range(start, min(start + window, n_requests)):
+                    try:
+                        tickets.append(batcher.submit(imgs[i % top]))
+                    except ShedError:
+                        continue
+                for t in tickets:
+                    try:
+                        _, iters_run, _ = t.result(timeout=600.0)
+                    except Exception:
+                        continue
+                    iters.append(iters_run)
+        budget = (
+            scfg.max_auto_iters
+            if scfg.max_auto_iters is not None
+            else cfg.default_iters
+        )
+        if iters:
+            hist: dict = {}
+            for it in iters:
+                hist[str(it)] = hist.get(str(it), 0) + 1
+            emit(
+                {
+                    "event": "iter_histogram",
+                    "config": label,
+                    "budget": budget,
+                    "histogram": hist,
+                    "n": len(iters),
+                },
+                kind="serve",
+            )
+            emit(
+                {
+                    "metric": f"serve_auto_mean_iters ({label})",
+                    "value": round(sum(iters) / len(iters), 3),
+                    "unit": "iters/request",
+                    "budget": budget,
+                }
+            )
+        else:
+            emit(
+                {
+                    "metric": f"serve_auto_mean_iters ({label})",
+                    "value": None,
+                    "unit": "iters/request",
+                    "error": "no-requests-served",
+                    "note": "UNMEASURED: early-exit pass served nothing",
+                },
+                kind="error",
+            )
+    for rec in engine.stats_records():
+        emit(dict(rec, config=label), kind="serve")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--requests", type=int, default=None,
+                    help="requests per load point (default: 48 TPU, 16 CPU)")
+    ap.add_argument("--iters", default=None,
+                    help="override the preset route: an int or 'auto'")
+    args = ap.parse_args(argv)
+
+    from glom_tpu.telemetry.sinks import bench_bootstrap, emit
+
+    if not bench_bootstrap("serve_p95_latency", "ms"):
+        return 0
+
+    import dataclasses
+
+    import jax
+
+    from glom_tpu.utils.config import GlomConfig, ServeConfig
+    from glom_tpu.utils.metrics import detect_chip
+    from glom_tpu.utils.presets import get_preset
+
+    chip = detect_chip()
+    on_tpu = chip != "cpu"
+    if on_tpu:
+        preset = get_preset("imagenet224-dp8")
+        cfg, scfg = preset.model, preset.serve
+        label = f"ImageNet-224 L6 d512 bf16, {chip}"
+        n_requests = args.requests or 48
+        load_fracs = (0.25, 0.5, 0.8)
+        ceiling_repeats = 5
+    else:
+        # CPU fallback: the labelled small config — live numbers for the
+        # harness/CI, never a dead zero for the trajectory.
+        cfg = GlomConfig(dim=64, levels=3, image_size=16, patch_size=4)
+        scfg = ServeConfig(
+            buckets=(1, 2, 4), max_batch=4, max_delay_ms=2.0,
+            iters="auto", exit_threshold=1e-3,
+        )
+        label = "cpu-fallback cfg"
+        n_requests = args.requests or 16
+        load_fracs = (0.5,)
+        ceiling_repeats = 2
+        emit(
+            {"note": "TPU backend unavailable; measuring the labelled "
+             "cpu-fallback serve config instead of recording a dead zero"},
+            kind="note",
+        )
+    if args.iters is not None:
+        scfg = dataclasses.replace(
+            scfg,
+            iters="auto" if args.iters == "auto" else int(args.iters),
+        )
+    del jax  # imported to fail fast before any measurement if broken
+    run_sweep(
+        cfg, scfg, label,
+        n_requests=n_requests,
+        load_fracs=load_fracs,
+        ceiling_repeats=ceiling_repeats,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

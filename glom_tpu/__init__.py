@@ -17,6 +17,8 @@ Layering (bottom to top):
   parallel/  mesh (ICI + multi-slice DCN) / sharding / ring + halo + Ulysses
              sequence parallelism / the fully-manual shard_map path that
              runs the Pallas kernels under DP x SP
+  serve/     batched inference engine: AOT-warmed compiled forwards per
+             bucket, dynamic batching with shed, consensus early exit
   utils/     config presets, checkpointing, metrics, profiling
 """
 

@@ -24,6 +24,7 @@ def prefetch_to_device(
     *,
     size: int = 2,
     sharding: Optional[jax.sharding.Sharding] = None,
+    metrics_writer=None,
 ) -> Iterator:
     """Wrap `data` so the next `size` batches are already on device (laid
     out per `sharding` if given — pass the DistributedTrainer's batch
@@ -38,11 +39,23 @@ def prefetch_to_device(
     infinite dataset and returns) signals the worker to stop and drains
     the staged batches, so neither the thread nor the device buffers
     outlive the consumer.
+
+    The worker's two host phases are span-covered (tracing.spans.spanned:
+    host_prefetch_next = pulling from the source iterator,
+    host_prefetch_stage = initiating the device transfer) into a private
+    aggregator; `metrics_writer` (when given) receives the per-phase
+    rollup "span" records when the stream ends — the last unattributed
+    host-time sink the ROADMAP named. Without a writer the rollups feed
+    the global flight recorder.
     """
     if size < 1:
         raise ValueError(f"prefetch size must be >= 1, got {size}")
     q: queue.Queue = queue.Queue(maxsize=size)
     stop = threading.Event()
+
+    from glom_tpu.tracing.spans import SpanAggregator, spanned
+
+    spans = SpanAggregator()
 
     def put(item) -> bool:
         """Blocking put that aborts when the consumer is gone."""
@@ -54,20 +67,35 @@ def prefetch_to_device(
                 continue
         return False
 
+    stage = spanned("host_prefetch_stage", aggregator=spans)(
+        lambda batch: jax.device_put(batch, sharding)
+        if sharding is not None
+        else jax.device_put(batch)
+    )
+    pull_next = spanned("host_prefetch_next", aggregator=spans)(
+        lambda it: next(it, _END)
+    )
+
     def worker():
         try:
-            for batch in data:
-                staged = (
-                    jax.device_put(batch, sharding)
-                    if sharding is not None
-                    else jax.device_put(batch)
-                )
-                if not put(staged):
+            while True:
+                batch = pull_next(iter_data)
+                if batch is _END:
+                    break
+                if not put(stage(batch)):
                     return
         except BaseException as e:  # noqa: BLE001 - relay to the consumer
             put((_END, e))
             return
         put((_END, None))
+
+    iter_data = iter(data)
+
+    def _drain_spans():
+        from glom_tpu.tracing.flight import write_or_observe
+
+        for rec in spans.records(extra={"source": "prefetch_to_device"}):
+            write_or_observe(metrics_writer, rec)
 
     thread = threading.Thread(target=worker, daemon=True)
     thread.start()
@@ -105,5 +133,6 @@ def prefetch_to_device(
                     break
                 deadline -= 1
             drain()
+            _drain_spans()
 
     return gen()

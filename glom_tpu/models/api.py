@@ -57,6 +57,9 @@ class Glom:
         use_pallas: Optional[bool] = None,
         mesh: Optional[Union[MeshConfig, object]] = None,
         sp_strategy: str = "none",
+        exit_threshold: float = 1e-3,
+        auto_max_iters: Optional[int] = None,
+        auto_min_iters: int = 1,
     ):
         if backend not in ("tpu", "cpu", "xla"):
             raise ValueError(
@@ -108,9 +111,68 @@ class Glom:
             key = key if key is not None else jax.random.PRNGKey(0)
             params = init_glom(key, self.config, param_dtype)
         self.params = params
+        # Consensus early-exit policy for iters="auto" (serve/early_exit):
+        # exit once no level's agreement moves more than exit_threshold
+        # between iterations, bounded by auto_max_iters (None -> 2L).
+        self.exit_threshold = exit_threshold
+        self.auto_max_iters = auto_max_iters
+        self.auto_min_iters = auto_min_iters
+        # Device scalar: how many iterations the last iters="auto" call
+        # actually ran (read it host-side with int(...) — that syncs).
+        self.last_auto_iters: Optional[jax.Array] = None
         self._jitted = {}
 
+    def _auto_forward(self, return_all):
+        """iters='auto' route: the early-exit while_loop forward
+        (glom_tpu/serve/early_exit). Single-device only — the sharded
+        forwards are fixed-length by construction (collectives inside a
+        while_loop body would need per-iteration dispatch)."""
+        if return_all:
+            raise ValueError(
+                "iters='auto' is incompatible with return_all=True: the "
+                "early exit makes the number of stacked states data-"
+                "dependent, which XLA cannot shape"
+            )
+        if self.mesh is not None:
+            raise NotImplementedError(
+                "iters='auto' is single-device (serving buckets replicate "
+                "the model); drop mesh= or use a fixed iteration count"
+            )
+        from glom_tpu.serve.early_exit import glom_forward_auto  # lazy
+
+        max_iters = (
+            self.auto_max_iters
+            if self.auto_max_iters is not None
+            else self.config.default_iters
+        )
+        sig = ("auto", max_iters, self.exit_threshold, self.auto_min_iters)
+        if sig not in self._jitted:
+
+            def fn(params, img, levels):
+                final, iters_run, _ = glom_forward_auto(
+                    params, img, self.config,
+                    max_iters=max_iters,
+                    threshold=self.exit_threshold,
+                    min_iters=self.auto_min_iters,
+                    levels=levels,
+                    compute_dtype=self.compute_dtype,
+                    use_pallas=self.use_pallas,
+                )
+                return final, iters_run
+
+            self._jitted[sig] = jax.jit(fn)
+        jitted = self._jitted[sig]
+
+        def call(params, img, levels):
+            final, iters_run = jitted(params, img, levels)
+            self.last_auto_iters = iters_run
+            return final
+
+        return call
+
     def _forward(self, iters, return_all):
+        if iters == "auto":
+            return self._auto_forward(return_all)
         # Normalize before keying so iters=None and the explicit default share
         # one compiled program; levels-presence is already distinguished by
         # jax.jit's own pytree-structure cache.
@@ -203,12 +265,25 @@ class Glom:
     def __call__(
         self,
         img: jnp.ndarray,
-        iters: Optional[int] = None,
+        iters: Union[int, str, None] = None,
         levels: Optional[jnp.ndarray] = None,
         return_all: bool = False,
     ) -> jnp.ndarray:
         """forward(img, iters=None, levels=None, return_all=False) — the
-        reference signature, jit-compiled and memoized per static config."""
+        reference signature, jit-compiled and memoized per static config.
+
+        iters="auto" (beyond the reference) runs consensus early exit:
+        up to auto_max_iters column updates, stopping once no level's
+        agreement moves more than exit_threshold between iterations
+        (docs/SERVING.md); the actual count lands on `last_auto_iters`.
+        With exit_threshold=0.0 the exit never fires: exactly max_iters
+        updates run, and on the reference-layout route (use_pallas=False)
+        the output equals the fixed-iters forward BITWISE. With
+        use_pallas=True the fixed route runs the fused level-major
+        program while the auto route runs the reference-layout body with
+        fused FFWs (dense consensus — the while_loop keeps one witness
+        across routes), so the two agree to kernel-parity tolerance, not
+        bit-for-bit."""
         fn = self._forward(iters, return_all)
         return fn(self.params, img, levels)
 

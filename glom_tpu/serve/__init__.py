@@ -1,0 +1,50 @@
+"""Serving: batched inference with AOT warmup and consensus early exit.
+
+The training stack ends at a checkpoint; this subsystem is what stands
+between that checkpoint and traffic (docs/SERVING.md). Layers:
+
+    engine     — InferenceEngine: params + one AOT-compiled forward per
+                 (bucket, iters-route) signature, explicit warmup(),
+                 donated input buffers, per-bucket latency histograms
+    batcher    — DynamicBatcher: bounded request queue, max_batch /
+                 max_delay_ms admission, pad-to-bucket with mask, and the
+                 fast-fail shed path wired to the backend watchdog
+    early_exit — glom_forward_auto: lax.while_loop over column updates
+                 with the per-level consensus-agreement delta as the
+                 stopping witness (iters="auto"; static max_iters keeps
+                 shapes fixed)
+    cli        — `python -m glom_tpu.serve`: the stdin/file micro-server
+
+Re-exports are LAZY (PEP 562, same pattern as glom_tpu/telemetry): the
+batcher's shed errors and ServeConfig must be importable without paying
+the jax import, and engine/early_exit pull jax only when actually used.
+"""
+
+_EXPORTS = {
+    "InferenceEngine": "engine",
+    "ServeResult": "engine",
+    "BackendDownError": "batcher",
+    "DynamicBatcher": "batcher",
+    "QueueFullError": "batcher",
+    "ShedError": "batcher",
+    "Ticket": "batcher",
+    "batch_agreement": "early_exit",
+    "glom_forward_auto": "early_exit",
+    "masked_level_agreement": "early_exit",
+    "emit_serve": "events",
+    "stamp_serve": "events",
+}
+_SUBMODULES = ("batcher", "cli", "early_exit", "engine", "events")
+
+__all__ = sorted([*_EXPORTS, *_SUBMODULES])
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in _SUBMODULES:
+        return importlib.import_module(f"glom_tpu.serve.{name}")
+    if name in _EXPORTS:
+        module = importlib.import_module(f"glom_tpu.serve.{_EXPORTS[name]}")
+        return getattr(module, name)
+    raise AttributeError(f"module 'glom_tpu.serve' has no attribute {name!r}")

@@ -1,0 +1,10 @@
+"""`python -m glom_tpu.serve ...` — the serving micro-server entry point
+(serve/cli.py; `-m glom_tpu.serve.cli` works too but trips runpy's
+already-imported warning, same as the telemetry CLI)."""
+
+import sys
+
+if __name__ == "__main__":
+    from glom_tpu.serve.cli import main
+
+    sys.exit(main(sys.argv[1:]))

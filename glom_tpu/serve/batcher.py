@@ -1,0 +1,388 @@
+"""Host-side dynamic batching: bounded queue, bucket padding, shed path.
+
+TPU serving economics are batch economics: one column-update of a batch-8
+bucket costs barely more than batch-1 (the MXU is latency-bound at tiny
+batches), so the host's job is to GATHER concurrent requests into bucket
+shapes without letting the gathering itself become the latency. The
+classic admission policy does it with two knobs:
+
+  * max_batch — dispatch the moment this many requests are waiting (the
+    throughput ceiling; never above the engine's largest bucket);
+  * max_delay_ms — dispatch anyway once the OLDEST waiting request has
+    aged this long (the latency floor: a lone 3am request pays at most
+    max_delay_ms of gathering, not forever).
+
+Gathered requests pad up to the smallest admitting bucket (the engine only
+ever sees precompiled shapes — no mid-traffic recompiles) with a validity
+mask, so pad rows neither reach callers nor vote on the consensus
+early-exit witness (serve/early_exit.masked_level_agreement).
+
+Failure discipline (the PR 2/3 lesson — a wedged backend must fail FAST
+and leave evidence, never hang):
+
+  * the request queue is BOUNDED: a submit against a full queue sheds
+    immediately with QueueFullError (backpressure to the caller, who can
+    retry/downgrade) and a schema-v3 "serve" shed event;
+  * when the global backend watchdog says "down", submissions and any
+    already-gathered requests fail fast with BackendDownError, and each
+    emits a schema-v3 "error" record carrying the machine-readable cause —
+    the serving analog of sinks.bench_bootstrap's UNMEASURED record;
+  * a dispatch exception fails ONLY that batch's requests (each ticket
+    re-raises it) and the worker keeps serving.
+
+Host phases ride tracing.spans (SERVE_PHASES: serve_enqueue, serve_batch,
+serve_dispatch, serve_fetch), aggregated per phase and drained by
+span_records() — the same <1%-overhead rollup form the fit loop uses.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from glom_tpu.telemetry import schema
+from glom_tpu.tracing.spans import SpanAggregator, span
+
+
+class ShedError(RuntimeError):
+    """Base of the fast-fail admission errors (never a hang)."""
+
+
+class QueueFullError(ShedError):
+    """Bounded queue at capacity: backpressure, retry later."""
+
+
+class BackendDownError(ShedError):
+    """The backend watchdog reports the accelerator down."""
+
+
+class Ticket:
+    """One request's future: result() blocks until served or failed."""
+
+    def __init__(self, request_id):
+        self.request_id = request_id
+        self._done = threading.Event()
+        self._levels: Optional[np.ndarray] = None
+        self._iters_run: Optional[int] = None
+        self._latency_s: Optional[float] = None
+        self._error: Optional[BaseException] = None
+        self.t_submit = time.perf_counter()
+
+    def _resolve(self, levels, iters_run):
+        self._levels = levels
+        self._iters_run = iters_run
+        self._latency_s = time.perf_counter() - self.t_submit
+        self._done.set()
+
+    def _fail(self, exc: BaseException):
+        self._error = exc
+        self._latency_s = time.perf_counter() - self.t_submit
+        self._done.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        """(levels [n, L, d], iters_run, latency_s) for THIS request, or
+        re-raises the failure. latency_s is submit-to-resolve wall time —
+        queueing + gathering + dispatch + fetch, the number the user felt."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not served within {timeout}s"
+            )
+        if self._error is not None:
+            raise self._error
+        return self._levels, self._iters_run, self._latency_s
+
+
+class _Request:
+    __slots__ = ("img", "ticket")
+
+    def __init__(self, img: np.ndarray, ticket: Ticket):
+        self.img = img
+        self.ticket = ticket
+
+
+def _backend_down() -> bool:
+    from glom_tpu.telemetry.watchdog import backend_record
+
+    return backend_record().get("backend_state") == "down"
+
+
+class DynamicBatcher:
+    """The admission scheduler in front of an InferenceEngine.
+
+    Lifecycle: use as a context manager (or start()/stop()). submit() is
+    thread-safe and returns a Ticket; a single worker thread gathers,
+    pads, and dispatches. `engine` needs .infer(imgs, n_valid) ->
+    ServeResult and .pick_bucket(n) — the tests drive the policy with a
+    fake engine, no device required.
+    """
+
+    def __init__(
+        self,
+        engine,
+        *,
+        max_batch: Optional[int] = None,
+        max_delay_ms: Optional[float] = None,
+        queue_depth: Optional[int] = None,
+        writer=None,
+        shed_when_down: bool = True,
+        clock=time.perf_counter,
+    ):
+        scfg = getattr(engine, "scfg", None)
+        self.engine = engine
+        self.max_batch = (
+            max_batch if max_batch is not None
+            else (scfg.max_batch if scfg else 8)
+        )
+        self.max_delay_s = (
+            max_delay_ms if max_delay_ms is not None
+            else (scfg.max_delay_ms if scfg else 5.0)
+        ) / 1e3
+        depth = (
+            queue_depth if queue_depth is not None
+            else (scfg.queue_depth if scfg else 64)
+        )
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch {self.max_batch} must be >= 1")
+        self.writer = writer
+        self.shed_when_down = shed_when_down
+        self._clock = clock
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.spans = SpanAggregator()
+        # Counters for the end-of-run summary record.
+        self.n_submitted = 0
+        self.n_served = 0
+        self.n_shed = 0
+        self.n_failed = 0
+        self.dispatches: List[dict] = []  # one dict per dispatched batch
+        self._counter_lock = threading.Lock()
+        self._seq = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "DynamicBatcher":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._worker, name="glom-serve-batcher", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        """Stop the worker. drain=True serves what is already queued first
+        (the graceful path); False fails queued requests FAST — the queue
+        is drained and every ticket failed BEFORE waiting on the worker,
+        so at most the one in-flight batch dispatches after the call.
+        Also safe on a never-started batcher: queued tickets are failed
+        (drain=False) — there is no worker to ever resolve them."""
+        self._stop.set()
+        if not drain:
+            self._fail_queued()
+        if self._thread is not None:
+            # drain=True: the worker exits once the stop flag is set AND
+            # the queue is empty — queued work is served on the way out.
+            self._thread.join(timeout=60.0)
+            self._thread = None
+        # Whatever is STILL queued (drain=True with a dead/timed-out
+        # worker, or a never-started batcher) can no longer resolve.
+        self._fail_queued()
+
+    def _fail_queued(self) -> None:
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                return
+            req.ticket._fail(ShedError("batcher stopped"))
+
+    def __enter__(self) -> "DynamicBatcher":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, img) -> Ticket:
+        """Enqueue one [c, H, W] request. Sheds immediately (raises) when
+        the queue is full or the backend is down — admission never blocks
+        the caller. Requests submitted before start() queue up and are
+        served once the worker runs; stop() fails whatever can no longer
+        resolve, so a ticket is never silently stranded."""
+        with self._counter_lock:
+            self._seq += 1
+            rid = self._seq
+        ticket = Ticket(rid)
+        with span("serve_enqueue", aggregator=self.spans):
+            if self.shed_when_down and _backend_down():
+                self._shed(ticket, "backend-down")
+                raise BackendDownError(
+                    "backend watchdog reports the accelerator down; "
+                    "request shed (fast-fail, never a hang)"
+                )
+            img = np.asarray(img, np.float32)
+            try:
+                self._q.put_nowait(_Request(img, ticket))
+            except queue.Full:
+                self._shed(ticket, "queue-full")
+                raise QueueFullError(
+                    f"request queue at capacity ({self._q.maxsize}); "
+                    "backpressure — retry later"
+                ) from None
+            if self._stop.is_set() and (
+                self._thread is None or not self._thread.is_alive()
+            ):
+                # Race with stop(): the put landed after the (dead or
+                # never-started) worker's final drain — no one will ever
+                # dispatch it, so fail it here rather than strand the
+                # ticket. A LIVE draining worker still owns the queue.
+                self._fail_queued()
+                raise ShedError("batcher stopped")
+            with self._counter_lock:
+                self.n_submitted += 1
+        return ticket
+
+    def _shed(self, ticket: Ticket, reason: str) -> None:
+        with self._counter_lock:
+            self.n_shed += 1
+        exc = (
+            BackendDownError(reason)
+            if reason == "backend-down"
+            else QueueFullError(reason)
+        )
+        ticket._fail(exc)
+        # The shed decision itself is a "serve" event; a backend-down shed
+        # ALSO emits the schema-v3 "error" record (value null, machine-
+        # readable cause) — the same UNMEASURED discipline as the benches.
+        self._emit({"event": "shed", "reason": reason, "request_id": ticket.request_id})
+        if reason == "backend-down":
+            self._emit(
+                {
+                    "error": "backend-down",
+                    "value": None,
+                    "request_id": ticket.request_id,
+                    "note": "request shed: backend watchdog reports down",
+                },
+                kind="error",
+            )
+
+    # -- the worker --------------------------------------------------------
+
+    def _gather(self) -> List[_Request]:
+        """Block for the first request, then gather until max_batch or the
+        first request ages past max_delay — the two-knob admission."""
+        try:
+            first = self._q.get(timeout=0.05)
+        except queue.Empty:
+            return []
+        batch = [first]
+        deadline = self._clock() + self.max_delay_s
+        while len(batch) < self.max_batch:
+            remaining = deadline - self._clock()
+            if remaining <= 0:
+                break
+            try:
+                batch.append(self._q.get(timeout=remaining))
+            except queue.Empty:
+                break
+        return batch
+
+    def _worker(self) -> None:
+        while not (self._stop.is_set() and self._q.empty()):
+            with span("serve_batch", aggregator=self.spans):
+                batch = self._gather()
+            if not batch:
+                continue
+            self._dispatch(batch)
+
+    def _dispatch(self, batch: List[_Request]) -> None:
+        n = len(batch)
+        if self.shed_when_down and _backend_down():
+            # Gathered but undispatchable: fail every ticket fast with the
+            # stamped evidence — never dispatch into a dead backend (the
+            # round-5 hang this subsystem exists to never reproduce).
+            for req in batch:
+                self._shed(req.ticket, "backend-down")
+            return
+        try:
+            bucket = self.engine.pick_bucket(n)
+            imgs = np.zeros((bucket, *batch[0].img.shape), np.float32)
+            for i, req in enumerate(batch):
+                imgs[i] = req.img
+            with span("serve_dispatch", aggregator=self.spans):
+                result = self.engine.infer(imgs, n_valid=n)
+            with span("serve_fetch", aggregator=self.spans):
+                levels = np.asarray(result.levels[:n])
+        except BaseException as e:  # noqa: BLE001 — relayed per ticket
+            with self._counter_lock:
+                self.n_failed += len(batch)
+            for req in batch:
+                req.ticket._fail(e)
+            self._emit(
+                {
+                    "event": "dispatch_error",
+                    "n_valid": n,
+                    "exception": f"{type(e).__name__}: {e}"[:300],
+                }
+            )
+            return
+        for i, req in enumerate(batch):
+            req.ticket._resolve(levels[i], result.iters_run)
+        with self._counter_lock:
+            self.n_served += n
+        rec = {
+            "event": "dispatch",
+            "bucket": result.bucket,
+            "n_valid": n,
+            "pad_fraction": round(1.0 - n / result.bucket, 4),
+            "latency_ms": round(1e3 * result.latency_s, 3),
+            "iters_run": result.iters_run,
+            "compiled": result.compiled,
+        }
+        self.dispatches.append(rec)
+        self._emit(rec)
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _emit(self, rec: dict, kind: str = "serve") -> None:
+        from glom_tpu.serve.events import emit_serve
+
+        emit_serve(self.writer, rec, kind=kind)
+
+    def span_records(self, **extra) -> list:
+        """Drain the serve-phase span rollups (one "span" record per phase
+        seen since the last drain)."""
+        return self.spans.records(extra=extra or None)
+
+    def summary_record(self) -> dict:
+        """The end-of-run "serve" summary event. The iteration histogram
+        is PER REQUEST (each of a dispatch's n_valid requests ran its
+        batch's iteration count) — the early-exit accounting unit."""
+        hist: dict = {}
+        for d in self.dispatches:
+            key = str(d["iters_run"])
+            hist[key] = hist.get(key, 0) + d["n_valid"]
+        return schema.stamp(
+            {
+                "event": "summary",
+                "n_submitted": self.n_submitted,
+                "n_served": self.n_served,
+                "n_shed": self.n_shed,
+                "n_failed": self.n_failed,
+                "n_dispatches": len(self.dispatches),
+                "mean_batch": round(
+                    self.n_served / len(self.dispatches), 3
+                ) if self.dispatches else 0.0,
+                "iters_histogram": hist,
+            },
+            kind="serve",
+        )

@@ -1,0 +1,214 @@
+"""`python -m glom_tpu.serve` — the stdin/file micro-server.
+
+Not a network server (that is a frontend's job); this is the operational
+harness for DRIVING the serving stack — warmup, admission, early exit,
+telemetry — from a shell or a CI job, the same way train/cli.py drives the
+trainer. Requests come from `--synthetic N` (seeded gaussian images — the
+reproducible load generator) or `--requests FILE|-` (JSON lines
+`{"id": ..., "seed": ...}`; images are generated from the seed, so request
+files stay bytes not tensors). Every response, dispatch, warmup, and shed
+lands as a schema-v3 record in the metrics stream — the output of a serve
+run lints with `python -m glom_tpu.telemetry FILE` like any other artifact
+of record, and CI runs exactly that smoke.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+from typing import Iterable, Tuple
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m glom_tpu.serve",
+        description="GLOM batched-inference micro-server (docs/SERVING.md)",
+    )
+    p.add_argument("--preset", default="mnist", help="see glom_tpu.utils.presets")
+    p.add_argument(
+        "--synthetic", type=int, default=None, metavar="N",
+        help="serve N seeded synthetic requests (the reproducible load)",
+    )
+    p.add_argument(
+        "--requests", default=None, metavar="FILE",
+        help="JSONL request source ('-' = stdin): {\"id\":..., \"seed\":...}",
+    )
+    p.add_argument(
+        "--iters", default=None,
+        help="forward iteration budget: an int, or 'auto' for consensus "
+        "early exit (serve/early_exit)",
+    )
+    p.add_argument(
+        "--exit-threshold", type=float, default=None, metavar="D",
+        help="iters=auto: exit once no level's agreement moves more than D "
+        "between iterations (0 disables the exit — full budget always runs)",
+    )
+    p.add_argument("--max-batch", type=int, default=None)
+    p.add_argument("--max-delay-ms", type=float, default=None)
+    p.add_argument("--queue-depth", type=int, default=None)
+    p.add_argument(
+        "--buckets", default=None, metavar="B1,B2,...",
+        help="ascending batch buckets to precompile (default: preset's)",
+    )
+    p.add_argument(
+        "--no-warmup", action="store_true",
+        help="skip the AOT warmup (buckets then compile on first miss — "
+        "the latency cliff warmup exists to remove; for A/B only)",
+    )
+    p.add_argument("--out", default=None, help="JSONL metrics path")
+    p.add_argument(
+        "--flight-recorder", default=None, metavar="DIR",
+        help="crash flight recorder over the serve event stream",
+    )
+    return p
+
+
+def _req_source(args) -> Iterable[Tuple[object, int]]:
+    """(request id, seed) pairs from --synthetic or --requests."""
+    if args.synthetic is not None:
+        for i in range(args.synthetic):
+            yield i, i
+        return
+    fh = sys.stdin if args.requests == "-" else open(args.requests)
+    try:
+        for line in fh:
+            line = line.strip()
+            if not line or not line.startswith("{"):
+                continue
+            rec = json.loads(line)
+            yield rec.get("id"), int(rec.get("seed", 0))
+    finally:
+        if fh is not sys.stdin:
+            fh.close()
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if (args.synthetic is None) == (args.requests is None):
+        print(
+            "exactly one of --synthetic N or --requests FILE required",
+            file=sys.stderr,
+        )
+        return 2
+
+    import numpy as np
+
+    from glom_tpu.serve.batcher import DynamicBatcher, ShedError
+    from glom_tpu.serve.engine import InferenceEngine
+    from glom_tpu.serve.events import stamp_serve as serve_rec
+    from glom_tpu.utils.metrics import MetricsWriter
+    from glom_tpu.utils.presets import get_preset
+
+    preset = get_preset(args.preset)
+    cfg = preset.model
+    scfg = preset.serve
+    overrides = {}
+    if args.iters is not None:
+        overrides["iters"] = (
+            "auto" if args.iters == "auto" else int(args.iters)
+        )
+    if args.exit_threshold is not None:
+        overrides["exit_threshold"] = args.exit_threshold
+    if args.max_batch is not None:
+        overrides["max_batch"] = args.max_batch
+    if args.max_delay_ms is not None:
+        overrides["max_delay_ms"] = args.max_delay_ms
+    if args.queue_depth is not None:
+        overrides["queue_depth"] = args.queue_depth
+    if args.buckets is not None:
+        overrides["buckets"] = tuple(
+            int(b) for b in args.buckets.split(",") if b
+        )
+    if overrides:
+        scfg = dataclasses.replace(scfg, **overrides)
+
+    writer = MetricsWriter(args.out, echo=True)
+    fr = None
+    if args.flight_recorder:
+        from glom_tpu.tracing.flight import (
+            FlightRecorder,
+            set_global_flight_recorder,
+        )
+
+        fr = FlightRecorder(args.flight_recorder)
+        fr.install_process_hooks()
+        set_global_flight_recorder(fr)
+
+    try:
+        engine = InferenceEngine(cfg, scfg, writer=writer)
+        if not args.no_warmup:
+            engine.warmup()
+
+        rng_img = lambda seed: np.random.default_rng(seed).normal(
+            size=(cfg.channels, cfg.image_size, cfg.image_size)
+        ).astype(np.float32)
+
+        served = failed = 0
+        with DynamicBatcher(engine, writer=writer) as batcher:
+            tickets = []
+            for rid, seed in _req_source(args):
+                try:
+                    tickets.append((rid, batcher.submit(rng_img(seed))))
+                except ShedError as e:
+                    failed += 1
+                    writer.write(
+                        serve_rec(
+                            {
+                                "event": "response",
+                                "id": rid,
+                                "ok": False,
+                                "reason": f"{type(e).__name__}: {e}"[:200],
+                            }
+                        )
+                    )
+            for rid, ticket in tickets:
+                try:
+                    levels, iters_run, latency_s = ticket.result(timeout=300.0)
+                except Exception as e:  # noqa: BLE001 — per-request record
+                    failed += 1
+                    writer.write(
+                        serve_rec(
+                            {
+                                "event": "response",
+                                "id": rid,
+                                "ok": False,
+                                "reason": f"{type(e).__name__}: {e}"[:200],
+                            }
+                        )
+                    )
+                    continue
+                served += 1
+                writer.write(
+                    serve_rec(
+                        {
+                            "event": "response",
+                            "id": rid,
+                            "ok": True,
+                            "latency_ms": round(1e3 * latency_s, 3),
+                            "iters_run": iters_run,
+                            "top_level_norm": round(
+                                float(np.linalg.norm(levels[:, -1]) / levels.shape[0]),
+                                4,
+                            ),
+                        }
+                    )
+                )
+            writer.write(serve_rec(batcher.summary_record()))
+            for rec in batcher.span_records():
+                writer.write(rec)
+        for rec in engine.stats_records():
+            writer.write(serve_rec(rec))
+        return 0 if failed == 0 and served > 0 else 1
+    finally:
+        writer.close()
+        if fr is not None:
+            fr.dump("run-end")
+            from glom_tpu.tracing.flight import set_global_flight_recorder
+
+            set_global_flight_recorder(None)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

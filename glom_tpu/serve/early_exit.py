@@ -1,0 +1,170 @@
+"""Consensus-convergence early exit: stop iterating when the columns settle.
+
+GLOM's forward is iterative settling — T is a budget, not a requirement.
+The per-level consensus agreement the telemetry subsystem already computes
+in-graph (telemetry/diagnostics.level_agreement, the "islands of agreement"
+formation signal) doubles as a stopping witness: when one more column
+update no longer moves any level's agreement by more than a threshold, the
+columns have converged and further iterations are wasted serving latency.
+
+`glom_forward_auto` is the fixed-`iters` forward (models/core.glom_forward)
+with the `lax.scan` replaced by a `lax.while_loop`:
+
+  * the loop body is the SAME `update_step` (same ops, same order, same
+    dtype discipline), so threshold=0.0 — where the exit condition can
+    never fire (the agreement delta is >= 0, the test is strict <) — runs
+    exactly `max_iters` iterations and reproduces the fixed-`iters` output
+    BITWISE (locked by tests/test_serve.py);
+  * `max_iters` is STATIC: shapes stay fixed, the program compiles once per
+    bucket signature, and a non-converging input is bounded — the while
+    loop only ever exits EARLY, never runs long;
+  * the witness is the max-over-levels absolute delta of the [L] agreement
+    vector between consecutive iterations, computed on the state the body
+    already holds (one extra [L] reduction per iteration — the same cost
+    telemetry_level="full" pays per training step);
+  * `valid_mask` restricts the witness to real requests: a serving batch
+    padded to its bucket must not let the PAD rows (which converge
+    instantly — a constant image collapses to one island) vote the batch
+    out of the loop early, nor hold it in.
+
+The trade against the scanned forward: a while loop cannot be unrolled or
+pipelined as aggressively by XLA, and autodiff does not apply — this is an
+INFERENCE form (glom_tpu/serve), not a training path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from einops import rearrange
+
+from glom_tpu.models.core import contribution_divisor, update_step
+from glom_tpu.ops.consensus import build_local_mask, consensus_attention
+from glom_tpu.ops.patch import image_to_tokens
+from glom_tpu.utils.config import GlomConfig
+from glom_tpu.utils.helpers import exists
+
+
+def batch_agreement(levels: jnp.ndarray) -> jnp.ndarray:
+    """Per-image, per-level consensus agreement from a state [b, n, L, d]:
+    mean over n of the cosine between each patch's level vector and that
+    image's mean vector at the same level -> [b, L] float32. The batch
+    mean of this is exactly diagnostics.level_agreement; serving keeps the
+    batch axis so pad rows can be masked out of the stopping witness."""
+    x = levels.astype(jnp.float32)
+    eps = 1e-8
+    xhat = x / (jnp.linalg.norm(x, axis=-1, keepdims=True) + eps)
+    mean = jnp.mean(xhat, axis=1, keepdims=True)  # [b, 1, L, d]
+    mhat = mean / (jnp.linalg.norm(mean, axis=-1, keepdims=True) + eps)
+    return jnp.mean(jnp.sum(xhat * mhat, axis=-1), axis=1)  # [b, L]
+
+
+def masked_level_agreement(
+    levels: jnp.ndarray, valid_mask: Optional[jnp.ndarray]
+) -> jnp.ndarray:
+    """[L] agreement over the VALID rows only (all rows when mask is None).
+    With an all-true mask this equals diagnostics.level_agreement exactly
+    (same reductions, grouped batch-last instead of batch-first)."""
+    per_image = batch_agreement(levels)  # [b, L]
+    if valid_mask is None:
+        return jnp.mean(per_image, axis=0)
+    w = valid_mask.astype(jnp.float32)[:, None]  # [b, 1]
+    return jnp.sum(per_image * w, axis=0) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def glom_forward_auto(
+    params,
+    img: jnp.ndarray,
+    cfg: GlomConfig,
+    *,
+    max_iters: Optional[int] = None,
+    threshold: float = 1e-3,
+    min_iters: int = 1,
+    levels: Optional[jnp.ndarray] = None,
+    valid_mask: Optional[jnp.ndarray] = None,
+    compute_dtype=None,
+    use_pallas: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """The early-exit GLOM forward: up to `max_iters` column updates,
+    stopping once the agreement delta drops below `threshold`.
+
+    Returns (final_levels [b, n, L, d], iters_run int32 scalar,
+    agreement [L] float32 of the final state). `min_iters` floors the exit
+    (at least that many updates always run); `threshold=0.0` disables the
+    exit entirely — the strict `delta < threshold` test can then never
+    pass and exactly `max_iters` updates run, bitwise-equal to
+    glom_forward(iters=max_iters).
+
+    use_pallas swaps the grouped-FFW for the fused Pallas kernel (which
+    auto-falls back to the XLA form off-TPU); consensus stays the dense op
+    — the serving engine compiles per bucket, and the reference-layout
+    body keeps the exit witness identical across routes.
+    """
+    T = max_iters if max_iters is not None else cfg.default_iters
+    if T < 1:
+        raise ValueError(f"max_iters={T} must be >= 1")
+    if not 1 <= min_iters <= T:
+        raise ValueError(f"min_iters={min_iters} outside 1..{T}")
+    if threshold < 0:
+        raise ValueError(f"threshold={threshold} must be >= 0")
+
+    if use_pallas:
+        from glom_tpu.kernels import fused_grouped_ffw
+
+        ffw_fn = fused_grouped_ffw
+    else:
+        from glom_tpu.ops.ffw import grouped_ffw
+
+        ffw_fn = grouped_ffw
+
+    local_mask = build_local_mask(cfg.num_patches_side, cfg.local_consensus_radius)
+    consensus_fn = partial(
+        consensus_attention,
+        attend_self=cfg.consensus_self,
+        local_mask=local_mask,
+    )
+
+    # Identical prologue to glom_forward: cast ONCE, outside the loop.
+    if compute_dtype is not None:
+        params = jax.tree_util.tree_map(lambda t: t.astype(compute_dtype), params)
+        img = img.astype(compute_dtype)
+        if exists(levels):
+            levels = levels.astype(compute_dtype)
+
+    with jax.named_scope("image_to_tokens"):
+        tokens = image_to_tokens(params.token_embed, img, cfg.patch_size)
+    b, n, d = tokens.shape
+    pos = rearrange(params.pos_emb, "n d -> 1 n 1 d")
+    bottom = rearrange(tokens, "b n d -> b n 1 d")
+
+    if not exists(levels):
+        levels = jnp.broadcast_to(
+            params.init_levels[None, None], (b, n, cfg.levels, d)
+        ).astype(tokens.dtype)
+
+    divisor = contribution_divisor(cfg.levels, jnp.float32)
+    thr = jnp.float32(threshold)
+
+    def cond(carry):
+        _, _, i, done = carry
+        return jnp.logical_and(i < T, jnp.logical_not(done))
+
+    def body(carry):
+        lv, prev_agree, i, _ = carry
+        new = update_step(
+            params, lv, bottom, pos, divisor,
+            consensus_fn=consensus_fn, ffw_fn=ffw_fn,
+        )
+        agree = masked_level_agreement(new, valid_mask)  # [L] f32
+        delta = jnp.max(jnp.abs(agree - prev_agree))
+        done = jnp.logical_and(i + 1 >= min_iters, delta < thr)
+        return new, agree, i + 1, done
+
+    init_agree = masked_level_agreement(levels, valid_mask)
+    final, agree, iters_run, _ = jax.lax.while_loop(
+        cond, body, (levels, init_agree, jnp.int32(0), jnp.bool_(False))
+    )
+    return final, iters_run, agree

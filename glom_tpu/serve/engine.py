@@ -1,0 +1,291 @@
+"""The inference engine: params + one AOT-compiled forward per signature.
+
+The trainer's throughput discipline (compile once, static shapes, donated
+buffers) applied to serving. `Glom.__call__` jit-compiles on FIRST call —
+fine for a notebook, a multi-second latency cliff for the first user to hit
+a fresh shape in production. The engine inverts that:
+
+  * every (bucket batch, iters route) signature is AOT-compiled — lowered
+    and compiled EXPLICITLY via jax.jit(...).lower(...).compile() from
+    abstract shapes, no dummy batch materialized — either eagerly by
+    `warmup()` before traffic or lazily on first miss (which emits a
+    "serve" warmup event either way, so a mid-traffic compile is always
+    attributable in the stream);
+  * compiled programs are memoized by signature for the engine's lifetime;
+    the batcher only ever dispatches bucket shapes, so steady-state traffic
+    never compiles;
+  * the input buffer is donated on TPU (ServeConfig.donate=None resolves
+    by platform) so XLA reuses the padded batch's HBM for outputs;
+  * every forward returns (levels, iters_run): the fixed route stamps its
+    constant, the "auto" route (serve/early_exit) returns the actual
+    iteration count — the consensus early-exit win lands directly in the
+    latency records.
+
+Latency accounting rides telemetry/sinks.StepTimeStats per signature
+(compile split out, p50/p95/p99/max), drained by `stats_records()` into
+schema-v3 "serve" events.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from glom_tpu.models.core import GlomParams, glom_forward, init_glom
+from glom_tpu.serve.early_exit import glom_forward_auto
+from glom_tpu.telemetry import schema
+from glom_tpu.telemetry.sinks import StepTimeStats
+from glom_tpu.utils.config import GlomConfig, ServeConfig
+
+
+class ServeResult(NamedTuple):
+    """One dispatched batch's outcome. `levels` is the full padded
+    [bucket, n, L, d] state (callers slice their valid rows); `iters_run`
+    is a host int (the auto route's early-exit count, or the fixed
+    budget); `latency_s` is dispatch-to-fetch wall time for the batch."""
+
+    levels: jax.Array
+    iters_run: int
+    latency_s: float
+    bucket: int
+    compiled: bool  # True when this call paid the signature's compile
+
+
+def _resolve_donate(donate: Optional[bool]) -> bool:
+    if donate is not None:
+        return donate
+    return jax.devices()[0].platform == "tpu"
+
+
+class InferenceEngine:
+    """Owns params + memoized AOT-compiled forwards per bucket signature.
+
+    The engine is the device-side half of the serving stack (the host-side
+    half is serve/batcher.DynamicBatcher, which owns admission and
+    padding). It is thread-compatible the way jax itself is: compiled
+    executables may be CALLED from any thread; `warmup`/first-miss
+    compilation is serialized by the GIL + dict memoization.
+    """
+
+    def __init__(
+        self,
+        cfg: GlomConfig,
+        scfg: ServeConfig = ServeConfig(),
+        *,
+        params: Optional[GlomParams] = None,
+        key: Optional[jax.Array] = None,
+        writer=None,
+    ):
+        self.cfg = cfg
+        self.scfg = scfg
+        if params is None:
+            key = key if key is not None else jax.random.PRNGKey(0)
+            params = init_glom(key, cfg)
+        self.params = params
+        self.writer = writer
+        self._donate = _resolve_donate(scfg.donate)
+        self._compute_dtype = (
+            jnp.bfloat16 if scfg.compute_dtype == "bfloat16" else None
+        )
+        self._compiled: Dict[Tuple, object] = {}
+        self._stats: Dict[Tuple, StepTimeStats] = {}
+
+    # -- signatures --------------------------------------------------------
+
+    @property
+    def iters_key(self):
+        """The route component of every signature: "auto" or the resolved
+        fixed iteration count."""
+        if self.scfg.iters == "auto":
+            return "auto"
+        return (
+            self.scfg.iters
+            if self.scfg.iters is not None
+            else self.cfg.default_iters
+        )
+
+    def pick_bucket(self, n: int) -> int:
+        """Smallest precompile bucket admitting n requests. n above the
+        largest bucket is the BATCHER's invariant to maintain (it never
+        gathers more than max_batch <= max bucket); a direct caller gets
+        the loud error."""
+        if n < 1:
+            raise ValueError(f"n={n} must be >= 1")
+        for b in self.scfg.buckets:
+            if n <= b:
+                return b
+        raise ValueError(
+            f"n={n} exceeds the largest bucket {max(self.scfg.buckets)}"
+        )
+
+    def signature(self, bucket: int) -> Tuple:
+        return (bucket, self.iters_key, self.scfg.use_pallas)
+
+    # -- compilation -------------------------------------------------------
+
+    def _build_fn(self, bucket: int):
+        """The pure forward for one bucket: (params, img [bucket,c,H,W],
+        mask [bucket]) -> (levels [bucket,n,L,d], iters_run int32). The
+        mask only matters on the auto route (pad rows must not vote on the
+        early-exit witness); the fixed route carries it for a uniform
+        calling convention."""
+        cfg, scfg = self.cfg, self.scfg
+        compute_dtype = self._compute_dtype
+
+        if self.iters_key == "auto":
+            max_iters = (
+                scfg.max_auto_iters
+                if scfg.max_auto_iters is not None
+                else cfg.default_iters
+            )
+
+            def fn(params, img, mask):
+                final, iters_run, _ = glom_forward_auto(
+                    params, img, cfg,
+                    max_iters=max_iters,
+                    threshold=scfg.exit_threshold,
+                    min_iters=scfg.min_iters,
+                    valid_mask=mask,
+                    compute_dtype=compute_dtype,
+                    use_pallas=scfg.use_pallas,
+                )
+                return final, iters_run
+
+        else:
+            iters = self.iters_key
+
+            def fn(params, img, mask):
+                del mask  # pad rows are harmless on the fixed route
+                final = glom_forward(
+                    params, img, cfg, iters=iters,
+                    compute_dtype=compute_dtype,
+                    use_pallas=scfg.use_pallas,
+                )
+                return final, jnp.int32(iters)
+
+        return fn
+
+    def _compile(self, bucket: int):
+        """AOT-compile one bucket signature from abstract shapes and emit
+        the "serve" warmup event (compile seconds attributed per bucket)."""
+        sig = self.signature(bucket)
+        if sig in self._compiled:
+            return self._compiled[sig]
+        cfg = self.cfg
+        img_abs = jax.ShapeDtypeStruct(
+            (bucket, cfg.channels, cfg.image_size, cfg.image_size), jnp.float32
+        )
+        mask_abs = jax.ShapeDtypeStruct((bucket,), jnp.bool_)
+        params_abs = jax.tree_util.tree_map(
+            lambda t: jax.ShapeDtypeStruct(t.shape, t.dtype), self.params
+        )
+        donate = (1,) if self._donate else ()
+        t0 = time.perf_counter()
+        compiled = (
+            jax.jit(self._build_fn(bucket), donate_argnums=donate)
+            .lower(params_abs, img_abs, mask_abs)
+            .compile()
+        )
+        dt = time.perf_counter() - t0
+        self._compiled[sig] = compiled
+        self._stats.setdefault(sig, StepTimeStats()).observe(dt, is_compile=True)
+        self._emit(
+            {
+                "event": "warmup",
+                "bucket": bucket,
+                "iters": self.iters_key,
+                "use_pallas": self.scfg.use_pallas,
+                "compile_time_s": round(dt, 4),
+            }
+        )
+        return compiled
+
+    def warmup(self, buckets: Optional[Tuple[int, ...]] = None) -> dict:
+        """Precompile every bucket signature BEFORE traffic. Returns
+        {bucket: compile_seconds}; already-compiled signatures are free."""
+        out = {}
+        for b in buckets if buckets is not None else self.scfg.buckets:
+            sig = self.signature(b)
+            already = sig in self._compiled
+            t0 = time.perf_counter()
+            self._compile(b)
+            out[b] = 0.0 if already else time.perf_counter() - t0
+        return out
+
+    # -- dispatch ----------------------------------------------------------
+
+    def infer(self, imgs, n_valid: Optional[int] = None) -> ServeResult:
+        """Run one padded batch. `imgs` is [b, c, H, W] (numpy or jax) with
+        b equal to a bucket size — callers that batch themselves pass an
+        exact bucket; the DynamicBatcher always does. `n_valid` marks how
+        many leading rows are real requests (default: all)."""
+        if self._donate and isinstance(imgs, jax.Array):
+            # The compiled call donates the input buffer; a caller-held
+            # jax array passed through jnp.asarray uncopied would be
+            # INVALIDATED by the dispatch (numpy inputs are copied by the
+            # transfer anyway — the batcher's fresh pad buffer never is a
+            # jax array, so the copy only guards direct device callers).
+            imgs = jnp.array(imgs, jnp.float32, copy=True)
+        else:
+            imgs = jnp.asarray(imgs, jnp.float32)
+        b = imgs.shape[0]
+        if b not in self.scfg.buckets:
+            raise ValueError(
+                f"batch {b} is not a bucket shape {self.scfg.buckets}; pad "
+                "to a bucket (DynamicBatcher does) or add the bucket"
+            )
+        n_valid = b if n_valid is None else n_valid
+        if not 1 <= n_valid <= b:
+            raise ValueError(f"n_valid={n_valid} outside 1..{b}")
+        mask = jnp.arange(b) < n_valid
+        sig = self.signature(b)
+        compiled_before = sig in self._compiled
+        fn = self._compile(b)
+        stats = self._stats.setdefault(sig, StepTimeStats())
+        t0 = time.perf_counter()
+        levels, iters_run = fn(self.params, imgs, mask)
+        iters_host = int(jax.device_get(iters_run))  # syncs: serving is
+        # request/response — the caller needs the answer now, and the fetch
+        # IS the latency being measured.
+        levels.block_until_ready()
+        dt = time.perf_counter() - t0
+        stats.observe(dt, is_compile=False)
+        return ServeResult(
+            levels=levels,
+            iters_run=iters_host,
+            latency_s=dt,
+            bucket=b,
+            compiled=not compiled_before,
+        )
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _emit(self, rec: dict) -> None:
+        from glom_tpu.serve.events import emit_serve
+
+        emit_serve(self.writer, rec)
+
+    def stats_records(self) -> list:
+        """One stamped "serve" event per compiled signature with the
+        per-bucket latency histogram (p50/p95/p99/max, compile split)."""
+        out = []
+        for (bucket, iters_key, pallas), stats in sorted(
+            self._stats.items(), key=lambda kv: str(kv[0])
+        ):
+            out.append(
+                schema.stamp(
+                    {
+                        "event": "bucket_stats",
+                        "bucket": bucket,
+                        "iters": iters_key,
+                        "use_pallas": pallas,
+                        **stats.summary(),
+                    },
+                    kind="serve",
+                )
+            )
+        return out

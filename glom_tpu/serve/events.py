@@ -1,0 +1,32 @@
+"""The one serve-record emitter: stamp kind, merge backend state, route.
+
+Every serving sink (engine warmups/bucket stats, batcher dispatches/sheds,
+CLI responses) speaks the same record discipline as sinks.emit's bench
+rows — schema-stamped, current watchdog backend state merged (keys already
+present win), delivered to the writer when one is attached else to the
+global flight recorder. One definition; the callers must not re-copy the
+stamp+merge sequence."""
+
+from __future__ import annotations
+
+from glom_tpu.telemetry import schema
+
+
+def stamp_serve(rec: dict, kind: str = "serve") -> dict:
+    """Stamped copy of `rec` carrying kind + the watchdog backend state."""
+    from glom_tpu.telemetry.watchdog import backend_record
+
+    stamped = schema.stamp(rec, kind=kind)
+    for k, v in backend_record().items():
+        stamped.setdefault(k, v)
+    return stamped
+
+
+def emit_serve(writer, rec: dict, kind: str = "serve") -> dict:
+    """stamp_serve + writer-else-flight delivery; returns the stamped
+    record (the CLI reuses it for response accounting)."""
+    from glom_tpu.tracing.flight import write_or_observe
+
+    stamped = stamp_serve(rec, kind=kind)
+    write_or_observe(writer, stamped)
+    return stamped

@@ -12,6 +12,7 @@ backend state) without a human tailing logs. Import surface:
     sinks        — step-time histograms, stamped bench emitter
     watchdog     — backend-liveness heartbeat + state machine
     compare      — bench-trajectory regression gate (compare BASE NEW)
+    perfetto     — span/flight JSONL -> Perfetto JSON trace (perfetto FILE)
 
 Re-exports are LAZY (PEP 562, same pattern as glom_tpu/__init__):
 diagnostics imports jax, and the lint entry point
@@ -37,7 +38,10 @@ _EXPORTS = {
     "get_global_watchdog": "watchdog",
     "set_global_watchdog": "watchdog",
 }
-_SUBMODULES = ("compare", "counters", "diagnostics", "schema", "sinks", "watchdog")
+_SUBMODULES = (
+    "compare", "counters", "diagnostics", "perfetto", "schema", "sinks",
+    "watchdog",
+)
 
 __all__ = sorted([*_EXPORTS, *_SUBMODULES])
 

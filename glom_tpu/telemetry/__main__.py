@@ -1,6 +1,6 @@
 """`python -m glom_tpu.telemetry ...` — the telemetry CLI.
 
-Two subcommands sharing one entry point (both pure stdlib — they must run
+Three subcommands sharing one entry point (all pure stdlib — they must run
 in a jax-broken environment, the exact wedged-image scenario they exist
 for):
 
@@ -8,6 +8,8 @@ for):
                                                     the versioned schema
     python -m glom_tpu.telemetry compare BASE NEW   bench-trajectory
                                                     regression gate
+    python -m glom_tpu.telemetry perfetto FILE...   span/flight JSONL ->
+                                                    Perfetto JSON trace
 
 (`-m ...telemetry.schema` / `-m ...telemetry.compare` work too but trip
 runpy's already-imported warning.)
@@ -21,6 +23,10 @@ if __name__ == "__main__":
         from glom_tpu.telemetry.compare import main as compare_main
 
         sys.exit(compare_main(argv[1:]))
+    if argv and argv[0] == "perfetto":
+        from glom_tpu.telemetry.perfetto import main as perfetto_main
+
+        sys.exit(perfetto_main(argv[1:]))
     from glom_tpu.telemetry.schema import main
 
     sys.exit(main(argv))

@@ -34,8 +34,10 @@ from glom_tpu.telemetry import schema
 
 # Unit substrings that mark a LOWER-is-better (cost) metric; anything else
 # — including the north-star "column-iters/s/chip" and speedup ratios "x"
-# — is a rate, where lower is the regression.
-_COST_UNIT_TOKENS = ("ms", "percent", "bytes", "second")
+# — is a rate, where lower is the regression. "iters" covers the serving
+# early-exit rows ("iters/request": column updates spent per request); the
+# rate check runs FIRST, so "column-iters/s/chip" still reads as a rate.
+_COST_UNIT_TOKENS = ("ms", "percent", "bytes", "second", "iters")
 _COST_METRIC_TOKENS = ("overhead", "time", "latency")
 
 

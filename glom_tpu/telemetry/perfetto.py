@@ -1,0 +1,189 @@
+"""Perfetto export: span/flight JSONL -> a browsable timeline.
+
+The flight recorder answers "what were the last N events"; spans answer
+"where did host time go" — but both as JSONL you read with grep. Perfetto
+(ui.perfetto.dev) reads the Chrome JSON trace-event format natively, and
+every stamped record this framework writes already carries enough to place
+it on a timeline, so the conversion is mechanical:
+
+  * "span" records WITH a start time (t_start from span(writer=...)) become
+    complete events (ph "X": name, ts, dur) on a per-depth track — the real
+    nested timeline;
+  * rollup "span" records (SpanAggregator drains carry only total dur_s /
+    count) become counter samples (ph "C") of seconds-per-drain per phase —
+    the per-phase load curve over the run;
+  * watchdog records become instant events (ph "i") named by state — an
+    outage is a visible gash in the timeline;
+  * everything else (train_step, bench, anomaly, error, note, serve)
+    becomes an instant event named by kind, args = the record.
+
+Timestamps: records carry heterogeneous clocks (epoch `t_start` /
+`wall_time_s`, run-relative `wall_time` / `t`). Each record uses its best
+clock, and the whole trace is normalized to start at 0 — Perfetto needs
+ORDER and DURATION, not absolute epochs. Records with no clock at all
+(flight dumps from writerless sinks) fall back to their flight_seq /
+line order at 1ms spacing, preserving sequence.
+
+Pure stdlib, like the linter and the compare gate: this must run against a
+crashed run's dumps in a jax-broken environment.
+
+    python -m glom_tpu.telemetry perfetto FILE... [-o OUT.json]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Iterable, List, Optional
+
+from glom_tpu.telemetry import schema
+
+_PID = 1
+# Track (tid) layout: real spans nest by depth on low tids; one-off
+# instants and counters get stable named tracks via process_labels.
+_TID_SPANS = 1
+_TID_EVENTS = 90
+_TID_ROLLUPS = 91
+
+
+def _timestamp_s(rec: dict, fallback: float) -> float:
+    """Best available clock for one record, in (heterogeneous) seconds.
+    Epoch clocks dwarf run-relative ones; normalization happens per clock
+    family in to_trace_events, so mixed streams still order sensibly."""
+    for key in ("t_start", "wall_time_s", "wall_time", "t"):
+        v = rec.get(key)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return float(v)
+    return fallback
+
+
+def to_trace_events(records: Iterable[dict]) -> List[dict]:
+    """Chrome trace-event dicts (ts/dur in microseconds) from stamped
+    telemetry records, chronologically normalized to start at ~0."""
+    raw: List[dict] = []
+    for i, rec in enumerate(records):
+        kind = rec.get("kind", schema.infer_kind(rec))
+        fallback = i * 1e-3  # 1ms spacing keeps clockless records ordered
+        ts = _timestamp_s(rec, fallback)
+        if kind == "span" and "t_start" in rec:
+            raw.append(
+                {
+                    "name": rec.get("name", "span"),
+                    "ph": "X",
+                    "pid": _PID,
+                    "tid": _TID_SPANS + int(rec.get("depth", 0)),
+                    "ts": ts,
+                    "dur": float(rec.get("dur_s", 0.0)) * 1e6,
+                    "args": rec,
+                }
+            )
+        elif kind == "span":
+            # Rollup form: a counter sample of seconds spent in the phase
+            # since the last drain (the per-phase load curve).
+            raw.append(
+                {
+                    "name": f"phase:{rec.get('name', 'span')}",
+                    "ph": "C",
+                    "pid": _PID,
+                    "tid": _TID_ROLLUPS,
+                    "ts": ts,
+                    "args": {"dur_s": float(rec.get("dur_s", 0.0))},
+                }
+            )
+        elif kind == "watchdog":
+            raw.append(
+                {
+                    "name": f"backend:{rec.get('backend_state', '?')}",
+                    "ph": "i",
+                    "s": "g",  # global scope: draw the full-height line
+                    "pid": _PID,
+                    "tid": _TID_EVENTS,
+                    "ts": ts,
+                    "args": rec,
+                }
+            )
+        else:
+            label = {
+                "train_step": f"step {rec.get('step', '?')}",
+                "bench": str(rec.get("metric", "bench")),
+                "anomaly": f"anomaly: {rec.get('reason', '?')}",
+                "error": f"error: {rec.get('error', '?')}",
+                "serve": f"serve:{rec.get('event', '?')}",
+            }.get(kind, kind)
+            raw.append(
+                {
+                    "name": label,
+                    "ph": "i",
+                    "s": "t",
+                    "pid": _PID,
+                    "tid": _TID_EVENTS,
+                    "ts": ts,
+                    "args": rec,
+                }
+            )
+    if not raw:
+        return []
+    # Normalize per clock family: epoch-clock events (> ~1e9 s) and
+    # run-relative ones each shift to their own zero, so a stream mixing
+    # both still renders compactly instead of 50 years wide.
+    epochs = [e["ts"] for e in raw if e["ts"] > 1e9]
+    relatives = [e["ts"] for e in raw if e["ts"] <= 1e9]
+    e0 = min(epochs) if epochs else 0.0
+    r0 = min(relatives) if relatives else 0.0
+    for e in raw:
+        base = e0 if e["ts"] > 1e9 else r0
+        e["ts"] = round((e["ts"] - base) * 1e6, 3)
+        if "dur" in e:
+            e["dur"] = round(e["dur"], 3)
+    raw.sort(key=lambda e: e["ts"])
+    return raw
+
+
+def convert_lines(lines: Iterable[str]) -> dict:
+    """One JSONL stream -> the Chrome/Perfetto trace object."""
+    records = [rec for _, rec in schema.iter_json_lines(lines)]
+    return {
+        "traceEvents": to_trace_events(records),
+        "displayTimeUnit": "ms",
+        "metadata": {"source": "glom_tpu.telemetry.perfetto"},
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m glom_tpu.telemetry perfetto",
+        description="Convert span/flight/telemetry JSONL to a Perfetto-"
+        "loadable JSON trace (open at ui.perfetto.dev)",
+    )
+    ap.add_argument("paths", nargs="+", help="JSONL logs / flight dumps")
+    ap.add_argument(
+        "-o", "--out", default=None,
+        help="output path (default: <first input>.perfetto.json); all "
+        "inputs merge into one trace",
+    )
+    args = ap.parse_args(argv)
+
+    records = []
+    for path in args.paths:
+        with open(path) as fh:
+            records.extend(rec for _, rec in schema.iter_json_lines(fh))
+    if not records:
+        print(f"no JSON records in {args.paths}", file=sys.stderr)
+        return 1
+    trace = {
+        "traceEvents": to_trace_events(records),
+        "displayTimeUnit": "ms",
+        "metadata": {"source": "glom_tpu.telemetry.perfetto",
+                     "inputs": args.paths},
+    }
+    out = args.out if args.out else args.paths[0] + ".perfetto.json"
+    with open(out, "w") as fh:
+        json.dump(trace, fh)
+    print(f"{out}: {len(trace['traceEvents'])} events")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

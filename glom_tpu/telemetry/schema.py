@@ -25,7 +25,9 @@ from typing import Iterable, List, Optional, Tuple
 # v2 added the "span" kind (host-side tracing, glom_tpu/tracing/spans.py)
 # and the "error" kind (UNMEASURED bench rows: value null + a machine-
 # readable error string, so trajectory tooling never ingests dead zeros).
-SCHEMA_VERSION = 2
+# v3 added the "serve" kind (glom_tpu/serve: inference-engine lifecycle —
+# warmup compiles, batch dispatches, request responses, shed decisions).
+SCHEMA_VERSION = 3
 
 _NUM = (int, float)
 _STR = (str,)
@@ -52,6 +54,12 @@ KINDS = {
     # `value: null` — NEVER 0.0 — plus the error string; the compare gate
     # and trajectory tooling treat these as missing, not zero.
     "error": {"error": _STR},
+    # One inference-serving lifecycle event (glom_tpu/serve): `event` names
+    # it — "warmup" (one AOT compile per bucket), "dispatch" (one batched
+    # forward), "response" (one request served), "shed" (admission
+    # rejected), "summary" (end-of-run rollup). Extra fields (bucket,
+    # n_valid, latency_ms, iters_run, ...) ride per event.
+    "serve": {"event": _STR},
 }
 
 WATCHDOG_STATES = ("unknown", "up", "down", "flapping")

@@ -85,6 +85,7 @@ class StepTimeStats:
             "compile_time_s": round(self.compile_time_s or 0.0, 4),
             "step_time_p50_ms": round(1e3 * self._quantile(s, 0.50), 3),
             "step_time_p95_ms": round(1e3 * self._quantile(s, 0.95), 3),
+            "step_time_p99_ms": round(1e3 * self._quantile(s, 0.99), 3),
             "step_time_max_ms": round(1e3 * self._running_max, 3),
             "steps_timed": self._count,
         }

@@ -16,6 +16,11 @@ than plainly down, because half your queue steps dispatch into the gap).
 A process-global watchdog (set_global_watchdog) lets every sink stamp the
 current backend state without threading a handle through every call:
 `backend_record()` is what trainers/benches merge into their records.
+
+Between transitions, a healthy backend confirms itself with a low-cadence
+heartbeat event (heartbeat_s, default 10 min): a run that later hangs
+SILENTLY leaves a ring whose last heartbeat dates the silence, instead of
+a stale buffer with no way to tell a quiet hour from a dead one.
 """
 
 from __future__ import annotations
@@ -58,6 +63,7 @@ class BackendWatchdog:
         writer=None,
         flap_window_s: float = 600.0,
         flap_threshold: int = 3,
+        heartbeat_s: float = 600.0,
         clock: Callable[[], float] = time.monotonic,
     ):
         if flap_threshold < 2:
@@ -69,6 +75,14 @@ class BackendWatchdog:
         self.writer = writer
         self.flap_window_s = flap_window_s
         self.flap_threshold = flap_threshold
+        # Low-cadence "up"-confirmation events (0 disables): transitions
+        # only fire on CHANGE, so a run that silently hangs leaves a stale
+        # flight-recorder ring with no way to date the silence. A
+        # heartbeat event at most every heartbeat_s keeps the ring
+        # timestamped — the gap after the LAST heartbeat bounds when the
+        # hang began (ROADMAP backlog item).
+        self.heartbeat_s = heartbeat_s
+        self._last_heartbeat: Optional[float] = None
         self._clock = clock
         self._t0 = clock()
         self._lock = threading.Lock()
@@ -119,6 +133,19 @@ class BackendWatchdog:
                     and now - self._transition_times[0] > self.flap_window_s
                 ):
                     self._transition_times.popleft()
+                # Quiet re-confirmation of a healthy backend: emit the
+                # low-cadence heartbeat so a later total hang is datable
+                # from the ring (transitions reset the cadence — a fresh
+                # transition event IS a timestamp).
+                if (
+                    self.heartbeat_s > 0
+                    and self._state == "up"
+                    and (
+                        self._last_heartbeat is None
+                        or now - self._last_heartbeat >= self.heartbeat_s
+                    )
+                ):
+                    self._record_heartbeat(now)
             return self._state
 
     def _record_transition(self, prev: str, new: str, t: float) -> None:
@@ -135,16 +162,35 @@ class BackendWatchdog:
             kind="watchdog",
         )
         self._timeline.append(event)
-        if self.writer is not None:
-            self.writer.write(event)
-        else:
-            # No writer: feed the global flight recorder directly so a
-            # down transition still triggers the postmortem dump. (With a
-            # writer, MetricsWriter.write already forwards the event —
-            # feeding both would double-buffer it.)
-            from glom_tpu.tracing.flight import observe_event
+        self._last_heartbeat = t  # any stamped event restarts the cadence
+        self._write_event(event)
 
-            observe_event(event)
+    def _record_heartbeat(self, t: float) -> None:
+        """The "up"-confirmation event: NOT a transition (the timeline and
+        transition counter stay clean), just a timestamped pulse into the
+        writer / flight ring. Only ever fired for state "up" — a repeated
+        "down" heartbeat would re-trigger the flight recorder's
+        backend-down dump on every probe."""
+        self._last_heartbeat = t
+        event = schema.stamp(
+            {
+                "t": round(t, 3),
+                "wall_time_s": round(time.time(), 3),
+                "event": "heartbeat",
+                "backend_state": self._state,
+                "backend_devices": self._devices,
+                "probes": self._probes,
+            },
+            kind="watchdog",
+        )
+        self._write_event(event)
+
+    def _write_event(self, event: dict) -> None:
+        # No writer: the global flight recorder gets the event directly,
+        # so a down transition still triggers the postmortem dump.
+        from glom_tpu.tracing.flight import write_or_observe
+
+        write_or_observe(self.writer, event)
 
     # -- heartbeat thread -------------------------------------------------
 

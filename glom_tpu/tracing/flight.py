@@ -198,6 +198,19 @@ def observe_event(rec: dict) -> None:
         fr.observe(rec)
 
 
+def write_or_observe(writer, rec: dict) -> None:
+    """THE writer-else-flight fallback every writerless sink takes: a
+    stamped record goes to `writer` when one is attached (MetricsWriter
+    already forwards to the flight ring — feeding both would double-buffer
+    it), else straight to the global recorder so a writerless run still
+    leaves a postmortem trail. One definition, not five copies (watchdog,
+    serve engine/batcher, checkpoint spans, prefetch spans)."""
+    if writer is not None:
+        writer.write(rec)
+    else:
+        observe_event(rec)
+
+
 def dump_flight_recorder(
     trigger: str, *, context: Optional[dict] = None
 ) -> Optional[str]:

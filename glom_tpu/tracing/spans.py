@@ -36,6 +36,17 @@ from typing import Optional
 # group identically.
 PHASES = ("bottom_up", "top_down", "consensus", "mean_update")
 
+# The serving stack's host phases (glom_tpu/serve): one request's path is
+# enqueue -> (gathered into a) batch -> dispatch (the compiled forward) ->
+# fetch (device->host of the valid rows). The batcher aggregates these the
+# same way fit_loop aggregates its host_ phases.
+SERVE_PHASES = (
+    "serve_enqueue",
+    "serve_batch",
+    "serve_dispatch",
+    "serve_fetch",
+)
+
 _local = threading.local()
 
 

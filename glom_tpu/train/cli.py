@@ -298,7 +298,7 @@ def _train_body(args, preset, cfg, tcfg, writer) -> int:
     if args.checkpoint_dir:
         from glom_tpu.utils.checkpoint import CheckpointManager, abstract_like
 
-        ckpt = CheckpointManager(args.checkpoint_dir)
+        ckpt = CheckpointManager(args.checkpoint_dir, metrics_writer=writer)
         if args.resume and ckpt.latest_step() is not None:
             start_step, trainer.state = ckpt.restore(
                 abstract_state=abstract_like(trainer.state)
@@ -317,6 +317,7 @@ def _train_body(args, preset, cfg, tcfg, writer) -> int:
             data,
             size=args.prefetch,
             sharding=getattr(trainer, "batch_sharding", None),
+            metrics_writer=writer,
         )
 
     # Step-windowed XLA capture: ONE TraceCapture across every checkpoint

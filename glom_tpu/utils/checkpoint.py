@@ -25,8 +25,29 @@ except ImportError:  # pragma: no cover
     HAVE_ORBAX = False
 
 
+class _SpanSink:
+    """Writer shim for the checkpoint spans: forwards to the manager's
+    metrics_writer when one is attached, else straight to the global
+    flight recorder — the same no-writer fallback every other sink takes."""
+
+    def __init__(self, mgr: "CheckpointManager"):
+        self._mgr = mgr
+
+    def write(self, rec: dict) -> None:
+        from glom_tpu.tracing.flight import write_or_observe
+
+        write_or_observe(self._mgr.metrics_writer, rec)
+
+
 class CheckpointManager:
-    """Thin wrapper over orbax.CheckpointManager for TrainState pytrees."""
+    """Thin wrapper over orbax.CheckpointManager for TrainState pytrees.
+
+    save()/wait() are span-covered (tracing.spans.spanned:
+    host_checkpoint_save / host_checkpoint_wait): with async saves the
+    save() span bounds the blocking serialize-and-enqueue slice and the
+    wait() span the drain — the last unattributed host-time sinks the
+    ROADMAP named. Pass `metrics_writer` to land the span events in the
+    run's metrics stream (train/cli.py does)."""
 
     def __init__(
         self,
@@ -35,6 +56,7 @@ class CheckpointManager:
         max_to_keep: int = 3,
         save_interval_steps: int = 1,
         async_save: bool = True,
+        metrics_writer=None,
     ):
         if not HAVE_ORBAX:
             raise RuntimeError("orbax-checkpoint is not available")
@@ -46,6 +68,12 @@ class CheckpointManager:
             enable_async_checkpointing=async_save,
         )
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
+        self.metrics_writer = metrics_writer
+        from glom_tpu.tracing.spans import spanned
+
+        sink = _SpanSink(self)
+        self.save = spanned("host_checkpoint_save", writer=sink)(self.save)
+        self.wait = spanned("host_checkpoint_wait", writer=sink)(self.wait)
 
     def save(self, step: int, state: Any, *, levels: Optional[Any] = None) -> bool:
         """Save state (+ optional carried temporal `levels`) at `step`."""

@@ -9,7 +9,7 @@ layers around them without touching the model contract.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Optional, Tuple, Union
 
 
 @dataclasses.dataclass(frozen=True)
@@ -92,6 +92,72 @@ class MeshConfig:
     @property
     def num_devices(self) -> int:
         return self.data * self.seq * self.model
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Batched-inference serving policy (glom_tpu/serve, docs/SERVING.md).
+
+    The engine compiles ONE program per batch bucket ahead of traffic
+    (warmup) and the batcher pads every dispatched batch up to the
+    smallest admitting bucket — requests never trigger a mid-traffic
+    recompile, the serving-side analog of the trainer's static-shape
+    discipline."""
+
+    # Ascending batch-size buckets the engine precompiles; a dispatch of n
+    # requests pads to the smallest bucket >= n. The largest bucket is the
+    # dispatch ceiling.
+    buckets: Tuple[int, ...] = (1, 2, 4, 8)
+    # Admission policy: dispatch when max_batch requests are waiting, or
+    # when the OLDEST waiting request has aged max_delay_ms — whichever
+    # comes first (latency floor vs throughput ceiling).
+    max_batch: int = 8
+    max_delay_ms: float = 5.0
+    # Bounded request queue: submissions beyond this depth are SHED
+    # immediately (backpressure — a full queue means the engine is already
+    # saturated; queueing deeper only grows tail latency).
+    queue_depth: int = 64
+    # Forward iteration budget: an int pins the count, None uses the model
+    # default (2L), "auto" enables consensus early exit (serve/early_exit:
+    # up to max_auto_iters updates, stopping when no level's agreement
+    # moves more than exit_threshold between iterations).
+    iters: Union[int, str, None] = None  # int | "auto" | None
+    exit_threshold: float = 1e-3
+    min_iters: int = 1
+    max_auto_iters: Optional[int] = None  # None -> model default (2L)
+    compute_dtype: str = "float32"  # "bfloat16" for MXU-native serving
+    use_pallas: bool = False
+    # Donate the input buffer to each compiled call so XLA reuses it for
+    # outputs (None = auto: on TPU only — CPU ignores donation noisily).
+    donate: Optional[bool] = None
+
+    def __post_init__(self):
+        if not self.buckets:
+            raise ValueError("buckets must be non-empty")
+        if list(self.buckets) != sorted(set(self.buckets)):
+            raise ValueError(f"buckets {self.buckets} must be strictly ascending")
+        if any(b < 1 for b in self.buckets):
+            raise ValueError(f"buckets {self.buckets} must be >= 1")
+        if self.max_batch > max(self.buckets):
+            raise ValueError(
+                f"max_batch {self.max_batch} exceeds the largest bucket "
+                f"{max(self.buckets)} (the dispatch ceiling)"
+            )
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch {self.max_batch} must be >= 1")
+        if self.queue_depth < 1:
+            raise ValueError(f"queue_depth {self.queue_depth} must be >= 1")
+        if self.max_delay_ms < 0:
+            raise ValueError(f"max_delay_ms {self.max_delay_ms} must be >= 0")
+        if self.iters is not None and self.iters != "auto":
+            if not isinstance(self.iters, int) or self.iters < 1:
+                raise ValueError(
+                    f"iters={self.iters!r}: an int >= 1, 'auto', or None"
+                )
+        if self.exit_threshold < 0:
+            raise ValueError(f"exit_threshold {self.exit_threshold} must be >= 0")
+        if self.min_iters < 1:
+            raise ValueError(f"min_iters {self.min_iters} must be >= 1")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -11,7 +11,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, Tuple
 
-from glom_tpu.utils.config import GlomConfig, MeshConfig, TrainConfig
+from glom_tpu.utils.config import GlomConfig, MeshConfig, ServeConfig, TrainConfig
 from glom_tpu.utils.helpers import halo_supported
 
 
@@ -23,6 +23,10 @@ class Preset:
     train: TrainConfig
     mesh: MeshConfig
     sp_strategy: str = "none"  # none | ring | ulysses | halo | auto
+    # Serving policy (glom_tpu/serve): the bucket ladder the engine
+    # precompiles and the batcher's admission knobs. The default suits the
+    # small correctness configs; the throughput presets override it.
+    serve: ServeConfig = ServeConfig()
 
     def scaled_to(self, num_devices: int) -> "Preset":
         """Shrink the mesh to fit `num_devices`. Data parallelism is the
@@ -147,6 +151,21 @@ _register(
             compute_dtype="bfloat16", use_pallas=True, scan_unroll=True,
         ),
         mesh=MeshConfig(data=8),
+        # The flagship serving config: bf16 fused forward, a deeper bucket
+        # ladder (heavy traffic fills big buckets; the small ones cover the
+        # tail), and consensus early exit — converged images stop settling
+        # before the full 2L budget (docs/SERVING.md).
+        serve=ServeConfig(
+            buckets=(1, 2, 4, 8, 16),
+            max_batch=16,
+            max_delay_ms=3.0,
+            queue_depth=256,
+            iters="auto",
+            exit_threshold=1e-3,
+            min_iters=4,
+            compute_dtype="bfloat16",
+            use_pallas=True,
+        ),
     )
 )
 

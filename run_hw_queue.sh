@@ -94,6 +94,13 @@ step trace_capture 1800 python -u bench_train.py --loss-curve 30 \
     --out results/hw_queue/trace_curve.jsonl \
     --trace-steps 20:24 --trace-dir results/hw_queue/xla_trace
 
+# 9d. Serving SLO sweep (glom_tpu/serve, docs/SERVING.md): AOT warmup per
+#     bucket, closed-loop throughput ceiling, offered-load p50/p95/p99
+#     latency rows, and the consensus early-exit iteration histogram on
+#     the flagship bf16 fused route. Gated against its own baseline in
+#     step 11b.
+step bench_serve 2400 python -u bench_serve.py
+
 # 10. Schema lint: every JSON row this queue produced must validate
 #     against the versioned event schema (glom_tpu/telemetry/schema.py).
 #     Shell noise in the logs is skipped; --allow-unstamped because the
@@ -115,6 +122,18 @@ if [ -f results/bench_baseline.jsonl ]; then
     }
 fi
 grep -ah '^{' results/hw_queue/bench.log > results/bench_baseline.jsonl 2>/dev/null || true
+
+# 11b. Serving-trajectory gate: the SLO rows (latency percentiles regress
+#      UP, throughput/ceiling regress DOWN, auto-iters regress UP — unit-
+#      derived) against the last good serve baseline; refresh on pass.
+if [ -f results/serve_baseline.jsonl ]; then
+    step serve_compare 300 python -m glom_tpu.telemetry compare \
+        results/serve_baseline.jsonl results/hw_queue/bench_serve.log || {
+        log "serve trajectory REGRESSION (results/hw_queue/serve_compare.log)"
+        exit 1
+    }
+fi
+grep -ah '^{' results/hw_queue/bench_serve.log > results/serve_baseline.jsonl 2>/dev/null || true
 
 log "queue complete — paste numbers into results/profiles/PROFILE.md, "
 log "docs/PARALLELISM.md (pod anchor + ZeRO table), results/batch_curve.jsonl,"

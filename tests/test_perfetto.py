@@ -1,0 +1,146 @@
+"""Perfetto export (glom_tpu/telemetry/perfetto.py): span/flight JSONL ->
+Chrome/Perfetto trace-event JSON. Pure host-side, no jax."""
+
+import json
+
+import pytest
+
+from glom_tpu.telemetry import schema
+from glom_tpu.telemetry.perfetto import (
+    convert_lines,
+    main,
+    to_trace_events,
+)
+
+FIXTURE = "tests/fixtures/bench_base.jsonl"
+
+
+def span_rec(name, t_start, dur_s, depth=0, **extra):
+    return schema.stamp(
+        {"name": name, "t_start": t_start, "dur_s": dur_s, "depth": depth,
+         **extra},
+        kind="span",
+    )
+
+
+class TestToTraceEvents:
+    def test_timed_spans_become_complete_events(self):
+        evs = to_trace_events([
+            span_rec("host_data_next", 100.0, 0.5),
+            span_rec("host_step_dispatch", 100.5, 1.0, depth=1),
+        ])
+        assert [e["ph"] for e in evs] == ["X", "X"]
+        first = evs[0]
+        assert first["name"] == "host_data_next"
+        assert first["ts"] == 0.0  # normalized to start at zero
+        assert first["dur"] == 0.5e6  # microseconds
+        assert evs[1]["ts"] == 0.5e6
+        assert evs[1]["tid"] != first["tid"]  # depth separates tracks
+
+    def test_rollup_spans_become_counters(self):
+        rollup = schema.stamp(
+            {"name": "serve_dispatch", "dur_s": 0.25, "count": 10},
+            kind="span",
+        )
+        evs = to_trace_events([rollup])
+        assert evs[0]["ph"] == "C"
+        assert evs[0]["name"] == "phase:serve_dispatch"
+        assert evs[0]["args"] == {"dur_s": 0.25}
+
+    def test_watchdog_becomes_named_instant(self):
+        wd = schema.stamp(
+            {"t": 12.0, "event": "backend_transition", "prev_state": "up",
+             "backend_state": "down", "backend_devices": None,
+             "transitions": 2},
+            kind="watchdog",
+        )
+        evs = to_trace_events([wd])
+        assert evs[0]["ph"] == "i"
+        assert evs[0]["name"] == "backend:down"
+
+    def test_other_kinds_become_instants_sorted_by_ts(self):
+        recs = [
+            schema.stamp({"step": 10, "loss": 0.5, "wall_time": 2.0},
+                         kind="train_step"),
+            schema.stamp({"step": 5, "loss": 0.9, "wall_time": 1.0},
+                         kind="train_step"),
+            schema.stamp({"note": "hello"}, kind="note"),
+        ]
+        evs = to_trace_events(recs)
+        assert len(evs) == 3
+        assert evs == sorted(evs, key=lambda e: e["ts"])
+        names = {e["name"] for e in evs}
+        assert "step 10" in names and "step 5" in names
+
+    def test_mixed_epoch_and_relative_clocks_normalize_separately(self):
+        """A stream mixing epoch t_start spans with run-relative wall_time
+        records must not render 50 years wide."""
+        evs = to_trace_events([
+            span_rec("a", 1.7e9, 0.1),  # epoch clock
+            schema.stamp({"step": 1, "loss": 1.0, "wall_time": 3.0},
+                         kind="train_step"),  # run-relative
+        ])
+        assert all(0 <= e["ts"] < 60e6 for e in evs), evs
+
+    def test_clockless_records_keep_order(self):
+        recs = [
+            schema.stamp({"metric": f"m{i}", "value": 1.0, "unit": "x"},
+                         kind="bench")
+            for i in range(3)
+        ]
+        evs = to_trace_events(recs)
+        assert [e["name"] for e in evs] == ["m0", "m1", "m2"]
+
+    def test_empty_input(self):
+        assert to_trace_events([]) == []
+
+
+class TestConvertAndCli:
+    def test_existing_fixture_converts(self):
+        """The committed bench fixtures are a real artifact of record: the
+        converter must map every row (incl. the UNMEASURED error row in
+        bench_new) to a trace event."""
+        with open(FIXTURE) as fh:
+            trace = convert_lines(fh)
+        assert trace["displayTimeUnit"] == "ms"
+        assert len(trace["traceEvents"]) == 4  # 4 bench rows
+        assert all(e["ph"] == "i" for e in trace["traceEvents"])
+        with open("tests/fixtures/bench_new.jsonl") as fh:
+            trace2 = convert_lines(fh)
+        names = [e["name"] for e in trace2["traceEvents"]]
+        assert any(n.startswith("error:") for n in names)
+        # The whole object must be JSON-serializable (Perfetto loads it).
+        json.dumps(trace2)
+
+    def test_cli_writes_trace_file(self, tmp_path, capsys):
+        src = tmp_path / "spans.jsonl"
+        with open(src, "w") as fh:
+            for i in range(3):
+                fh.write(json.dumps(span_rec("phase", 10.0 + i, 0.5)) + "\n")
+            fh.write("shell noise to be skipped\n")
+        out = tmp_path / "trace.json"
+        assert main([str(src), "-o", str(out)]) == 0
+        trace = json.loads(out.read_text())
+        assert len(trace["traceEvents"]) == 3
+        assert trace["metadata"]["inputs"] == [str(src)]
+
+    def test_cli_default_output_path(self, tmp_path):
+        src = tmp_path / "flight.jsonl"
+        src.write_text(json.dumps(span_rec("x", 1.0, 0.1)) + "\n")
+        assert main([str(src)]) == 0
+        assert (tmp_path / "flight.jsonl.perfetto.json").exists()
+
+    def test_cli_fails_on_empty_input(self, tmp_path):
+        src = tmp_path / "empty.log"
+        src.write_text("no json here\n")
+        assert main([str(src)]) == 1
+
+    def test_cli_merges_multiple_inputs(self, tmp_path):
+        a = tmp_path / "a.jsonl"
+        b = tmp_path / "b.jsonl"
+        a.write_text(json.dumps(span_rec("a", 1.0, 0.1)) + "\n")
+        b.write_text(json.dumps(span_rec("b", 2.0, 0.1)) + "\n")
+        out = tmp_path / "merged.json"
+        assert main([str(a), str(b), "-o", str(out)]) == 0
+        trace = json.loads(out.read_text())
+        assert len(trace["traceEvents"]) == 2

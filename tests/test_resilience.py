@@ -219,6 +219,9 @@ print("DONE", flush=True)
 
 
 class TestKillAWorker:
+    # Two full training subprocesses (~35s): slow-marked for the tier-1
+    # budget; CI's zero-parity job runs test_resilience unfiltered.
+    @pytest.mark.slow
     def test_sigkill_and_resume(self, tmp_path):
         """Inject a real fault: SIGKILL the training process mid-run, then
         restart it and require it to resume from the last committed step

@@ -1,0 +1,495 @@
+"""Serving subsystem (glom_tpu/serve, docs/SERVING.md): engine AOT warmup
+and bucket discipline, dynamic-batching admission policy (host-side, fake
+engine — no device), consensus early-exit correctness.
+
+The two acceptance locks:
+  * threshold=0.0 -> iters="auto" output is BITWISE-identical to the
+    fixed-iters forward (both jitted: the exit test `delta < 0` can never
+    fire, and the while_loop body is the same update_step as the scan's);
+  * a converged input (a long-settled state fed back in) exits in fewer
+    than max_iters iterations.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from glom_tpu.models import Glom
+from glom_tpu.models.core import glom_forward, init_glom
+from glom_tpu.serve.batcher import (
+    BackendDownError,
+    DynamicBatcher,
+    QueueFullError,
+)
+from glom_tpu.serve.early_exit import (
+    glom_forward_auto,
+    masked_level_agreement,
+)
+from glom_tpu.serve.engine import InferenceEngine, ServeResult
+from glom_tpu.telemetry import schema
+from glom_tpu.utils.config import GlomConfig, ServeConfig
+
+CFG = GlomConfig(dim=16, levels=3, image_size=8, patch_size=2)  # n=16, tiny
+SCFG = ServeConfig(buckets=(1, 2, 4), max_batch=4, max_delay_ms=5.0)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_glom(jax.random.PRNGKey(1), CFG)
+
+
+@pytest.fixture(scope="module")
+def img():
+    return jnp.asarray(
+        np.random.default_rng(2).normal(size=(2, 3, 8, 8)), jnp.float32
+    )
+
+
+class Sink:
+    def __init__(self):
+        self.records = []
+
+    def write(self, rec):
+        self.records.append(rec)
+
+
+# ---------------------------------------------------------------------------
+# early exit
+# ---------------------------------------------------------------------------
+
+
+class TestEarlyExit:
+    def test_threshold_zero_is_bitwise_fixed_iters(self, params, img):
+        """The acceptance lock: exit disabled -> exactly max_iters updates,
+        output bitwise-equal to the scanned fixed-iters forward."""
+        fixed = jax.jit(
+            lambda p, x: glom_forward(p, x, CFG, iters=6)
+        )(params, img)
+        auto, iters_run, _ = jax.jit(
+            lambda p, x: glom_forward_auto(
+                p, x, CFG, max_iters=6, threshold=0.0
+            )
+        )(params, img)
+        assert int(iters_run) == 6
+        assert np.array_equal(np.asarray(fixed), np.asarray(auto))
+
+    def test_converged_input_exits_early(self, params, img):
+        """A long-settled state fed back as the carry has a near-zero
+        agreement delta: the loop must exit before the full budget."""
+        settled = glom_forward(params, img, CFG, iters=40)
+        _, iters_run, _ = jax.jit(
+            lambda p, x, lv: glom_forward_auto(
+                p, x, CFG, max_iters=12, threshold=1e-3, levels=lv
+            )
+        )(params, img, settled)
+        assert int(iters_run) < 12
+
+    @pytest.mark.slow  # one more while_loop compile; CI serve job runs it
+    def test_min_iters_floors_the_exit(self, params, img):
+        # A threshold so large every delta passes: exit lands exactly at
+        # the floor, never below it.
+        _, iters_run, _ = jax.jit(
+            lambda p, x: glom_forward_auto(
+                p, x, CFG, max_iters=8, threshold=1e9, min_iters=3
+            )
+        )(params, img)
+        assert int(iters_run) == 3
+
+    def test_masked_agreement_matches_unmasked_when_all_valid(
+        self, params, img
+    ):
+        from glom_tpu.telemetry.diagnostics import level_agreement
+
+        lv = glom_forward(params, img, CFG, iters=4)
+        full = np.asarray(level_agreement(lv))
+        np.testing.assert_allclose(
+            np.asarray(masked_level_agreement(lv, None)), full, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(
+                masked_level_agreement(lv, jnp.ones(lv.shape[0], bool))
+            ),
+            full,
+            atol=1e-6,
+        )
+
+    @pytest.mark.slow  # one more while_loop compile; CI serve job runs it
+    def test_pad_rows_do_not_vote_on_the_witness(self, params, img):
+        """The serving contract: the SAME two valid rows must exit after
+        the SAME number of iterations whatever garbage occupies the pad
+        rows — the mask keeps the witness to real requests."""
+        pad_a = jnp.concatenate([img, jnp.zeros_like(img)], axis=0)
+        pad_b = jnp.concatenate([img, 100.0 * jnp.ones_like(img)], axis=0)
+        mask = jnp.asarray([True, True, False, False])
+        fn = jax.jit(
+            lambda p, x, m: glom_forward_auto(
+                p, x, CFG, max_iters=8, threshold=1e-2, valid_mask=m
+            )
+        )
+        out_a, n_a, _ = fn(params, pad_a, mask)
+        out_b, n_b, _ = fn(params, pad_b, mask)
+        assert int(n_a) == int(n_b)
+        assert np.array_equal(np.asarray(out_a[:2]), np.asarray(out_b[:2]))
+
+    def test_validation(self, params, img):
+        with pytest.raises(ValueError, match="max_iters"):
+            glom_forward_auto(params, img, CFG, max_iters=0)
+        with pytest.raises(ValueError, match="min_iters"):
+            glom_forward_auto(params, img, CFG, max_iters=4, min_iters=5)
+        with pytest.raises(ValueError, match="threshold"):
+            glom_forward_auto(params, img, CFG, max_iters=4, threshold=-1.0)
+
+
+class TestGlomAutoIters:
+    def test_auto_matches_fixed_with_threshold_zero(self, img):
+        """iters='auto' on the preserved API: exit disabled reproduces the
+        fixed-iters call bitwise (both memoized jitted programs)."""
+        model = Glom(
+            dim=16, levels=3, image_size=8, patch_size=2, backend="cpu",
+            exit_threshold=0.0, auto_max_iters=4,
+        )
+        fixed = model(img, iters=4)
+        auto = model(img, iters="auto")
+        assert np.array_equal(np.asarray(fixed), np.asarray(auto))
+        assert int(model.last_auto_iters) == 4
+
+    @pytest.mark.slow  # extra jit variant; CI serve job runs it
+    def test_auto_early_exit_reports_count(self, img):
+        model = Glom(
+            dim=16, levels=3, image_size=8, patch_size=2, backend="cpu",
+            exit_threshold=1e9, auto_max_iters=8, auto_min_iters=2,
+        )
+        model(img, iters="auto")
+        assert int(model.last_auto_iters) == 2
+
+    def test_auto_rejects_return_all(self, img):
+        model = Glom(
+            dim=16, levels=3, image_size=8, patch_size=2, backend="cpu"
+        )
+        with pytest.raises(ValueError, match="return_all"):
+            model(img, iters="auto", return_all=True)
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+
+class TestInferenceEngine:
+    @pytest.fixture(scope="class")
+    def engine(self, params):
+        return InferenceEngine(CFG, SCFG, params=params)
+
+    def test_pick_bucket(self, engine):
+        assert engine.pick_bucket(1) == 1
+        assert engine.pick_bucket(2) == 2
+        assert engine.pick_bucket(3) == 4
+        assert engine.pick_bucket(4) == 4
+        with pytest.raises(ValueError, match="exceeds"):
+            engine.pick_bucket(5)
+        with pytest.raises(ValueError, match=">= 1"):
+            engine.pick_bucket(0)
+
+    def test_warmup_precompiles_every_bucket(self, engine):
+        sink = Sink()
+        engine.writer = sink
+        times = engine.warmup()
+        assert set(times) == {1, 2, 4}
+        assert all(
+            engine.signature(b) in engine._compiled for b in SCFG.buckets
+        )
+        warm = [r for r in sink.records if r.get("event") == "warmup"]
+        assert {r["bucket"] for r in warm} == {1, 2, 4}
+        for r in warm:
+            assert r["kind"] == "serve"
+            assert schema.validate_record(r) == [], r
+        # Re-warmup is free: everything is already compiled.
+        assert all(v == 0.0 for v in engine.warmup().values())
+
+    def test_infer_shapes_and_fixed_iters_stamp(self, engine):
+        imgs = np.random.default_rng(0).normal(size=(4, 3, 8, 8))
+        res = engine.infer(imgs, n_valid=3)
+        assert isinstance(res, ServeResult)
+        assert res.levels.shape == (4, 16, 3, 16)
+        assert res.iters_run == CFG.default_iters  # fixed route stamp
+        assert res.bucket == 4 and res.latency_s > 0
+
+    def test_pad_rows_never_reach_valid_outputs(self, engine, params):
+        """Rows are independent through the forward: the valid rows of a
+        padded bucket equal the same images served alone."""
+        rng = np.random.default_rng(3)
+        two = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+        padded = np.zeros((4, 3, 8, 8), np.float32)
+        padded[:2] = two
+        got = np.asarray(engine.infer(padded, n_valid=2).levels[:2])
+        alone = np.asarray(engine.infer(two, n_valid=2).levels)
+        np.testing.assert_allclose(got, alone, rtol=1e-5, atol=1e-6)
+
+    def test_infer_rejects_non_bucket_shapes(self, engine):
+        imgs = np.zeros((3, 3, 8, 8), np.float32)
+        with pytest.raises(ValueError, match="bucket"):
+            engine.infer(imgs)
+        with pytest.raises(ValueError, match="n_valid"):
+            engine.infer(np.zeros((2, 3, 8, 8), np.float32), n_valid=3)
+
+    def test_stats_records_lint(self, engine):
+        recs = engine.stats_records()
+        assert recs, "warmup/infer must have produced per-bucket stats"
+        for r in recs:
+            assert r["kind"] == "serve"
+            assert schema.validate_record(r) == [], r
+
+    @pytest.mark.slow  # compiles its own auto-route engine; CI runs it
+    def test_auto_route_engine_exits_early_on_converged_input(self, params):
+        """End-to-end: an engine on the auto route serves a converged
+        batch in fewer iterations than the budget, and the count lands on
+        the result."""
+        scfg = ServeConfig(
+            buckets=(2,), max_batch=2, iters="auto",
+            exit_threshold=0.25, min_iters=1, max_auto_iters=10,
+        )
+        eng = InferenceEngine(CFG, scfg, params=params)
+        # A constant image collapses to one island almost immediately —
+        # the cheapest converged input there is.
+        imgs = np.ones((2, 3, 8, 8), np.float32)
+        res = eng.infer(imgs)
+        assert res.iters_run < 10
+
+
+# ---------------------------------------------------------------------------
+# batcher (host-side: fake engine, no device)
+# ---------------------------------------------------------------------------
+
+
+class FakeEngine:
+    """Engine-shaped policy probe: records every dispatch, returns
+    zero-levels instantly."""
+
+    def __init__(self, buckets=(1, 2, 4), latency_s=0.0, fail=None):
+        self.scfg = ServeConfig(
+            buckets=buckets, max_batch=max(buckets), max_delay_ms=5.0,
+            queue_depth=8,
+        )
+        self.latency_s = latency_s
+        self.fail = fail
+        self.calls = []
+
+    def pick_bucket(self, n):
+        for b in self.scfg.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"n={n} exceeds the largest bucket")
+
+    def infer(self, imgs, n_valid=None):
+        if self.fail is not None:
+            raise self.fail
+        b = imgs.shape[0]
+        self.calls.append((b, n_valid))
+        if self.latency_s:
+            time.sleep(self.latency_s)
+        return ServeResult(
+            levels=np.zeros((b, 16, 3, 16), np.float32),
+            iters_run=6,
+            latency_s=self.latency_s,
+            bucket=b,
+            compiled=False,
+        )
+
+
+class DownWatchdog:
+    def record(self):
+        return {"backend_state": "down", "backend_devices": None,
+                "backend_transitions": 1}
+
+
+IMG = np.zeros((3, 8, 8), np.float32)
+
+
+class TestDynamicBatcher:
+    def test_queue_bound_sheds_with_backpressure(self):
+        eng = FakeEngine()
+        sink = Sink()
+        b = DynamicBatcher(eng, queue_depth=2, writer=sink)  # NOT started
+        b.submit(IMG)
+        b.submit(IMG)
+        with pytest.raises(QueueFullError):
+            b.submit(IMG)
+        assert b.n_shed == 1
+        shed = [r for r in sink.records if r.get("event") == "shed"]
+        assert shed and shed[0]["reason"] == "queue-full"
+        assert schema.validate_record(shed[0]) == []
+        b.stop(drain=False)
+
+    def test_full_batch_dispatches_at_max_batch(self):
+        eng = FakeEngine(buckets=(1, 2, 4))
+        b = DynamicBatcher(eng, max_batch=4, max_delay_ms=10_000.0)
+        tickets = [b.submit(IMG) for _ in range(4)]
+        b.start()
+        for t in tickets:
+            levels, iters_run, latency = t.result(timeout=10.0)
+            assert levels.shape == (16, 3, 16) and iters_run == 6
+        b.stop()
+        # One dispatch, gathered to the full batch, no padding.
+        assert eng.calls == [(4, 4)]
+
+    def test_max_delay_flushes_a_partial_batch(self):
+        """The latency floor: 2 waiting requests must not wait forever for
+        2 more — the oldest request's age bounds the gather."""
+        eng = FakeEngine(buckets=(1, 2, 4))
+        with DynamicBatcher(eng, max_batch=4, max_delay_ms=30.0) as b:
+            t1 = b.submit(IMG)
+            t2 = b.submit(IMG)
+            t1.result(timeout=10.0)
+            t2.result(timeout=10.0)
+        # Padded up to bucket 2 with both rows valid.
+        assert eng.calls == [(2, 2)]
+
+    def test_bucket_selection_pads_to_smallest_admitting(self):
+        eng = FakeEngine(buckets=(1, 2, 4))
+        with DynamicBatcher(eng, max_batch=3, max_delay_ms=10_000.0) as b:
+            tickets = [b.submit(IMG) for _ in range(3)]
+            for t in tickets:
+                t.result(timeout=10.0)
+        assert eng.calls == [(4, 3)]  # 3 valid rows ride the 4-bucket
+
+    def test_shed_on_backend_down_fails_fast_with_error_record(self):
+        from glom_tpu.telemetry.watchdog import set_global_watchdog
+
+        eng = FakeEngine()
+        sink = Sink()
+        set_global_watchdog(DownWatchdog())
+        try:
+            b = DynamicBatcher(eng, writer=sink)
+            t0 = time.perf_counter()
+            with pytest.raises(BackendDownError):
+                b.submit(IMG)
+            assert time.perf_counter() - t0 < 1.0  # fast-fail, not a hang
+        finally:
+            set_global_watchdog(None)
+        errs = [r for r in sink.records if r.get("kind") == "error"]
+        assert errs and errs[0]["error"] == "backend-down"
+        assert errs[0].get("value") is None  # UNMEASURED, never a zero
+        assert schema.validate_record(errs[0]) == []
+        assert not eng.calls  # nothing was dispatched into a dead backend
+
+    def test_gathered_batch_sheds_when_backend_dies_before_dispatch(self):
+        from glom_tpu.telemetry.watchdog import set_global_watchdog
+
+        eng = FakeEngine()
+        sink = Sink()
+        b = DynamicBatcher(eng, writer=sink)  # not started: requests queue
+        tickets = [b.submit(IMG), b.submit(IMG)]
+        set_global_watchdog(DownWatchdog())
+        try:
+            b.start()
+            for t in tickets:
+                with pytest.raises(BackendDownError):
+                    t.result(timeout=10.0)
+        finally:
+            set_global_watchdog(None)
+            b.stop(drain=False)
+        assert not eng.calls
+
+    def test_dispatch_error_fails_only_that_batch(self):
+        eng = FakeEngine(fail=RuntimeError("XLA boom"))
+        sink = Sink()
+        with DynamicBatcher(eng, max_batch=2, max_delay_ms=10.0,
+                            writer=sink) as b:
+            t = b.submit(IMG)
+            with pytest.raises(RuntimeError, match="XLA boom"):
+                t.result(timeout=10.0)
+            # The worker survives: a later healthy dispatch still serves.
+            eng.fail = None
+            t2 = b.submit(IMG)
+            t2.result(timeout=10.0)
+        assert [r.get("event") for r in sink.records].count("dispatch_error") == 1
+
+    def test_dispatch_records_and_summary_lint(self):
+        eng = FakeEngine()
+        sink = Sink()
+        with DynamicBatcher(eng, max_batch=2, max_delay_ms=10.0,
+                            writer=sink) as b:
+            for t in [b.submit(IMG) for _ in range(4)]:
+                t.result(timeout=10.0)
+            summary = b.summary_record()
+        for r in sink.records + [summary]:
+            assert schema.validate_record(r) == [], r
+        dispatches = [r for r in sink.records if r.get("event") == "dispatch"]
+        assert dispatches
+        for d in dispatches:
+            assert 0.0 <= d["pad_fraction"] < 1.0
+            assert d["iters_run"] == 6
+        assert summary["n_served"] == 4
+        assert summary["iters_histogram"] == {"6": 4}
+
+    def test_span_rollups_cover_the_serve_phases(self):
+        eng = FakeEngine()
+        with DynamicBatcher(eng, max_batch=2, max_delay_ms=10.0) as b:
+            for t in [b.submit(IMG) for _ in range(2)]:
+                t.result(timeout=10.0)
+            recs = b.span_records()
+        names = {r["name"] for r in recs}
+        assert "serve_enqueue" in names and "serve_dispatch" in names
+        for r in recs:
+            assert r["kind"] == "span"
+            assert schema.validate_record(r) == [], r
+
+    def test_ticket_timeout(self):
+        eng = FakeEngine()
+        b = DynamicBatcher(eng)  # never started: the ticket cannot resolve
+        t = b.submit(IMG)
+        with pytest.raises(TimeoutError):
+            t.result(timeout=0.05)
+        b.stop(drain=False)
+
+
+class TestServeConfig:
+    def test_bucket_validation(self):
+        with pytest.raises(ValueError, match="ascending"):
+            ServeConfig(buckets=(4, 2))
+        with pytest.raises(ValueError, match="non-empty"):
+            ServeConfig(buckets=())
+        with pytest.raises(ValueError, match="max_batch"):
+            ServeConfig(buckets=(1, 2), max_batch=4)
+        with pytest.raises(ValueError, match="iters"):
+            ServeConfig(iters="sometimes")
+        with pytest.raises(ValueError, match="iters"):
+            ServeConfig(iters=0)
+
+    def test_presets_carry_serve_configs(self):
+        from glom_tpu.utils.presets import get_preset
+
+        assert get_preset("mnist").serve.buckets == (1, 2, 4, 8)
+        flagship = get_preset("imagenet224-dp8").serve
+        assert flagship.iters == "auto" and flagship.use_pallas
+
+
+@pytest.mark.slow
+class TestServeCli:
+    def test_synthetic_run_emits_lintable_records(self, tmp_path):
+        from glom_tpu.serve.cli import main
+        from glom_tpu.telemetry.schema import lint_stream
+
+        out = tmp_path / "serve.jsonl"
+        rc = main([
+            "--preset", "mnist", "--synthetic", "3",
+            "--buckets", "1,2", "--max-batch", "2",
+            "--iters", "auto", "--out", str(out),
+        ])
+        assert rc == 0
+        lines = out.read_text().splitlines()
+        assert lint_stream(lines) == []
+        import json
+
+        recs = [json.loads(l) for l in lines]
+        responses = [
+            r for r in recs
+            if r.get("kind") == "serve" and r.get("event") == "response"
+        ]
+        assert len(responses) == 3 and all(r["ok"] for r in responses)
+        assert any(r.get("event") == "summary" for r in recs)
+        assert any(r.get("event") == "warmup" for r in recs)

@@ -569,6 +569,105 @@ class TestWatchdog:
         assert backend_record()["backend_state"] in ("up", "unknown")
 
 
+class TestWatchdogHeartbeat:
+    """Low-cadence "up"-confirmation events: a silent hang must leave a
+    timestamped ring, not a stale buffer (ROADMAP backlog item)."""
+
+    def _wd(self, probes, **kw):
+        from glom_tpu.telemetry.watchdog import BackendWatchdog
+
+        seq = iter(probes)
+        t = [0.0]
+
+        def probe(timeout):
+            return next(seq)
+
+        def clock():
+            t[0] += 10.0
+            return t[0]
+
+        kw.setdefault("clock", clock)
+        return BackendWatchdog(probe=probe, **kw)
+
+    def _sink(self):
+        class Sink:
+            def __init__(self):
+                self.records = []
+
+            def write(self, rec):
+                self.records.append(rec)
+
+        return Sink()
+
+    def test_heartbeat_fires_at_cadence_between_transitions(self):
+        sink = self._sink()
+        # 10s clock ticks, 25s cadence: probes at t=10 (transition), then
+        # re-confirmations at 20,30,40,... — heartbeats land every >= 25s
+        # after the last stamped event.
+        wd = self._wd([8] * 10, writer=sink, heartbeat_s=25.0)
+        for _ in range(10):
+            wd.probe_once()
+        beats = [r for r in sink.records if r.get("event") == "heartbeat"]
+        transitions = [
+            r for r in sink.records if r.get("event") == "backend_transition"
+        ]
+        assert len(transitions) == 1  # unknown -> up, once
+        assert len(beats) >= 2
+        for b in beats:
+            assert b["kind"] == "watchdog"
+            assert b["backend_state"] == "up"
+            assert schema.validate_record(b) == [], b
+        # Cadence respected: consecutive stamped events >= heartbeat_s apart.
+        times = [r["t"] for r in sink.records]
+        assert all(b - a >= 25.0 for a, b in zip(times, times[1:]))
+
+    def test_no_heartbeat_when_disabled(self):
+        sink = self._sink()
+        wd = self._wd([8] * 10, writer=sink, heartbeat_s=0.0)
+        for _ in range(10):
+            wd.probe_once()
+        assert all(
+            r.get("event") != "heartbeat" for r in sink.records
+        )
+
+    def test_no_heartbeat_while_down(self):
+        """A repeated "down" heartbeat would re-trigger the flight
+        recorder's backend-down dump every probe — only UP confirms."""
+        sink = self._sink()
+        wd = self._wd([8, None, None, None, None], writer=sink,
+                      heartbeat_s=15.0)
+        for _ in range(5):
+            wd.probe_once()
+        beats = [r for r in sink.records if r.get("event") == "heartbeat"]
+        assert all(b["backend_state"] == "up" for b in beats)
+        # While down, the only events are transitions.
+        down_events = [
+            r for r in sink.records
+            if r.get("backend_state") == "down"
+        ]
+        assert all(
+            r.get("event") == "backend_transition" for r in down_events
+        )
+
+    def test_heartbeat_feeds_flight_ring_without_writer(self):
+        from glom_tpu.tracing.flight import (
+            FlightRecorder,
+            set_global_flight_recorder,
+        )
+
+        fr = FlightRecorder("/tmp/_hb_flight_unused", capacity=16)
+        set_global_flight_recorder(fr)
+        try:
+            wd = self._wd([8] * 6, heartbeat_s=15.0)
+            for _ in range(6):
+                wd.probe_once()
+        finally:
+            set_global_flight_recorder(None)
+        buffered = list(fr._buf)
+        assert any(r.get("event") == "heartbeat" for r in buffered)
+        assert not fr.dumps  # up-confirmations never trigger a dump
+
+
 class TestSinks:
     def test_step_time_stats_splits_compile(self):
         from glom_tpu.telemetry.sinks import StepTimeStats
