@@ -1,0 +1,129 @@
+"""CLI: python -m glom_tpu.analysis [PATHS] [--baseline FILE].
+
+Exit codes: 0 clean (or fully covered by the baseline), 1 new findings
+(or an unreviewed baseline entry), 2 usage errors. Stale baseline
+entries and unused pragmas are warnings — the ratchet tightens without
+blocking the fix that made an entry stale.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from glom_tpu.analysis import baseline as baseline_mod
+from glom_tpu.analysis.core import default_checkers, run
+
+DEFAULT_BASELINE = "analysis_baseline.json"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m glom_tpu.analysis",
+        description="glom-lint: JAX-aware static analysis over the repo",
+    )
+    ap.add_argument(
+        "paths", nargs="*", default=["glom_tpu"],
+        help="files/directories to lint (default: glom_tpu)",
+    )
+    ap.add_argument(
+        "--baseline", default=None,
+        help=f"reviewed-suppression file (default: {DEFAULT_BASELINE} "
+        "when it exists in the working directory)",
+    )
+    ap.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore any baseline file: report every finding",
+    )
+    ap.add_argument(
+        "--write-baseline", metavar="FILE", default=None,
+        help="accept the current findings into FILE and exit 0 (annotate "
+        "every entry's 'reviewed' note before committing — enforcement "
+        "refuses unreviewed entries)",
+    )
+    ap.add_argument(
+        "--select", default=None,
+        help="comma-separated checker names to run (default: all)",
+    )
+    ap.add_argument(
+        "--list-checkers", action="store_true",
+        help="print the checker catalog and exit",
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_checkers:
+        for c in default_checkers():
+            print(f"{c.name:22s} {c.description}")
+        return 0
+
+    select = (
+        [s.strip() for s in args.select.split(",") if s.strip()]
+        if args.select
+        else None
+    )
+    warnings: List[str] = []
+    try:
+        findings = run(args.paths, select=select, warnings=warnings)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    for w in warnings:
+        print(f"warning: {w}")
+
+    if args.write_baseline:
+        baseline_mod.write(findings, args.write_baseline)
+        print(
+            f"wrote {len(findings)} finding(s) to {args.write_baseline}; "
+            "fill in every entry's 'reviewed' note before committing"
+        )
+        return 0
+
+    baseline_path = args.baseline
+    if baseline_path is None and not args.no_baseline:
+        if Path(DEFAULT_BASELINE).exists():
+            baseline_path = DEFAULT_BASELINE
+    rc = 0
+    if baseline_path and not args.no_baseline:
+        try:
+            data = baseline_mod.load(baseline_path)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        bad = baseline_mod.unreviewed(data)
+        if bad:
+            rc = 1
+            for fp in bad:
+                print(
+                    f"baseline entry without a 'reviewed' note: {fp}",
+                    file=sys.stderr,
+                )
+        new, stale = baseline_mod.apply(findings, data)
+        for fp in stale:
+            print(f"warning: stale baseline entry (no longer fires): {fp}")
+        n_suppressed = len(findings) - len(new)
+        findings = new
+        if n_suppressed:
+            print(
+                f"{n_suppressed} finding(s) suppressed by {baseline_path}"
+            )
+
+    for f in findings:
+        print(f.render())
+    if findings:
+        print(
+            f"\n{len(findings)} new finding(s). Fix them, pragma them "
+            "(# glom-lint: ok[checker] reason), or review them into the "
+            "baseline (--write-baseline; see docs/ANALYSIS.md).",
+            file=sys.stderr,
+        )
+        rc = 1
+    else:
+        print("glom-lint: clean")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

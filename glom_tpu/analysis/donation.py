@@ -1,0 +1,197 @@
+"""donation-safety: never touch a buffer after handing it to a donated
+dispatch.
+
+`donate_argnums` tells XLA it may reuse the input's HBM for outputs — the
+padded-batch reuse the serve engine leans on. The flip side: the moment
+the dispatch is enqueued, the caller's array is INVALIDATED; a later read
+raises `RuntimeError: Array has been deleted` only on the platforms where
+donation actually resolves on (TPU), so CPU tests pass and the pod run
+dies. PR 4's review caught exactly this shape of bug in engine.infer
+(caller-held jax array passed through uncopied); this checker is the
+static form.
+
+Intra-function analysis:
+
+  * a name bound from `jax.jit(f, donate_argnums=...)` (directly or via a
+    `.lower(...).compile()` chain), or a local function decorated
+    `@partial(jax.jit, donate_argnums=...)`, is a DONATING callable; a
+    literal argnums spec pins the donated positions, an unresolvable spec
+    conservatively donates every positional argument;
+  * at each call of a donating callable, positional Name arguments in
+    donated slots become dead buffers;
+  * any later read of a dead name (before it is re-assigned) is a
+    finding.
+
+Branch structure is ignored (statement order by line); cross-function
+flows (a compiled handle stashed in a dict and fetched elsewhere, as the
+engine's memoization does) are out of reach — the runtime copy-guard in
+engine.infer stays the defense there, and docs/ANALYSIS.md says so.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from glom_tpu.analysis.astutil import (
+    FuncInfo,
+    call_name,
+    dotted,
+    literal_int_tuple,
+)
+from glom_tpu.analysis.core import Checker, Context, Finding, SourceModule
+
+ALL_POSITIONS = -1  # sentinel: unresolvable argnums — treat all as donated
+
+
+def _jit_donation(call: ast.Call) -> Optional[object]:
+    """Donated-position spec if `call` is a jit(...) with donation: a
+    tuple of ints, ALL_POSITIONS, or None (no donation / not a jit)."""
+    name = call_name(call) or ""
+    if name.split(".")[-1] not in ("jit", "pjit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            spec = literal_int_tuple(kw.value)
+            if kw.arg == "donate_argnames":
+                return ALL_POSITIONS  # names don't map to positions here
+            return spec if spec is not None else ALL_POSITIONS
+    return None
+
+
+def _root_jit_call(node: ast.AST) -> Optional[ast.Call]:
+    """Unwrap `jax.jit(...).lower(...).compile()` chains to the jit call."""
+    while isinstance(node, ast.Call):
+        func = node.func
+        name = dotted(func) or ""
+        if name.split(".")[-1] in ("jit", "pjit"):
+            return node
+        if isinstance(func, ast.Attribute) and func.attr in (
+            "lower",
+            "compile",
+        ):
+            node = func.value
+            continue
+        return None
+    return None
+
+
+class DonationSafety(Checker):
+    name = "donation-safety"
+    description = "no use of a caller-held array after a donated dispatch"
+
+    def check(self, module: SourceModule, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        for info in module.index.functions.values():
+            findings.extend(self._check_function(module, info))
+        return findings
+
+    def _donating_names(self, info: FuncInfo) -> Dict[str, object]:
+        """name -> donated-position spec for callables bound inside this
+        function, plus sibling defs decorated with a donating jit."""
+        donating: Dict[str, object] = {}
+        for node in info.body_nodes():
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                jit_call = _root_jit_call(node.value)
+                if jit_call is None:
+                    continue
+                spec = _jit_donation(jit_call)
+                if spec is None:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        donating[t.id] = spec
+        # decorated siblings / module-level defs resolvable from this scope
+        scope = info.scope
+        while scope is not None:
+            for fname, finfo in scope.functions.items():
+                for dec in getattr(finfo.node, "decorator_list", []):
+                    if isinstance(dec, ast.Call):
+                        inner = _root_jit_call(dec)
+                        if inner is None and dotted(dec.func) in (
+                            "partial",
+                            "functools.partial",
+                        ):
+                            arg0 = dec.args[0] if dec.args else None
+                            iname = dotted(arg0) if arg0 is not None else ""
+                            if (iname or "").split(".")[-1] in ("jit", "pjit"):
+                                spec = None
+                                for kw in dec.keywords:
+                                    if kw.arg in (
+                                        "donate_argnums",
+                                        "donate_argnames",
+                                    ):
+                                        lit = literal_int_tuple(kw.value)
+                                        # () means "explicitly no
+                                        # donation" — only an
+                                        # UNRESOLVABLE spec goes
+                                        # conservative
+                                        spec = (
+                                            lit
+                                            if lit is not None
+                                            else ALL_POSITIONS
+                                        )
+                                if spec is not None:
+                                    donating.setdefault(fname, spec)
+                        elif inner is not None:
+                            spec = _jit_donation(inner)
+                            if spec is not None:
+                                donating.setdefault(fname, spec)
+            scope = scope.parent
+        return donating
+
+    def _check_function(
+        self, module: SourceModule, info: FuncInfo
+    ) -> List[Finding]:
+        donating = self._donating_names(info)
+        if not donating:
+            return []
+        # events in line order: donations (name killed at line) and uses
+        donations: List[Tuple[int, str, str]] = []  # (line, var, callee)
+        rebinds: Dict[str, List[int]] = {}
+        uses: List[Tuple[int, int, ast.Name]] = []
+        for node in info.body_nodes():
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                spec = donating.get(node.func.id)
+                if spec is not None:
+                    for pos, arg in enumerate(node.args):
+                        if isinstance(arg, ast.Name) and (
+                            spec == ALL_POSITIONS or pos in spec
+                        ):
+                            donations.append(
+                                (node.lineno, arg.id, node.func.id)
+                            )
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Store):
+                    rebinds.setdefault(node.id, []).append(node.lineno)
+                elif isinstance(node.ctx, ast.Load):
+                    uses.append((node.lineno, node.col_offset, node))
+
+        findings: List[Finding] = []
+        for dline, var, callee in donations:
+            for uline, col, name in uses:
+                if name.id != var or uline <= dline:
+                    continue
+                # a re-assignment between donation and use revives the name
+                if any(dline <= r <= uline for r in rebinds.get(var, [])):
+                    continue
+                findings.append(
+                    Finding(
+                        checker=self.name,
+                        path=module.relpath,
+                        line=uline,
+                        col=col,
+                        message=(
+                            f"{var!r} is read after being passed to donated "
+                            f"dispatch {callee}(...) at line {dline} — the "
+                            "buffer is invalidated on platforms where "
+                            "donation resolves (TPU)"
+                        ),
+                        symbol=info.qualname,
+                        key=f"use-after-donate-{var}",
+                    )
+                )
+                break  # one finding per donation+name is enough
+        return findings

@@ -1,0 +1,319 @@
+"""lockset: shared mutable state in the threaded modules stays behind its
+lock.
+
+Four host-side threads share mutable objects with their callers —
+DynamicBatcher's worker, BackendWatchdog's heartbeat loop, the prefetch
+worker, the flight ring fed from every sink — and until this pass the
+only guard was discipline. The checker infers, per class that OWNS a lock
+(`self._lock = threading.Lock()/RLock()/Condition()` in __init__), which
+attributes the lock protects, and flags the accesses that slip out:
+
+  * INCONSISTENT GUARDING: an attribute accessed at least once inside a
+    `with self.<lock>:` block must be accessed under it everywhere
+    (outside __init__) — the one unlocked read of a counter the lock
+    otherwise guards is the classic lost-update / torn-read site;
+  * UNLOCKED SHARING: an attribute WRITTEN from thread-entry context (a
+    method reachable from `threading.Thread(target=...)`) and accessed
+    from non-entry (caller-facing) methods must be guarded somewhere —
+    two threads, a mutation, and no lock is a race by construction.
+
+Precision choices: attributes assigned only in __init__ are config
+(exempt); attributes holding intrinsically thread-safe objects
+(threading.Event/Lock/RLock/Condition/local, queue.Queue/SimpleQueue) are
+exempt; a private method whose every intra-class call site is lock-held
+inherits the held context (the watchdog's _record_transition pattern);
+nested functions (the heartbeat `loop`) belong to their defining method.
+The runtime companion is tests/test_races.py — the seeded interleaving
+harness that catches what a static lockset cannot (orderings, not just
+guards).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Set, Tuple
+
+from glom_tpu.analysis.astutil import FUNC_NODES, call_name, dotted
+from glom_tpu.analysis.core import Checker, Context, Finding, SourceModule
+
+LOCK_TYPES = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+EXEMPT_TYPES = {
+    "Event", "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue", "local",
+    "Thread",
+}
+MUTATORS = {
+    "append", "appendleft", "extend", "insert", "add", "update", "pop",
+    "popleft", "remove", "discard", "clear", "setdefault", "set",
+}
+
+
+@dataclass
+class Access:
+    attr: str
+    line: int
+    col: int
+    method: str  # display name ("start.loop" for nested funcs)
+    unit: str    # ownership unit for entry analysis (the defining method)
+    is_write: bool
+    held: bool
+
+
+class Lockset(Checker):
+    name = "lockset"
+    description = "shared attributes in threaded classes accessed under lock"
+
+    def check(self, module: SourceModule, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in module.tree.body:
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(module, node))
+        return findings
+
+    # -- per-class analysis --------------------------------------------------
+
+    def _check_class(
+        self, module: SourceModule, cls: ast.ClassDef
+    ) -> List[Finding]:
+        methods = [n for n in cls.body if isinstance(n, FUNC_NODES)]
+        init = next((m for m in methods if m.name == "__init__"), None)
+        lock_attrs, exempt_attrs = self._classify_attrs(init)
+        if not lock_attrs:
+            return []  # a class that owns no lock has no lockset contract
+
+        accesses: List[Access] = []
+        entry_targets: Set[str] = set()   # units named as Thread targets
+        calls: Dict[str, Set[str]] = {}   # unit -> self-methods it calls
+        # method -> (caller unit, lexically lock-held) per call site; the
+        # caller matters so heldness can propagate transitively (a method
+        # called only from held methods is itself held)
+        call_held: Dict[str, List[Tuple[str, bool]]] = {}
+
+        for m in methods:
+            self._scan_unit(
+                m, m.name, m.name, lock_attrs, accesses, entry_targets,
+                calls, call_held,
+            )
+
+        init_written = {a.attr for a in accesses if a.method == "__init__"}
+        later_written = {
+            a.attr
+            for a in accesses
+            if a.is_write and a.method != "__init__"
+        }
+        config_attrs = init_written - later_written
+
+        # fixpoint: a private method whose every call site is lock-held —
+        # lexically, or because the calling method is itself held —
+        # inherits the held context (watchdog's _record_transition chain)
+        held_methods: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for m in methods:
+                name = m.name
+                if name in held_methods or not name.startswith("_"):
+                    continue
+                if name in ("__init__",):
+                    continue
+                sites = call_held.get(name, [])
+                if sites and all(
+                    held or caller in held_methods for caller, held in sites
+                ):
+                    held_methods.add(name)
+                    changed = True
+
+        # entry-reachable units (thread side)
+        entry_units: Set[str] = set(entry_targets)
+        frontier = list(entry_targets)
+        while frontier:
+            unit = frontier.pop()
+            for callee in calls.get(unit, ()):
+                if callee not in entry_units:
+                    entry_units.add(callee)
+                    frontier.append(callee)
+
+        findings: List[Finding] = []
+        method_names = {m.name for m in methods}
+        by_attr: Dict[str, List[Access]] = {}
+        for a in accesses:
+            if a.method == "__init__":
+                continue
+            if a.attr in lock_attrs or a.attr in exempt_attrs:
+                continue
+            if a.attr in config_attrs or a.attr in method_names:
+                continue
+            eff_held = a.held or a.method in held_methods
+            by_attr.setdefault(a.attr, []).append(
+                Access(a.attr, a.line, a.col, a.method, a.unit,
+                       a.is_write, eff_held)
+            )
+
+        for attr, accs in sorted(by_attr.items()):
+            guarded = any(a.held for a in accs)
+            if guarded:
+                for a in accs:
+                    if not a.held:
+                        findings.append(
+                            Finding(
+                                checker=self.name,
+                                path=module.relpath,
+                                line=a.line,
+                                col=a.col,
+                                message=(
+                                    f"{cls.name}.{attr} is lock-guarded "
+                                    "elsewhere but accessed without the "
+                                    f"lock in {a.method}() — torn read / "
+                                    "lost update"
+                                ),
+                                symbol=f"{cls.name}.{a.method}",
+                                key=f"unguarded-{attr}",
+                            )
+                        )
+            else:
+                entry_writes = [
+                    a for a in accs if a.is_write and a.unit in entry_units
+                ]
+                other_side = [a for a in accs if a.unit not in entry_units]
+                if entry_writes and other_side:
+                    a = entry_writes[0]
+                    findings.append(
+                        Finding(
+                            checker=self.name,
+                            path=module.relpath,
+                            line=a.line,
+                            col=a.col,
+                            message=(
+                                f"{cls.name}.{attr} is mutated from the "
+                                f"worker thread ({a.method}()) and accessed "
+                                "from caller-facing methods "
+                                f"({', '.join(sorted({o.method for o in other_side}))}) "
+                                "with no lock anywhere — unsynchronized "
+                                "sharing"
+                            ),
+                            symbol=f"{cls.name}.{a.method}",
+                            key=f"unlocked-shared-{attr}",
+                        )
+                    )
+        return findings
+
+    # -- helpers -------------------------------------------------------------
+
+    def _classify_attrs(self, init) -> Tuple[Set[str], Set[str]]:
+        lock_attrs: Set[str] = set()
+        exempt: Set[str] = set()
+        if init is None:
+            return lock_attrs, exempt
+        for node in ast.walk(init):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            ctor = (call_name(node.value) or "").split(".")[-1]
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    if ctor in LOCK_TYPES:
+                        lock_attrs.add(t.attr)
+                    elif ctor in EXEMPT_TYPES:
+                        exempt.add(t.attr)
+        return lock_attrs, exempt
+
+    def _scan_unit(
+        self,
+        fn,
+        display: str,
+        unit: str,
+        lock_attrs: Set[str],
+        accesses: List[Access],
+        entry_targets: Set[str],
+        calls: Dict[str, Set[str]],
+        call_held: Dict[str, List[Tuple[str, bool]]],
+    ) -> None:
+        """Collect accesses/calls in one function body; recurse into
+        nested defs as their own display names but the same ownership
+        unit handling (a nested func named as a Thread target becomes its
+        own entry unit)."""
+
+        def is_lock_with(item: ast.withitem) -> bool:
+            d = dotted(item.context_expr)
+            return bool(
+                d
+                and d.startswith("self.")
+                and d.split(".")[1] in lock_attrs
+            )
+
+        def walk(node: ast.AST, held: bool) -> None:
+            if isinstance(node, ast.With):
+                now_held = held or any(is_lock_with(i) for i in node.items)
+                for child in node.body:
+                    walk(child, now_held)
+                return
+            if isinstance(node, FUNC_NODES) and node is not fn:
+                nested_name = f"{display}.{node.name}"
+                self._scan_unit(
+                    node, nested_name, nested_name, lock_attrs, accesses,
+                    entry_targets, calls, call_held,
+                )
+                # the nested unit is callable from its definer
+                calls.setdefault(unit, set()).add(nested_name)
+                return
+            if isinstance(node, ast.Call):
+                name = call_name(node) or ""
+                leaf = name.split(".")[-1]
+                if leaf == "Thread":
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            target = dotted(kw.value)
+                            if target and target.startswith("self."):
+                                entry_targets.add(target.split(".", 1)[1])
+                            elif target:
+                                # nested function target: qualify with the
+                                # defining unit's name
+                                entry_targets.add(f"{display}.{target}")
+                if name.startswith("self.") and name.count(".") == 1:
+                    callee = name.split(".")[1]
+                    calls.setdefault(unit, set()).add(callee)
+                    call_held.setdefault(callee, []).append((unit, held))
+                # mutation through an attribute: self.x.append(...) — ONE
+                # write access; skip the func subtree so the inner
+                # `self.x` Attribute isn't double-counted as a read, and
+                # walk only the argument expressions
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in MUTATORS
+                    and isinstance(node.func.value, ast.Attribute)
+                    and isinstance(node.func.value.value, ast.Name)
+                    and node.func.value.value.id == "self"
+                ):
+                    accesses.append(
+                        Access(
+                            node.func.value.attr, node.lineno,
+                            node.col_offset, display, unit, True, held,
+                        )
+                    )
+                    for child in list(node.args) + [
+                        kw.value for kw in node.keywords
+                    ]:
+                        walk(child, held)
+                    return
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+            ):
+                is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+                accesses.append(
+                    Access(
+                        node.attr, node.lineno, node.col_offset, display,
+                        unit, is_write, held,
+                    )
+                )
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        for stmt in fn.body:
+            walk(stmt, False)

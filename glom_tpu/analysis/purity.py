@@ -1,0 +1,333 @@
+"""trace-purity: no host side effects inside functions jax will trace.
+
+A `time.time()` or `print` inside a jitted body runs ONCE at trace time
+and never again — the classic silent-wrongness bug: the code looks like it
+measures/logs per step, and the compiled program does neither. Worse are
+`np.*` calls and Python `float()`/`if` on tracer values, which either
+crash at trace time on exactly the config that first exercises the path,
+or silently bake a trace-time constant into the program.
+
+The checker finds TRACED ENTRIES — functions handed to jax.jit / pjit /
+shard_map / lax.scan / lax.while_loop / lax.cond / lax.switch /
+lax.fori_loop / jax.checkpoint / jax.grad / jax.value_and_grad /
+pl.pallas_call / custom_vjp.defvjp (decorator or call form) — walks the
+intra-module call graph reachable from them, and inside that region flags:
+
+  * host clocks (`time.*`), `print` (use jax.debug.print), `open`/`input`,
+    host RNG (`random.*`);
+  * `.item()` / `.tolist()` / `.block_until_ready()` / `jax.device_get`;
+  * `np.*` calls whose arguments reference function parameters (numpy on
+    tracers) — metadata reads (`x.shape`, `x.dtype`, ...) are exempt:
+    host math on static shape info at trace time is pure and idiomatic;
+  * Python `if`/`while` on values produced by jnp./lax. calls (branching
+    on a tracer; `is None` config dispatch is exempt).
+
+Heuristic by design: cross-module calls are not followed (jnp/lax/the
+repo's own kernel helpers are trusted), and branching on raw parameters is
+not flagged (config ints thread through the same signatures as tracers).
+The seeded-violation tests in tests/test_analysis.py pin what IS caught.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from glom_tpu.analysis.astutil import (
+    SCOPE_NODES,
+    FuncInfo,
+    call_name,
+    dotted,
+    names_in,
+)
+from glom_tpu.analysis.core import Checker, Context, Finding, SourceModule
+
+# wrapper leaf-name -> positions of the traced-callable arguments
+TRACED_ARG_POSITIONS = {
+    "jit": (0,),
+    "pjit": (0,),
+    "shard_map": (0,),
+    "checkpoint": (0,),
+    "remat": (0,),
+    "grad": (0,),
+    "value_and_grad": (0,),
+    "eval_shape": (0,),
+    "scan": (0,),
+    "while_loop": (0, 1),
+    "fori_loop": (2,),
+    "cond": (1, 2),
+    "switch": (1, 2, 3, 4, 5),
+    "pallas_call": (0,),
+    "custom_vjp": (0,),
+    "custom_jvp": (0,),
+    "defvjp": (0, 1),
+    "defjvp": (0, 1),
+}
+
+BANNED_PREFIXES = {
+    "time.": "host clock runs once at trace time, not per step",
+    "random.": "host RNG is frozen at trace time (use jax.random)",
+    "np.random.": "host RNG is frozen at trace time (use jax.random)",
+    "numpy.random.": "host RNG is frozen at trace time (use jax.random)",
+}
+BANNED_NAMES = {
+    "print": "runs at trace time only (use jax.debug.print)",
+    "open": "host I/O inside a traced function",
+    "input": "host I/O inside a traced function",
+    "breakpoint": "host debugger inside a traced function",
+}
+BANNED_METHODS = {
+    "item": "forces a device sync / fails on tracers",
+    "tolist": "forces a device sync / fails on tracers",
+    "block_until_ready": "host sync inside a traced function",
+}
+METADATA_ATTRS = {"shape", "dtype", "ndim", "size", "aval", "sharding"}
+ARRAY_PREFIXES = ("jnp.", "jax.numpy.", "lax.", "jax.lax.")
+
+
+def _unguarded_names(node: ast.AST) -> Set[str]:
+    """Name ids referenced in `node` OUTSIDE metadata attribute reads."""
+    out: Set[str] = set()
+
+    def scan(n: ast.AST) -> None:
+        if isinstance(n, ast.Attribute) and n.attr in METADATA_ATTRS:
+            return  # the whole subtree is a host metadata read
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        for child in ast.iter_child_nodes(n):
+            scan(child)
+
+    scan(node)
+    return out
+
+
+class TracePurity(Checker):
+    name = "trace-purity"
+    description = "host side effects inside jit/shard_map/while_loop bodies"
+
+    def check(self, module: SourceModule, ctx: Context) -> List[Finding]:
+        reached = self._reachable_traced(module)
+        findings: List[Finding] = []
+        for info in reached:
+            findings.extend(self._check_function(module, info))
+        return findings
+
+    # -- entry discovery + reachability --------------------------------------
+
+    def _traced_callables(self, call: ast.Call) -> List[ast.AST]:
+        name = call_name(call)
+        if name is None:
+            return []
+        leaf = name.split(".")[-1]
+        positions = TRACED_ARG_POSITIONS.get(leaf)
+        if positions is None:
+            return []
+        out = []
+        for idx in positions:
+            if len(call.args) > idx:
+                out.append(call.args[idx])
+        for kw in call.keywords:
+            if kw.arg in ("f", "fun", "body", "body_fun", "cond_fun", "kernel"):
+                out.append(kw.value)
+        return out
+
+    def _reachable_traced(self, module: SourceModule) -> List[FuncInfo]:
+        """FuncInfos reachable from any traced entry, via intra-module
+        simple-name calls (lexical scope chain)."""
+        entries: List[FuncInfo] = []
+
+        def resolve_in(node: ast.AST, scope) -> Optional[FuncInfo]:
+            if isinstance(node, ast.Name):
+                return scope.resolve(node.id)
+            if isinstance(node, SCOPE_NODES):
+                return module.index.info_for(node)
+            return None
+
+        # decorator form
+        for fn_id, info in module.index.functions.items():
+            node = info.node
+            for dec in getattr(node, "decorator_list", []):
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                name = dotted(target)
+                leaf = name.split(".")[-1] if name else None
+                if leaf in TRACED_ARG_POSITIONS and leaf not in (
+                    "defvjp", "defjvp"
+                ):
+                    entries.append(info)
+                elif isinstance(dec, ast.Call) and dotted(dec.func) in (
+                    "partial", "functools.partial"
+                ):
+                    inner = dec.args[0] if dec.args else None
+                    iname = dotted(inner) if inner is not None else None
+                    if iname and iname.split(".")[-1] in TRACED_ARG_POSITIONS:
+                        entries.append(info)
+
+        # call form: jit(f) / shard_map(body, ...) / lax.scan(body, ...)
+        scope_of: Dict[int, object] = {}
+        for info in module.index.functions.values():
+            for node in info.body_nodes():
+                scope_of[id(node)] = info.scope
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            scope = scope_of.get(id(node), module.index.module_scope)
+            for target in self._traced_callables(node):
+                resolved = resolve_in(target, scope)
+                if resolved is not None:
+                    entries.append(resolved)
+
+        # BFS through intra-module calls
+        reached: Dict[int, FuncInfo] = {}
+        queue = list(entries)
+        while queue:
+            info = queue.pop()
+            if id(info.node) in reached:
+                continue
+            reached[id(info.node)] = info
+            for node in info.body_nodes():
+                if isinstance(node, ast.Call):
+                    callee = None
+                    if isinstance(node.func, ast.Name):
+                        callee = info.scope.resolve(node.func.id)
+                    if callee is not None:
+                        queue.append(callee)
+                    # nested traced wrappers inside a traced region
+                    for target in self._traced_callables(node):
+                        resolved = resolve_in(target, info.scope)
+                        if resolved is not None:
+                            queue.append(resolved)
+        return list(reached.values())
+
+    # -- per-function effect scan --------------------------------------------
+
+    def _taint(self, info: FuncInfo) -> Tuple[Set[str], Set[str]]:
+        """(maybe_tracer, definite_tracer) name sets, one forward pass.
+        maybe: parameters and anything derived from them. definite: values
+        produced by jnp./lax. calls (and arithmetic on them)."""
+        maybe = {p for p in info.params if p not in ("self", "cls")}
+        definite: Set[str] = set()
+        for node in info.body_nodes():
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                value = node.value
+                if value is None:
+                    continue
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                # Metadata reads (x.shape[0], x.dtype, ...) produce host
+                # ints, not tracers — they must not propagate taint, or
+                # every shape-derived loop bound reads as a tracer branch.
+                rhs_names = _unguarded_names(value)
+                rhs_calls_array = any(
+                    isinstance(sub, ast.Call)
+                    and (call_name(sub) or "").startswith(ARRAY_PREFIXES)
+                    for sub in ast.walk(value)
+                )
+                tainted = bool(rhs_names & maybe) or rhs_calls_array
+                definite_rhs = rhs_calls_array or bool(rhs_names & definite)
+                for t in targets:
+                    for name in names_in(t):
+                        if isinstance(name.ctx, ast.Store):
+                            if tainted:
+                                maybe.add(name.id)
+                            if definite_rhs:
+                                definite.add(name.id)
+        return maybe, definite
+
+    def _is_metadata_guarded(self, arg: ast.AST, tainted: Set[str]) -> bool:
+        """True when every tainted Name in `arg` is only read through a
+        metadata attribute (x.shape / x.dtype / ...)."""
+
+        def scan(node: ast.AST) -> bool:  # returns "has unguarded taint"
+            if isinstance(node, ast.Attribute) and node.attr in METADATA_ATTRS:
+                return False  # whole subtree is metadata access
+            if isinstance(node, ast.Name):
+                return node.id in tainted
+            return any(scan(c) for c in ast.iter_child_nodes(node))
+
+        return not scan(arg)
+
+    def _check_function(
+        self, module: SourceModule, info: FuncInfo
+    ) -> List[Finding]:
+        findings: List[Finding] = []
+        maybe, definite = self._taint(info)
+
+        def add(node, message, key):
+            findings.append(
+                Finding(
+                    checker=self.name,
+                    path=module.relpath,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=f"{message} (reachable from a traced entry)",
+                    symbol=info.qualname,
+                    key=key,
+                )
+            )
+
+        for node in info.body_nodes():
+            if isinstance(node, ast.Call):
+                name = call_name(node) or ""
+                leaf = name.split(".")[-1]
+                if name in BANNED_NAMES:
+                    add(node, f"{name}(): {BANNED_NAMES[name]}", f"host-{name}")
+                    continue
+                matched = False
+                for prefix, why in BANNED_PREFIXES.items():
+                    if name.startswith(prefix):
+                        add(node, f"{name}(): {why}", f"host-{prefix[:-1]}")
+                        matched = True
+                        break
+                if matched:
+                    continue
+                if leaf in BANNED_METHODS and isinstance(node.func, ast.Attribute):
+                    add(
+                        node,
+                        f".{leaf}(): {BANNED_METHODS[leaf]}",
+                        f"host-{leaf}",
+                    )
+                    continue
+                if leaf == "device_get" and name.split(".")[0] == "jax":
+                    add(node, "jax.device_get: host sync in traced code",
+                        "host-device_get")
+                    continue
+                if name.startswith(("np.", "numpy.")) and not name.startswith(
+                    ("np.random.", "numpy.random.")
+                ):
+                    for arg in list(node.args) + [k.value for k in node.keywords]:
+                        if not self._is_metadata_guarded(arg, maybe):
+                            add(
+                                node,
+                                f"{name}() on a value derived from function "
+                                "parameters — numpy cannot consume tracers",
+                                "np-on-tracer",
+                            )
+                            break
+            elif isinstance(node, (ast.If, ast.While)):
+                test = node.test
+                if self._is_none_check(test):
+                    continue
+                if _unguarded_names(test) & definite:
+                    add(
+                        node,
+                        "Python branch on a jnp/lax-produced value — the "
+                        "branch is decided ONCE at trace time (use lax.cond "
+                        "/ jnp.where)",
+                        "tracer-branch",
+                    )
+        return findings
+
+    @staticmethod
+    def _is_none_check(test: ast.AST) -> bool:
+        if isinstance(test, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+        ):
+            return True
+        if isinstance(test, ast.BoolOp):
+            return all(TracePurity._is_none_check(v) for v in test.values)
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return TracePurity._is_none_check(test.operand)
+        return False

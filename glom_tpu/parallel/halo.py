@@ -27,8 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from glom_tpu.parallel.ring import NEG_MAX, _block_sim_masks
-from glom_tpu.utils.compat import array_vma, axis_size, pcast_varying, shard_map
+from glom_tpu.parallel.ring import _block_sim_masks
+from glom_tpu.utils.compat import axis_size, shard_map
 from glom_tpu.utils.helpers import halo_supported, l2norm
 
 

@@ -63,7 +63,6 @@ from glom_tpu.train.objectives import DenoiseParams, default_recon_index
 from glom_tpu.train.trainer import TrainState, pinned_grad_accum
 from glom_tpu.utils.config import GlomConfig, TrainConfig
 from glom_tpu.utils.compat import array_vma, pcast_varying, shard_map
-from glom_tpu.utils.helpers import halo_supported
 
 DATA_AXIS = "data"
 SEQ_AXIS = "seq"
@@ -182,7 +181,26 @@ def _forward_local(
 
         def ffw_lm(p, x):
             p = p._replace(b2=p.b2 * jnp.asarray(inv_mp, p.b2.dtype))
-            return lax.psum(inner_ffw(p, x), MODEL_AXIS)
+            out = inner_ffw(p, x)
+            # This is a WIRE-MOVING collective (full FFW activations over
+            # 'model', every scan iteration — the scans below run under
+            # scaled(iters) so the trace-time record prices every
+            # execution), found unregistered by glom-lint's
+            # collective-coverage pass: the drift reconciliation could
+            # never see TP forward traffic. Recording only fires inside a
+            # counters.recording() context, so no runtime change outside
+            # the counting trace. NOTE: comm_volume_model prices the
+            # gradient/update path only (no TP term), and the trainer's
+            # counting trace can never reach this site today (manual x
+            # zero>=1 degrades to zero 0 on model>1 meshes, see
+            # runtime.py) — if a future route records a TP config, the
+            # model needs a TP term FIRST or comm_model_drift becomes a
+            # permanent false alarm. The per-execution pricing contract
+            # is pinned by test_telemetry's TP counting test.
+            tele_counters.record_collective(
+                "reduce", tele_counters.ring_allreduce_bytes(out, mp)
+            )
+            return lax.psum(out, MODEL_AXIS)
     if consensus_shard is None and not use_pallas:
         raise ValueError(
             "seq=1 without use_pallas has no per-shard consensus body; pass "
@@ -287,11 +305,18 @@ def _forward_local(
             return new, new
         if remat:
             body_ys = jax.checkpoint(body_ys)
-        final, ys = lax.scan(body_ys, levels_lm, None, length=iters, unroll=unroll)
+        # scaled(iters): the body traces ONCE here but executes per scan
+        # iteration — collective sites inside it (the TP psum) must price
+        # every execution (same convention as the stage-2 microbatch hook).
+        with tele_counters.scaled(iters):
+            final, ys = lax.scan(
+                body_ys, levels_lm, None, length=iters, unroll=unroll
+            )
         return jnp.concatenate([levels_lm[None], ys], axis=0)  # [T+1, L, ...]
     if remat:
         body = jax.checkpoint(body)
-    final, _ = lax.scan(body, levels_lm, None, length=iters, unroll=unroll)
+    with tele_counters.scaled(iters):
+        final, _ = lax.scan(body, levels_lm, None, length=iters, unroll=unroll)
     if return_mode == "final":
         return final  # [L, b_loc, n_loc, d]
     return final[-1]  # top level, [b_loc, n_loc, d]
@@ -622,7 +647,7 @@ def make_manual_zero_train_step(
     sp_strategy: str = "none",
     with_grad_norm: bool = True,
     interpret: bool = False,
-    quantized_reduce: bool = None,
+    quantized_reduce: Optional[bool] = None,
 ):
     """The EXPLICIT form of the ZeRO weight update (the GSPMD form lives in
     train.trainer.make_train_step): one shard_map over (data, seq, model)

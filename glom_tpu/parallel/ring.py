@@ -21,7 +21,6 @@ Mask parity with the dense op (SURVEY.md §3.2 items 3-4):
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -18,10 +18,9 @@ from functools import partial
 from typing import Optional
 
 import jax
-import numpy as np
 from jax import lax
 
-from glom_tpu.utils.compat import array_vma, axis_size, pcast_varying, shard_map
+from glom_tpu.utils.compat import axis_size, shard_map
 from glom_tpu.ops.consensus import consensus_attention
 
 

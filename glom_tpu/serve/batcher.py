@@ -230,9 +230,18 @@ class DynamicBatcher:
                     "request shed (fast-fail, never a hang)"
                 )
             img = np.asarray(img, np.float32)
+            # Count the admission BEFORE the put (rolled back on a full
+            # queue): the instant the request is enqueued the worker may
+            # serve it, and n_served must never exceed n_submitted even
+            # transiently (the race harness caught both orderings that
+            # counted after the put as off-by-ones).
+            with self._counter_lock:
+                self.n_submitted += 1
             try:
                 self._q.put_nowait(_Request(img, ticket))
             except queue.Full:
+                with self._counter_lock:
+                    self.n_submitted -= 1
                 self._shed(ticket, "queue-full")
                 raise QueueFullError(
                     f"request queue at capacity ({self._q.maxsize}); "
@@ -247,8 +256,6 @@ class DynamicBatcher:
                 # ticket. A LIVE draining worker still owns the queue.
                 self._fail_queued()
                 raise ShedError("batcher stopped")
-            with self._counter_lock:
-                self.n_submitted += 1
         return ticket
 
     def _shed(self, ticket: Ticket, reason: str) -> None:
@@ -337,8 +344,6 @@ class DynamicBatcher:
             return
         for i, req in enumerate(batch):
             req.ticket._resolve(levels[i], result.iters_run)
-        with self._counter_lock:
-            self.n_served += n
         rec = {
             "event": "dispatch",
             "bucket": result.bucket,
@@ -348,7 +353,13 @@ class DynamicBatcher:
             "iters_run": result.iters_run,
             "compiled": result.compiled,
         }
-        self.dispatches.append(rec)
+        # The dispatch log is read by summary_record() from the CALLER's
+        # thread while this worker appends — glom-lint's lockset checker
+        # flagged the bare append (iteration during append is a crash, not
+        # just a stale read), so the batch log rides the counter lock.
+        with self._counter_lock:
+            self.n_served += n
+            self.dispatches.append(rec)
         self._emit(rec)
 
     # -- telemetry ---------------------------------------------------------
@@ -366,22 +377,31 @@ class DynamicBatcher:
     def summary_record(self) -> dict:
         """The end-of-run "serve" summary event. The iteration histogram
         is PER REQUEST (each of a dispatch's n_valid requests ran its
-        batch's iteration count) — the early-exit accounting unit."""
+        batch's iteration count) — the early-exit accounting unit.
+        Snapshot under the counter lock: the worker may still be serving
+        while a caller summarizes, and the counters must be mutually
+        consistent (n_served vs the dispatch log it was derived from)."""
+        with self._counter_lock:
+            dispatches = list(self.dispatches)
+            n_submitted = self.n_submitted
+            n_served = self.n_served
+            n_shed = self.n_shed
+            n_failed = self.n_failed
         hist: dict = {}
-        for d in self.dispatches:
+        for d in dispatches:
             key = str(d["iters_run"])
             hist[key] = hist.get(key, 0) + d["n_valid"]
         return schema.stamp(
             {
                 "event": "summary",
-                "n_submitted": self.n_submitted,
-                "n_served": self.n_served,
-                "n_shed": self.n_shed,
-                "n_failed": self.n_failed,
-                "n_dispatches": len(self.dispatches),
+                "n_submitted": n_submitted,
+                "n_served": n_served,
+                "n_shed": n_shed,
+                "n_failed": n_failed,
+                "n_dispatches": len(dispatches),
                 "mean_batch": round(
-                    self.n_served / len(self.dispatches), 3
-                ) if self.dispatches else 0.0,
+                    n_served / len(dispatches), 3
+                ) if dispatches else 0.0,
                 "iters_histogram": hist,
             },
             kind="serve",

@@ -74,14 +74,14 @@ class InferenceEngine:
     def __init__(
         self,
         cfg: GlomConfig,
-        scfg: ServeConfig = ServeConfig(),
+        scfg: Optional[ServeConfig] = None,
         *,
         params: Optional[GlomParams] = None,
         key: Optional[jax.Array] = None,
         writer=None,
     ):
         self.cfg = cfg
-        self.scfg = scfg
+        self.scfg = scfg = scfg if scfg is not None else ServeConfig()
         if params is None:
             key = key if key is not None else jax.random.PRNGKey(0)
             params = init_glom(key, cfg)

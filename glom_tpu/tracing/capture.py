@@ -21,7 +21,7 @@ View captures with: tensorboard --logdir <trace_dir>  (or xprof).
 from __future__ import annotations
 
 import contextlib
-from typing import Optional, Tuple
+from typing import Tuple
 
 
 def parse_trace_steps(spec: str) -> Tuple[int, int]:

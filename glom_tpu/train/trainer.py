@@ -12,7 +12,6 @@ in reference; README recipe only). TPU-native design:
 from __future__ import annotations
 
 import time
-from functools import partial
 from typing import Any, Callable, Iterator, NamedTuple, Optional, Tuple
 
 import jax

@@ -9,7 +9,7 @@ is actually available (e.g. the 8-device CPU test harness or one chip).
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Tuple
+from typing import Dict
 
 from glom_tpu.utils.config import GlomConfig, MeshConfig, ServeConfig, TrainConfig
 from glom_tpu.utils.helpers import halo_supported

@@ -19,7 +19,15 @@ step() {  # step <name> <timeout_s> <cmd...>; returns the command's rc
     return "$rc"
 }
 
-# 0. Gate: is the backend actually up? (bounded — never hangs)
+# 0. Pre-flight: glom-lint (glom_tpu/analysis) over the tree against the
+#    reviewed baseline. Pure-CPU AST pass, seconds — a hardware window
+#    must never start on code with a known collective/schema/lockset
+#    violation (exactly the class of silent mismatch that burns a pod
+#    session before anyone notices the evidence trail is wrong).
+step lint 300 python -m glom_tpu.analysis glom_tpu/ --baseline analysis_baseline.json || {
+    log "glom-lint found NEW violations — fix (or review into the baseline) before burning a hardware window"; exit 1; }
+
+# 0b. Gate: is the backend actually up? (bounded — never hangs)
 step probe 120 python -c "import jax; print(jax.devices())" || true
 grep -q "TpuDevice\|tpu" results/hw_queue/probe.log || {
     log "backend still down; aborting queue"; exit 1; }

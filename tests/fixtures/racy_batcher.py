@@ -1,0 +1,65 @@
+"""Lockset regression fixture: a trimmed DynamicBatcher with ONE guard
+removed, next to its correctly-locked twin.
+
+tests/test_analysis.py runs glom-lint's lockset checker over this file
+and asserts the deliberately-unlocked queue mutation in RacyBatcher is
+flagged (file:line) while LockedBatcher stays clean — the static half of
+the acceptance pair; tests/test_races.py is the runtime half (the same
+shape of bug demonstrably loses updates under the seeded interleaving
+harness). NOT importable production code: it exists to be linted.
+"""
+
+import threading
+
+
+class RacyBatcher:
+    """The bug shape: pending/n_shed are mutated by the worker thread AND
+    read by callers, but the pending append slipped out of the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.pending = []
+        self.n_shed = 0
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+
+    def submit(self, req):
+        self.pending.append(req)  # BUG: unlocked queue mutation
+
+    def _worker(self):
+        while not self._stop.is_set():
+            with self._lock:
+                if self.pending:
+                    self.pending.pop()
+            with self._lock:
+                self.n_shed += 1
+
+    def stats(self):
+        with self._lock:
+            return {"n_shed": self.n_shed, "pending": len(self.pending)}
+
+
+class LockedBatcher:
+    """The same class with every shared access behind the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.pending = []
+        self.n_shed = 0
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+
+    def submit(self, req):
+        with self._lock:
+            self.pending.append(req)
+
+    def _worker(self):
+        while not self._stop.is_set():
+            with self._lock:
+                if self.pending:
+                    self.pending.pop()
+                self.n_shed += 1
+
+    def stats(self):
+        with self._lock:
+            return {"n_shed": self.n_shed, "pending": len(self.pending)}

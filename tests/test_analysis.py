@@ -1,0 +1,646 @@
+"""glom-lint (glom_tpu/analysis): every checker catches its seeded
+violation with file:line, passes a clean snippet, and the pass self-hosts
+clean on the repo with the reviewed baseline.
+
+Pure AST tests — no jax import, no compiles; they stay in tier-1.
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from glom_tpu.analysis import run
+from glom_tpu.analysis import baseline as baseline_mod
+from glom_tpu.analysis.__main__ import main
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).resolve().parent / "fixtures"
+
+
+def lint(tmp_path, source, name="snippet.py", select=None):
+    path = tmp_path / name
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(source)
+    return run([str(path)], select=select)
+
+
+def by_checker(findings, checker):
+    return [f for f in findings if f.checker == checker]
+
+
+# ---------------------------------------------------------------------------
+# collective-coverage
+# ---------------------------------------------------------------------------
+
+
+class TestCollectiveCoverage:
+    def test_unknown_axis_literal_flagged(self, tmp_path):
+        src = (
+            "from jax import lax\n"
+            "def f(x):\n"
+            "    return lax.psum(x, 'bogus_axis')\n"
+        )
+        fs = by_checker(lint(tmp_path, src), "collective-coverage")
+        assert len(fs) == 1
+        assert fs[0].line == 3
+        assert "bogus_axis" in fs[0].message
+
+    def test_declared_axis_constant_clean(self, tmp_path):
+        src = (
+            "from jax import lax\n"
+            "DATA_AXIS = 'data'\n"
+            "def f(x):\n"
+            "    return lax.psum(x, DATA_AXIS)\n"
+        )
+        assert by_checker(lint(tmp_path, src), "collective-coverage") == []
+
+    def test_axis_param_threading_clean(self, tmp_path):
+        src = (
+            "from jax import lax\n"
+            "def shard_body(x, axis_name):\n"
+            "    return lax.ppermute(x, axis_name, [(0, 1)])\n"
+        )
+        assert by_checker(lint(tmp_path, src), "collective-coverage") == []
+
+    def test_non_axis_param_flagged(self, tmp_path):
+        src = (
+            "from jax import lax\n"
+            "def f(x, which):\n"
+            "    return lax.pmean(x, which)\n"
+        )
+        fs = by_checker(lint(tmp_path, src), "collective-coverage")
+        assert len(fs) == 1 and "which" in fs[0].message
+
+    def test_unregistered_collective_in_wire_module(self, tmp_path):
+        src = (
+            "from jax import lax\n"
+            "def grads(g):\n"
+            "    return lax.psum_scatter(g, 'data', scatter_dimension=0)\n"
+        )
+        fs = by_checker(
+            lint(tmp_path, src, name="parallel/manual.py"),
+            "collective-coverage",
+        )
+        assert len(fs) == 1
+        assert fs[0].line == 3 and "record_collective" in fs[0].message
+
+    def test_registered_collective_clean(self, tmp_path):
+        src = (
+            "from jax import lax\n"
+            "from glom_tpu.telemetry import counters as tele_counters\n"
+            "def grads(g):\n"
+            "    tele_counters.record_collective('reduce', 8)\n"
+            "    return lax.psum_scatter(g, 'data', scatter_dimension=0)\n"
+        )
+        assert (
+            by_checker(
+                lint(tmp_path, src, name="parallel/manual.py"),
+                "collective-coverage",
+            )
+            == []
+        )
+
+    def test_registration_not_required_outside_wire_modules(self, tmp_path):
+        src = (
+            "from jax import lax\n"
+            "def f(x):\n"
+            "    return lax.psum(x, 'data')\n"
+        )
+        assert by_checker(lint(tmp_path, src), "collective-coverage") == []
+
+
+# ---------------------------------------------------------------------------
+# trace-purity
+# ---------------------------------------------------------------------------
+
+
+class TestTracePurity:
+    def test_host_clock_in_jitted_body(self, tmp_path):
+        src = (
+            "import time\n"
+            "import jax\n"
+            "def step(x):\n"
+            "    t0 = time.perf_counter()\n"
+            "    return x + t0\n"
+            "fast = jax.jit(step)\n"
+        )
+        fs = by_checker(lint(tmp_path, src), "trace-purity")
+        assert len(fs) == 1 and fs[0].line == 4
+        assert "trace time" in fs[0].message
+
+    def test_print_reachable_through_helper(self, tmp_path):
+        src = (
+            "import jax\n"
+            "def helper(x):\n"
+            "    print('loss', x)\n"
+            "    return x\n"
+            "def step(x):\n"
+            "    return helper(x) * 2\n"
+            "fast = jax.jit(step)\n"
+        )
+        fs = by_checker(lint(tmp_path, src), "trace-purity")
+        assert len(fs) == 1 and fs[0].line == 3
+        assert "jax.debug.print" in fs[0].message
+
+    def test_numpy_on_parameter_in_shard_map_body(self, tmp_path):
+        src = (
+            "import numpy as np\n"
+            "from glom_tpu.utils.compat import shard_map\n"
+            "def build(mesh):\n"
+            "    def body(params, x):\n"
+            "        return np.asarray(x).sum()\n"
+            "    return shard_map(body, mesh=mesh, in_specs=(), out_specs=())\n"
+        )
+        fs = by_checker(lint(tmp_path, src), "trace-purity")
+        assert len(fs) == 1 and "numpy cannot consume tracers" in fs[0].message
+
+    def test_metadata_reads_are_pure(self, tmp_path):
+        src = (
+            "import numpy as np\n"
+            "import jax\n"
+            "def step(x):\n"
+            "    b = x.shape[0]\n"
+            "    scale = np.float32(1.0 / b)\n"
+            "    dt = np.dtype(x.dtype).itemsize\n"
+            "    return x * scale + dt\n"
+            "fast = jax.jit(step)\n"
+        )
+        assert by_checker(lint(tmp_path, src), "trace-purity") == []
+
+    def test_branch_on_tracer_value(self, tmp_path):
+        src = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def body(c, x):\n"
+            "    s = jnp.sum(x)\n"
+            "    if s > 0:\n"
+            "        return c, x\n"
+            "    return c, -x\n"
+            "def outer(xs):\n"
+            "    return jax.lax.scan(body, 0, xs)\n"
+        )
+        fs = by_checker(lint(tmp_path, src), "trace-purity")
+        assert len(fs) == 1 and fs[0].line == 5
+        assert "lax.cond" in fs[0].message
+
+    def test_while_loop_cond_and_config_branch_clean(self, tmp_path):
+        src = (
+            "import jax.numpy as jnp\n"
+            "from jax import lax\n"
+            "def run(x0, remat):\n"
+            "    def cond(c):\n"
+            "        return jnp.max(jnp.abs(c)) > 1e-3\n"
+            "    def body(c):\n"
+            "        if remat:\n"
+            "            return c * 0.5\n"
+            "        return c * 0.9\n"
+            "    return lax.while_loop(cond, body, x0)\n"
+        )
+        assert by_checker(lint(tmp_path, src), "trace-purity") == []
+
+    def test_host_code_not_flagged(self, tmp_path):
+        src = (
+            "import time\n"
+            "def bench(step):\n"
+            "    t0 = time.perf_counter()\n"
+            "    step()\n"
+            "    print(time.perf_counter() - t0)\n"
+        )
+        assert by_checker(lint(tmp_path, src), "trace-purity") == []
+
+
+# ---------------------------------------------------------------------------
+# donation-safety
+# ---------------------------------------------------------------------------
+
+
+class TestDonationSafety:
+    def test_use_after_donated_dispatch(self, tmp_path):
+        src = (
+            "import jax\n"
+            "def serve(params, imgs):\n"
+            "    fn = jax.jit(lambda p, x: x * 2, donate_argnums=(1,))\n"
+            "    out = fn(params, imgs)\n"
+            "    return out, imgs.mean()\n"
+        )
+        fs = by_checker(lint(tmp_path, src), "donation-safety")
+        assert len(fs) == 1 and fs[0].line == 5
+        assert "imgs" in fs[0].message and "donated" in fs[0].message
+
+    def test_non_donated_position_clean(self, tmp_path):
+        src = (
+            "import jax\n"
+            "def serve(params, imgs):\n"
+            "    fn = jax.jit(lambda p, x: x * 2, donate_argnums=(1,))\n"
+            "    out = fn(params, imgs)\n"
+            "    return out, params\n"
+        )
+        assert by_checker(lint(tmp_path, src), "donation-safety") == []
+
+    def test_rebind_revives_the_name(self, tmp_path):
+        src = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def serve(imgs):\n"
+            "    fn = jax.jit(lambda x: x * 2, donate_argnums=(0,))\n"
+            "    out = fn(imgs)\n"
+            "    imgs = jnp.zeros((4,))\n"
+            "    return out, imgs\n"
+        )
+        assert by_checker(lint(tmp_path, src), "donation-safety") == []
+
+    def test_decorated_empty_argnums_means_no_donation(self, tmp_path):
+        src = (
+            "import jax\n"
+            "from functools import partial\n"
+            "@partial(jax.jit, donate_argnums=())\n"
+            "def fwd(x):\n"
+            "    return x * 2\n"
+            "def serve(imgs):\n"
+            "    out = fwd(imgs)\n"
+            "    return out, imgs.mean()\n"
+        )
+        assert by_checker(lint(tmp_path, src), "donation-safety") == []
+
+    def test_decorated_donating_function_flagged(self, tmp_path):
+        src = (
+            "import jax\n"
+            "from functools import partial\n"
+            "@partial(jax.jit, donate_argnums=(0,))\n"
+            "def fwd(x):\n"
+            "    return x * 2\n"
+            "def serve(imgs):\n"
+            "    out = fwd(imgs)\n"
+            "    return out, imgs.mean()\n"
+        )
+        fs = by_checker(lint(tmp_path, src), "donation-safety")
+        assert len(fs) == 1 and fs[0].line == 8
+
+    def test_lowered_compile_chain_conservative(self, tmp_path):
+        src = (
+            "import jax\n"
+            "def serve(donate, abstract, params, imgs):\n"
+            "    fn = jax.jit(lambda p, x: x, donate_argnums=donate)"
+            ".lower(abstract, abstract).compile()\n"
+            "    out = fn(params, imgs)\n"
+            "    return imgs.sum()\n"
+        )
+        # unresolvable argnums spec -> every positional arg is treated as
+        # donated, so the later read of imgs is flagged
+        fs = by_checker(lint(tmp_path, src), "donation-safety")
+        assert len(fs) == 1 and "imgs" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# schema-emit
+# ---------------------------------------------------------------------------
+
+
+class TestSchemaEmit:
+    def test_unknown_kind_flagged(self, tmp_path):
+        src = (
+            "from glom_tpu.telemetry.sinks import emit\n"
+            "emit({'metric': 'x', 'value': 1.0, 'unit': 'u'}, kind='benhc')\n"
+        )
+        fs = by_checker(lint(tmp_path, src), "schema-emit")
+        assert len(fs) == 1 and "benhc" in fs[0].message
+
+    def test_registered_kind_clean(self, tmp_path):
+        src = (
+            "from glom_tpu.telemetry.sinks import emit\n"
+            "emit({'metric': 'x', 'value': 1.0, 'unit': 'u'}, kind='bench')\n"
+            "emit({'event': 'dispatch'}, kind='serve')\n"
+        )
+        assert by_checker(lint(tmp_path, src), "schema-emit") == []
+
+    def test_dead_zero_unmeasured_flagged(self, tmp_path):
+        src = (
+            "from glom_tpu.telemetry.sinks import emit\n"
+            "emit({'metric': 'x', 'value': 0.0, 'unit': 'u',\n"
+            "      'error': 'backend-down'}, kind='error')\n"
+        )
+        fs = by_checker(lint(tmp_path, src), "schema-emit")
+        assert len(fs) == 1 and "must be None" in fs[0].message
+
+    def test_null_unmeasured_clean(self, tmp_path):
+        src = (
+            "from glom_tpu.telemetry.sinks import emit\n"
+            "emit({'metric': 'x', 'value': None, 'unit': 'u',\n"
+            "      'error': 'backend-down'}, kind='error')\n"
+        )
+        assert by_checker(lint(tmp_path, src), "schema-emit") == []
+
+    def test_error_kind_requires_error_field(self, tmp_path):
+        src = (
+            "from glom_tpu.telemetry import schema\n"
+            "rec = schema.stamp({'metric': 'x', 'value': None}, kind='error')\n"
+        )
+        fs = by_checker(lint(tmp_path, src), "schema-emit")
+        assert len(fs) == 1 and "no 'error' field" in fs[0].message
+
+    def test_writer_write_with_inline_kind(self, tmp_path):
+        src = "writer.write({'kind': 'not_a_kind', 'note': 'x'})\n"
+        fs = by_checker(lint(tmp_path, src), "schema-emit")
+        assert len(fs) == 1 and "not_a_kind" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# lockset
+# ---------------------------------------------------------------------------
+
+RACY = '''
+import threading
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._thread = threading.Thread(target=self._run)
+
+    def _run(self):
+        with self._lock:
+            self.count += 1
+
+    def read(self):
+        return self.count
+'''
+
+CLEAN = '''
+import threading
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._thread = threading.Thread(target=self._run)
+
+    def _run(self):
+        with self._lock:
+            self.count += 1
+
+    def read(self):
+        with self._lock:
+            return self.count
+'''
+
+
+class TestLockset:
+    def test_unguarded_read_flagged(self, tmp_path):
+        fs = by_checker(lint(tmp_path, RACY), "lockset")
+        assert len(fs) == 1 and fs[0].line == 15
+        assert "count" in fs[0].message and "read" in fs[0].message
+
+    def test_guarded_everywhere_clean(self, tmp_path):
+        assert by_checker(lint(tmp_path, CLEAN), "lockset") == []
+
+    def test_unlocked_shared_write_from_thread(self, tmp_path):
+        src = (
+            "import threading\n"
+            "class W:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.log = []\n"
+            "        self._t = threading.Thread(target=self._run)\n"
+            "    def _run(self):\n"
+            "        self.log.append(1)\n"
+            "    def snapshot(self):\n"
+            "        return list(self.log)\n"
+        )
+        fs = by_checker(lint(tmp_path, src), "lockset")
+        assert len(fs) == 1 and "unsynchronized" in fs[0].message
+
+    def test_held_context_inherits_transitively(self, tmp_path):
+        """A private method called only from a held method (which is
+        itself only called from lexically-held sites) inherits heldness
+        through the fixpoint — the watchdog's _record_transition ->
+        _write_event chain must not false-positive."""
+        src = (
+            "import threading\n"
+            "class W:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.count = 0\n"
+            "        self._t = threading.Thread(target=self._run)\n"
+            "    def _run(self):\n"
+            "        with self._lock:\n"
+            "            self._bump()\n"
+            "    def _bump(self):\n"
+            "        self._write()\n"
+            "    def _write(self):\n"
+            "        self.count += 1\n"
+            "    def read(self):\n"
+            "        with self._lock:\n"
+            "            return self.count\n"
+        )
+        assert by_checker(lint(tmp_path, src), "lockset") == []
+
+    def test_mutator_call_is_one_finding_not_two(self, tmp_path):
+        """self.buf.clear() is ONE access (a write): the walk must not
+        also count the inner self.buf read, or the baseline needs
+        count=2 for one site."""
+        src = (
+            "import threading\n"
+            "class W:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.buf = []\n"
+            "        self._t = threading.Thread(target=self._run)\n"
+            "    def _run(self):\n"
+            "        with self._lock:\n"
+            "            self.buf.append(1)\n"
+            "    def reset(self):\n"
+            "        self.buf.clear()\n"
+        )
+        fs = by_checker(lint(tmp_path, src), "lockset")
+        assert len(fs) == 1 and fs[0].line == 11
+
+    def test_config_and_queue_attrs_exempt(self, tmp_path):
+        src = (
+            "import queue\n"
+            "import threading\n"
+            "class W:\n"
+            "    def __init__(self, depth):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.depth = depth\n"
+            "        self._q = queue.Queue(maxsize=depth)\n"
+            "        self._t = threading.Thread(target=self._run)\n"
+            "    def _run(self):\n"
+            "        self._q.put(self.depth)\n"
+            "    def submit(self):\n"
+            "        self._q.put(self.depth)\n"
+        )
+        assert by_checker(lint(tmp_path, src), "lockset") == []
+
+    def test_regression_fixture_racy_flagged_locked_clean(self):
+        """THE acceptance pair: the deliberately-unlocked DynamicBatcher
+        queue mutation in the checked-in fixture is flagged at its line;
+        the locked twin in the same file is not."""
+        findings = by_checker(
+            run([str(FIXTURES / "racy_batcher.py")]), "lockset"
+        )
+        assert findings, "lockset checker missed the seeded race"
+        assert all("RacyBatcher" in f.message for f in findings)
+        src_lines = (FIXTURES / "racy_batcher.py").read_text().splitlines()
+        for f in findings:
+            assert "LockedBatcher" not in f.message
+        # the finding anchors the unlocked append itself
+        assert any(
+            "pending.append" in src_lines[f.line - 1] for f in findings
+        )
+
+
+# ---------------------------------------------------------------------------
+# framework: pragmas, baseline, CLI, self-hosting
+# ---------------------------------------------------------------------------
+
+
+class TestFramework:
+    def test_pragma_suppresses_same_line(self, tmp_path):
+        src = (
+            "from jax import lax\n"
+            "def f(x):\n"
+            "    return lax.psum(x, 'bogus')  "
+            "# glom-lint: ok[collective-coverage] seeded test axis\n"
+        )
+        assert by_checker(lint(tmp_path, src), "collective-coverage") == []
+
+    def test_pragma_on_own_line_suppresses_next(self, tmp_path):
+        src = (
+            "from jax import lax\n"
+            "def f(x):\n"
+            "    # glom-lint: ok[collective-coverage] seeded test axis\n"
+            "    return lax.psum(x, 'bogus')\n"
+        )
+        assert by_checker(lint(tmp_path, src), "collective-coverage") == []
+
+    def test_pragma_without_reason_is_a_finding(self, tmp_path):
+        src = (
+            "from jax import lax\n"
+            "def f(x):\n"
+            "    return lax.psum(x, 'bogus')  "
+            "# glom-lint: ok[collective-coverage]\n"
+        )
+        fs = lint(tmp_path, src)
+        assert by_checker(fs, "collective-coverage") == []
+        assert len(by_checker(fs, "pragma")) == 1
+
+    def test_pragma_wrong_checker_does_not_suppress(self, tmp_path):
+        src = (
+            "from jax import lax\n"
+            "def f(x):\n"
+            "    return lax.psum(x, 'bogus')  "
+            "# glom-lint: ok[lockset] wrong checker\n"
+        )
+        assert len(by_checker(lint(tmp_path, src), "collective-coverage")) == 1
+
+    def test_pragma_in_docstring_is_not_a_suppression(self, tmp_path):
+        """The framework documents its own syntax in docstrings; those
+        examples must neither suppress nor warn as unused."""
+        src = (
+            '"""Docs: write  # glom-lint: ok[lockset] reason  inline."""\n'
+            "x = 1\n"
+        )
+        path = tmp_path / "m.py"
+        path.write_text(src)
+        warnings = []
+        assert run([str(path)], warnings=warnings) == []
+        assert warnings == []
+
+    def test_unused_pragma_warns(self, tmp_path):
+        src = (
+            "def f(x):\n"
+            "    return x  # glom-lint: ok[lockset] nothing fires here\n"
+        )
+        path = tmp_path / "m.py"
+        path.write_text(src)
+        warnings = []
+        assert run([str(path)], warnings=warnings) == []
+        assert len(warnings) == 1 and "unused pragma" in warnings[0]
+        # a USED pragma does not warn
+        used = (
+            "from jax import lax\n"
+            "def f(x):\n"
+            "    return lax.psum(x, 'bogus')  "
+            "# glom-lint: ok[collective-coverage] seeded\n"
+        )
+        path.write_text(used)
+        warnings = []
+        assert run([str(path)], warnings=warnings) == []
+        assert warnings == []
+        # a partial --select cannot judge unusedness: no warning
+        path.write_text(src)
+        warnings = []
+        run([str(path)], select=["schema-emit"], warnings=warnings)
+        assert warnings == []
+
+    def test_select_unknown_checker_raises(self, tmp_path):
+        with pytest.raises(ValueError, match="unknown checkers"):
+            lint(tmp_path, "x = 1\n", select=["nope"])
+
+    def test_parse_error_is_a_finding(self, tmp_path):
+        fs = lint(tmp_path, "def broken(:\n")
+        assert len(by_checker(fs, "parse")) == 1
+
+    def test_baseline_roundtrip_and_ratchet(self, tmp_path, capsys):
+        bad = tmp_path / "mod.py"
+        bad.write_text(
+            "from jax import lax\n"
+            "def f(x):\n"
+            "    return lax.psum(x, 'bogus')\n"
+        )
+        b = tmp_path / "baseline.json"
+        # 1. unbaselined run fails
+        assert main([str(bad), "--no-baseline"]) == 1
+        # 2. write + annotate the baseline
+        assert main([str(bad), "--write-baseline", str(b)]) == 0
+        data = json.loads(b.read_text())
+        assert len(data["suppressions"]) == 1
+        # 3. unreviewed entries refuse to gate
+        assert main([str(bad), "--baseline", str(b)]) == 1
+        for entry in data["suppressions"].values():
+            entry["reviewed"] = "seeded test suppression"
+        b.write_text(json.dumps(data))
+        # 4. reviewed baseline gates green
+        assert main([str(bad), "--baseline", str(b)]) == 0
+        # 5. a NEW finding beyond the baselined count fails
+        bad.write_text(
+            bad.read_text()
+            + "def g(x):\n    return lax.pmean(x, 'bogus2')\n"
+        )
+        assert main([str(bad), "--baseline", str(b)]) == 1
+        # 6. fixing everything leaves the stale entry as a warning only
+        bad.write_text("def f(x):\n    return x\n")
+        assert main([str(bad), "--baseline", str(b)]) == 0
+        assert "stale baseline entry" in capsys.readouterr().out
+
+    def test_baseline_fingerprints_are_line_free(self, tmp_path):
+        src = (
+            "from jax import lax\n"
+            "def f(x):\n"
+            "    return lax.psum(x, 'bogus')\n"
+        )
+        shifted = "# a comment pushing everything down\n\n\n" + src
+        fp1 = [f.fingerprint for f in lint(tmp_path, src, name="a/m.py")]
+        fp2 = [f.fingerprint for f in lint(tmp_path, shifted, name="a/m.py")]
+        assert fp1 == fp2
+
+    def test_list_checkers(self, capsys):
+        assert main(["--list-checkers"]) == 0
+        out = capsys.readouterr().out
+        for name in (
+            "collective-coverage", "trace-purity", "donation-safety",
+            "schema-emit", "lockset",
+        ):
+            assert name in out
+
+    def test_self_host_repo_is_clean_with_baseline(self, monkeypatch):
+        """The acceptance gate: the merged tree + the checked-in reviewed
+        baseline (<= 10 suppressions) lints clean."""
+        monkeypatch.chdir(REPO)
+        findings = run(["glom_tpu"])
+        data = baseline_mod.load(str(REPO / "analysis_baseline.json"))
+        assert len(data["suppressions"]) <= 10
+        assert baseline_mod.unreviewed(data) == []
+        new, _stale = baseline_mod.apply(findings, data)
+        assert new == [], "\n".join(f.render() for f in new)

@@ -6,7 +6,7 @@ import numpy as np
 import pytest
 
 from glom_tpu.kernels import fused_grouped_ffw
-from glom_tpu.ops.ffw import GroupedFFWParams, grouped_ffw, init_grouped_ffw
+from glom_tpu.ops.ffw import grouped_ffw, init_grouped_ffw
 
 
 @pytest.fixture(scope="module")

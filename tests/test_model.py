@@ -7,7 +7,6 @@ import pytest
 
 from glom_tpu.models import Glom, glom_forward, init_glom
 from glom_tpu.models.core import contribution_divisor
-from glom_tpu.ops.consensus import build_local_mask
 from glom_tpu.utils.config import GlomConfig
 from oracle_np import np_forward, np_local_mask
 

@@ -3,7 +3,6 @@ Chrome/Perfetto trace-event JSON. Pure host-side, no jax."""
 
 import json
 
-import pytest
 
 from glom_tpu.telemetry import schema
 from glom_tpu.telemetry.perfetto import (

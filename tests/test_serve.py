@@ -10,7 +10,6 @@ The two acceptance locks:
     than max_iters iterations.
 """
 
-import threading
 import time
 
 import jax

@@ -397,6 +397,47 @@ class TestCollectiveCounters:
             abs=1e-5,
         )
 
+    def test_tp_forward_psum_counts_per_scan_execution(self):
+        """The TP forward psum (registered by PR 5's glom-lint
+        self-host) prices its ring wire bytes PER SCAN EXECUTION: the
+        body traces once under counters.scaled(iters), so one counting
+        trace must record exactly 2 sites (bu + td ffw outputs) carrying
+        iters x ring_allreduce_bytes each. Trace-level contract only —
+        the trainer's counting path can't reach mp>1 today (manual x
+        zero>=1 degrades to stage 0 on model>1 meshes), which is exactly
+        why the multiplicity needs its own lock."""
+        from glom_tpu.models.core import init_glom
+        from glom_tpu.parallel.manual import make_manual_forward
+        from glom_tpu.parallel.mesh import make_mesh
+        from glom_tpu.telemetry.counters import (
+            CollectiveCounters,
+            recording,
+            ring_allreduce_bytes,
+        )
+
+        cfg = GlomConfig(dim=16, levels=3, image_size=8, patch_size=4)
+        mesh = make_mesh(MeshConfig(data=2, model=2), jax.devices()[:4])
+        iters, mp, b = 4, 2, 4
+        fwd = make_manual_forward(mesh, cfg, iters=iters, use_pallas=True)
+        params = jax.eval_shape(
+            lambda k: init_glom(k, cfg), jax.random.PRNGKey(0)
+        )
+        img = jax.ShapeDtypeStruct((b, 3, 8, 8), jnp.float32)
+        c = CollectiveCounters()
+        with recording(c):
+            jax.eval_shape(fwd, params, img)
+        # per-shard ffw outputs: bu [L, b_loc*n_loc, d], td [L-1, ...]
+        L, d = cfg.levels, cfg.dim
+        rows = (b // 2) * cfg.num_patches  # b_loc * n_loc (seq=1)
+        bu = jax.ShapeDtypeStruct((L, rows, d), jnp.float32)
+        td = jax.ShapeDtypeStruct((L - 1, rows, d), jnp.float32)
+        t = c.totals()
+        assert c.n_reduce == 2  # two sites, traced once each
+        assert t["comm_measured_reduce_bytes_per_step"] == iters * (
+            ring_allreduce_bytes(bu, mp) + ring_allreduce_bytes(td, mp)
+        )
+        assert t["comm_measured_gather_bytes_per_step"] == 0
+
     def test_stage2_accum_counts_per_microbatch_scatter(self):
         """Stage 2 scatters once PER MICROBATCH inside the scan (one trace,
         accum executions): the measured reduce bytes must scale with

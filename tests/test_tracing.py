@@ -16,7 +16,6 @@ import numpy as np
 import pytest
 
 from glom_tpu.telemetry import schema
-from glom_tpu.tracing import capture as cap_mod
 from glom_tpu.tracing.capture import TraceCapture, parse_trace_steps
 from glom_tpu.tracing.flight import (
     FlightRecorder,
