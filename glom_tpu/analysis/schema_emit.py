@@ -48,10 +48,10 @@ RECORD_POSITION = {
 }
 
 # Frozen fallback if neither the import nor the AST scan can find the
-# registry (running the pass over a partial checkout): the v3 kinds.
+# registry (running the pass over a partial checkout): the v4 kinds.
 _FALLBACK_KINDS = {
     "train_step", "bench", "watchdog", "anomaly", "summary", "note",
-    "span", "error", "serve",
+    "span", "error", "serve", "fault", "recovery",
 }
 
 

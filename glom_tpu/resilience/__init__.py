@@ -1,0 +1,60 @@
+"""Fault injection + recovery runtime (docs/RESILIENCE.md).
+
+The observability stack (telemetry/, tracing/) sees failures; this
+package makes the framework SURVIVE them — and proves it, by injecting
+the failures deterministically and reconciling the stamped recovery
+events against the stamped faults:
+
+  * faults   — seedable, scoped, stamped injectors (FaultPlan + one
+               injector per fault class in the catalog);
+  * retry    — watchdog-aware retry-with-backoff (flapping retries,
+               down fails fast);
+  * ladder   — the serving degradation ladder (normal -> capped iters ->
+               capped buckets -> shed; every rung reversible + stamped);
+  * chaos    — end-to-end scenarios (`python -m glom_tpu.resilience`):
+               kill a real training worker, require resume.
+
+The training-side restart loop lives with the trainers
+(glom_tpu/train/supervise.fit_supervised); the checkpoint integrity layer
+with the checkpoints (glom_tpu/utils/checkpoint.py).
+"""
+
+from glom_tpu.resilience.faults import (
+    FaultPlan,
+    InjectedFault,
+    dispatch_fault,
+    emit_fault,
+    emit_recovery,
+    nan_storm,
+    probe_flap,
+    queue_stall,
+    truncate_newest_checkpoint,
+)
+from glom_tpu.resilience.ladder import (
+    BUCKET_CAP,
+    CAPPED_ITERS,
+    NORMAL,
+    RUNGS,
+    SHED,
+    DegradationLadder,
+)
+from glom_tpu.resilience.retry import RetryPolicy
+
+__all__ = [
+    "FaultPlan",
+    "InjectedFault",
+    "dispatch_fault",
+    "emit_fault",
+    "emit_recovery",
+    "nan_storm",
+    "probe_flap",
+    "queue_stall",
+    "truncate_newest_checkpoint",
+    "DegradationLadder",
+    "RUNGS",
+    "NORMAL",
+    "CAPPED_ITERS",
+    "BUCKET_CAP",
+    "SHED",
+    "RetryPolicy",
+]

@@ -1,0 +1,8 @@
+"""`python -m glom_tpu.resilience` — the chaos scenario driver."""
+
+import sys
+
+from glom_tpu.resilience.chaos import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,326 @@
+"""End-to-end chaos scenarios: kill a real training worker, require the
+stack to come back.
+
+`python -m glom_tpu.resilience --scenario kill-train --dir /tmp/chaos`
+drives the full kill-and-resume loop the unit tests can only approximate:
+
+  1. launch the REAL training CLI (train/cli.py) as a subprocess with
+     per-step checkpointing, a metrics file, and a flight recorder;
+  2. wait until >= --kill-after checkpoints are manifest-committed, then
+     deliver the fault — SIGKILL (kill-train: the uncatchable death) or
+     SIGTERM (preempt-train: the pod-preemption grace path, which must
+     land a deadline-bounded checkpoint + flight dump on the way out);
+  3. relaunch the same command; --resume must restore from the latest
+     VALID checkpoint and run to completion;
+  4. validate the evidence trail: every record schema-lints, a stamped
+     "recovery" resume event exists, the train_step sequence is
+     CONTINUOUS across the kill (no lost or skipped steps), and — for
+     preempt-train — the SIGTERM flight dump carries the
+     "preemption-checkpoint" recovery event.
+
+Every decision the driver takes is itself a stamped record on stdout
+(kind "fault" for the kill, "note"/"summary" around it), so a chaos run's
+log lints like any other artifact of record. Exit 0 = the system
+recovered and the evidence proves it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import List, Optional
+
+from glom_tpu.telemetry import schema
+
+
+def _emit(rec: dict, kind: str) -> dict:
+    stamped = schema.stamp(rec, kind=kind)
+    print(json.dumps(stamped), flush=True)
+    return stamped
+
+
+def _note(text: str, **extra) -> None:
+    _emit({"note": text, **extra}, kind="note")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m glom_tpu.resilience",
+        description="Chaos scenarios: fault-inject a real run, verify recovery "
+        "(docs/RESILIENCE.md)",
+    )
+    p.add_argument(
+        "--scenario", choices=["kill-train", "preempt-train"],
+        default="kill-train",
+        help="kill-train = SIGKILL mid-run (uncatchable; resume must come "
+        "from the last committed checkpoint); preempt-train = SIGTERM (the "
+        "grace path: deadline-bounded checkpoint + flight dump, then resume)",
+    )
+    p.add_argument("--dir", required=True, help="scenario working directory")
+    p.add_argument("--preset", default="mnist")
+    p.add_argument("--steps", type=int, default=6)
+    p.add_argument("--batch-size", type=int, default=2)
+    p.add_argument(
+        "--kill-after", type=int, default=2, metavar="N",
+        help="deliver the fault once N checkpoints are manifest-committed",
+    )
+    p.add_argument(
+        "--timeout", type=float, default=600.0,
+        help="per-phase deadline in seconds (a hang is a FAILURE: the whole "
+        "point is that nothing in the stack may hang)",
+    )
+    return p
+
+
+def _worker_cmd(args, paths) -> List[str]:
+    return [
+        sys.executable, "-u", "-m", "glom_tpu.train.cli",
+        "--preset", args.preset,
+        "--steps", str(args.steps),
+        "--batch-size", str(args.batch_size),
+        "--data", "gaussian",
+        "--log-every", "1",
+        "--checkpoint-dir", str(paths["ckpt"]),
+        "--checkpoint-every", "1",
+        "--resume",
+        "--metrics-file", str(paths["metrics"]),
+        "--flight-recorder", str(paths["flight"]),
+    ]
+
+
+def _spawn(cmd, log_path: Path) -> subprocess.Popen:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    with open(log_path, "a") as log:
+        # The child inherits a duplicate of the fd at Popen time; closing
+        # the parent's handle immediately neither truncates nor races it.
+        return subprocess.Popen(
+            cmd, stdout=log, stderr=subprocess.STDOUT, env=env,
+        )
+
+
+def _manifest_count(ckpt_dir: Path) -> int:
+    return len(list(ckpt_dir.glob("manifest_*.json")))
+
+
+def _wait_for_checkpoints(
+    proc: subprocess.Popen, ckpt_dir: Path, n: int, deadline: float
+) -> bool:
+    while time.monotonic() < deadline:
+        if _manifest_count(ckpt_dir) >= n:
+            return True
+        if proc.poll() is not None:
+            return False
+        time.sleep(0.2)
+    return False
+
+
+def _records(path: Path) -> List[dict]:
+    if not path.is_file():
+        return []
+    with open(path) as fh:
+        return [rec for _, rec in schema.iter_json_lines(fh)]
+
+
+def _lint(paths: List[Path]) -> List[str]:
+    errors = []
+    for p in paths:
+        with open(p) as fh:
+            errors.extend(f"{p}: {e}" for e in schema.lint_stream(fh))
+    return errors
+
+
+def run_scenario(args) -> int:
+    workdir = Path(args.dir)
+    paths = {
+        "ckpt": workdir / "ckpt",
+        "flight": workdir / "flight",
+        "metrics": workdir / "metrics.jsonl",
+        "run1_log": workdir / "run1.log",
+        "run2_log": workdir / "run2.log",
+    }
+    workdir.mkdir(parents=True, exist_ok=True)
+    sig = signal.SIGKILL if args.scenario == "kill-train" else signal.SIGTERM
+    cmd = _worker_cmd(args, paths)
+    _note(
+        f"chaos {args.scenario}: launching worker", cmd=" ".join(cmd),
+        workdir=str(workdir),
+    )
+
+    # Phase 1: run until enough checkpoints committed, then kill.
+    proc = _spawn(cmd, paths["run1_log"])
+    deadline = time.monotonic() + args.timeout
+    try:
+        if not _wait_for_checkpoints(proc, paths["ckpt"], args.kill_after, deadline):
+            _emit(
+                {
+                    "error": "worker-never-checkpointed",
+                    "value": None,
+                    "note": f"no {args.kill_after} committed checkpoints within "
+                    f"{args.timeout}s (rc={proc.poll()}); see {paths['run1_log']}",
+                },
+                kind="error",
+            )
+            return 1
+        if proc.poll() is not None:
+            # The worker finished between polls before the fault could
+            # land — the scenario exercised nothing. A distinct stamped
+            # error (not "survived-kill"): rerun with a smaller
+            # --kill-after or more --steps.
+            _emit(
+                {"error": "kill-window-missed", "value": None,
+                 "note": f"worker exited rc={proc.returncode} before the "
+                 f"kill landed; lower --kill-after (now {args.kill_after}) "
+                 f"or raise --steps (now {args.steps})"},
+                kind="error",
+            )
+            return 1
+        os.kill(proc.pid, sig)
+        _emit(
+            {
+                "fault": "sigkill" if sig == signal.SIGKILL else "sigterm",
+                "site": "train-worker",
+                "pid": proc.pid,
+                "manifests_at_kill": _manifest_count(paths["ckpt"]),
+                "wall_time_s": round(time.time(), 3),
+            },
+            kind="fault",
+        )
+        try:
+            rc1 = proc.wait(timeout=min(120.0, args.timeout))
+        except subprocess.TimeoutExpired:
+            # A worker that outlives its kill signal (e.g. a wedged
+            # SIGTERM grace save) is itself a finding — stamped, like
+            # every other failure path here, never a raw traceback.
+            _emit(
+                {"error": "worker-outlived-kill", "value": None,
+                 "note": f"worker pid {proc.pid} still alive "
+                 f"{min(120.0, args.timeout)}s after {sig!s}; hard-killing"},
+                kind="error",
+            )
+            return 1
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30.0)
+    if rc1 == 0:
+        # rc 0 after the signal means the worker was already past its
+        # last unsaved work when the fault landed (the exit raced the
+        # kill) — same remedy as a missed window, stamped distinctly
+        # from a worker that IGNORED the signal (which cannot exit 0:
+        # SIGKILL is uncatchable and the SIGTERM chain raises).
+        _emit(
+            {"error": "kill-window-missed", "value": None,
+             "note": "worker exited 0 despite the injected kill (exit "
+             "raced the signal); lower --kill-after or raise --steps"},
+            kind="error",
+        )
+        return 1
+    _note(f"phase 1 done: worker killed (rc={rc1})")
+
+    # Phase 2: relaunch; --resume must restore and run to completion.
+    proc2 = _spawn(cmd, paths["run2_log"])
+    try:
+        rc2 = proc2.wait(timeout=args.timeout)
+    except subprocess.TimeoutExpired:
+        proc2.kill()
+        proc2.wait(timeout=30.0)
+        _emit(
+            {"error": "resume-hung", "value": None,
+             "note": f"phase-2 worker exceeded {args.timeout}s — a hang IS "
+             "the failure mode this harness exists to catch"},
+            kind="error",
+        )
+        return 1
+    if rc2 != 0:
+        _emit(
+            {"error": "resume-failed", "value": None,
+             "note": f"phase-2 worker rc={rc2}; see {paths['run2_log']}"},
+            kind="error",
+        )
+        return 1
+    _note("phase 2 done: resumed worker ran to completion")
+
+    # Phase 3: the evidence trail must prove the recovery.
+    failures: List[str] = []
+    recs = _records(paths["metrics"])
+    steps = sorted(
+        {int(r["step"]) for r in recs
+         if r.get("kind") == "train_step" and isinstance(r.get("step"), (int, float))}
+    )
+    resumes = [
+        r for r in recs
+        if r.get("kind") == "recovery"
+        and r.get("action") == "resume-from-checkpoint"
+    ]
+    if not resumes:
+        failures.append("no stamped resume-from-checkpoint recovery event")
+    want = set(range(args.steps))
+    missing = want - set(steps)
+    # SIGKILL resume re-trains (and re-logs) everything after the last
+    # committed step, so the stream must be gapless. The SIGTERM grace
+    # save deliberately commits PAST the last flushed record (the
+    # in-flight step's record dies with the process, its training is in
+    # the checkpoint), so exactly that one step may be missing.
+    allowed = set()
+    if args.scenario == "preempt-train" and resumes:
+        r0 = resumes[0].get("step")
+        if isinstance(r0, (int, float)):
+            allowed = {int(r0) - 1}
+    if not steps or not missing <= allowed:
+        failures.append(
+            f"train_step sequence not continuous: got {steps}, want "
+            f"{sorted(want)} (missing {sorted(missing)}, allowed gap "
+            f"{sorted(allowed)})"
+        )
+    dumps = sorted(paths["flight"].glob("flight_*.jsonl"))
+    if not dumps:
+        failures.append(f"no flight dumps under {paths['flight']}")
+    if args.scenario == "preempt-train":
+        preempt = [
+            r
+            for d in dumps
+            for r in _records(d)
+            if r.get("kind") == "recovery"
+            and r.get("action") == "preemption-checkpoint"
+        ]
+        if not any(r.get("ok") for r in preempt):
+            failures.append(
+                "no successful preemption-checkpoint recovery event in any "
+                "flight dump (the SIGTERM grace path did not land a save)"
+            )
+    failures.extend(_lint([paths["metrics"], *dumps]))
+
+    resumed_step: Optional[int] = (
+        resumes[0].get("step") if resumes else None
+    )
+    summary = {
+        "event": "chaos-summary",
+        "scenario": args.scenario,
+        "ok": not failures,
+        "steps": args.steps,
+        "resumed_from_step": resumed_step,
+        "n_recovery_events": len([r for r in recs if r.get("kind") == "recovery"]),
+        "n_flight_dumps": len(dumps),
+        "failures": failures[:10],
+    }
+    _emit(summary, kind="summary")
+    if failures:
+        for f in failures:
+            print(f"CHAOS FAIL: {f}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    return run_scenario(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,184 @@
+"""The serving degradation ladder: shed is the LAST resort, not the only
+move.
+
+Before this module the batcher had exactly two answers to trouble: serve
+normally, or shed. The ladder inserts the rungs between them — each one a
+cheaper serving mode, each transition a stamped, REVERSIBLE decision:
+
+    rung 0  normal        — configured route (iters="auto", full buckets)
+    rung 1  capped_iters  — early exit capped at a fixed degraded budget:
+                            every request costs a bounded, smaller number
+                            of column iterations (quality degrades
+                            gracefully; GLOM consensus at half budget is a
+                            coarser island structure, not garbage)
+    rung 2  bucket_cap    — additionally gather smaller batches (a capped
+                            dispatch ceiling drains the queue in smaller,
+                            faster bites — latency per dispatch drops when
+                            the backend is struggling)
+    rung 3  shed          — new admissions fail fast (the old behavior,
+                            now the floor of the ladder instead of its
+                            entirety)
+
+Inputs per evaluation: queue fill fraction (pressure) and the watchdog
+backend state. Fill >= high_water steps DOWN one rung; fill <= low_water
+steps back UP; a FLAPPING backend pins the ladder at capped_iters or
+worse while the flap lasts — but flapping alone NEVER sheds (satellite
+contract: flapping is a degraded-service signal, not an outage; "down"
+is handled by the batcher's fast-fail shed path, not the ladder). A
+min_dwell_s hysteresis keeps one burst from riding the ladder up and
+down per dispatch.
+
+Every transition is emitted via serve/events.emit_serve (kind "serve",
+event "ladder") so backend_state rides along, and kept in an in-memory
+timeline for end-of-run summaries — the same discipline as the watchdog's
+transitions. Thread-safe: observe() runs on the batcher worker while
+rung()/record() serve caller threads.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+RUNGS = ("normal", "capped_iters", "bucket_cap", "shed")
+NORMAL, CAPPED_ITERS, BUCKET_CAP, SHED = range(4)
+
+
+class DegradationLadder:
+    """Pressure/flap-driven serving mode, one reversible rung at a time."""
+
+    def __init__(
+        self,
+        *,
+        degraded_iters: int,
+        bucket_cap: int,
+        high_water: float = 0.75,
+        low_water: float = 0.25,
+        min_dwell_s: float = 0.25,
+        writer=None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if not 0.0 <= low_water < high_water <= 1.0:
+            raise ValueError(
+                f"need 0 <= low_water ({low_water}) < high_water "
+                f"({high_water}) <= 1"
+            )
+        if degraded_iters < 1:
+            raise ValueError(f"degraded_iters {degraded_iters} must be >= 1")
+        if bucket_cap < 1:
+            raise ValueError(f"bucket_cap {bucket_cap} must be >= 1")
+        if min_dwell_s < 0:
+            raise ValueError(f"min_dwell_s {min_dwell_s} must be >= 0")
+        self.degraded_iters = degraded_iters
+        self.bucket_cap = bucket_cap
+        self.high_water = high_water
+        self.low_water = low_water
+        self.min_dwell_s = min_dwell_s
+        self.writer = writer
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._rung = NORMAL
+        self._last_change: Optional[float] = None
+        self._transitions: List[dict] = []
+        self._n_degrade = 0
+        self._n_restore = 0
+
+    @classmethod
+    def from_config(cls, cfg, scfg, *, writer=None, **overrides):
+        """Resolve the ladder knobs from a (GlomConfig, ServeConfig) pair:
+        degraded_iters defaults to half the model's iteration budget
+        (floor 1) and bucket_cap to half the admission ceiling — both
+        overridable per ServeConfig field or kwarg."""
+        kw = dict(
+            degraded_iters=(
+                scfg.degraded_iters
+                if scfg.degraded_iters is not None
+                else max(1, cfg.default_iters // 2)
+            ),
+            bucket_cap=(
+                scfg.degraded_max_batch
+                if scfg.degraded_max_batch is not None
+                else max(1, scfg.max_batch // 2)
+            ),
+            high_water=scfg.ladder_high_water,
+            low_water=scfg.ladder_low_water,
+            writer=writer,
+        )
+        kw.update(overrides)
+        return cls(**kw)
+
+    # -- the decision ------------------------------------------------------
+
+    def observe(self, *, queue_fill: float, backend_state: str = "up") -> int:
+        """Evaluate one (pressure, backend) observation; returns the rung
+        now in force. At most ONE rung of movement per call, at most one
+        transition per min_dwell_s — the ladder is deliberately slower
+        than the queue it watches."""
+        event = None
+        with self._lock:
+            now = self._clock()
+            desired = self._rung
+            reason = None
+            if queue_fill >= self.high_water and self._rung < SHED:
+                desired, reason = self._rung + 1, "pressure"
+            elif queue_fill <= self.low_water and self._rung > NORMAL:
+                desired, reason = self._rung - 1, "drained"
+            if backend_state == "flapping":
+                if desired < CAPPED_ITERS:
+                    # Flap floor: degraded service while the backend
+                    # settles. NOT shed — a flapping backend still serves.
+                    desired, reason = CAPPED_ITERS, "backend-flapping"
+            dwell_ok = (
+                self._last_change is None
+                or now - self._last_change >= self.min_dwell_s
+            )
+            if desired != self._rung and dwell_ok:
+                prev = self._rung
+                self._rung = desired
+                self._last_change = now
+                if desired > prev:
+                    self._n_degrade += 1
+                else:
+                    self._n_restore += 1
+                event = {
+                    "event": "ladder",
+                    "rung": RUNGS[desired],
+                    "prev_rung": RUNGS[prev],
+                    "direction": "degrade" if desired > prev else "restore",
+                    "reason": reason,
+                    "queue_fill": round(queue_fill, 3),
+                }
+            rung = self._rung
+        if event is not None:
+            # Emit outside the lock (the writer chain locks on its own);
+            # emit_serve merges the live backend_state onto the record.
+            from glom_tpu.serve.events import emit_serve
+
+            stamped = emit_serve(self.writer, event)
+            with self._lock:
+                self._transitions.append(stamped)
+        return rung
+
+    # -- reads -------------------------------------------------------------
+
+    def rung(self) -> int:
+        with self._lock:
+            return self._rung
+
+    def rung_name(self) -> str:
+        return RUNGS[self.rung()]
+
+    def timeline(self) -> List[dict]:
+        """The stamped transition events, oldest first."""
+        with self._lock:
+            return list(self._transitions)
+
+    def record(self) -> dict:
+        """The fields a serve summary stamps."""
+        with self._lock:
+            return {
+                "ladder_rung": RUNGS[self._rung],
+                "ladder_degrades": self._n_degrade,
+                "ladder_restores": self._n_restore,
+            }

@@ -1,0 +1,172 @@
+"""Retry-with-backoff for transient dispatch failures, watchdog-aware.
+
+The serving stack's failure discipline so far was binary: a dispatch
+exception failed its batch, a down backend shed. That is right for a DEAD
+backend — retrying into it is the round-5 hang — and wrong for a FLAPPING
+one, where the gap closes in seconds and a retry converts a failed batch
+into a served one. The watchdog already distinguishes the two states;
+RetryPolicy is where that distinction becomes behavior:
+
+  * backend_state == "down"  -> fail FAST, no retry (the shed path owns it);
+  * "up" / "flapping" / "unknown" -> bounded exponential backoff, each
+    retry stamped as a schema-v4 "recovery" event (action
+    "dispatch-retry"), and a success after retries stamped as
+    "dispatch-recovered" — a flap survived on the record, not silently.
+
+Nonretryable exception types (caller bugs: ValueError/TypeError by
+default) raise immediately; so do KeyboardInterrupt/SystemExit, which the
+policy never catches. Thread-safe: the counters ride one lock — the
+engine is called from the batcher worker while summaries read from the
+caller's thread (the lockset contract, docs/ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional, Tuple, Type
+
+NONRETRYABLE_DEFAULT: Tuple[Type[BaseException], ...] = (ValueError, TypeError)
+
+
+def validate_backoff(
+    backoff_s: float, backoff_factor: float, backoff_max_s: float
+) -> None:
+    """THE bounded-exponential-backoff parameter contract — one
+    definition for every policy that backs off (RetryPolicy here,
+    train/supervise.TrainSupervisor): a change to what 'valid backoff'
+    means must not be able to diverge between them."""
+    if backoff_s < 0 or backoff_max_s < 0 or backoff_factor < 1.0:
+        raise ValueError(
+            f"backoff_s={backoff_s} backoff_max_s={backoff_max_s} "
+            f"backoff_factor={backoff_factor}: backoffs must be >= 0 "
+            "and the factor >= 1"
+        )
+
+
+def next_backoff(
+    backoff_s: float, backoff_factor: float, backoff_max_s: float, n: int
+) -> float:
+    """The n-th (0-based) delay of the bounded exponential schedule:
+    min(backoff_s * factor**n, backoff_max_s). Shared by RetryPolicy and
+    TrainSupervisor so the growth/cap semantics cannot silently fork."""
+    return min(backoff_s * backoff_factor ** n, backoff_max_s)
+
+
+class RetryPolicy:
+    """Bounded exponential-backoff retry around one callable attempt."""
+
+    def __init__(
+        self,
+        *,
+        retries: int = 2,
+        backoff_s: float = 0.025,
+        backoff_factor: float = 2.0,
+        backoff_max_s: float = 1.0,
+        nonretryable: Optional[Tuple[Type[BaseException], ...]] = None,
+        writer=None,
+        sleep: Callable[[float], None] = time.sleep,
+        site: str = "dispatch",
+    ):
+        if retries < 0:
+            raise ValueError(f"retries {retries} must be >= 0")
+        validate_backoff(backoff_s, backoff_factor, backoff_max_s)
+        self.retries = retries
+        self.backoff_s = backoff_s
+        self.backoff_factor = backoff_factor
+        self.backoff_max_s = backoff_max_s
+        self.nonretryable = (
+            nonretryable if nonretryable is not None else NONRETRYABLE_DEFAULT
+        )
+        self.writer = writer
+        self.site = site
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._n_calls = 0
+        self._n_retries = 0
+        self._n_recovered = 0
+        self._n_gave_up = 0
+        self._n_fast_failed = 0
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _emit(self, rec: dict) -> None:
+        from glom_tpu.resilience.faults import emit_recovery
+
+        emit_recovery(self.writer, rec)
+
+    def record(self) -> dict:
+        """Counter snapshot for summary records (one consistent read)."""
+        with self._lock:
+            return {
+                "retry_site": self.site,
+                "n_calls": self._n_calls,
+                "n_retries": self._n_retries,
+                "n_recovered": self._n_recovered,
+                "n_gave_up": self._n_gave_up,
+                "n_fast_failed": self._n_fast_failed,
+            }
+
+    # -- the loop ----------------------------------------------------------
+
+    def run(self, attempt: Callable[[], object], **context):
+        """Call `attempt` until it returns, the budget exhausts, or the
+        backend goes down. `context` (bucket, n_valid, ...) rides every
+        stamped recovery event."""
+        from glom_tpu.telemetry.watchdog import backend_record
+
+        with self._lock:
+            self._n_calls += 1
+        tries = 0
+        while True:
+            try:
+                out = attempt()
+            except self.nonretryable:
+                raise
+            except Exception as e:  # noqa: BLE001 — classified below
+                state = backend_record().get("backend_state", "unknown")
+                if state == "down":
+                    # Never retry into a dead backend: the watchdog says
+                    # the gap is not closing, and the caller's shed path
+                    # (fast-fail + stamped evidence) owns this case.
+                    with self._lock:
+                        self._n_fast_failed += 1
+                    raise
+                if tries >= self.retries:
+                    with self._lock:
+                        self._n_gave_up += 1
+                    raise
+                tries += 1
+                with self._lock:
+                    self._n_retries += 1
+                backoff = next_backoff(
+                    self.backoff_s, self.backoff_factor,
+                    self.backoff_max_s, tries - 1,
+                )
+                self._emit(
+                    {
+                        "action": "dispatch-retry",
+                        "site": self.site,
+                        "attempt": tries,
+                        "retries_budget": self.retries,
+                        "backoff_s": round(backoff, 4),
+                        "backend_state": state,
+                        "exception": f"{type(e).__name__}: {e}"[:300],
+                        **context,
+                    }
+                )
+                if backoff > 0:
+                    self._sleep(backoff)
+                continue
+            if tries:
+                with self._lock:
+                    self._n_recovered += 1
+                self._emit(
+                    {
+                        "action": "dispatch-recovered",
+                        "site": self.site,
+                        "attempts": tries + 1,
+                        **context,
+                    }
+                )
+            return out

@@ -25,6 +25,7 @@ _EXPORTS = {
     "ServeResult": "engine",
     "BackendDownError": "batcher",
     "DynamicBatcher": "batcher",
+    "LadderShedError": "batcher",
     "QueueFullError": "batcher",
     "ShedError": "batcher",
     "Ticket": "batcher",

@@ -22,13 +22,20 @@ and leave evidence, never hang):
 
   * the request queue is BOUNDED: a submit against a full queue sheds
     immediately with QueueFullError (backpressure to the caller, who can
-    retry/downgrade) and a schema-v3 "serve" shed event;
+    retry/downgrade) and a stamped "serve" shed event carrying the WHY
+    (queue depth/capacity, ladder rung);
   * when the global backend watchdog says "down", submissions and any
     already-gathered requests fail fast with BackendDownError, and each
-    emits a schema-v3 "error" record carrying the machine-readable cause —
-    the serving analog of sinks.bench_bootstrap's UNMEASURED record;
+    emits a schema "error" record carrying the machine-readable cause —
+    the serving analog of sinks.bench_bootstrap's UNMEASURED record. A
+    FLAPPING backend is NOT down: it keeps serving (degraded via the
+    ladder; dispatch failures retry per the engine's RetryPolicy);
   * a dispatch exception fails ONLY that batch's requests (each ticket
-    re-raises it) and the worker keeps serving.
+    re-raises it) and the worker keeps serving;
+  * with a DegradationLadder attached (glom_tpu/resilience/ladder.py),
+    pressure and flap step serving DOWN one reversible rung at a time —
+    capped iterations, then capped batches, then (last) shed — so
+    shedding is the floor of the ladder, not the only move.
 
 Host phases ride tracing.spans (SERVE_PHASES: serve_enqueue, serve_batch,
 serve_dispatch, serve_fetch), aggregated per phase and drained by
@@ -49,7 +56,14 @@ from glom_tpu.tracing.spans import SpanAggregator, span
 
 
 class ShedError(RuntimeError):
-    """Base of the fast-fail admission errors (never a hang)."""
+    """Base of the fast-fail admission errors (never a hang). `detail`
+    carries the machine-readable why (queue depth, ladder rung) — the
+    same fields the stamped shed record gets, so a caller's except block
+    and the telemetry stream read one story."""
+
+    def __init__(self, message: str, **detail):
+        super().__init__(message)
+        self.detail = detail
 
 
 class QueueFullError(ShedError):
@@ -58,6 +72,11 @@ class QueueFullError(ShedError):
 
 class BackendDownError(ShedError):
     """The backend watchdog reports the accelerator down."""
+
+
+class LadderShedError(ShedError):
+    """The degradation ladder's last rung: every cheaper serving mode is
+    already exhausted (glom_tpu/resilience/ladder.py)."""
 
 
 class Ticket:
@@ -132,6 +151,7 @@ class DynamicBatcher:
         queue_depth: Optional[int] = None,
         writer=None,
         shed_when_down: bool = True,
+        ladder=None,
         clock=time.perf_counter,
     ):
         scfg = getattr(engine, "scfg", None)
@@ -152,16 +172,42 @@ class DynamicBatcher:
             raise ValueError(f"max_batch {self.max_batch} must be >= 1")
         self.writer = writer
         self.shed_when_down = shed_when_down
+        # Degradation ladder (glom_tpu/resilience/ladder.py) — opt-in:
+        # when attached, the worker feeds it queue pressure + backend
+        # state each cycle, a capped_iters-or-worse rung dispatches with
+        # the degraded fixed budget, a bucket_cap-or-worse rung gathers
+        # smaller batches, and the shed rung fails NEW admissions fast
+        # (the last resort, after the cheaper modes). ladder=None
+        # RESOLVES from the engine's ServeConfig (scfg.ladder=True builds
+        # one — a config that asks for the ladder must never be silently
+        # two-mode); pass an explicit instance to own the knobs.
+        if (
+            ladder is None
+            and scfg is not None
+            and getattr(scfg, "ladder", False)
+            and getattr(engine, "cfg", None) is not None
+        ):
+            from glom_tpu.resilience.ladder import DegradationLadder
+
+            ladder = DegradationLadder.from_config(
+                engine.cfg, scfg, writer=writer
+            )
+        self.ladder = ladder
         self._clock = clock
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.spans = SpanAggregator()
-        # Counters for the end-of-run summary record.
+        # Counters for the end-of-run summary record. n_requests counts
+        # every submit() ATTEMPT (n_submitted only the admitted ones), so
+        # chaos runs can assert conservation: every request is served,
+        # shed, or failed — never lost, never hung.
+        self.n_requests = 0
         self.n_submitted = 0
         self.n_served = 0
         self.n_shed = 0
         self.n_failed = 0
+        self.n_degraded = 0  # requests served on a capped-iters rung
         self.dispatches: List[dict] = []  # one dict per dispatched batch
         self._counter_lock = threading.Lock()
         self._seq = 0
@@ -202,6 +248,12 @@ class DynamicBatcher:
                 req = self._q.get_nowait()
             except queue.Empty:
                 return
+            # Counted as FAILED: these tickets were admitted (n_submitted
+            # incremented) and can no longer resolve — without the count,
+            # summary_record()'s conservation (n_served + n_shed +
+            # n_failed == n_requests) silently loses them.
+            with self._counter_lock:
+                self.n_failed += 1
             req.ticket._fail(ShedError("batcher stopped"))
 
     def __enter__(self) -> "DynamicBatcher":
@@ -214,21 +266,36 @@ class DynamicBatcher:
 
     def submit(self, img) -> Ticket:
         """Enqueue one [c, H, W] request. Sheds immediately (raises) when
-        the queue is full or the backend is down — admission never blocks
-        the caller. Requests submitted before start() queue up and are
-        served once the worker runs; stop() fails whatever can no longer
-        resolve, so a ticket is never silently stranded."""
+        the queue is full, the backend is down, or the degradation ladder
+        is on its shed rung — admission never blocks the caller. Requests
+        submitted before start() queue up and are served once the worker
+        runs; stop() fails whatever can no longer resolve, so a ticket is
+        never silently stranded."""
         with self._counter_lock:
             self._seq += 1
             rid = self._seq
+            self.n_requests += 1
         ticket = Ticket(rid)
         with span("serve_enqueue", aggregator=self.spans):
             if self.shed_when_down and _backend_down():
-                self._shed(ticket, "backend-down")
+                detail = self._pressure()
+                self._shed(ticket, "backend-down", **detail)
                 raise BackendDownError(
                     "backend watchdog reports the accelerator down; "
-                    "request shed (fast-fail, never a hang)"
+                    "request shed (fast-fail, never a hang)",
+                    **detail,
                 )
+            if self.ladder is not None:
+                from glom_tpu.resilience.ladder import SHED
+
+                if self.ladder.rung() >= SHED:
+                    detail = self._pressure()
+                    self._shed(ticket, "ladder-shed", **detail)
+                    raise LadderShedError(
+                        "degradation ladder at its shed rung (every "
+                        "cheaper serving mode exhausted); retry later",
+                        **detail,
+                    )
             img = np.asarray(img, np.float32)
             # Count the admission BEFORE the put (rolled back on a full
             # queue): the instant the request is enqueued the worker may
@@ -242,10 +309,12 @@ class DynamicBatcher:
             except queue.Full:
                 with self._counter_lock:
                     self.n_submitted -= 1
-                self._shed(ticket, "queue-full")
+                detail = self._pressure()
+                self._shed(ticket, "queue-full", **detail)
                 raise QueueFullError(
                     f"request queue at capacity ({self._q.maxsize}); "
-                    "backpressure — retry later"
+                    "backpressure — retry later",
+                    **detail,
                 ) from None
             if self._stop.is_set() and (
                 self._thread is None or not self._thread.is_alive()
@@ -258,19 +327,40 @@ class DynamicBatcher:
                 raise ShedError("batcher stopped")
         return ticket
 
-    def _shed(self, ticket: Ticket, reason: str) -> None:
+    def _pressure(self) -> dict:
+        """The machine-readable WHY of a shed/ladder decision: queue depth
+        and capacity, plus the ladder rung when one is attached — these
+        fields ride both the stamped record and the raised exception
+        (before this, the shed path lost the why)."""
+        detail = {
+            "queue_depth": self._q.qsize(),
+            "queue_capacity": self._q.maxsize,
+        }
+        if self.ladder is not None:
+            detail["rung"] = self.ladder.rung_name()
+        return detail
+
+    def _shed(self, ticket: Ticket, reason: str, **detail) -> None:
         with self._counter_lock:
             self.n_shed += 1
-        exc = (
-            BackendDownError(reason)
-            if reason == "backend-down"
-            else QueueFullError(reason)
+        exc_type = {
+            "backend-down": BackendDownError,
+            "ladder-shed": LadderShedError,
+        }.get(reason, QueueFullError)
+        ticket._fail(exc_type(reason, **detail))
+        # The shed decision itself is a "serve" event carrying the why
+        # (queue depth / ladder rung; stamp_serve merges backend_state);
+        # a backend-down shed ALSO emits the schema "error" record (value
+        # null, machine-readable cause) — the same UNMEASURED discipline
+        # as the benches.
+        self._emit(
+            {
+                "event": "shed",
+                "reason": reason,
+                "request_id": ticket.request_id,
+                **detail,
+            }
         )
-        ticket._fail(exc)
-        # The shed decision itself is a "serve" event; a backend-down shed
-        # ALSO emits the schema-v3 "error" record (value null, machine-
-        # readable cause) — the same UNMEASURED discipline as the benches.
-        self._emit({"event": "shed", "reason": reason, "request_id": ticket.request_id})
         if reason == "backend-down":
             self._emit(
                 {
@@ -284,16 +374,38 @@ class DynamicBatcher:
 
     # -- the worker --------------------------------------------------------
 
+    def _ladder_observe(self) -> None:
+        """Feed the ladder one (pressure, backend) observation. Runs every
+        worker cycle — INCLUDING idle ones, so a drained queue steps the
+        ladder back up even when no traffic arrives to dispatch."""
+        if self.ladder is None:
+            return
+        from glom_tpu.telemetry.watchdog import backend_record
+
+        fill = self._q.qsize() / max(1, self._q.maxsize)
+        self.ladder.observe(
+            queue_fill=fill,
+            backend_state=backend_record().get("backend_state", "unknown"),
+        )
+
     def _gather(self) -> List[_Request]:
         """Block for the first request, then gather until max_batch or the
-        first request ages past max_delay — the two-knob admission."""
+        first request ages past max_delay — the two-knob admission. A
+        ladder at bucket_cap or worse gathers smaller batches: smaller,
+        faster dispatches drain a backed-up queue in bounded bites."""
+        max_batch = self.max_batch
+        if self.ladder is not None:
+            from glom_tpu.resilience.ladder import BUCKET_CAP
+
+            if self.ladder.rung() >= BUCKET_CAP:
+                max_batch = min(max_batch, self.ladder.bucket_cap)
         try:
             first = self._q.get(timeout=0.05)
         except queue.Empty:
             return []
         batch = [first]
         deadline = self._clock() + self.max_delay_s
-        while len(batch) < self.max_batch:
+        while len(batch) < max_batch:
             remaining = deadline - self._clock()
             if remaining <= 0:
                 break
@@ -305,6 +417,7 @@ class DynamicBatcher:
 
     def _worker(self) -> None:
         while not (self._stop.is_set() and self._q.empty()):
+            self._ladder_observe()
             with span("serve_batch", aggregator=self.spans):
                 batch = self._gather()
             if not batch:
@@ -318,15 +431,27 @@ class DynamicBatcher:
             # stamped evidence — never dispatch into a dead backend (the
             # round-5 hang this subsystem exists to never reproduce).
             for req in batch:
-                self._shed(req.ticket, "backend-down")
+                self._shed(req.ticket, "backend-down", **self._pressure())
             return
+        iters_override = None
+        rung_name = None
+        if self.ladder is not None:
+            from glom_tpu.resilience.ladder import CAPPED_ITERS, RUNGS
+
+            rung = self.ladder.rung()
+            rung_name = RUNGS[rung]
+            if rung >= CAPPED_ITERS:
+                iters_override = self.ladder.degraded_iters
         try:
             bucket = self.engine.pick_bucket(n)
             imgs = np.zeros((bucket, *batch[0].img.shape), np.float32)
             for i, req in enumerate(batch):
                 imgs[i] = req.img
+            kw = {} if iters_override is None else {
+                "iters_override": iters_override
+            }
             with span("serve_dispatch", aggregator=self.spans):
-                result = self.engine.infer(imgs, n_valid=n)
+                result = self.engine.infer(imgs, n_valid=n, **kw)
             with span("serve_fetch", aggregator=self.spans):
                 levels = np.asarray(result.levels[:n])
         except BaseException as e:  # noqa: BLE001 — relayed per ticket
@@ -353,14 +478,21 @@ class DynamicBatcher:
             "iters_run": result.iters_run,
             "compiled": result.compiled,
         }
+        if rung_name is not None:
+            rec["rung"] = rung_name
+        if iters_override is not None:
+            rec["iters_override"] = iters_override
         # The dispatch log is read by summary_record() from the CALLER's
         # thread while this worker appends — glom-lint's lockset checker
         # flagged the bare append (iteration during append is a crash, not
         # just a stale read), so the batch log rides the counter lock.
         with self._counter_lock:
             self.n_served += n
+            if iters_override is not None:
+                self.n_degraded += n
             self.dispatches.append(rec)
         self._emit(rec)
+        self._ladder_observe()
 
     # -- telemetry ---------------------------------------------------------
 
@@ -383,26 +515,33 @@ class DynamicBatcher:
         consistent (n_served vs the dispatch log it was derived from)."""
         with self._counter_lock:
             dispatches = list(self.dispatches)
+            n_requests = self.n_requests
             n_submitted = self.n_submitted
             n_served = self.n_served
             n_shed = self.n_shed
             n_failed = self.n_failed
+            n_degraded = self.n_degraded
         hist: dict = {}
         for d in dispatches:
             key = str(d["iters_run"])
             hist[key] = hist.get(key, 0) + d["n_valid"]
-        return schema.stamp(
-            {
-                "event": "summary",
-                "n_submitted": n_submitted,
-                "n_served": n_served,
-                "n_shed": n_shed,
-                "n_failed": n_failed,
-                "n_dispatches": len(dispatches),
-                "mean_batch": round(
-                    n_served / len(dispatches), 3
-                ) if dispatches else 0.0,
-                "iters_histogram": hist,
-            },
-            kind="serve",
-        )
+        rec = {
+            "event": "summary",
+            "n_requests": n_requests,
+            "n_submitted": n_submitted,
+            "n_served": n_served,
+            "n_shed": n_shed,
+            "n_failed": n_failed,
+            "n_degraded": n_degraded,
+            "n_dispatches": len(dispatches),
+            "mean_batch": round(
+                n_served / len(dispatches), 3
+            ) if dispatches else 0.0,
+            "iters_histogram": hist,
+        }
+        if self.ladder is not None:
+            rec.update(self.ladder.record())
+        retry = getattr(self.engine, "retry", None)
+        if retry is not None:
+            rec.update(retry.record())
+        return schema.stamp(rec, kind="serve")

@@ -57,6 +57,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="skip the AOT warmup (buckets then compile on first miss — "
         "the latency cliff warmup exists to remove; for A/B only)",
     )
+    p.add_argument(
+        "--ladder", action="store_true",
+        help="enable the degradation ladder (glom_tpu/resilience/ladder): "
+        "under queue pressure or a flapping backend, step down capped-iters "
+        "-> capped-buckets -> shed instead of shedding outright "
+        "(docs/RESILIENCE.md)",
+    )
+    p.add_argument(
+        "--dispatch-retries", type=int, default=None, metavar="N",
+        help="transient dispatch failures retry up to N times with backoff "
+        "(watchdog-aware: a DOWN backend never retries; default: preset's)",
+    )
     p.add_argument("--out", default=None, help="JSONL metrics path")
     p.add_argument(
         "--flight-recorder", default=None, metavar="DIR",
@@ -121,6 +133,10 @@ def main(argv=None) -> int:
         overrides["buckets"] = tuple(
             int(b) for b in args.buckets.split(",") if b
         )
+    if args.ladder:
+        overrides["ladder"] = True
+    if args.dispatch_retries is not None:
+        overrides["dispatch_retries"] = args.dispatch_retries
     if overrides:
         scfg = dataclasses.replace(scfg, **overrides)
 
@@ -138,15 +154,25 @@ def main(argv=None) -> int:
 
     try:
         engine = InferenceEngine(cfg, scfg, writer=writer)
+        ladder = None
+        if scfg.ladder:
+            from glom_tpu.resilience.ladder import DegradationLadder
+
+            ladder = DegradationLadder.from_config(cfg, scfg, writer=writer)
         if not args.no_warmup:
             engine.warmup()
+            if ladder is not None:
+                # Pre-warm the capped-iters route too: the first degraded
+                # dispatch must not pay a mid-traffic compile on top of
+                # the pressure that degraded it.
+                engine.warmup(iters_override=ladder.degraded_iters)
 
         rng_img = lambda seed: np.random.default_rng(seed).normal(
             size=(cfg.channels, cfg.image_size, cfg.image_size)
         ).astype(np.float32)
 
         served = failed = 0
-        with DynamicBatcher(engine, writer=writer) as batcher:
+        with DynamicBatcher(engine, writer=writer, ladder=ladder) as batcher:
             tickets = []
             for rid, seed in _req_source(args):
                 try:

@@ -79,6 +79,8 @@ class InferenceEngine:
         params: Optional[GlomParams] = None,
         key: Optional[jax.Array] = None,
         writer=None,
+        retry=None,
+        fault_hook=None,
     ):
         self.cfg = cfg
         self.scfg = scfg = scfg if scfg is not None else ServeConfig()
@@ -93,6 +95,25 @@ class InferenceEngine:
         )
         self._compiled: Dict[Tuple, object] = {}
         self._stats: Dict[Tuple, StepTimeStats] = {}
+        # Transient-dispatch retry (glom_tpu/resilience/retry.py): None
+        # resolves from the config (scfg.dispatch_retries; 0 disables).
+        # The policy is watchdog-aware — a FLAPPING backend retries (the
+        # gap closes), a DOWN backend fails fast into the shed path.
+        if retry is None and scfg.dispatch_retries > 0:
+            from glom_tpu.resilience.retry import RetryPolicy
+
+            retry = RetryPolicy(
+                retries=scfg.dispatch_retries,
+                backoff_s=scfg.retry_backoff_ms / 1e3,
+                writer=writer,
+                site="engine-dispatch",
+            )
+        self.retry = retry
+        # Chaos seam (glom_tpu/resilience/faults.dispatch_fault): called
+        # once per dispatch ATTEMPT with {bucket, n_valid, attempt}; a
+        # raise here is exactly a transient backend failure as far as the
+        # retry policy and the batcher are concerned. None in production.
+        self._fault_hook = fault_hook
 
     # -- signatures --------------------------------------------------------
 
@@ -122,21 +143,27 @@ class InferenceEngine:
             f"n={n} exceeds the largest bucket {max(self.scfg.buckets)}"
         )
 
-    def signature(self, bucket: int) -> Tuple:
-        return (bucket, self.iters_key, self.scfg.use_pallas)
+    def signature(self, bucket: int, iters_override: Optional[int] = None) -> Tuple:
+        route = iters_override if iters_override is not None else self.iters_key
+        return (bucket, route, self.scfg.use_pallas)
 
     # -- compilation -------------------------------------------------------
 
-    def _build_fn(self, bucket: int):
+    def _build_fn(self, bucket: int, iters_override: Optional[int] = None):
         """The pure forward for one bucket: (params, img [bucket,c,H,W],
         mask [bucket]) -> (levels [bucket,n,L,d], iters_run int32). The
         mask only matters on the auto route (pad rows must not vote on the
         early-exit witness); the fixed route carries it for a uniform
-        calling convention."""
+        calling convention.
+
+        iters_override (the degradation ladder's capped_iters rung) pins
+        a FIXED budget regardless of the configured route — a degraded
+        dispatch costs a bounded, smaller iteration count, compiled and
+        memoized as its own signature like any bucket."""
         cfg, scfg = self.cfg, self.scfg
         compute_dtype = self._compute_dtype
 
-        if self.iters_key == "auto":
+        if iters_override is None and self.iters_key == "auto":
             max_iters = (
                 scfg.max_auto_iters
                 if scfg.max_auto_iters is not None
@@ -156,7 +183,9 @@ class InferenceEngine:
                 return final, iters_run
 
         else:
-            iters = self.iters_key
+            iters = (
+                iters_override if iters_override is not None else self.iters_key
+            )
 
             def fn(params, img, mask):
                 del mask  # pad rows are harmless on the fixed route
@@ -169,10 +198,10 @@ class InferenceEngine:
 
         return fn
 
-    def _compile(self, bucket: int):
+    def _compile(self, bucket: int, iters_override: Optional[int] = None):
         """AOT-compile one bucket signature from abstract shapes and emit
         the "serve" warmup event (compile seconds attributed per bucket)."""
-        sig = self.signature(bucket)
+        sig = self.signature(bucket, iters_override)
         if sig in self._compiled:
             return self._compiled[sig]
         cfg = self.cfg
@@ -186,7 +215,7 @@ class InferenceEngine:
         donate = (1,) if self._donate else ()
         t0 = time.perf_counter()
         compiled = (
-            jax.jit(self._build_fn(bucket), donate_argnums=donate)
+            jax.jit(self._build_fn(bucket, iters_override), donate_argnums=donate)
             .lower(params_abs, img_abs, mask_abs)
             .compile()
         )
@@ -197,42 +226,62 @@ class InferenceEngine:
             {
                 "event": "warmup",
                 "bucket": bucket,
-                "iters": self.iters_key,
+                "iters": sig[1],
+                "degraded": iters_override is not None,
                 "use_pallas": self.scfg.use_pallas,
                 "compile_time_s": round(dt, 4),
             }
         )
         return compiled
 
-    def warmup(self, buckets: Optional[Tuple[int, ...]] = None) -> dict:
+    def warmup(
+        self,
+        buckets: Optional[Tuple[int, ...]] = None,
+        *,
+        iters_override: Optional[int] = None,
+    ) -> dict:
         """Precompile every bucket signature BEFORE traffic. Returns
-        {bucket: compile_seconds}; already-compiled signatures are free."""
+        {bucket: compile_seconds}; already-compiled signatures are free.
+        Call a second time with iters_override=<degraded budget> to also
+        pre-warm the ladder's capped_iters route (otherwise the first
+        degraded dispatch pays an attributable mid-traffic compile)."""
         out = {}
         for b in buckets if buckets is not None else self.scfg.buckets:
-            sig = self.signature(b)
+            sig = self.signature(b, iters_override)
             already = sig in self._compiled
             t0 = time.perf_counter()
-            self._compile(b)
+            self._compile(b, iters_override)
             out[b] = 0.0 if already else time.perf_counter() - t0
         return out
 
     # -- dispatch ----------------------------------------------------------
 
-    def infer(self, imgs, n_valid: Optional[int] = None) -> ServeResult:
+    def infer(
+        self,
+        imgs,
+        n_valid: Optional[int] = None,
+        *,
+        iters_override: Optional[int] = None,
+    ) -> ServeResult:
         """Run one padded batch. `imgs` is [b, c, H, W] (numpy or jax) with
         b equal to a bucket size — callers that batch themselves pass an
         exact bucket; the DynamicBatcher always does. `n_valid` marks how
-        many leading rows are real requests (default: all)."""
-        if self._donate and isinstance(imgs, jax.Array):
-            # The compiled call donates the input buffer; a caller-held
-            # jax array passed through jnp.asarray uncopied would be
-            # INVALIDATED by the dispatch (numpy inputs are copied by the
-            # transfer anyway — the batcher's fresh pad buffer never is a
-            # jax array, so the copy only guards direct device callers).
-            imgs = jnp.array(imgs, jnp.float32, copy=True)
-        else:
-            imgs = jnp.asarray(imgs, jnp.float32)
-        b = imgs.shape[0]
+        many leading rows are real requests (default: all).
+
+        iters_override pins a fixed iteration budget for THIS dispatch
+        (the degradation ladder's capped_iters rung); None runs the
+        configured route. Transient dispatch failures retry per the
+        engine's RetryPolicy — a failed attempt against an up-or-flapping
+        backend backs off and re-dispatches from a FRESH input buffer
+        (donation invalidates the old one), while a down backend raises
+        straight into the batcher's shed path."""
+        if iters_override is not None and (
+            not isinstance(iters_override, int) or iters_override < 1
+        ):
+            raise ValueError(
+                f"iters_override={iters_override!r}: an int >= 1 or None"
+            )
+        b = np.shape(imgs)[0]
         if b not in self.scfg.buckets:
             raise ValueError(
                 f"batch {b} is not a bucket shape {self.scfg.buckets}; pad "
@@ -241,17 +290,49 @@ class InferenceEngine:
         n_valid = b if n_valid is None else n_valid
         if not 1 <= n_valid <= b:
             raise ValueError(f"n_valid={n_valid} outside 1..{b}")
+        if self._donate:
+            # Every ATTEMPT needs a fresh device buffer: the compiled call
+            # donates its input, so a retry after a failed dispatch must
+            # never reuse a possibly-invalidated array. Hold the source on
+            # the host (numpy transfers copy; a caller-held jax array is
+            # deep-copied per attempt).
+            src = imgs if isinstance(imgs, jax.Array) else np.asarray(
+                imgs, np.float32
+            )
+            if isinstance(src, jax.Array):
+                make_input = lambda: jnp.array(src, jnp.float32, copy=True)
+            else:
+                make_input = lambda: jnp.asarray(src, jnp.float32)
+        else:
+            dev = jnp.asarray(imgs, jnp.float32)
+            make_input = lambda: dev
         mask = jnp.arange(b) < n_valid
-        sig = self.signature(b)
+        sig = self.signature(b, iters_override)
         compiled_before = sig in self._compiled
-        fn = self._compile(b)
+        fn = self._compile(b, iters_override)
         stats = self._stats.setdefault(sig, StepTimeStats())
+        attempts = [0]
+
+        def attempt():
+            attempts[0] += 1
+            if self._fault_hook is not None:
+                self._fault_hook(
+                    {"bucket": b, "n_valid": n_valid, "attempt": attempts[0]}
+                )
+            levels, iters_run = fn(self.params, make_input(), mask)
+            iters_host = int(jax.device_get(iters_run))  # syncs: serving
+            # is request/response — the caller needs the answer now, and
+            # the fetch IS the latency being measured.
+            levels.block_until_ready()
+            return levels, iters_host
+
         t0 = time.perf_counter()
-        levels, iters_run = fn(self.params, imgs, mask)
-        iters_host = int(jax.device_get(iters_run))  # syncs: serving is
-        # request/response — the caller needs the answer now, and the fetch
-        # IS the latency being measured.
-        levels.block_until_ready()
+        if self.retry is not None:
+            levels, iters_host = self.retry.run(
+                attempt, bucket=b, n_valid=n_valid
+            )
+        else:
+            levels, iters_host = attempt()
         dt = time.perf_counter() - t0
         stats.observe(dt, is_compile=False)
         return ServeResult(

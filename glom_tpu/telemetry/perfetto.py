@@ -13,9 +13,11 @@ it on a timeline, so the conversion is mechanical:
     count) become counter samples (ph "C") of seconds-per-drain per phase —
     the per-phase load curve over the run;
   * watchdog records become instant events (ph "i") named by state — an
-    outage is a visible gash in the timeline;
-  * everything else (train_step, bench, anomaly, error, note, serve)
-    becomes an instant event named by kind, args = the record.
+    outage is a visible gash in the timeline; "fault" records (injected
+    failures, glom_tpu/resilience) draw the same full-height line, so a
+    chaos run shows each injection next to the recovery that answered it;
+  * everything else (train_step, bench, anomaly, error, note, serve,
+    recovery) becomes an instant event named by kind, args = the record.
 
 Timestamps: records carry heterogeneous clocks (epoch `t_start` /
 `wall_time_s`, run-relative `wall_time` / `t`). Each record uses its best
@@ -102,6 +104,21 @@ def to_trace_events(records: Iterable[dict]) -> List[dict]:
                     "args": rec,
                 }
             )
+        elif kind == "fault":
+            # An injected fault is a full-height line like a watchdog
+            # transition: a chaos run's timeline shows each injection as a
+            # gash the recovery events then answer.
+            raw.append(
+                {
+                    "name": f"fault:{rec.get('fault', '?')}",
+                    "ph": "i",
+                    "s": "g",
+                    "pid": _PID,
+                    "tid": _TID_EVENTS,
+                    "ts": ts,
+                    "args": rec,
+                }
+            )
         else:
             label = {
                 "train_step": f"step {rec.get('step', '?')}",
@@ -109,6 +126,7 @@ def to_trace_events(records: Iterable[dict]) -> List[dict]:
                 "anomaly": f"anomaly: {rec.get('reason', '?')}",
                 "error": f"error: {rec.get('error', '?')}",
                 "serve": f"serve:{rec.get('event', '?')}",
+                "recovery": f"recovery:{rec.get('action', '?')}",
             }.get(kind, kind)
             raw.append(
                 {

@@ -27,7 +27,12 @@ from typing import Iterable, List, Optional, Tuple
 # readable error string, so trajectory tooling never ingests dead zeros).
 # v3 added the "serve" kind (glom_tpu/serve: inference-engine lifecycle —
 # warmup compiles, batch dispatches, request responses, shed decisions).
-SCHEMA_VERSION = 3
+# v4 added the "fault" kind (glom_tpu/resilience/faults.py: one INJECTED
+# failure — the chaos harness's ground truth, so recovery can be verified
+# against exactly what was injected) and the "recovery" kind (one recovery
+# decision or action: checkpoint resume, dispatch retry, torn-checkpoint
+# skip, preemption save — docs/RESILIENCE.md).
+SCHEMA_VERSION = 4
 
 _NUM = (int, float)
 _STR = (str,)
@@ -57,9 +62,21 @@ KINDS = {
     # One inference-serving lifecycle event (glom_tpu/serve): `event` names
     # it — "warmup" (one AOT compile per bucket), "dispatch" (one batched
     # forward), "response" (one request served), "shed" (admission
-    # rejected), "summary" (end-of-run rollup). Extra fields (bucket,
-    # n_valid, latency_ms, iters_run, ...) ride per event.
+    # rejected), "ladder" (one degradation-ladder rung transition),
+    # "summary" (end-of-run rollup). Extra fields (bucket, n_valid,
+    # latency_ms, iters_run, rung, queue_fill, ...) ride per event.
     "serve": {"event": _STR},
+    # One INJECTED failure (glom_tpu/resilience/faults.py): `fault` names
+    # the fault class ("backend-flap", "dispatch-error", "nan-storm",
+    # "ckpt-write", "queue-stall", ...); `site` and `index` pin where and
+    # which occurrence, so a chaos run's recovery events can be reconciled
+    # one-to-one against what the harness actually injected.
+    "fault": {"fault": _STR},
+    # One recovery decision or action (docs/RESILIENCE.md): `action` names
+    # it — "resume-from-checkpoint", "restart", "dispatch-retry",
+    # "skip-torn-checkpoint", "preemption-checkpoint", "give-up". Extra
+    # fields (step, attempt, backoff_s, ...) ride per action.
+    "recovery": {"action": _STR},
 }
 
 WATCHDOG_STATES = ("unknown", "up", "down", "flapping")
@@ -71,6 +88,8 @@ class SchemaError(ValueError):
 
 def infer_kind(rec: dict) -> str:
     """Best-effort kind for legacy records written before stamping."""
+    if "fault" in rec:
+        return "fault"
     if "backend_state" in rec and ("t" in rec or "event" in rec):
         return "watchdog"
     if "name" in rec and "dur_s" in rec:

@@ -94,12 +94,35 @@ class BackendWatchdog:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._probes = 0
+        self._probe_fault: Optional[Callable[[Optional[int]], Optional[int]]] = None
+
+    # -- fault-injection seam ---------------------------------------------
+
+    def set_probe_fault(
+        self, fault: Optional[Callable[[Optional[int]], Optional[int]]]
+    ) -> None:
+        """Chaos seam (glom_tpu/resilience/faults.py): `fault` receives the
+        REAL probe's result and returns the possibly-corrupted one (None =
+        backend looks down). The state machine, transition stamping, and
+        every downstream consumer see only the faulted value — exactly the
+        view a genuinely flapping backend would present — while the
+        injector stamps its own schema "fault" event per injection, so a
+        chaos run can reconcile observed transitions against injected
+        flaps. Pass None to remove."""
+        with self._lock:
+            self._probe_fault = fault
 
     # -- state machine ----------------------------------------------------
 
     def probe_once(self) -> str:
         """Run one probe, update the state machine, stamp any transition."""
         n = self._probe(self.probe_timeout)
+        with self._lock:
+            fault = self._probe_fault
+        if fault is not None:
+            # Outside the lock: the injector stamps "fault" events, and a
+            # writer that re-enters record() must not deadlock.
+            n = fault(n)
         with self._lock:
             self._probes += 1
             self._devices = n

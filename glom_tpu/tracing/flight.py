@@ -66,6 +66,11 @@ class FlightRecorder:
         self._last_dump_seq = 0
         self._anomaly_times: deque = deque()
         self.dumps: List[str] = []  # paths written, oldest first
+        # Preemption checkpoint hook (set_checkpoint_hook): run a bounded
+        # save before the SIGTERM dump so a preempted worker leaves a
+        # RESUMABLE step, not just a postmortem.
+        self._checkpoint_fn: Optional[Callable[[], Optional[int]]] = None
+        self._checkpoint_deadline_s: float = 30.0
 
     # -- feed --------------------------------------------------------------
 
@@ -137,11 +142,79 @@ class FlightRecorder:
 
     # -- process hooks -----------------------------------------------------
 
+    def set_checkpoint_hook(
+        self,
+        checkpoint_fn: Optional[Callable[[], Optional[int]]],
+        *,
+        deadline_s: float = 30.0,
+    ) -> None:
+        """Grow the SIGTERM (preemption) hook a checkpoint step: before the
+        flight dump, `checkpoint_fn` — typically `lambda: save-and-wait the
+        current TrainState, returning the step` — runs in a daemon thread
+        bounded by `deadline_s` (TPU preemption notices give a fixed grace
+        window; a save that can't land inside it must not stall the dump or
+        the exit). The outcome is stamped as a schema "recovery" event
+        (action "preemption-checkpoint", ok/step/elapsed_s) into the ring
+        ahead of the dump, so the postmortem records whether a resumable
+        step was left behind. Installed separately from
+        install_process_hooks because the trainer/manager usually exist
+        only after the hooks do (train/cli.py installs hooks first thing).
+        Pass None to remove."""
+        with self._lock:
+            self._checkpoint_fn = checkpoint_fn
+            self._checkpoint_deadline_s = deadline_s
+
+    def _preemption_checkpoint(self) -> None:
+        """Run the bounded checkpoint hook; never raises (the SIGTERM
+        handler must always reach the dump and the chained handler)."""
+        with self._lock:
+            fn = self._checkpoint_fn
+            deadline = self._checkpoint_deadline_s
+        if fn is None:
+            return
+        try:
+            from glom_tpu.telemetry import schema
+
+            result: List = [None, None]  # [step, exception]
+
+            def run():
+                try:
+                    result[0] = fn()
+                except BaseException as e:  # noqa: BLE001 — relayed on the record
+                    result[1] = e
+
+            t0 = time.monotonic()
+            worker = threading.Thread(
+                target=run, name="glom-preempt-ckpt", daemon=True
+            )
+            worker.start()
+            worker.join(timeout=deadline)
+            elapsed = time.monotonic() - t0
+            ok = not worker.is_alive() and result[1] is None
+            rec = {
+                "action": "preemption-checkpoint",
+                "ok": ok,
+                "deadline_s": deadline,
+                "elapsed_s": round(elapsed, 3),
+                "wall_time_s": round(time.time(), 3),
+            }
+            if result[0] is not None:
+                rec["step"] = result[0]
+            if worker.is_alive():
+                rec["note"] = "save overran the deadline; dumping anyway"
+            elif result[1] is not None:
+                rec["note"] = f"{type(result[1]).__name__}: {result[1]}"[:300]
+            self.observe(schema.stamp(rec, kind="recovery"))
+        except Exception:
+            pass
+
     def install_process_hooks(self, *, sigterm: bool = True, on_exit: bool = True):
         """Dump on SIGTERM (the pod-preemption path) and at interpreter
         exit. SIGTERM chains any previously installed handler; installing
         from a non-main thread (where signal.signal raises) skips the
-        signal hook silently. Returns self."""
+        signal hook silently. When a checkpoint hook is set
+        (set_checkpoint_hook), SIGTERM first runs the bounded preemption
+        save so the dump records a resumable step. Returns self."""
         if on_exit:
             import atexit
 
@@ -153,6 +226,7 @@ class FlightRecorder:
                 prev = signal.getsignal(signal.SIGTERM)
 
                 def _handler(signum, frame):
+                    self._preemption_checkpoint()
                     self.dump("sigterm")
                     if callable(prev):
                         prev(signum, frame)

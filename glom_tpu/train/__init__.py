@@ -5,6 +5,7 @@ from glom_tpu.train.objectives import (
     init_denoise,
     reconstruct,
 )
+from glom_tpu.train.supervise import TrainSupervisor, fit_supervised
 from glom_tpu.train.temporal import temporal_rollout
 from glom_tpu.train.trainer import (
     Trainer,
@@ -21,6 +22,8 @@ __all__ = [
     "denoise_loss",
     "init_denoise",
     "reconstruct",
+    "TrainSupervisor",
+    "fit_supervised",
     "temporal_rollout",
     "Trainer",
     "TrainState",

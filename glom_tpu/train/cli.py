@@ -95,6 +95,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-every", type=int, default=100)
     p.add_argument("--resume", action="store_true", help="resume from latest ckpt")
     p.add_argument(
+        "--supervise", type=_nonneg_int, default=None, metavar="RESTARTS",
+        help="run under the fit_supervised restart loop (docs/RESILIENCE.md): "
+        "on an unhandled training exception, restore the latest VALID "
+        "checkpoint and retry with bounded exponential backoff, up to "
+        "RESTARTS restarts; every decision is a stamped 'recovery' event. "
+        "Requires --checkpoint-dir; implies --resume semantics.",
+    )
+    p.add_argument(
+        "--preempt-deadline", type=float, default=30.0, metavar="SECONDS",
+        help="SIGTERM (preemption) grace budget: with --flight-recorder and "
+        "--checkpoint-dir, the SIGTERM hook saves a checkpoint bounded by "
+        "this deadline before dumping the flight ring (docs/RESILIENCE.md)",
+    )
+    p.add_argument(
         "--profile-dir", default=None,
         help="capture an XProf trace of the WHOLE run (for step-windowed "
         "capture use --trace-steps)",
@@ -253,6 +267,50 @@ def _train_body(args, preset, cfg, tcfg, writer) -> int:
             )
     else:
         make_data = shapes_dataset if args.data == "shapes" else gaussian_dataset
+
+    if args.supervise is not None:
+        # The restart loop owns trainer/data/checkpoint lifecycle per
+        # attempt (factories: a crashed attempt's state never leaks).
+        from glom_tpu.train.supervise import TrainSupervisor, fit_supervised
+
+        if not args.checkpoint_dir:
+            raise SystemExit("--supervise requires --checkpoint-dir (the "
+                             "restart loop resumes from checkpoints)")
+        if args.check_parity or args.profile_dir or args.trace_steps:
+            raise SystemExit(
+                "--supervise does not compose with --check-parity/"
+                "--profile-dir/--trace-steps (one concern per run)"
+            )
+        if args.prefetch > 0:
+            print(
+                "note: --prefetch is ignored under --supervise (the data "
+                "stream is rebuilt per attempt)", file=sys.stderr,
+            )
+
+        def make_trainer():
+            if args.distributed:
+                from glom_tpu.parallel import DistributedTrainer
+
+                scaled = preset.scaled_to(len(jax.devices()))
+                return DistributedTrainer(
+                    cfg, tcfg, scaled.mesh,
+                    sp_strategy=scaled.sp_strategy, metrics_writer=writer,
+                )
+            return Trainer(cfg, tcfg, metrics_writer=writer)
+
+        fit_supervised(
+            make_trainer,
+            lambda: make_data(tcfg.batch_size, cfg.image_size, seed=tcfg.seed),
+            args.steps,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every,
+            log_every=args.log_every,
+            supervisor=TrainSupervisor(max_restarts=args.supervise, writer=writer),
+            metrics_writer=writer,
+            preemption_deadline_s=args.preempt_deadline,
+        )
+        return 0
+
     data = make_data(tcfg.batch_size, cfg.image_size, seed=tcfg.seed)
 
     if args.check_parity:
@@ -303,7 +361,35 @@ def _train_body(args, preset, cfg, tcfg, writer) -> int:
             start_step, trainer.state = ckpt.restore(
                 abstract_state=abstract_like(trainer.state)
             )
+            # The resume IS a recovery action — stamped into the same
+            # stream as everything else, so a kill-and-resume run's
+            # evidence trail reconciles without parsing stderr.
+            from glom_tpu.telemetry import schema
+
+            writer.write(
+                schema.stamp(
+                    {"action": "resume-from-checkpoint", "step": int(start_step)},
+                    kind="recovery",
+                )
+            )
             print(f"resumed from step {start_step}", file=sys.stderr)
+        from glom_tpu.tracing.flight import get_global_flight_recorder
+
+        fr_live = get_global_flight_recorder()
+        if fr_live is not None:
+            # Preemption grace path: SIGTERM saves the live state bounded
+            # by --preempt-deadline, then dumps the flight ring.
+            def _preempt_save(trainer=trainer):
+                from glom_tpu.utils.checkpoint import preemption_save
+
+                return preemption_save(
+                    args.checkpoint_dir, trainer.state,
+                    int(trainer.state.step), metrics_writer=writer,
+                )
+
+            fr_live.set_checkpoint_hook(
+                _preempt_save, deadline_s=args.preempt_deadline
+            )
 
     if args.prefetch > 0:
         # Wrap ONCE, outside the checkpoint-span loop: a per-span wrap over

@@ -1,0 +1,280 @@
+"""fit_supervised: the restart loop that stands between a fault and a
+dead training run.
+
+TPU recovery is checkpoint-based restart (tests/test_resilience.py): the
+slice is fixed-shape, so "recovery" means restore the latest VALID step
+and continue. Until now a human was the restart loop. fit_supervised
+closes it in-process:
+
+    attempt:
+        fresh trainer  (a crashed attempt's state never leaks forward)
+        restore latest VALID checkpoint   -> stamped "recovery" event
+        realign the data stream to the restored step
+        fit in checkpoint spans, saving each span
+    on failure:
+        bounded exponential backoff       -> stamped "recovery" event
+        next attempt (budget: max_restarts)
+    budget exhausted:
+        stamped "give-up" + the original exception re-raised
+
+Cross-PROCESS faults (SIGKILL — nothing in-process survives those)
+compose with this same loop: the replacement process calls
+fit_supervised over the same checkpoint dir and attempt 1 resumes where
+the dead process committed (glom_tpu/resilience/chaos.py drives exactly
+that end-to-end). The in-process loop covers the faults a process DOES
+survive: NaN storms that escalate to a raise, transient backend/dispatch
+exceptions, poisoned batches, checkpoint-write failures.
+
+The trainer protocol is deliberately thin — `.state` (settable), `.fit
+(data, num_steps, log_every=...)`, optional `.state_shardings` for
+sharded restore — so both Trainer and DistributedTrainer (and the test
+harness's host-only fakes) supervise identically.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Iterator, List, Optional
+
+import jax
+import numpy as np
+
+
+def _emit_recovery(writer, rec: dict) -> dict:
+    from glom_tpu.resilience.faults import emit_recovery
+
+    return emit_recovery(writer, rec)
+
+
+class TrainSupervisor:
+    """Restart budget + backoff state, stamped.
+
+    Separated from fit_supervised so chaos tests can drive the policy
+    directly and monitoring threads can read status() while the loop
+    runs — the counters ride one lock (the lockset contract,
+    docs/ANALYSIS.md)."""
+
+    def __init__(
+        self,
+        *,
+        max_restarts: int = 3,
+        backoff_s: float = 0.5,
+        backoff_factor: float = 2.0,
+        backoff_max_s: float = 30.0,
+        writer=None,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        from glom_tpu.resilience.retry import validate_backoff
+
+        if max_restarts < 0:
+            raise ValueError(f"max_restarts {max_restarts} must be >= 0")
+        validate_backoff(backoff_s, backoff_factor, backoff_max_s)
+        self.max_restarts = max_restarts
+        self.backoff_s = backoff_s
+        self.backoff_factor = backoff_factor
+        self.backoff_max_s = backoff_max_s
+        self.writer = writer
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._attempts = 0
+        self._restarts = 0
+        self._gave_up = False
+        self._last_error: Optional[str] = None
+
+    def begin_attempt(self) -> int:
+        with self._lock:
+            self._attempts += 1
+            return self._attempts
+
+    def on_failure(self, exc: BaseException) -> Optional[float]:
+        """One failed attempt: returns the backoff slept before the next
+        attempt, or None when the budget is exhausted (the caller
+        re-raises). Stamps the "recovery" event either way."""
+        err = f"{type(exc).__name__}: {exc}"[:300]
+        with self._lock:
+            self._last_error = err
+            attempt = self._attempts
+            if self._restarts >= self.max_restarts:
+                self._gave_up = True
+                budget_left = False
+            else:
+                from glom_tpu.resilience.retry import next_backoff
+
+                self._restarts += 1
+                budget_left = True
+                backoff = next_backoff(
+                    self.backoff_s, self.backoff_factor,
+                    self.backoff_max_s, self._restarts - 1,
+                )
+        if not budget_left:
+            _emit_recovery(
+                self.writer,
+                {
+                    "action": "give-up",
+                    "attempt": attempt,
+                    "max_restarts": self.max_restarts,
+                    "exception": err,
+                },
+            )
+            return None
+        _emit_recovery(
+            self.writer,
+            {
+                "action": "restart",
+                "attempt": attempt,
+                "restarts": self._restarts_snapshot(),
+                "max_restarts": self.max_restarts,
+                "backoff_s": round(backoff, 4),
+                "exception": err,
+            },
+        )
+        if backoff > 0:
+            self._sleep(backoff)
+        return backoff
+
+    def _restarts_snapshot(self) -> int:
+        with self._lock:
+            return self._restarts
+
+    def record(self) -> dict:
+        """Status snapshot (stampable; readable from monitor threads)."""
+        with self._lock:
+            return {
+                "attempts": self._attempts,
+                "restarts": self._restarts,
+                "max_restarts": self.max_restarts,
+                "gave_up": self._gave_up,
+                "last_error": self._last_error,
+            }
+
+
+def _abstract_state(trainer):
+    """Restore target for the trainer's state: ShapeDtypeStructs carrying
+    the trainer's NamedShardings when it exposes them (DistributedTrainer
+    does — restored arrays land sharded, no host bounce)."""
+    shardings = getattr(trainer, "state_shardings", None)
+    if shardings is None:
+        return jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype),
+            trainer.state,
+        )
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.ShapeDtypeStruct(np.shape(x), x.dtype, sharding=s),
+        trainer.state,
+        shardings,
+    )
+
+
+def fit_supervised(
+    make_trainer: Callable[[], object],
+    make_data: Callable[[], Iterator],
+    num_steps: int,
+    *,
+    checkpoint_dir: str,
+    checkpoint_every: int = 100,
+    log_every: int = 10,
+    supervisor: Optional[TrainSupervisor] = None,
+    metrics_writer=None,
+    checkpoint_async: bool = False,
+    preemption_deadline_s: float = 30.0,
+) -> List[dict]:
+    """Run `num_steps` updates under the restart supervisor; returns the
+    concatenated fit history across attempts.
+
+    make_trainer/make_data are FACTORIES, called fresh per attempt: a
+    crashed trainer's params/optimizer state must never leak into the
+    next attempt (the checkpoint is the one source of resumed state), and
+    the data stream must be deterministic from the start so the resumed
+    attempt can realign by skipping `resumed_step` batches — the same
+    contract tests/test_resilience.py's kill-a-worker harness pins.
+
+    Checkpoints land every `checkpoint_every` steps through the
+    manifest-verified CheckpointManager (utils/checkpoint.py): a torn
+    final step restores from the previous valid one, stamped. While an
+    attempt runs, the global flight recorder's SIGTERM hook (when one is
+    installed) carries a bounded preemption checkpoint of the live
+    trainer state (tracing/flight.py set_checkpoint_hook).
+
+    checkpoint_async=False by default: the supervised loop's reason to
+    exist is surviving kills, and a synchronous save is committed the
+    moment the span ends — the async overlap win belongs to unsupervised
+    throughput runs."""
+    from glom_tpu.tracing.flight import get_global_flight_recorder
+    from glom_tpu.utils.checkpoint import CheckpointManager
+
+    if num_steps < 1:
+        raise ValueError(f"num_steps {num_steps} must be >= 1")
+    if checkpoint_every < 1:
+        raise ValueError(f"checkpoint_every {checkpoint_every} must be >= 1")
+    sup = (
+        supervisor
+        if supervisor is not None
+        else TrainSupervisor(writer=metrics_writer)
+    )
+    history: List[dict] = []
+    while True:
+        attempt = sup.begin_attempt()
+        ckpt = CheckpointManager(
+            checkpoint_dir,
+            async_save=checkpoint_async,
+            metrics_writer=metrics_writer,
+        )
+        fr = get_global_flight_recorder()
+        try:
+            trainer = make_trainer()
+            start = 0
+            latest = ckpt.latest_step()
+            if latest is not None:
+                start, trainer.state = ckpt.restore(
+                    abstract_state=_abstract_state(trainer)
+                )
+                _emit_recovery(
+                    metrics_writer,
+                    {
+                        "action": "resume-from-checkpoint",
+                        "step": int(start),
+                        "attempt": attempt,
+                    },
+                )
+            if start >= num_steps:
+                return history
+            data = make_data()
+            for _ in range(start):
+                next(data)  # realign the deterministic stream
+            if fr is not None:
+
+                def preempt_save():
+                    from glom_tpu.utils.checkpoint import preemption_save
+
+                    return preemption_save(
+                        checkpoint_dir, trainer.state,
+                        int(np.asarray(trainer.state.step)),
+                        metrics_writer=metrics_writer,
+                    )
+
+                fr.set_checkpoint_hook(
+                    preempt_save, deadline_s=preemption_deadline_s
+                )
+            done = start
+            while done < num_steps:
+                span = min(checkpoint_every, num_steps - done)
+                history.extend(
+                    trainer.fit(data, num_steps=span, log_every=log_every)
+                )
+                done += span
+                ckpt.save(done, trainer.state)
+            ckpt.wait()
+            return history
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:  # noqa: BLE001 — the supervisor classifies
+            if sup.on_failure(e) is None:
+                raise
+        finally:
+            if fr is not None:
+                fr.set_checkpoint_hook(None)
+            try:
+                ckpt.close()
+            except Exception:  # noqa: BLE001 — best-effort on teardown
+                pass

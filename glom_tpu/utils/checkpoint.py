@@ -7,12 +7,33 @@ of a temporal run. Async by default (the save overlaps the next training
 steps); `wait()` or close() drains. Restore is sharding-aware: pass the
 abstract state (jax.eval_shape of your init) plus shardings and Orbax
 device_puts shards directly on restore — the multi-host resume path.
+
+Crash-safety (docs/RESILIENCE.md): Orbax's commit marker makes each step
+ATOMIC against a mid-write kill, but not VERIFIED — a step that corrupts
+after commit (truncated array file, torn copy, bad disk) still lists as
+latest and crashes the restore that production recovery depends on. Every
+save therefore also lands a checksum manifest (`manifest_<step>.json`
+next to the step dir: per-file size + sha256, itself written temp-file →
+fsync → atomic rename), and the read side — `latest_step`, `valid_steps`,
+`restore(step=None)` — only ever hands out steps that VERIFY: a torn or
+checksum-failed step is skipped with a stamped "recovery" event
+(action "skip-torn-checkpoint") and the previous valid step restores
+instead. A step with no manifest at all (written by an older build, or by
+a process killed between Orbax's commit and the manifest write) is
+accepted on Orbax's commit marker alone — strictly better-than-before,
+never worse.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
+import os
+import tempfile
+import time
+import threading
 from pathlib import Path
-from typing import Any, Optional
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 import jax
 import numpy as np
@@ -23,6 +44,95 @@ try:  # orbax is in the image; guard anyway so import of glom_tpu never dies
     HAVE_ORBAX = True
 except ImportError:  # pragma: no cover
     HAVE_ORBAX = False
+
+
+class CheckpointCorruptError(RuntimeError):
+    """An EXPLICITLY requested step failed manifest verification. The
+    step=None path never raises this — it skips to the previous valid
+    step — but a caller who names a step gets the loud failure."""
+
+
+def _fsync_dir(path: Path) -> None:
+    """fsync the directory entry so the rename itself is durable (an
+    atomic rename that the kernel never flushed is atomic only until the
+    power fails)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # pragma: no cover — exotic FS without dir-open
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def atomic_write_json(path: Path, obj: Any) -> None:
+    """Temp path in the SAME directory + flush + fsync + os.replace: a
+    reader (or a crash) sees either the old file or the complete new one,
+    never a torn write — the manifest must itself be un-tearable or it
+    certifies nothing."""
+    path = Path(path)
+    fd, tmp = tempfile.mkstemp(
+        prefix=f".{path.name}.", suffix=".tmp", dir=path.parent
+    )
+    try:
+        with os.fdopen(fd, "w") as fh:
+            json.dump(obj, fh, sort_keys=True)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(path.parent)
+
+
+def _file_sha256(path: Path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def build_manifest(step_dir: Path) -> Dict[str, Any]:
+    """Per-file size + sha256 over everything under one committed step."""
+    files: Dict[str, Dict[str, Any]] = {}
+    for p in sorted(Path(step_dir).rglob("*")):
+        if p.is_file():
+            files[str(p.relative_to(step_dir))] = {
+                "size": p.stat().st_size,
+                "sha256": _file_sha256(p),
+            }
+    return {
+        "manifest_version": 1,
+        "wall_time_s": round(time.time(), 3),
+        "n_files": len(files),
+        "files": files,
+    }
+
+
+def verify_manifest(step_dir: Path, manifest: Dict[str, Any]) -> List[str]:
+    """Mismatches between a step dir and its manifest; empty = verified.
+    Extra files are tolerated (Orbax layouts grow metadata); a missing,
+    resized, or checksum-failed manifested file is corruption."""
+    errs: List[str] = []
+    step_dir = Path(step_dir)
+    for rel, meta in manifest.get("files", {}).items():
+        p = step_dir / rel
+        if not p.is_file():
+            errs.append(f"{rel}: missing")
+            continue
+        size = p.stat().st_size
+        if size != meta.get("size"):
+            errs.append(f"{rel}: size {size} != manifest {meta.get('size')}")
+            continue
+        if _file_sha256(p) != meta.get("sha256"):
+            errs.append(f"{rel}: sha256 mismatch")
+    return errs
 
 
 class _SpanSink:
@@ -47,7 +157,15 @@ class CheckpointManager:
     save() span bounds the blocking serialize-and-enqueue slice and the
     wait() span the drain — the last unattributed host-time sinks the
     ROADMAP named. Pass `metrics_writer` to land the span events in the
-    run's metrics stream (train/cli.py does)."""
+    run's metrics stream (train/cli.py does).
+
+    Manifest discipline: the checksum manifest for a step can only be
+    computed AFTER Orbax commits it, so async saves queue the step as
+    pending and the manifest lands at the next synchronization point —
+    the following save(), wait(), close(), or any read (valid_steps /
+    latest_step / restore). A kill inside that window leaves a committed
+    step with no manifest, which the read side accepts on Orbax's own
+    commit marker (see module docstring)."""
 
     def __init__(
         self,
@@ -68,6 +186,25 @@ class CheckpointManager:
             enable_async_checkpointing=async_save,
         )
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
+        self._async = async_save
+        self._pending: Set[int] = set()  # committed-manifest debt
+        # Orbax managers are not reentrant and not thread-safe: every
+        # manager operation rides this RLock so concurrent callers (the
+        # preemption hook's worker thread vs the training loop) serialize
+        # instead of corrupting the manager. The SIGTERM grace path
+        # deliberately does NOT share this instance — see
+        # preemption_save() below for why.
+        self._op_lock = threading.RLock()
+        # verify_step result cache keyed by the manifest's (mtime_ns,
+        # size) signature: the resume path asks "is this step good?"
+        # more than once (latest_step, then restore), and re-hashing
+        # every file of every retained multi-GB step per ask would put
+        # minutes of dead time into exactly the recovery path this layer
+        # exists to speed up. A rewritten manifest (new signature)
+        # invalidates its entry; data corruption AFTER a verified pass
+        # is the accepted staleness (the same window any
+        # verify-then-read has).
+        self._verify_cache: Dict[int, Tuple[Tuple[int, int], bool]] = {}
         self.metrics_writer = metrics_writer
         from glom_tpu.tracing.spans import spanned
 
@@ -75,12 +212,145 @@ class CheckpointManager:
         self.save = spanned("host_checkpoint_save", writer=sink)(self.save)
         self.wait = spanned("host_checkpoint_wait", writer=sink)(self.wait)
 
+    # -- manifest plumbing -------------------------------------------------
+
+    def _manifest_path(self, step: int) -> Path:
+        return self.directory / f"manifest_{int(step)}.json"
+
+    def _step_dir(self, step: int) -> Path:
+        return self.directory / str(int(step))
+
+    def _emit_recovery(self, rec: dict) -> None:
+        from glom_tpu.resilience.faults import emit_recovery
+
+        emit_recovery(self.metrics_writer, rec)
+
+    def _finalize_pending(self) -> None:
+        """Write manifests for pending steps Orbax has committed, and
+        garbage-collect manifests of steps Orbax has retired
+        (max_to_keep)."""
+        committed = set(self._mgr.all_steps())
+        for step in sorted(self._pending & committed):
+            step_dir = self._step_dir(step)
+            if step_dir.is_dir():
+                atomic_write_json(
+                    self._manifest_path(step), build_manifest(step_dir)
+                )
+            self._pending.discard(step)
+        for p in self.directory.glob("manifest_*.json"):
+            try:
+                step = int(p.stem.split("_", 1)[1])
+            except ValueError:
+                continue
+            if step not in committed and step not in self._pending:
+                try:
+                    p.unlink()
+                except OSError:
+                    pass
+
+    def _quarantine_torn(self, step: int) -> Optional[str]:
+        """Move a torn step OUT of Orbax's step namespace and reconcile
+        the manager's bookkeeping. Skipping a torn step on restore is not
+        enough on its own: the torn dir still reads as the latest step,
+        so Orbax DECLINES (should_save False) every later save at or
+        below it and the retrained state would never persist — resume
+        would re-train the same span forever. The corrupt bytes are
+        preserved under .quarantine/<step>_<ts> for postmortems — a
+        HIDDEN dir, because Orbax's step scanner raises on any visible
+        non-step directory name in the root (measured on 0.7) — and the
+        manifest + verify-cache entry drop with the step. Returns the
+        quarantine path (None when the dir was already gone)."""
+        step = int(step)
+        step_dir = self._step_dir(step)
+        dest: Optional[Path] = None
+        if step_dir.is_dir():
+            qdir = self.directory / ".quarantine"
+            try:
+                qdir.mkdir(exist_ok=True)
+                dest = qdir / f"{step}_{time.strftime('%Y%m%d_%H%M%S')}"
+                step_dir.rename(dest)
+            except OSError:
+                dest = None
+        try:
+            # Reconciles Orbax's internal step list; warns (dir already
+            # moved) but updates the bookkeeping either way.
+            self._mgr.delete(step)
+        except Exception:  # noqa: BLE001 — bookkeeping-only, best effort
+            pass
+        try:
+            self._manifest_path(step).unlink()
+        except OSError:
+            pass
+        self._verify_cache.pop(step, None)
+        return str(dest) if dest is not None else None
+
+    def verify_step(self, step: int) -> bool:
+        """True when `step` is safe to restore: a present manifest must
+        verify bit-for-bit; an absent manifest falls back to Orbax's
+        commit marker (legacy step, or a kill between commit and manifest
+        write)."""
+        with self._op_lock:
+            mpath = self._manifest_path(step)
+            try:
+                st = mpath.stat()
+            except OSError:
+                # No manifest: Orbax's commit IS the atomic rename from
+                # the tmp dir to the final step dir, so existence of the
+                # step dir is the commit marker — read from the
+                # FILESYSTEM, not Orbax's step-list cache, which goes
+                # stale exactly when the preemption path races a
+                # concurrent background commit.
+                self._verify_cache.pop(int(step), None)
+                return self._step_dir(step).is_dir()
+            sig = (st.st_mtime_ns, st.st_size)
+            cached = self._verify_cache.get(int(step))
+            if cached is not None and cached[0] == sig:
+                return cached[1]
+            try:
+                with open(mpath) as fh:
+                    manifest = json.load(fh)
+            except (OSError, json.JSONDecodeError):
+                # A torn manifest cannot certify its step
+                # (atomic_write_json makes this unreachable for OUR
+                # writes; a foreign/corrupt file still must not crash the
+                # read side).
+                ok = False
+            else:
+                ok = not verify_manifest(self._step_dir(step), manifest)
+            self._verify_cache[int(step)] = (sig, ok)
+            return ok
+
+    def valid_steps(self) -> List[int]:
+        """Ascending steps that pass verification — the only steps the
+        restore path will ever hand out."""
+        with self._op_lock:
+            self._mgr.wait_until_finished()
+            self._finalize_pending()
+            return [
+                s for s in sorted(self._mgr.all_steps()) if self.verify_step(s)
+            ]
+
+    # -- save / restore ----------------------------------------------------
+
     def save(self, step: int, state: Any, *, levels: Optional[Any] = None) -> bool:
         """Save state (+ optional carried temporal `levels`) at `step`."""
-        items = {"state": ocp.args.StandardSave(state)}
-        if levels is not None:
-            items["levels"] = ocp.args.StandardSave(levels)
-        return self._mgr.save(step, args=ocp.args.Composite(**items))
+        with self._op_lock:
+            # Settle the PREVIOUS async save first: its manifest debt can
+            # only be paid once Orbax commits, and back-to-back saves are
+            # the one place that is guaranteed (Orbax serializes them
+            # anyway).
+            self._mgr.wait_until_finished()
+            self._finalize_pending()
+            items = {"state": ocp.args.StandardSave(state)}
+            if levels is not None:
+                items["levels"] = ocp.args.StandardSave(levels)
+            saved = self._mgr.save(step, args=ocp.args.Composite(**items))
+            if saved:
+                self._pending.add(int(step))
+                if not self._async:
+                    self._mgr.wait_until_finished()
+                    self._finalize_pending()
+            return saved
 
     def restore(
         self,
@@ -89,38 +359,144 @@ class CheckpointManager:
         abstract_state: Any,
         abstract_levels: Optional[Any] = None,
     ):
-        """Restore the latest (or a specific) step.
+        """Restore the latest VALID (or a specific) step.
 
         abstract_state: jax.eval_shape-style pytree of ShapeDtypeStruct,
         optionally with .sharding set — restored arrays land directly in
         that sharding (no host bounce), which is what makes multi-host
         resume work.
+
+        step=None walks the valid steps newest-first: a step that fails
+        verification — or that verifies (no manifest) but still blows up
+        inside Orbax deserialization — is skipped with a stamped
+        "recovery" event and the previous one restores; the recovery loop
+        never dies on a torn file. An EXPLICIT step that fails
+        verification raises CheckpointCorruptError instead.
         Returns (step, state) or (step, (state, levels)).
         """
-        step = step if step is not None else self._mgr.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint found in {self.directory}")
-        items = {"state": ocp.args.StandardRestore(abstract_state)}
-        if abstract_levels is not None:
-            items["levels"] = ocp.args.StandardRestore(abstract_levels)
-        restored = self._mgr.restore(step, args=ocp.args.Composite(**items))
-        if abstract_levels is not None:
-            return step, (restored["state"], restored["levels"])
-        return step, restored["state"]
+        with self._op_lock:
+            return self._restore_locked(step, abstract_state, abstract_levels)
+
+    def _restore_locked(self, step, abstract_state, abstract_levels):
+        self._mgr.wait_until_finished()
+        self._finalize_pending()
+        if step is not None:
+            if not self.verify_step(step):
+                raise CheckpointCorruptError(
+                    f"checkpoint step {step} in {self.directory} failed "
+                    "manifest verification (torn or corrupted)"
+                )
+            candidates = [int(step)]
+        else:
+            # LAZY walk: verify per candidate inside the loop, newest
+            # first — the common resume touches only the newest step's
+            # hashes instead of sweeping every retained multi-GB step
+            # up front (the recovery path must not spend minutes
+            # re-verifying checkpoints it will never restore).
+            candidates = sorted(self._mgr.all_steps(), reverse=True)
+        last_exc: Optional[BaseException] = None
+        for s in candidates:
+            if step is None and not self.verify_step(s):
+                self._emit_recovery(
+                    {
+                        "action": "skip-torn-checkpoint",
+                        "step": int(s),
+                        "note": "manifest verification failed",
+                        "quarantined": self._quarantine_torn(s),
+                    }
+                )
+                continue
+            items = {"state": ocp.args.StandardRestore(abstract_state)}
+            if abstract_levels is not None:
+                items["levels"] = ocp.args.StandardRestore(abstract_levels)
+            try:
+                restored = self._mgr.restore(s, args=ocp.args.Composite(**items))
+            except Exception as e:  # noqa: BLE001 — any torn step skips
+                if step is not None:
+                    raise
+                last_exc = e
+                self._emit_recovery(
+                    {
+                        "action": "skip-torn-checkpoint",
+                        "step": s,
+                        "note": f"{type(e).__name__}: {e}"[:300],
+                        "quarantined": self._quarantine_torn(s),
+                    }
+                )
+                continue
+            if abstract_levels is not None:
+                return s, (restored["state"], restored["levels"])
+            return s, restored["state"]
+        if last_exc is not None:
+            raise FileNotFoundError(
+                f"no restorable checkpoint in {self.directory} (every "
+                f"candidate failed; last: {last_exc})"
+            )
+        raise FileNotFoundError(f"no checkpoint found in {self.directory}")
 
     def latest_step(self) -> Optional[int]:
-        return self._mgr.latest_step()
+        """Newest VERIFIED step (None when nothing valid exists) — a torn
+        newest checkpoint yields the previous one, not a crash."""
+        steps = self.valid_steps()
+        return steps[-1] if steps else None
 
     def all_steps(self):
-        return self._mgr.all_steps()
+        with self._op_lock:
+            return self._mgr.all_steps()
 
     def wait(self):
-        """Block until any in-flight async save lands."""
-        self._mgr.wait_until_finished()
+        """Block until any in-flight async save lands (and pay its
+        manifest debt)."""
+        with self._op_lock:
+            self._mgr.wait_until_finished()
+            self._finalize_pending()
 
     def close(self):
-        self._mgr.wait_until_finished()
-        self._mgr.close()
+        with self._op_lock:
+            self._mgr.wait_until_finished()
+            self._finalize_pending()
+            self._mgr.close()
+
+
+def preemption_save(
+    checkpoint_dir, state: Any, step: int, *, metrics_writer=None
+) -> int:
+    """THE SIGTERM grace-window save (tracing/flight.set_checkpoint_hook
+    plugs this in via a closure over the live trainer): save `state` at
+    `step` through a THROWAWAY sync manager, not the training loop's —
+    the signal handler pauses the main thread wherever it was, possibly
+    inside the loop manager's save holding its op lock, and a paused
+    owner never releases (measured deadlock, not theory). Orbax per-step
+    dirs + atomic commit make two managers safe side by side; a same-step
+    race with the loop's async background commit that still lands the
+    step counts as SUCCESS (the state is on disk — whose write won is
+    irrelevant). Returns the step; raises when no save landed (the hook
+    stamps the failure on the recovery record)."""
+    mgr = CheckpointManager(
+        checkpoint_dir, async_save=False, metrics_writer=metrics_writer
+    )
+    try:
+        if mgr.verify_step(step):
+            return step  # already committed (e.g. the loop's save)
+        try:
+            saved = mgr.save(step, state)
+        except Exception:
+            if not mgr.verify_step(step):
+                raise
+            saved = True
+        if not saved and not mgr.verify_step(step):
+            # Orbax DECLINED the save (a later — possibly torn — step
+            # owns the latest-step slot) and nothing committed: that is
+            # a failure the recovery record must carry, never a silent
+            # ok=true pointing at a step that does not exist.
+            raise RuntimeError(
+                f"orbax declined the save for step {step} and no "
+                "committed step exists (a torn later step may own the "
+                "step namespace)"
+            )
+        return step
+    finally:
+        mgr.close()
 
 
 def abstract_like(tree: Any) -> Any:

@@ -130,6 +130,24 @@ class ServeConfig:
     # Donate the input buffer to each compiled call so XLA reuses it for
     # outputs (None = auto: on TPU only — CPU ignores donation noisily).
     donate: Optional[bool] = None
+    # Transient-dispatch retry (glom_tpu/resilience/retry.py): a failed
+    # dispatch retries up to dispatch_retries times with exponential
+    # backoff from retry_backoff_ms — UNLESS the watchdog says the backend
+    # is down, which fails fast (never retry into a dead backend). 0
+    # disables. Caller bugs (ValueError/TypeError) never retry.
+    dispatch_retries: int = 2
+    retry_backoff_ms: float = 25.0
+    # Degradation ladder (glom_tpu/resilience/ladder.py, opt-in via
+    # DynamicBatcher(ladder=...) — serve/cli.py --ladder wires it): under
+    # queue pressure or a flapping backend, step down normal ->
+    # capped-iters -> capped-buckets -> shed instead of jumping straight
+    # to shed. degraded_iters None -> half the model budget (floor 1);
+    # degraded_max_batch None -> half max_batch (floor 1).
+    ladder: bool = False
+    degraded_iters: Optional[int] = None
+    degraded_max_batch: Optional[int] = None
+    ladder_high_water: float = 0.75  # queue fill that steps DOWN a rung
+    ladder_low_water: float = 0.25   # queue fill that steps back UP
 
     def __post_init__(self):
         if not self.buckets:
@@ -158,6 +176,28 @@ class ServeConfig:
             raise ValueError(f"exit_threshold {self.exit_threshold} must be >= 0")
         if self.min_iters < 1:
             raise ValueError(f"min_iters {self.min_iters} must be >= 1")
+        if self.dispatch_retries < 0:
+            raise ValueError(
+                f"dispatch_retries {self.dispatch_retries} must be >= 0"
+            )
+        if self.retry_backoff_ms < 0:
+            raise ValueError(
+                f"retry_backoff_ms {self.retry_backoff_ms} must be >= 0"
+            )
+        if self.degraded_iters is not None and self.degraded_iters < 1:
+            raise ValueError(
+                f"degraded_iters {self.degraded_iters} must be >= 1 or None"
+            )
+        if self.degraded_max_batch is not None and self.degraded_max_batch < 1:
+            raise ValueError(
+                f"degraded_max_batch {self.degraded_max_batch} must be >= 1 "
+                "or None"
+            )
+        if not 0.0 <= self.ladder_low_water < self.ladder_high_water <= 1.0:
+            raise ValueError(
+                f"need 0 <= ladder_low_water ({self.ladder_low_water}) < "
+                f"ladder_high_water ({self.ladder_high_water}) <= 1"
+            )
 
 
 @dataclasses.dataclass(frozen=True)

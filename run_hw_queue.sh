@@ -102,6 +102,16 @@ step trace_capture 1800 python -u bench_train.py --loss-curve 30 \
     --out results/hw_queue/trace_curve.jsonl \
     --trace-steps 20:24 --trace-dir results/hw_queue/xla_trace
 
+# 9d-. Chaos gate BEFORE the serve sweep (docs/RESILIENCE.md): SIGKILL a
+#      real training worker mid-run and require the resumed worker to
+#      finish with a continuous, schema-clean evidence trail. A serving
+#      stack about to be load-swept on real hardware must first prove it
+#      survives a kill — recovery bugs found during the sweep burn the
+#      window.
+step chaos 1200 python -m glom_tpu.resilience --scenario kill-train \
+    --dir results/hw_queue/chaos --steps 6 || {
+    log "chaos kill-and-resume FAILED — not sweeping a serving stack that cannot recover"; exit 1; }
+
 # 9d. Serving SLO sweep (glom_tpu/serve, docs/SERVING.md): AOT warmup per
 #     bucket, closed-loop throughput ceiling, offered-load p50/p95/p99
 #     latency rows, and the consensus early-exit iteration histogram on

@@ -1,0 +1,463 @@
+"""End-to-end chaos: the acceptance scenarios from the resilience PR.
+
+  (1) injected kill mid-train -> fit_supervised resumes from the last
+      VALID checkpoint, the metrics stream shows a continuous step
+      sequence, and a stamped "recovery" event marks the resume;
+  (2) injected backend flap during a serve load burst -> every ticket
+      reaches a terminal state (served, degraded-served, or shed — never
+      hung), the degradation ladder steps down AND back up, and the
+      request accounting conserves exactly.
+
+The in-process fit_supervised tests run host-only (fake trainer, orbax
+over np pytrees) and stay tier-1; the subprocess SIGKILL ride and the
+threaded serve burst are slow-marked — CI's chaos job runs this module
+unfiltered, and `python -m glom_tpu.resilience` drives the same kill
+scenario against the REAL training CLI.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from glom_tpu.resilience import DegradationLadder, FaultPlan, InjectedFault
+from glom_tpu.telemetry import schema
+
+
+class ListWriter:
+    def __init__(self):
+        self.records = []
+
+    def write(self, rec):
+        self.records.append(rec)
+
+
+# ---------------------------------------------------------------------------
+# fit_supervised: the in-process restart loop (host-only, tier-1)
+# ---------------------------------------------------------------------------
+
+
+class FlakyTrainer:
+    """Host-only trainer honoring the fit_supervised protocol, with a
+    seeded failure plan: 'training' folds each batch's mean into w, so a
+    resumed-and-realigned run must produce bit-identical state to an
+    unfaulted one — the restart loop cannot silently skip or repeat a
+    batch without this catching it."""
+
+    def __init__(self, plan=None):
+        self.state = {
+            "w": np.zeros((), np.float64),
+            "step": np.zeros((), np.int32),
+        }
+        self.plan = plan
+
+    def fit(self, data, num_steps, log_every=10):
+        hist = []
+        for _ in range(num_steps):
+            batch = next(data)
+            if self.plan is not None and self.plan.fires("train-step"):
+                raise InjectedFault("injected trainer crash")
+            step = int(np.asarray(self.state["step"]))
+            self.state = {
+                "w": np.asarray(
+                    np.asarray(self.state["w"]) + float(np.mean(batch)),
+                    np.float64,
+                ),
+                "step": np.asarray(step + 1, np.int32),
+            }
+            hist.append({"step": step, "loss": 1.0})
+        return hist
+
+
+def _data_factory():
+    def make():
+        return iter(np.full((2,), float(i)) for i in range(1000))
+
+    return make
+
+
+class TestFitSupervised:
+    def test_crash_resumes_from_last_valid_checkpoint(self, tmp_path):
+        from glom_tpu.train.supervise import TrainSupervisor, fit_supervised
+
+        w = ListWriter()
+        plan = FaultPlan(seed=1)
+        plan.register("train-step", at=(5,), fault="trainer-crash")
+        history = fit_supervised(
+            lambda: FlakyTrainer(plan),
+            _data_factory(),
+            8,
+            checkpoint_dir=str(tmp_path / "ckpt"),
+            checkpoint_every=2,
+            log_every=1,
+            supervisor=TrainSupervisor(
+                max_restarts=2, backoff_s=0.0, writer=w
+            ),
+            metrics_writer=w,
+        )
+        steps = sorted({h["step"] for h in history})
+        assert steps == list(range(8))  # continuous, no gap, no loss
+        actions = [
+            r["action"] for r in w.records if r.get("kind") == "recovery"
+        ]
+        assert actions == ["restart", "resume-from-checkpoint"]
+        resume = [
+            r for r in w.records
+            if r.get("action") == "resume-from-checkpoint"
+        ][0]
+        assert resume["step"] == 4  # last span committed before the crash
+        assert schema.validate_record(resume) == []
+        # Bit-identical to an unfaulted run: restart + realign is exact.
+        clean = FlakyTrainer()
+        data = _data_factory()()
+        clean.fit(data, 8, log_every=1)
+        final = FlakyTrainer(plan=None)
+        # reload the supervised run's final committed state
+        from glom_tpu.utils.checkpoint import CheckpointManager, abstract_like
+
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        step, got = mgr.restore(abstract_state=abstract_like(final.state))
+        mgr.close()
+        assert step == 8
+        np.testing.assert_array_equal(
+            np.asarray(got["w"]), np.asarray(clean.state["w"])
+        )
+
+    def test_budget_exhausted_gives_up_and_reraises(self, tmp_path):
+        from glom_tpu.train.supervise import TrainSupervisor, fit_supervised
+
+        w = ListWriter()
+        plan = FaultPlan(seed=1)
+        plan.register("train-step", rate=1.0, fault="trainer-crash")
+        with pytest.raises(InjectedFault):
+            fit_supervised(
+                lambda: FlakyTrainer(plan),
+                _data_factory(),
+                4,
+                checkpoint_dir=str(tmp_path / "ckpt"),
+                checkpoint_every=2,
+                supervisor=TrainSupervisor(
+                    max_restarts=1, backoff_s=0.0, writer=w
+                ),
+                metrics_writer=w,
+            )
+        actions = [
+            r["action"] for r in w.records if r.get("kind") == "recovery"
+        ]
+        assert actions == ["restart", "give-up"]
+        for r in w.records:
+            assert schema.validate_record(r) == []
+
+    def test_backoff_is_bounded_exponential(self):
+        from glom_tpu.train.supervise import TrainSupervisor
+
+        sleeps = []
+        sup = TrainSupervisor(
+            max_restarts=4, backoff_s=0.5, backoff_factor=2.0,
+            backoff_max_s=1.5, sleep=sleeps.append,
+        )
+        for _ in range(4):
+            sup.begin_attempt()
+            assert sup.on_failure(InjectedFault("x")) is not None
+        assert sleeps == [0.5, 1.0, 1.5, 1.5]  # capped, never unbounded
+        sup.begin_attempt()
+        assert sup.on_failure(InjectedFault("x")) is None  # budget spent
+        assert sup.record()["gave_up"] is True
+
+    def test_already_complete_checkpoint_returns_immediately(self, tmp_path):
+        from glom_tpu.train.supervise import fit_supervised
+
+        fit_supervised(
+            lambda: FlakyTrainer(),
+            _data_factory(),
+            4,
+            checkpoint_dir=str(tmp_path / "ckpt"),
+            checkpoint_every=2,
+        )
+        # second run over the same dir: nothing left to train
+        history = fit_supervised(
+            lambda: FlakyTrainer(),
+            _data_factory(),
+            4,
+            checkpoint_dir=str(tmp_path / "ckpt"),
+            checkpoint_every=2,
+        )
+        assert history == []
+
+    def test_torn_newest_checkpoint_resumes_from_previous(self, tmp_path):
+        """Compose the torn-checkpoint fault with the restart loop: the
+        newest step is corrupted between runs (the mid-write SIGKILL
+        signature), and the next fit_supervised resumes one step back and
+        still finishes."""
+        from glom_tpu.resilience import truncate_newest_checkpoint
+        from glom_tpu.train.supervise import fit_supervised
+
+        ckpt = str(tmp_path / "ckpt")
+        fit_supervised(
+            lambda: FlakyTrainer(), _data_factory(), 4,
+            checkpoint_dir=ckpt, checkpoint_every=2,
+        )
+        truncate_newest_checkpoint(ckpt)
+        w = ListWriter()
+        history = fit_supervised(
+            lambda: FlakyTrainer(), _data_factory(), 6,
+            checkpoint_dir=ckpt, checkpoint_every=2, metrics_writer=w,
+        )
+        assert sorted({h["step"] for h in history}) == [2, 3, 4, 5]
+        resume = [
+            r for r in w.records
+            if r.get("action") == "resume-from-checkpoint"
+        ]
+        assert resume and resume[0]["step"] == 2  # torn 4 skipped
+        skips = [
+            r for r in w.records
+            if r.get("action") == "skip-torn-checkpoint"
+        ]
+        assert skips and skips[0]["quarantined"]  # torn step moved aside
+        # THE persistence regression (reviewer-reproduced): a skipped
+        # torn step must not keep owning Orbax's latest-step slot — the
+        # retrained progress must land durably, or every future resume
+        # re-trains the same span forever.
+        from pathlib import Path
+
+        from glom_tpu.utils.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(ckpt)
+        assert mgr.latest_step() == 6
+        assert 4 in mgr.valid_steps()  # the retrained step 4, re-saved
+        mgr.close()
+        # forensics preserved, hidden from Orbax's step scanner
+        assert list((Path(ckpt) / ".quarantine").glob("4_*"))
+
+
+# ---------------------------------------------------------------------------
+# Acceptance (1): SIGKILL mid-train, real trainer, subprocess
+# ---------------------------------------------------------------------------
+
+_WORKER = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+from glom_tpu.data import gaussian_dataset
+from glom_tpu.train import Trainer, fit_supervised
+from glom_tpu.utils.config import GlomConfig, TrainConfig
+from glom_tpu.utils.metrics import MetricsWriter
+
+ckpt_dir, metrics_path, steps = sys.argv[1], sys.argv[2], int(sys.argv[3])
+cfg = GlomConfig(dim=16, levels=3, image_size=8, patch_size=2)
+tcfg = TrainConfig(batch_size=4, learning_rate=1e-3, iters=2, recon_iter_index=1)
+writer = MetricsWriter(metrics_path, echo=False)
+history = fit_supervised(
+    lambda: Trainer(cfg, tcfg, metrics_writer=writer),
+    lambda: gaussian_dataset(tcfg.batch_size, cfg.image_size, seed=0),
+    steps,
+    checkpoint_dir=ckpt_dir,
+    checkpoint_every=1,
+    log_every=1,
+    metrics_writer=writer,
+)
+writer.close()
+print("DONE", len(history), flush=True)
+"""
+
+
+class TestSigkillSupervised:
+    @pytest.mark.slow
+    def test_sigkill_mid_train_resumes_continuous(self, tmp_path):
+        ckpt = str(tmp_path / "ckpt")
+        metrics = str(tmp_path / "metrics.jsonl")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        args = [sys.executable, "-u", "-c", _WORKER, ckpt, metrics, "6"]
+
+        proc = subprocess.Popen(
+            args, env=env, cwd=repo,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        watchdog = threading.Timer(300, proc.kill)
+        watchdog.start()
+        try:
+            # SIGKILL the moment >= 2 steps are manifest-committed.
+            deadline = time.monotonic() + 240
+            import glob
+
+            while time.monotonic() < deadline:
+                if len(glob.glob(os.path.join(ckpt, "manifest_*.json"))) >= 2:
+                    os.kill(proc.pid, signal.SIGKILL)
+                    break
+                if proc.poll() is not None:
+                    pytest.fail(
+                        f"worker exited early rc={proc.returncode}: "
+                        f"{proc.stdout.read()[-2000:]}"
+                    )
+                time.sleep(0.1)
+            else:
+                pytest.fail("no 2 committed checkpoints before deadline")
+            proc.wait(timeout=60)
+        finally:
+            watchdog.cancel()
+            if proc.poll() is None:
+                proc.kill()
+        assert proc.returncode != 0
+
+        out = subprocess.run(
+            args, env=env, cwd=repo, capture_output=True, text=True,
+            timeout=300,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "DONE" in out.stdout
+
+        with open(metrics) as fh:
+            recs = [r for _, r in schema.iter_json_lines(fh)]
+        steps = sorted(
+            {
+                int(r["step"]) for r in recs
+                if r.get("kind") == "train_step"
+            }
+        )
+        assert steps == list(range(6))  # CONTINUOUS across the kill
+        resumes = [
+            r for r in recs
+            if r.get("kind") == "recovery"
+            and r.get("action") == "resume-from-checkpoint"
+        ]
+        assert resumes and resumes[0]["step"] >= 2
+        with open(metrics) as fh:
+            assert schema.lint_stream(fh) == []
+
+
+# ---------------------------------------------------------------------------
+# Acceptance (2): backend flap during a serve load burst
+# ---------------------------------------------------------------------------
+
+
+class BurstEngine:
+    """Engine-shaped stub with adjustable latency (the queue-pressure
+    knob) that honors iters_override like the real engine."""
+
+    retry = None
+
+    def __init__(self, latency_s=0.004):
+        self.buckets = (1, 2, 4)
+        self.latency_s = latency_s
+
+    def pick_bucket(self, n):
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(n)
+
+    def infer(self, imgs, n_valid=None, iters_override=None):
+        from glom_tpu.serve.engine import ServeResult
+
+        time.sleep(self.latency_s)
+        b = imgs.shape[0]
+        return ServeResult(
+            levels=np.zeros((b, 4, 3, 8), np.float32),
+            iters_run=iters_override if iters_override is not None else 6,
+            latency_s=self.latency_s,
+            bucket=b,
+            compiled=False,
+        )
+
+
+class TestServeFlapBurst:
+    @pytest.mark.slow
+    def test_flap_under_load_every_ticket_terminal_ladder_round_trips(self):
+        from glom_tpu.serve.batcher import DynamicBatcher, ShedError
+        from glom_tpu.telemetry.watchdog import (
+            BackendWatchdog,
+            set_global_watchdog,
+        )
+
+        w = ListWriter()
+        # Controllable backend: the cell is what the probe sees; the flap
+        # schedule below drives down->up->down inside the flap window.
+        cell = [1]
+        clock = [0.0]
+        wd = BackendWatchdog(
+            probe=lambda timeout: cell[0],
+            flap_window_s=30.0,
+            flap_threshold=3,
+            heartbeat_s=0,
+            clock=lambda: clock[0],
+        )
+        ladder = DegradationLadder(
+            degraded_iters=3, bucket_cap=2,
+            high_water=0.5, low_water=0.2, min_dwell_s=0.0, writer=w,
+        )
+        set_global_watchdog(wd)
+        try:
+            assert wd.probe_once() == "up"
+            batcher = DynamicBatcher(
+                BurstEngine(), max_batch=4, max_delay_ms=1.0,
+                queue_depth=8, writer=w, ladder=ladder,
+            ).start()
+            img = np.zeros((3, 8, 8), np.float32)
+            tickets, n_shed_seen = [], 0
+
+            def burst(n, pace_s=0.0):
+                nonlocal n_shed_seen
+                for _ in range(n):
+                    try:
+                        tickets.append(batcher.submit(img))
+                    except ShedError:
+                        n_shed_seen += 1
+                    if pace_s:
+                        time.sleep(pace_s)
+
+            # Phase A — pressure burst: overfill the bounded queue.
+            burst(60)
+            # Phase B — flap: down -> up -> down -> up inside the window.
+            for t, state in ((1.0, 0), (2.0, 1), (3.0, 0), (4.0, 1)):
+                clock[0] = t
+                cell[0] = state if state else None
+                cell[0] = 1 if state else None
+                wd.probe_once()
+            assert wd.state == "flapping"
+            # Flapping backend still SERVES (paced so the queue breathes).
+            burst(20, pace_s=0.005)
+            # Phase C — settle: age the flap window out, drain, restore.
+            # (One probe ages the window, the next settles flapping->up —
+            # the state machine's two-beat settle.)
+            clock[0] = 120.0
+            wd.probe_once()
+            assert wd.probe_once() == "up"
+            deadline = time.monotonic() + 30.0
+            while ladder.rung() != 0 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert ladder.rung() == 0, "ladder never stepped back up"
+            batcher.stop(drain=True)
+
+            # Every ticket terminal — served or shed, never hung.
+            n_served = n_failed = 0
+            for t in tickets:
+                try:
+                    t.result(timeout=10.0)
+                    n_served += 1
+                except ShedError:
+                    n_failed += 1
+            s = batcher.summary_record()
+            assert schema.validate_record(s) == []
+            # Conservation: every submit attempt accounted for, exactly.
+            assert s["n_requests"] == len(tickets) + n_shed_seen
+            assert s["n_served"] + s["n_shed"] + s["n_failed"] == s["n_requests"]
+            assert s["n_failed"] == 0  # dispatch never failed a batch
+            assert s["n_served"] >= 1 and s["n_shed"] >= 1
+            # The ladder stepped DOWN and BACK UP, on the record.
+            directions = {e["direction"] for e in ladder.timeline()}
+            assert directions == {"degrade", "restore"}
+            # Degraded service actually happened during the flap.
+            assert s["n_degraded"] >= 1
+            # Every stamped record in the stream validates.
+            for rec in w.records:
+                assert schema.validate_record(rec) == [], rec
+        finally:
+            set_global_watchdog(None)

@@ -1,0 +1,594 @@
+"""Fault-injection harness + recovery machinery, host-side (tier-1 fast).
+
+The determinism contract comes first: every test here drives the SAME
+seeded FaultPlan API the chaos suite uses, and the assertions pin exact
+fire patterns, exact watchdog transition chains, and exact ladder rung
+sequences — a fault harness that flakes certifies nothing. Heavier
+integration (real engine compiles, subprocess kills) lives in
+tests/test_chaos.py (slow-marked; CI's chaos job runs it unfiltered).
+"""
+
+import numpy as np
+import pytest
+
+from glom_tpu.resilience import (
+    CAPPED_ITERS,
+    NORMAL,
+    SHED,
+    DegradationLadder,
+    FaultPlan,
+    InjectedFault,
+    RetryPolicy,
+    dispatch_fault,
+    nan_storm,
+    probe_flap,
+    truncate_newest_checkpoint,
+)
+from glom_tpu.telemetry import schema
+from glom_tpu.telemetry.watchdog import (
+    BackendWatchdog,
+    set_global_watchdog,
+)
+
+
+class ListWriter:
+    """Minimal writer: records land in .records (the tests' stream)."""
+
+    def __init__(self):
+        self.records = []
+
+    def write(self, rec):
+        self.records.append(rec)
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    yield
+    set_global_watchdog(None)
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan: the seeded decision source
+# ---------------------------------------------------------------------------
+
+
+class TestFaultPlan:
+    def test_at_schedule_fires_exactly_there(self):
+        w = ListWriter()
+        plan = FaultPlan(seed=7, writer=w)
+        plan.register("site", at=(1, 3))
+        assert [plan.fires("site") for _ in range(5)] == [
+            False, True, False, True, False,
+        ]
+        assert [e["index"] for e in plan.events()] == [1, 3]
+        assert plan.record()["sites"]["site"] == {"calls": 5, "fired": 2}
+        for rec in w.records:
+            assert rec["kind"] == "fault"
+            assert schema.validate_record(rec) == []
+
+    def test_rate_schedule_is_seed_deterministic(self):
+        def pattern(seed):
+            p = FaultPlan(seed=seed)
+            p.register("s", rate=0.3)
+            return [p.fires("s") for _ in range(64)]
+
+        assert pattern(11) == pattern(11)
+        assert pattern(11) != pattern(12)
+        assert any(pattern(11))  # a 0.3 rate over 64 calls fires
+
+    def test_sites_are_independent(self):
+        p1 = FaultPlan(seed=5)
+        p1.register("a", rate=0.5)
+        fired_a = [p1.fires("a") for _ in range(32)]
+        p2 = FaultPlan(seed=5)
+        p2.register("b", rate=0.5)  # extra site must not perturb "a"
+        p2.register("a", rate=0.5)
+        assert [p2.fires("a") for _ in range(32)] == fired_a
+
+    def test_window_bounds_rate_fires(self):
+        p = FaultPlan(seed=0)
+        p.register("s", rate=1.0, start=2, stop=4)
+        assert [p.fires("s") for _ in range(6)] == [
+            False, False, True, True, False, False,
+        ]
+
+    def test_unregistered_site_never_fires(self):
+        p = FaultPlan(seed=0)
+        assert not any(p.fires("nope") for _ in range(10))
+
+    def test_register_validation(self):
+        p = FaultPlan()
+        with pytest.raises(ValueError):
+            p.register("s")  # neither at nor rate
+        with pytest.raises(ValueError):
+            p.register("s", at=(1,), rate=0.5)  # both
+        with pytest.raises(ValueError):
+            p.register("s", rate=1.5)
+
+    def test_wrap_raises_scheduled_and_passes_through(self):
+        p = FaultPlan(seed=0)
+        p.register("ckpt-write", at=(1,), fault="ckpt-write-failure")
+        calls = []
+        fn = p.wrap(lambda x: calls.append(x) or x, "ckpt-write")
+        assert fn(10) == 10
+        with pytest.raises(InjectedFault):
+            fn(11)
+        assert fn(12) == 12
+        assert calls == [10, 12]  # the faulted call never reached fn
+        [event] = p.events()
+        assert event["fault"] == "ckpt-write-failure"
+
+    def test_wrap_custom_exception(self):
+        p = FaultPlan(seed=0)
+        p.register("io", at=(0,))
+        fn = p.wrap(lambda: "ok", "io", exc=lambda: OSError("injected"))
+        with pytest.raises(OSError):
+            fn()
+        assert fn() == "ok"
+
+
+# ---------------------------------------------------------------------------
+# Backend flap: the watchdog's injection seam
+# ---------------------------------------------------------------------------
+
+
+def _flap_watchdog(fault_indices, *, flap_threshold=3, writer=None):
+    """Watchdog on a healthy fake probe with a seeded flap schedule
+    installed through the production seam (set_probe_fault)."""
+    plan = FaultPlan(seed=3, writer=writer)
+    plan.register(
+        "watchdog-probe", at=fault_indices, fault="backend-flap"
+    )
+    clock = [0.0]
+    wd = BackendWatchdog(
+        probe=lambda timeout: 1,
+        flap_window_s=1e9,
+        flap_threshold=flap_threshold,
+        heartbeat_s=0,
+        writer=writer,
+        clock=lambda: clock[0],
+    )
+    wd.set_probe_fault(probe_flap(plan))
+    return wd, plan, clock
+
+
+class TestInjectedFlap:
+    def test_seeded_schedule_pins_the_transition_chain(self):
+        """The satellite contract: the down->up->down flap window is pinned
+        by a seeded fault schedule — same seed, same chain, every run."""
+        w = ListWriter()
+        wd, plan, clock = _flap_watchdog((2, 4), writer=w)
+        states = []
+        for i in range(6):
+            clock[0] = float(i)
+            states.append(wd.probe_once())
+        # idx: 0 up (unknown->up), 1 up, 2 injected down, 3 up — third
+        # transition inside the window => FLAPPING, 4 injected down,
+        # 5 up (still flapping).
+        assert states == ["up", "up", "down", "flapping", "down", "flapping"]
+        tl = wd.timeline()
+        for prev, nxt in zip(tl, tl[1:]):
+            assert nxt["prev_state"] == prev["backend_state"]
+        assert [t["backend_state"] for t in tl] == [
+            "up", "down", "flapping", "down", "flapping",
+        ]
+        # the injected ground truth reconciles: two faults, two downs
+        assert [e["index"] for e in plan.events()] == [2, 4]
+        for rec in w.records:
+            assert schema.validate_record(rec) == []
+
+    def test_flapping_state_never_triggers_backend_down_dump(self, tmp_path):
+        """Flapping must NOT fire the flight recorder's backend-down dump:
+        only hard "down" transitions dump; the flapping re-entries (and
+        the up legs between) add nothing."""
+        from glom_tpu.tracing.flight import FlightRecorder
+
+        fr = FlightRecorder(str(tmp_path))
+        wd, plan, clock = _flap_watchdog((2, 4, 6), writer=fr)
+        for i in range(9):
+            clock[0] = float(i)
+            wd.probe_once()
+        # Exactly one dump per DOWN transition — the flapping events in
+        # between never re-trigger (they are "up with history").
+        n_down = sum(
+            1 for t in wd.timeline() if t["backend_state"] == "down"
+        )
+        assert n_down == 3
+        assert len(fr.dumps) == n_down
+        for path in fr.dumps:
+            with open(path) as fh:
+                lines = fh.read().splitlines()
+            assert schema.lint_stream(lines) == []
+            first = next(schema.iter_json_lines([lines[0]]))[1]
+            assert first["trigger"] == "backend-down"
+
+    def test_batcher_serves_through_flapping_but_sheds_on_down(self):
+        """Flapping is degraded service, not an outage: submissions must
+        be ACCEPTED while flapping and shed only on hard down."""
+        from glom_tpu.serve.batcher import BackendDownError, DynamicBatcher
+
+        wd, plan, clock = _flap_watchdog((2,))
+        for i in range(4):
+            clock[0] = float(i)
+            wd.probe_once()
+        assert wd.state == "flapping"
+        set_global_watchdog(wd)
+        batcher = DynamicBatcher(
+            _FakeEngine(), max_batch=2, queue_depth=4
+        )
+        ticket = batcher.submit(np.zeros((3, 8, 8), np.float32))
+        assert not ticket.done() or ticket  # admitted, not shed
+        # now force a hard down
+        wd.set_probe_fault(lambda n: None)
+        clock[0] = 10.0
+        assert wd.probe_once() == "down"
+        with pytest.raises(BackendDownError) as ei:
+            batcher.submit(np.zeros((3, 8, 8), np.float32))
+        assert "queue_depth" in ei.value.detail
+        batcher.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy: flapping retries, down fails fast
+# ---------------------------------------------------------------------------
+
+
+class _FakeEngine:
+    """Engine-shaped stub (tests/test_races.py's FakeEngine, leaner)."""
+
+    retry = None
+
+    def __init__(self, buckets=(1, 2, 4), latency_s=0.0):
+        self.buckets = buckets
+        self.latency_s = latency_s
+        self.calls = []
+
+    def pick_bucket(self, n):
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(n)
+
+    def infer(self, imgs, n_valid=None, iters_override=None):
+        import time as _time
+
+        from glom_tpu.serve.engine import ServeResult
+
+        if self.latency_s:
+            _time.sleep(self.latency_s)
+        self.calls.append({"n_valid": n_valid, "iters_override": iters_override})
+        b = imgs.shape[0]
+        return ServeResult(
+            levels=np.zeros((b, 4, 3, 8), np.float32),
+            iters_run=iters_override if iters_override is not None else 6,
+            latency_s=self.latency_s,
+            bucket=b,
+            compiled=False,
+        )
+
+
+class _StubWatchdog:
+    def __init__(self, state):
+        self.state = state
+
+    def record(self):
+        return {"backend_state": self.state}
+
+
+class TestRetryPolicy:
+    def _policy(self, writer=None, **kw):
+        kw.setdefault("backoff_s", 0.0)
+        return RetryPolicy(writer=writer, **kw)
+
+    def test_transient_failure_recovers_and_stamps(self):
+        w = ListWriter()
+        sleeps = []
+        policy = RetryPolicy(
+            retries=2, backoff_s=0.05, backoff_factor=2.0,
+            writer=w, sleep=sleeps.append,
+        )
+        attempts = [0]
+
+        def attempt():
+            attempts[0] += 1
+            if attempts[0] < 3:
+                raise InjectedFault("transient")
+            return "served"
+
+        assert policy.run(attempt, bucket=4) == "served"
+        assert attempts[0] == 3
+        assert sleeps == [0.05, 0.1]  # exponential
+        actions = [r["action"] for r in w.records]
+        assert actions == [
+            "dispatch-retry", "dispatch-retry", "dispatch-recovered",
+        ]
+        for rec in w.records:
+            assert rec["kind"] == "recovery"
+            assert rec["bucket"] == 4
+            assert schema.validate_record(rec) == []
+        rec = policy.record()
+        assert rec["n_retries"] == 2 and rec["n_recovered"] == 1
+
+    def test_nonretryable_raises_immediately(self):
+        policy = self._policy()
+        calls = [0]
+
+        def attempt():
+            calls[0] += 1
+            raise ValueError("caller bug")
+
+        with pytest.raises(ValueError):
+            policy.run(attempt)
+        assert calls[0] == 1
+        assert policy.record()["n_retries"] == 0
+
+    def test_down_backend_fails_fast_no_retry(self):
+        set_global_watchdog(_StubWatchdog("down"))
+        policy = self._policy(retries=5)
+        calls = [0]
+
+        def attempt():
+            calls[0] += 1
+            raise InjectedFault("wedged")
+
+        with pytest.raises(InjectedFault):
+            policy.run(attempt)
+        assert calls[0] == 1  # never retried into the dead backend
+        assert policy.record()["n_fast_failed"] == 1
+
+    def test_flapping_backend_does_retry(self):
+        set_global_watchdog(_StubWatchdog("flapping"))
+        policy = self._policy(retries=1)
+        calls = [0]
+
+        def attempt():
+            calls[0] += 1
+            if calls[0] == 1:
+                raise InjectedFault("flap gap")
+            return "served"
+
+        assert policy.run(attempt) == "served"
+        assert calls[0] == 2
+
+    def test_budget_exhausted_gives_up(self):
+        w = ListWriter()
+        policy = self._policy(retries=2, writer=w)
+
+        def attempt():
+            raise InjectedFault("persistent")
+
+        with pytest.raises(InjectedFault):
+            policy.run(attempt)
+        assert policy.record()["n_gave_up"] == 1
+        assert [r["action"] for r in w.records] == [
+            "dispatch-retry", "dispatch-retry",
+        ]
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(retries=-1)
+        with pytest.raises(ValueError):
+            RetryPolicy(backoff_factor=0.5)
+
+
+# ---------------------------------------------------------------------------
+# Degradation ladder
+# ---------------------------------------------------------------------------
+
+
+class TestLadder:
+    def _ladder(self, writer=None, **kw):
+        kw.setdefault("degraded_iters", 3)
+        kw.setdefault("bucket_cap", 2)
+        kw.setdefault("min_dwell_s", 0.0)
+        return DegradationLadder(writer=writer, **kw)
+
+    def test_pressure_steps_down_then_drains_back_up(self):
+        w = ListWriter()
+        ladder = self._ladder(writer=w)
+        rungs = [ladder.observe(queue_fill=0.9) for _ in range(3)]
+        assert rungs == [1, 2, 3]  # one rung per evaluation, down to shed
+        assert ladder.rung_name() == "shed"
+        rungs = [ladder.observe(queue_fill=0.0) for _ in range(3)]
+        assert rungs == [2, 1, 0]  # fully REVERSIBLE
+        assert ladder.rung() == NORMAL
+        events = ladder.timeline()
+        assert [e["direction"] for e in events] == (
+            ["degrade"] * 3 + ["restore"] * 3
+        )
+        assert [e["rung"] for e in events] == [
+            "capped_iters", "bucket_cap", "shed",
+            "bucket_cap", "capped_iters", "normal",
+        ]
+        for e in events:
+            assert e["kind"] == "serve" and e["event"] == "ladder"
+            assert "backend_state" in e  # stamp_serve merged it
+            assert schema.validate_record(e) == []
+        rec = ladder.record()
+        assert rec["ladder_degrades"] == 3 and rec["ladder_restores"] == 3
+
+    def test_flapping_floors_at_capped_iters_never_sheds(self):
+        ladder = self._ladder()
+        # flapping with an EMPTY queue: degrade to capped_iters, no more
+        for _ in range(5):
+            rung = ladder.observe(queue_fill=0.0, backend_state="flapping")
+        assert rung == CAPPED_ITERS
+        assert ladder.rung_name() == "capped_iters"
+        # the flap alone can never reach shed
+        assert all(
+            ladder.observe(queue_fill=0.3, backend_state="flapping") < SHED
+            for _ in range(5)
+        )
+        # backend settles, queue empty -> full restore
+        ladder.observe(queue_fill=0.0, backend_state="up")
+        assert ladder.rung() == NORMAL
+
+    def test_dwell_hysteresis_limits_transition_rate(self):
+        clock = [0.0]
+        ladder = self._ladder(min_dwell_s=10.0, clock=lambda: clock[0])
+        assert ladder.observe(queue_fill=0.9) == 1
+        assert ladder.observe(queue_fill=0.9) == 1  # dwell blocks
+        clock[0] = 11.0
+        assert ladder.observe(queue_fill=0.9) == 2
+
+    def test_from_config_resolves_defaults(self):
+        from glom_tpu.utils.config import GlomConfig, ServeConfig
+
+        cfg = GlomConfig(dim=16, levels=3, image_size=8, patch_size=2)
+        scfg = ServeConfig(max_batch=8)
+        ladder = DegradationLadder.from_config(cfg, scfg)
+        assert ladder.degraded_iters == cfg.default_iters // 2 == 3
+        assert ladder.bucket_cap == 4
+        scfg2 = ServeConfig(degraded_iters=2, degraded_max_batch=1)
+        ladder2 = DegradationLadder.from_config(cfg, scfg2)
+        assert ladder2.degraded_iters == 2 and ladder2.bucket_cap == 1
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            self._ladder(high_water=0.2, low_water=0.5)
+        with pytest.raises(ValueError):
+            self._ladder(degraded_iters=0)
+
+
+class TestBatcherLadder:
+    def test_shed_rung_sheds_new_admissions_with_the_why(self):
+        from glom_tpu.serve.batcher import DynamicBatcher, LadderShedError
+
+        w = ListWriter()
+        ladder = DegradationLadder(
+            degraded_iters=3, bucket_cap=2, min_dwell_s=0.0, writer=w
+        )
+        for _ in range(3):
+            ladder.observe(queue_fill=1.0)
+        assert ladder.rung() == SHED
+        batcher = DynamicBatcher(
+            _FakeEngine(), max_batch=2, queue_depth=4, writer=w,
+            shed_when_down=False, ladder=ladder,
+        )
+        with pytest.raises(LadderShedError) as ei:
+            batcher.submit(np.zeros((3, 8, 8), np.float32))
+        assert ei.value.detail["rung"] == "shed"
+        assert "queue_depth" in ei.value.detail
+        shed = [r for r in w.records if r.get("event") == "shed"]
+        assert shed and shed[0]["reason"] == "ladder-shed"
+        assert shed[0]["rung"] == "shed"
+        assert "queue_depth" in shed[0] and "queue_capacity" in shed[0]
+        assert schema.validate_record(shed[0]) == []
+        assert batcher.summary_record()["n_requests"] == 1
+        batcher.stop(drain=False)
+
+    def test_capped_iters_rung_dispatches_degraded(self):
+        from glom_tpu.serve.batcher import DynamicBatcher
+
+        w = ListWriter()
+        # huge dwell: the forced rung cannot restore mid-test
+        ladder = DegradationLadder(
+            degraded_iters=3, bucket_cap=2, min_dwell_s=1e9, writer=w
+        )
+        ladder.observe(queue_fill=0.9)
+        assert ladder.rung() == CAPPED_ITERS
+        engine = _FakeEngine()
+        batcher = DynamicBatcher(
+            engine, max_batch=2, max_delay_ms=1.0, queue_depth=8,
+            writer=w, shed_when_down=False, ladder=ladder,
+        ).start()
+        ticket = batcher.submit(np.zeros((3, 8, 8), np.float32))
+        _, iters_run, _ = ticket.result(timeout=10.0)
+        batcher.stop()
+        assert iters_run == 3  # the degraded budget, not the full 6
+        assert engine.calls[-1]["iters_override"] == 3
+        disp = [r for r in w.records if r.get("event") == "dispatch"]
+        assert disp[0]["rung"] == "capped_iters"
+        assert disp[0]["iters_override"] == 3
+        s = batcher.summary_record()
+        assert s["n_degraded"] == 1 and s["ladder_rung"] == "capped_iters"
+        assert schema.validate_record(s) == []
+
+    def test_serve_config_ladder_auto_resolves(self):
+        """ServeConfig(ladder=True) must never be silently two-mode: a
+        batcher built without an explicit ladder resolves one from the
+        engine's config (docs/RESILIENCE.md names this enable path)."""
+        from glom_tpu.serve.batcher import DynamicBatcher
+        from glom_tpu.utils.config import GlomConfig, ServeConfig
+
+        engine = _FakeEngine()
+        engine.cfg = GlomConfig(dim=16, levels=3, image_size=8, patch_size=2)
+        engine.scfg = ServeConfig(ladder=True, max_batch=4, buckets=(1, 2, 4))
+        batcher = DynamicBatcher(engine, shed_when_down=False)
+        assert batcher.ladder is not None
+        assert batcher.ladder.degraded_iters == 3  # default_iters // 2
+        assert batcher.ladder.bucket_cap == 2
+        batcher.stop(drain=False)
+        # explicit instances and plain configs stay untouched
+        assert DynamicBatcher(_FakeEngine(), shed_when_down=False).ladder is None
+
+    def test_queue_full_shed_carries_depth(self):
+        from glom_tpu.serve.batcher import DynamicBatcher, QueueFullError
+
+        w = ListWriter()
+        batcher = DynamicBatcher(
+            _FakeEngine(), max_batch=4, queue_depth=1,
+            shed_when_down=False, writer=w,
+        )
+        batcher.submit(np.zeros((3, 8, 8), np.float32))  # fills depth-1
+        with pytest.raises(QueueFullError) as ei:
+            batcher.submit(np.zeros((3, 8, 8), np.float32))
+        assert ei.value.detail == {"queue_depth": 1, "queue_capacity": 1}
+        shed = [r for r in w.records if r.get("event") == "shed"]
+        assert shed[0]["queue_depth"] == 1
+        assert shed[0]["reason"] == "queue-full"
+        batcher.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# NaN storm + checkpoint faults
+# ---------------------------------------------------------------------------
+
+
+class TestDataAndCheckpointFaults:
+    def test_nan_storm_poisons_exactly_the_scheduled_batches(self):
+        plan = FaultPlan(seed=0)
+        plan.register("nan-storm", at=(1,))
+        clean = [np.ones((2, 2), np.float32) for _ in range(3)]
+        out = list(nan_storm(iter(clean), plan))
+        assert not np.isnan(out[0]).any()
+        assert np.isnan(out[1]).any()
+        assert not np.isnan(out[2]).any()
+        # the source batches are never mutated in place
+        assert not np.isnan(clean[1]).any()
+
+    def test_dispatch_fault_hook_raises_on_schedule(self):
+        plan = FaultPlan(seed=0)
+        plan.register("engine-dispatch", at=(0,), fault="dispatch-error")
+        hook = dispatch_fault(plan)
+        with pytest.raises(InjectedFault):
+            hook({"bucket": 4, "n_valid": 2, "attempt": 1})
+        hook({"bucket": 4, "n_valid": 2, "attempt": 2})  # retry lands
+        [event] = plan.events()
+        assert event["fault"] == "dispatch-error"
+        assert event["bucket"] == 4 and event["attempt"] == 1
+
+    def test_truncate_newest_checkpoint_stamps_the_fault(self, tmp_path):
+        from glom_tpu.utils.checkpoint import CheckpointManager
+
+        w = ListWriter()
+        mgr = CheckpointManager(str(tmp_path), async_save=False)
+        state = {"a": np.arange(16, dtype=np.float32)}
+        mgr.save(1, state)
+        mgr.save(2, state)
+        out = truncate_newest_checkpoint(tmp_path, writer=w)
+        assert out is not None and out[0] == 2
+        [rec] = w.records
+        assert rec["kind"] == "fault" and rec["fault"] == "torn-checkpoint"
+        assert rec["step"] == 2
+        assert schema.validate_record(rec) == []
+        mgr.close()
+
+    def test_schema_v4_kinds_validate(self):
+        fault = schema.stamp({"fault": "backend-flap", "site": "s"}, kind="fault")
+        rec = schema.stamp({"action": "restart", "attempt": 1}, kind="recovery")
+        assert schema.validate_record(fault) == []
+        assert schema.validate_record(rec) == []
+        assert schema.infer_kind({"fault": "x"}) == "fault"
+        bad = schema.stamp({"note": "n"}, kind="fault")
+        assert schema.validate_record(bad)  # missing required `fault`

@@ -266,7 +266,7 @@ class RacyShedBatcher(DynamicBatcher):
         self._read_barrier = read_barrier
         self._write_barrier = write_barrier
 
-    def _shed(self, ticket, reason):
+    def _shed(self, ticket, reason, **detail):
         n = self.n_shed  # unlocked read...
         self._read_barrier.wait()  # ...held stale by every thread
         self.n_shed = n + 1  # unlocked write: all but one increment lost
