@@ -15,8 +15,18 @@ fleet bill is written against. This harness drives the real stack
      request latency collected from tickets -> p50/p95/p99 + achieved
      throughput per load point (StepTimeStats percentiles);
   4. with iters="auto": the early-exit iteration histogram — how many
-     column updates requests ACTUALLY ran vs the fixed budget — as a
-     schema-v3 "serve" record plus the mean-iters bench row.
+     column updates requests ACTUALLY ran vs the fixed budget;
+  5. with --two-tier-ab: the two-tier A/B — heterogeneous synthetic
+     traffic (easy requests converge in ~B-3 iterations, hard 100x-scale
+     requests near the budget B; --hetero sets the hard fraction) served
+     under batch-level exit (quorum 1.0, no continuations) vs two-tier
+     exit (quorum + continuation queue), emitting the per-request
+     executed-iters histogram SPLIT BY TIER and the mean-executed-iters
+     rows the reduction claim is measured by (docs/SERVING.md).
+
+--engines N fans the batcher out over N engine replicas (shared params,
+shared admission); --mesh-data/--mesh-seq route every bucket through the
+sharded shard_map forward (parallel/serve_mesh.py).
 
 Rows ride sinks.emit / bench_bootstrap like every other bench: UNMEASURED
 is an "error" record with value null (never a dead zero), every row stamps
@@ -31,21 +41,46 @@ import argparse
 import time
 
 
+def _make_engines(cfg, scfg, n_engines: int):
+    import jax
+
+    from glom_tpu.serve.engine import InferenceEngine
+
+    params = None
+    if n_engines > 1 or scfg.mesh_data > 1 or scfg.mesh_seq > 1:
+        from glom_tpu.models.core import init_glom
+
+        params = init_glom(jax.random.PRNGKey(0), cfg)
+    if scfg.mesh_data > 1 or scfg.mesh_seq > 1:
+        from glom_tpu.parallel.runtime import make_engine_meshes
+
+        meshes = make_engine_meshes(scfg, n_engines)
+    else:
+        meshes = [None] * n_engines
+    return [
+        InferenceEngine(
+            cfg, scfg, params=params, mesh=meshes[i], name=f"engine{i}"
+        )
+        for i in range(n_engines)
+    ]
+
+
 def run_sweep(cfg, scfg, label: str, *, n_requests: int, load_fracs,
-              ceiling_repeats: int) -> None:
+              ceiling_repeats: int, n_engines: int = 1) -> None:
     import numpy as np
 
     from glom_tpu.serve.batcher import DynamicBatcher, ShedError
-    from glom_tpu.serve.engine import InferenceEngine
     from glom_tpu.telemetry.sinks import StepTimeStats, emit
 
-    engine = InferenceEngine(cfg, scfg)
-    for bucket, dt in engine.warmup().items():
-        emit(
-            {"event": "warmup", "bucket": bucket,
-             "compile_time_s": round(dt, 4), "config": label},
-            kind="serve",
-        )
+    engines = _make_engines(cfg, scfg, n_engines)
+    engine = engines[0]
+    for eng in engines:
+        for bucket, dt in eng.warmup().items():
+            emit(
+                {"event": "warmup", "engine": eng.name, "bucket": bucket,
+                 "compile_time_s": round(dt, 4), "config": label},
+                kind="serve",
+            )
 
     top = max(scfg.buckets)
     rng = np.random.default_rng(0)
@@ -53,18 +88,20 @@ def run_sweep(cfg, scfg, label: str, *, n_requests: int, load_fracs,
                       ).astype(np.float32)
 
     # 2. Closed-loop ceiling: back-to-back full buckets, min over repeats
-    # (jitter only ever slows things down — bench.py's convention).
+    # (jitter only ever slows things down — bench.py's convention). One
+    # engine's ceiling; N engines admit up to N x this.
     per_batch = min(
         engine.infer(imgs, n_valid=top).latency_s
         for _ in range(ceiling_repeats)
     )
-    ceiling = top / per_batch
+    ceiling = top / per_batch * n_engines
     emit(
         {
             "metric": f"serve_throughput_ceiling ({label})",
             "value": round(ceiling, 2),
             "unit": "req/s",
             "bucket": top,
+            "engines": n_engines,
             "batch_latency_ms": round(1e3 * per_batch, 3),
         }
     )
@@ -76,7 +113,7 @@ def run_sweep(cfg, scfg, label: str, *, n_requests: int, load_fracs,
         stats.observe(0.0, is_compile=True)  # no compile phase here
         served = shed = 0
         t0 = time.perf_counter()
-        with DynamicBatcher(engine) as batcher:
+        with DynamicBatcher(engines=engines) as batcher:
             tickets = []
             for i in range(n_requests):
                 target = t0 + i / rate
@@ -163,7 +200,7 @@ def run_sweep(cfg, scfg, label: str, *, n_requests: int, load_fracs,
     if engine.iters_key == "auto":
         iters = []
         window = max(1, min(scfg.queue_depth // 2, 32))
-        with DynamicBatcher(engine) as batcher:
+        with DynamicBatcher(engines=engines) as batcher:
             for start in range(0, n_requests, window):
                 tickets = []
                 for i in range(start, min(start + window, n_requests)):
@@ -177,11 +214,7 @@ def run_sweep(cfg, scfg, label: str, *, n_requests: int, load_fracs,
                     except Exception:
                         continue
                     iters.append(iters_run)
-        budget = (
-            scfg.max_auto_iters
-            if scfg.max_auto_iters is not None
-            else cfg.default_iters
-        )
+        budget = engine.auto_budget
         if iters:
             hist: dict = {}
             for it in iters:
@@ -215,8 +248,116 @@ def run_sweep(cfg, scfg, label: str, *, n_requests: int, load_fracs,
                 },
                 kind="error",
             )
-    for rec in engine.stats_records():
-        emit(dict(rec, config=label), kind="serve")
+    for eng in engines:
+        for rec in eng.stats_records():
+            emit(dict(rec, config=label), kind="serve")
+
+
+def run_two_tier_ab(cfg, scfg, label: str, *, n_requests: int,
+                    hard_frac: float, n_engines: int = 1,
+                    quorum: float = 0.5, continuations: int = 3) -> dict:
+    """Batch-level vs two-tier exit over HETEROGENEOUS traffic: the same
+    request stream (easy gaussian images interleaved with hard 100x-scale
+    ones — far from the consensus attractor, they converge near the
+    budget) served under both exit policies, with the per-request
+    executed-iters histogram split by tier. Returns {arm: mean} so CI can
+    assert the reduction as a measured number, not a claim."""
+    import dataclasses
+
+    import numpy as np
+
+    from glom_tpu.serve.batcher import DynamicBatcher, ShedError
+    from glom_tpu.telemetry.sinks import emit
+
+    if scfg.iters != "auto":
+        emit(
+            {"note": "two-tier A/B skipped: the configured route is not "
+             "iters='auto' (no witness, no stragglers)"},
+            kind="note",
+        )
+        return {}
+    rng = np.random.default_rng(7)
+    shape = (cfg.channels, cfg.image_size, cfg.image_size)
+    n_hard = int(round(hard_frac * n_requests))
+    hard_idx = (
+        set(np.linspace(0, n_requests - 1, n_hard).astype(int).tolist())
+        if n_hard
+        else set()
+    )
+    imgs = []
+    for i in range(n_requests):
+        img = rng.normal(size=shape).astype(np.float32)
+        if i in hard_idx:
+            img *= 100.0
+        imgs.append(img)
+
+    arms = (
+        ("batch-level", dataclasses.replace(
+            scfg, exit_quorum=1.0, max_continuations=0)),
+        ("two-tier", dataclasses.replace(
+            scfg, exit_quorum=quorum, max_continuations=continuations)),
+    )
+    means: dict = {}
+    for arm, arm_scfg in arms:
+        engines = _make_engines(cfg, arm_scfg, n_engines)
+        for eng in engines:
+            eng.warmup()
+        window = max(2, min(arm_scfg.max_batch, arm_scfg.queue_depth // 2))
+        got = 0
+        with DynamicBatcher(engines=engines) as batcher:
+            for start in range(0, n_requests, window):
+                tickets = []
+                for i in range(start, min(start + window, n_requests)):
+                    try:
+                        tickets.append(batcher.submit(imgs[i]))
+                    except ShedError:
+                        continue
+                for t in tickets:
+                    try:
+                        t.result(timeout=600.0)
+                        got += 1
+                    except Exception:
+                        continue
+            summary = batcher.summary_record()
+        mean = summary.get("mean_executed_iters")
+        emit(
+            {
+                "event": "iter_histogram_tiered",
+                "arm": arm,
+                "config": label,
+                "budget": engines[0].auto_budget,
+                "quorum": arm_scfg.exit_quorum,
+                "max_continuations": arm_scfg.max_continuations,
+                "hard_frac": hard_frac,
+                "histogram_by_tier": summary["iters_histogram_by_tier"],
+                "n_continued": summary["n_continued"],
+                "n": got,
+            },
+            kind="serve",
+        )
+        if mean is None:
+            emit(
+                {
+                    "metric": f"serve_mean_executed_iters ({arm}, {label})",
+                    "value": None,
+                    "unit": "iters/request",
+                    "error": "no-requests-served",
+                    "note": f"UNMEASURED: {arm} arm served nothing",
+                },
+                kind="error",
+            )
+        else:
+            means[arm] = mean
+            emit(
+                {
+                    "metric": f"serve_mean_executed_iters ({arm}, {label})",
+                    "value": mean,
+                    "unit": "iters/request",
+                    "hard_frac": hard_frac,
+                    "served": got,
+                }
+            )
+    return means
 
 
 def main(argv=None) -> int:
@@ -225,6 +366,19 @@ def main(argv=None) -> int:
                     help="requests per load point (default: 48 TPU, 16 CPU)")
     ap.add_argument("--iters", default=None,
                     help="override the preset route: an int or 'auto'")
+    ap.add_argument("--engines", type=int, default=1, metavar="N",
+                    help="engine replicas behind one shared batcher")
+    ap.add_argument("--mesh-data", type=int, default=None, metavar="D",
+                    help="shard each engine's buckets over a D-way 'data' "
+                    "axis (parallel/serve_mesh.py)")
+    ap.add_argument("--mesh-seq", type=int, default=None, metavar="S",
+                    help="shard the patch axis over an S-way 'seq' axis")
+    ap.add_argument("--two-tier-ab", action="store_true",
+                    help="run the batch-level vs two-tier exit A/B over "
+                    "heterogeneous traffic (tiered executed-iters rows)")
+    ap.add_argument("--hetero", type=float, default=0.5, metavar="FRAC",
+                    help="fraction of HARD (slow-converging) requests in "
+                    "the two-tier A/B's synthetic traffic (default 0.5)")
     args = ap.parse_args(argv)
 
     from glom_tpu.telemetry.sinks import bench_bootstrap, emit
@@ -251,11 +405,14 @@ def main(argv=None) -> int:
         ceiling_repeats = 5
     else:
         # CPU fallback: the labelled small config — live numbers for the
-        # harness/CI, never a dead zero for the trajectory.
+        # harness/CI, never a dead zero for the trajectory. The budget is
+        # raised past the config's 2L default so the two-tier A/B's easy
+        # requests have room to converge inside it (~budget-6 at
+        # threshold 1e-3; hard 100x requests land near the budget).
         cfg = GlomConfig(dim=64, levels=3, image_size=16, patch_size=4)
         scfg = ServeConfig(
             buckets=(1, 2, 4), max_batch=4, max_delay_ms=2.0,
-            iters="auto", exit_threshold=1e-3,
+            iters="auto", exit_threshold=1e-3, max_auto_iters=16,
         )
         label = "cpu-fallback cfg"
         n_requests = args.requests or 16
@@ -266,18 +423,46 @@ def main(argv=None) -> int:
              "cpu-fallback serve config instead of recording a dead zero"},
             kind="note",
         )
+    overrides = {}
     if args.iters is not None:
-        scfg = dataclasses.replace(
-            scfg,
-            iters="auto" if args.iters == "auto" else int(args.iters),
+        overrides["iters"] = (
+            "auto" if args.iters == "auto" else int(args.iters)
         )
+    if args.mesh_data is not None:
+        overrides["mesh_data"] = args.mesh_data
+    if args.mesh_seq is not None:
+        overrides["mesh_seq"] = args.mesh_seq
+    mesh_data = overrides.get("mesh_data", scfg.mesh_data)
+    if mesh_data > 1:
+        # Buckets must divide by the data axis; drop the ones that don't
+        # (a preset ladder with a 1-bucket tail can't shard its rows) and
+        # cap the admission ceiling to what remains.
+        buckets = tuple(b for b in scfg.buckets if b % mesh_data == 0)
+        if not buckets:
+            buckets = (mesh_data,)
+        overrides["buckets"] = buckets
+        overrides["max_batch"] = min(scfg.max_batch, max(buckets))
+    if overrides:
+        scfg = dataclasses.replace(scfg, **overrides)
+    if args.engines > 1:
+        label = f"{label}, engines={args.engines}"
+    if scfg.mesh_data > 1 or scfg.mesh_seq > 1:
+        label = f"{label}, mesh={scfg.mesh_data}x{scfg.mesh_seq}"
     del jax  # imported to fail fast before any measurement if broken
     run_sweep(
         cfg, scfg, label,
         n_requests=n_requests,
         load_fracs=load_fracs,
         ceiling_repeats=ceiling_repeats,
+        n_engines=args.engines,
     )
+    if args.two_tier_ab:
+        run_two_tier_ab(
+            cfg, scfg, label,
+            n_requests=n_requests,
+            hard_frac=args.hetero,
+            n_engines=args.engines,
+        )
     return 0
 
 

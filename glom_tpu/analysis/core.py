@@ -132,6 +132,7 @@ class Context:
     registration_modules: Sequence[str] = (
         "parallel/manual.py",
         "parallel/quantized.py",
+        "parallel/serve_mesh.py",
     )
     # kind registry for the schema-emit checker (filled by the checker on
     # first use: schema.py import, else AST fallback).
@@ -213,7 +214,7 @@ def _collect_axis_vocab(modules: List[SourceModule], ctx: Context) -> None:
 def default_checkers() -> List[Checker]:
     from glom_tpu.analysis.collectives import CollectiveCoverage
     from glom_tpu.analysis.donation import DonationSafety
-    from glom_tpu.analysis.lockset import Lockset
+    from glom_tpu.analysis.lockset import LockOrder, Lockset
     from glom_tpu.analysis.purity import TracePurity
     from glom_tpu.analysis.schema_emit import SchemaEmit
 
@@ -223,6 +224,7 @@ def default_checkers() -> List[Checker]:
         DonationSafety(),
         SchemaEmit(),
         Lockset(),
+        LockOrder(),
     ]
 
 

@@ -1,5 +1,5 @@
 """lockset: shared mutable state in the threaded modules stays behind its
-lock.
+lock — and multi-lock classes acquire their locks in ONE order.
 
 Four host-side threads share mutable objects with their callers —
 DynamicBatcher's worker, BackendWatchdog's heartbeat loop, the prefetch
@@ -26,6 +26,23 @@ nested functions (the heartbeat `loop`) belong to their defining method.
 The runtime companion is tests/test_races.py — the seeded interleaving
 harness that catches what a static lockset cannot (orderings, not just
 guards).
+
+LOCK-ORDER CYCLES (the second checker here, `lock-order`): a class that
+owns TWO OR MORE locks must acquire them in one global order — thread 1
+holding A while waiting on B, thread 2 holding B while waiting on A, is a
+deadlock by construction, and unlike a data race it hangs rather than
+corrupts, so no runtime harness catches it until production does. The
+checker builds the class's lock-acquisition graph — an edge A -> B for
+every site that acquires B while (lexically, or transitively through
+self-method calls) holding A — and flags every edge on a directed cycle.
+The multi-engine DynamicBatcher (serve/batcher.py) carries the codebase's
+first real two-lock pattern (_engine_lock -> _counter_lock, documented at
+the top of that file); this checker is what keeps a future edit from
+quietly adding the reverse nesting. Blind spots, by design: orders across
+DIFFERENT objects' locks (attr names are per-class), and locks handed out
+through non-`with` acquire()/release() pairs. Self-edges (re-acquiring a
+held lock) are not reported — RLock makes them legal and the ctor-type
+distinction is one assignment away from invisible.
 """
 
 from __future__ import annotations
@@ -317,3 +334,165 @@ class Lockset(Checker):
 
         for stmt in fn.body:
             walk(stmt, False)
+
+
+class LockOrder(Checker):
+    """Directed-cycle detection over a class's lock-acquisition order."""
+
+    name = "lock-order"
+    description = (
+        "multi-lock classes acquire their locks in one global order "
+        "(a cycle in the acquisition graph is a deadlock by construction)"
+    )
+
+    def check(self, module: SourceModule, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in module.tree.body:
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(module, node))
+        return findings
+
+    def _check_class(
+        self, module: SourceModule, cls: ast.ClassDef
+    ) -> List[Finding]:
+        methods = [n for n in cls.body if isinstance(n, FUNC_NODES)]
+        init = next((m for m in methods if m.name == "__init__"), None)
+        lock_attrs, _ = Lockset()._classify_attrs(init)
+        if len(lock_attrs) < 2:
+            return []  # one lock cannot order-conflict with itself
+
+        # Per method: direct acquisitions (held-set at the acquire, lock,
+        # line), self-calls (callee, held-set at the call, line), and the
+        # set of locks acquired anywhere in the body.
+        direct: Dict[str, List[Tuple[frozenset, str, int]]] = {}
+        calls: Dict[str, List[Tuple[str, frozenset, int]]] = {}
+        acquires: Dict[str, Set[str]] = {}
+
+        def scan(fn, unit: str) -> None:
+            direct.setdefault(unit, [])
+            calls.setdefault(unit, [])
+            acquires.setdefault(unit, set())
+
+            def locks_of(with_node: ast.With) -> List[str]:
+                out = []
+                for item in with_node.items:
+                    d = dotted(item.context_expr)
+                    if d and d.startswith("self."):
+                        attr = d.split(".")[1]
+                        if attr in lock_attrs:
+                            out.append(attr)
+                return out
+
+            def walk(node: ast.AST, held: frozenset) -> None:
+                if isinstance(node, ast.With):
+                    now = set(held)
+                    for lock in locks_of(node):
+                        if lock not in now:
+                            direct[unit].append(
+                                (frozenset(now), lock, node.lineno)
+                            )
+                            acquires[unit].add(lock)
+                            now.add(lock)
+                    for child in node.body:
+                        walk(child, frozenset(now))
+                    return
+                if isinstance(node, FUNC_NODES) and node is not fn:
+                    # Nested defs run later under an unknown held-set;
+                    # scan them as their own unit reachable from here.
+                    nested = f"{unit}.{node.name}"
+                    scan(node, nested)
+                    calls[unit].append((nested, held, node.lineno))
+                    return
+                if isinstance(node, ast.Call):
+                    name = call_name(node) or ""
+                    if name.startswith("self.") and name.count(".") == 1:
+                        calls[unit].append(
+                            (name.split(".")[1], held, node.lineno)
+                        )
+                for child in ast.iter_child_nodes(node):
+                    walk(child, held)
+
+            for stmt in fn.body:
+                walk(stmt, frozenset())
+
+        for m in methods:
+            scan(m, m.name)
+
+        # Fixpoint: locks a method acquires TRANSITIVELY through
+        # self-calls (so `with A: self.helper()` where helper takes B
+        # contributes the A -> B edge).
+        changed = True
+        while changed:
+            changed = False
+            for unit, sites in calls.items():
+                for callee, _, _ in sites:
+                    extra = acquires.get(callee, set()) - acquires[unit]
+                    if extra:
+                        acquires[unit] |= extra
+                        changed = True
+
+        # The acquisition graph: held -> acquired, with one witness line
+        # per edge (first seen, deterministic scan order).
+        edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+        def add_edge(a: str, b: str, unit: str, line: int) -> None:
+            if a != b:
+                edges.setdefault((a, b), (unit, line))
+
+        for unit, sites in direct.items():
+            for held, lock, line in sites:
+                for a in sorted(held):
+                    add_edge(a, lock, unit, line)
+        for unit, sites in calls.items():
+            for callee, held, line in sites:
+                if not held:
+                    continue
+                for b in sorted(acquires.get(callee, ())):
+                    for a in sorted(held):
+                        add_edge(a, b, unit, line)
+
+        # Every edge that lies on a directed cycle is a finding: compute
+        # reachability and keep (a, b) where b reaches a.
+        adj: Dict[str, Set[str]] = {}
+        for a, b in edges:
+            adj.setdefault(a, set()).add(b)
+
+        def reaches(src: str, dst: str) -> bool:
+            seen, frontier = {src}, [src]
+            while frontier:
+                n = frontier.pop()
+                for nxt in adj.get(n, ()):
+                    if nxt == dst:
+                        return True
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        frontier.append(nxt)
+            return False
+
+        findings: List[Finding] = []
+        for (a, b), (unit, line) in sorted(
+            edges.items(), key=lambda kv: (kv[1][1], kv[0])
+        ):
+            if reaches(b, a):
+                back = edges.get((b, a))
+                where = (
+                    f"the reverse order is taken in {back[0]}() line "
+                    f"{back[1]}" if back else
+                    "the reverse order is reachable through another edge"
+                )
+                findings.append(
+                    Finding(
+                        checker=self.name,
+                        path=module.relpath,
+                        line=line,
+                        col=0,
+                        message=(
+                            f"{cls.name} acquires {b} while holding {a} "
+                            f"here, but {where} — a lock-order cycle "
+                            "deadlocks the moment two threads interleave"
+                        ),
+                        symbol=f"{cls.name}.{unit}",
+                        key=f"lock-order-{a}-{b}",
+                    )
+                )
+        return findings

@@ -76,7 +76,7 @@ def manual_supported(mesh, tp_axis: str = "hidden") -> bool:
     return mesh.shape.get(MODEL_AXIS, 1) == 1 or tp_axis == "hidden"
 
 
-def _shard_consensus_fn(cfg: GlomConfig, seq: int, sp_strategy: str):
+def shard_consensus_fn(cfg: GlomConfig, seq: int, sp_strategy: str):
     """Pick the per-shard consensus body ([b, n_loc, L, d] -> same) for the
     'seq'-manual region. None means seq is unsharded and the caller should
     use the fused consensus+update kernel instead.
@@ -348,7 +348,7 @@ def _build_local_loss(
     if not 1 <= k <= T:
         raise ValueError(f"recon_index {k} outside 1..{T}")
     compute_dtype = jnp.bfloat16 if tcfg.compute_dtype == "bfloat16" else None
-    consensus_shard = _shard_consensus_fn(cfg, seq, sp_strategy)
+    consensus_shard = shard_consensus_fn(cfg, seq, sp_strategy)
     use_pallas = tcfg.use_pallas
 
     # seq==1 with use_pallas=False has no kernel to fuse — the caller
@@ -478,7 +478,7 @@ def make_manual_forward(
     seq = mesh.shape[SEQ_AXIS]
     mp = mesh.shape.get(MODEL_AXIS, 1)
     T = iters if iters is not None else cfg.default_iters
-    consensus_shard = _shard_consensus_fn(cfg, seq, sp_strategy)
+    consensus_shard = shard_consensus_fn(cfg, seq, sp_strategy)
     if consensus_shard is None and not use_pallas:
         from glom_tpu.ops.consensus import build_local_mask, consensus_attention
 

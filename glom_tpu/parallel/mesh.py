@@ -92,6 +92,27 @@ def _make_hybrid_mesh(cfg: MeshConfig, devices: list) -> Mesh:
     return Mesh(dev_array, cfg.axis_names)
 
 
+def replica_device_groups(devices: list, per_replica: int) -> list:
+    """Partition `devices` into contiguous groups of `per_replica` — one
+    group per serving engine replica (multi-engine fan-out,
+    docs/SERVING.md). Contiguous slices keep each replica's mesh on
+    neighboring ICI links (jax.devices() enumerates torus-contiguously on
+    TPU); leftover devices beyond the last full group are unused rather
+    than silently forming an undersized replica."""
+    if per_replica < 1:
+        raise ValueError(f"per_replica {per_replica} must be >= 1")
+    n_groups = len(devices) // per_replica
+    if n_groups < 1:
+        raise ValueError(
+            f"{len(devices)} devices cannot host a {per_replica}-device "
+            "replica"
+        )
+    return [
+        devices[i * per_replica : (i + 1) * per_replica]
+        for i in range(n_groups)
+    ]
+
+
 def initialize_multihost(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,

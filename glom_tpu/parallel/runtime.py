@@ -197,6 +197,37 @@ def make_consensus_fn(
     )
 
 
+def make_engine_meshes(
+    scfg, n_engines: int, devices: Optional[list] = None
+) -> list:
+    """One serve mesh (or None for single-device engines) per engine
+    replica: the device list partitions into contiguous
+    (mesh_data * mesh_seq)-sized groups (parallel/mesh.py
+    replica_device_groups), each group hosting one InferenceEngine behind
+    the shared-admission batcher (multi-engine fan-out, docs/SERVING.md).
+    Lives here because it is mesh + spec RESOLUTION, the seam ROADMAP
+    item 5's unified runtime extracts — a new serve parallelism should
+    land in one place, not per caller."""
+    import jax as _jax
+
+    from glom_tpu.parallel.mesh import replica_device_groups
+    from glom_tpu.parallel.serve_mesh import make_serve_mesh
+
+    if n_engines < 1:
+        raise ValueError(f"n_engines {n_engines} must be >= 1")
+    per = scfg.mesh_data * scfg.mesh_seq
+    if per == 1:
+        return [None] * n_engines
+    devices = devices if devices is not None else _jax.devices()
+    groups = replica_device_groups(devices, per)
+    if len(groups) < n_engines:
+        raise ValueError(
+            f"{len(devices)} devices host only {len(groups)} "
+            f"{per}-device engine replicas; {n_engines} requested"
+        )
+    return [make_serve_mesh(scfg, g) for g in groups[:n_engines]]
+
+
 class DistributedTrainer:
     """Sharded trainer over an explicit device mesh.
 

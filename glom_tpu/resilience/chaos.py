@@ -56,11 +56,15 @@ def build_parser() -> argparse.ArgumentParser:
         "(docs/RESILIENCE.md)",
     )
     p.add_argument(
-        "--scenario", choices=["kill-train", "preempt-train"],
+        "--scenario", choices=["kill-train", "preempt-train", "kill-serve"],
         default="kill-train",
         help="kill-train = SIGKILL mid-run (uncatchable; resume must come "
         "from the last committed checkpoint); preempt-train = SIGTERM (the "
-        "grace path: deadline-bounded checkpoint + flight dump, then resume)",
+        "grace path: deadline-bounded checkpoint + flight dump, then "
+        "resume); kill-serve = permanently fail one engine of a "
+        "multi-engine serve run (seeded dispatch_fault) and require its "
+        "queued tickets to re-dispatch to a sibling with a reconciling "
+        "evidence trail",
     )
     p.add_argument("--dir", required=True, help="scenario working directory")
     p.add_argument("--preset", default="mnist")
@@ -74,6 +78,15 @@ def build_parser() -> argparse.ArgumentParser:
         "--timeout", type=float, default=600.0,
         help="per-phase deadline in seconds (a hang is a FAILURE: the whole "
         "point is that nothing in the stack may hang)",
+    )
+    p.add_argument(
+        "--requests", type=int, default=12, metavar="N",
+        help="kill-serve: synthetic requests to serve across the kill",
+    )
+    p.add_argument(
+        "--engines", type=int, default=2, metavar="N",
+        help="kill-serve: engine replicas behind the shared batcher "
+        "(engine 0 is the one killed; >= 2 so a sibling exists)",
     )
     return p
 
@@ -136,7 +149,135 @@ def _lint(paths: List[Path]) -> List[str]:
     return errors
 
 
+def run_kill_serve(args) -> int:
+    """The serve-side kill: engine 0 of a multi-engine micro-server run is
+    permanently failed via the seeded dispatch_fault seam (the in-process
+    analog of a dead replica — a real SIGKILL would take every engine in
+    the process with it), and the evidence trail must prove the hand-off:
+
+      * the run COMPLETES with rc 0 — every request served by a sibling;
+      * the injected faults are stamped ("fault" events at the
+        engine0-dispatch site), so recovery reconciles against ground
+        truth, not luck;
+      * engine_failover events re-queued the dead engine's batches and an
+        engine_dead event marks it; the summary shows engine0 with zero
+        completed dispatches and the siblings carrying the load;
+      * ticket conservation holds across the re-dispatch: n_served ==
+        n_submitted, n_failed == 0 — no ticket lost, none double-served.
+    """
+    workdir = Path(args.dir)
+    workdir.mkdir(parents=True, exist_ok=True)
+    paths = {
+        "metrics": workdir / "serve_metrics.jsonl",
+        "log": workdir / "serve_run.log",
+    }
+    if args.engines < 2:
+        _emit(
+            {"error": "no-sibling-engine", "value": None,
+             "note": f"--engines {args.engines}: kill-serve needs a "
+             "sibling for the dead engine's tickets to land on"},
+            kind="error",
+        )
+        return 1
+    paths["metrics"].unlink(missing_ok=True)
+    cmd = [
+        sys.executable, "-u", "-m", "glom_tpu.serve",
+        "--preset", args.preset,
+        "--synthetic", str(args.requests),
+        "--engines", str(args.engines),
+        "--kill-engine", "0:after=0",
+        "--dispatch-retries", "0",
+        "--iters", "auto",
+        "--buckets", "1,2,4",
+        "--max-batch", "4",
+        "--out", str(paths["metrics"]),
+    ]
+    _note("chaos kill-serve: launching micro-server", cmd=" ".join(cmd),
+          workdir=str(workdir))
+    _emit(
+        {"fault": "engine-dead", "site": "engine0-dispatch",
+         "scenario": "kill-serve", "engines": args.engines},
+        kind="fault",
+    )
+    proc = _spawn(cmd, paths["log"])
+    try:
+        rc = proc.wait(timeout=args.timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait(timeout=30.0)
+        _emit(
+            {"error": "serve-hung", "value": None,
+             "note": f"serve worker exceeded {args.timeout}s — a hang IS "
+             "the failure mode this harness exists to catch"},
+            kind="error",
+        )
+        return 1
+    failures: List[str] = []
+    if rc != 0:
+        failures.append(
+            f"serve worker rc={rc} (a dead engine must not fail the run "
+            f"while siblings live); see {paths['log']}"
+        )
+    recs = _records(paths["metrics"])
+    responses = [r for r in recs if r.get("event") == "response"]
+    ok = [r for r in responses if r.get("ok")]
+    if len(ok) != args.requests:
+        failures.append(
+            f"{len(ok)}/{args.requests} requests served ok "
+            f"({len(responses)} responses)"
+        )
+    faults = [
+        r for r in recs
+        if r.get("kind") == "fault" and r.get("site") == "engine0-dispatch"
+    ]
+    if not faults:
+        failures.append("no stamped fault events at engine0-dispatch — "
+                        "the injection itself left no ground truth")
+    failovers = [r for r in recs if r.get("event") == "engine_failover"]
+    dead = [r for r in recs if r.get("event") == "engine_dead"]
+    if not failovers:
+        failures.append("no engine_failover event: the dead engine's "
+                        "batches were never handed to a sibling")
+    if not any(r.get("engine") == "engine0" for r in dead):
+        failures.append("engine0 was never marked dead")
+    summaries = [r for r in recs if r.get("event") == "summary"]
+    if not summaries:
+        failures.append("no serve summary record")
+    else:
+        s = summaries[-1]
+        if s.get("n_served") != args.requests or s.get("n_failed"):
+            failures.append(
+                "ticket conservation broken across re-dispatch: "
+                f"n_served={s.get('n_served')} n_failed={s.get('n_failed')} "
+                f"n_submitted={s.get('n_submitted')} "
+                f"(want n_served == {args.requests}, n_failed == 0)"
+            )
+        eng0 = (s.get("engines") or {}).get("engine0", {})
+        if eng0.get("alive") or eng0.get("dispatches"):
+            failures.append(
+                f"engine0 state does not reconcile with the kill: {eng0}"
+            )
+    failures.extend(_lint([paths["metrics"]]))
+    summary = {
+        "event": "chaos-summary",
+        "scenario": "kill-serve",
+        "ok": not failures,
+        "requests": args.requests,
+        "n_fault_events": len(faults),
+        "n_failovers": len(failovers),
+        "failures": failures[:10],
+    }
+    _emit(summary, kind="summary")
+    if failures:
+        for f in failures:
+            print(f"CHAOS FAIL: {f}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def run_scenario(args) -> int:
+    if args.scenario == "kill-serve":
+        return run_kill_serve(args)
     workdir = Path(args.dir)
     paths = {
         "ckpt": workdir / "ckpt",

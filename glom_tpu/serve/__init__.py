@@ -4,15 +4,21 @@ The training stack ends at a checkpoint; this subsystem is what stands
 between that checkpoint and traffic (docs/SERVING.md). Layers:
 
     engine     — InferenceEngine: params + one AOT-compiled forward per
-                 (bucket, iters-route) signature, explicit warmup(),
-                 donated input buffers, per-bucket latency histograms
+                 (bucket, iters-route, warm/cold) signature, explicit
+                 warmup(), donated input buffers, per-bucket latency
+                 histograms; ServeConfig.mesh_data/.mesh_seq route every
+                 signature through the sharded (data x seq) shard_map
+                 forward (parallel/serve_mesh.py)
     batcher    — DynamicBatcher: bounded request queue, max_batch /
-                 max_delay_ms admission, pad-to-bucket with mask, and the
-                 fast-fail shed path wired to the backend watchdog
-    early_exit — glom_forward_auto: lax.while_loop over column updates
-                 with the per-level consensus-agreement delta as the
-                 stopping witness (iters="auto"; static max_iters keeps
-                 shapes fixed)
+                 max_delay_ms admission, pad-to-bucket with mask, the
+                 continuation queue (two-tier stragglers re-bucketed
+                 warm), multi-engine fan-out with dead-engine failover,
+                 and the fast-fail shed path wired to the watchdog
+    early_exit — glom_forward_auto / glom_forward_tiered: lax.while_loop
+                 over column updates with the consensus-agreement delta
+                 as the stopping witness (iters="auto"; the tiered form
+                 is per-row + quorum — static max_iters keeps shapes
+                 fixed either way)
     cli        — `python -m glom_tpu.serve`: the stdin/file micro-server
 
 Re-exports are LAZY (PEP 562, same pattern as glom_tpu/telemetry): the
@@ -29,8 +35,10 @@ _EXPORTS = {
     "QueueFullError": "batcher",
     "ShedError": "batcher",
     "Ticket": "batcher",
+    "TieredAutoResult": "early_exit",
     "batch_agreement": "early_exit",
     "glom_forward_auto": "early_exit",
+    "glom_forward_tiered": "early_exit",
     "masked_level_agreement": "early_exit",
     "emit_serve": "events",
     "stamp_serve": "events",

@@ -1,4 +1,5 @@
-"""Host-side dynamic batching: bounded queue, bucket padding, shed path.
+"""Host-side dynamic batching: bounded queue, bucket padding, continuation
+queue, multi-engine fan-out, shed path.
 
 TPU serving economics are batch economics: one column-update of a batch-8
 bucket costs barely more than batch-1 (the MXU is latency-bound at tiny
@@ -15,7 +16,38 @@ classic admission policy does it with two knobs:
 Gathered requests pad up to the smallest admitting bucket (the engine only
 ever sees precompiled shapes — no mid-traffic recompiles) with a validity
 mask, so pad rows neither reach callers nor vote on the consensus
-early-exit witness (serve/early_exit.masked_level_agreement).
+early-exit witness (serve/early_exit).
+
+TWO-TIER EARLY EXIT (ServeConfig.max_continuations > 0, auto route): a
+bucket exits when its fastest quorum converges (exit_quorum); rows still
+unconverged at exit are STRAGGLERS — their warm column state re-buckets
+into the continuation queue as one group per dispatch, carrying the
+remaining per-request budget, and workers drain that queue ahead of fresh
+traffic (stragglers are the oldest requests in the system). Per-request
+early exit wins without dynamic shapes: every compiled program still has
+a static bucket and budget; what varies is which program a request's NEXT
+hop runs. Ticket conservation holds across hops — a request resolves
+exactly once, with the SUM of its dispatches' executed iterations.
+
+MULTI-ENGINE FAN-OUT (engines=[...]): one worker thread per engine pulls
+from the SHARED admission queue — least-queue-depth dispatch by
+construction (an idle engine takes the next batch; a busy one doesn't
+pull). A dispatch failure on one engine re-dispatches its requests to the
+siblings (bounded per-request redispatch budget), and an engine whose
+failures persist is marked DEAD — its worker exits, its queued work
+drains to the survivors, and the stamped engine_failover/engine_dead
+events let a chaos run reconcile the hand-off (docs/RESILIENCE.md,
+kill-serve). The PR 6 ladder/retry machinery operates PER ENGINE: each
+engine keeps its own RetryPolicy, and with ServeConfig.ladder each gets
+its own DegradationLadder (admission sheds only when every live engine's
+ladder is on its shed rung).
+
+LOCK ORDER (the lock-ORDER cycle checker in glom_tpu/analysis/lockset.py
+gates this file): `_engine_lock` is always acquired BEFORE
+`_counter_lock`, never the reverse — the per-engine dispatch bookkeeping
+and the global conservation counters must move together (a summary that
+read one without the other could see served work on a dead engine), so
+the counter update nests inside the engine-state update.
 
 Failure discipline (the PR 2/3 lesson — a wedged backend must fail FAST
 and leave evidence, never hang):
@@ -26,16 +58,14 @@ and leave evidence, never hang):
     (queue depth/capacity, ladder rung);
   * when the global backend watchdog says "down", submissions and any
     already-gathered requests fail fast with BackendDownError, and each
-    emits a schema "error" record carrying the machine-readable cause —
-    the serving analog of sinks.bench_bootstrap's UNMEASURED record. A
+    emits a schema "error" record carrying the machine-readable cause. A
     FLAPPING backend is NOT down: it keeps serving (degraded via the
     ladder; dispatch failures retry per the engine's RetryPolicy);
-  * a dispatch exception fails ONLY that batch's requests (each ticket
-    re-raises it) and the worker keeps serving;
-  * with a DegradationLadder attached (glom_tpu/resilience/ladder.py),
-    pressure and flap step serving DOWN one reversible rung at a time —
-    capped iterations, then capped batches, then (last) shed — so
-    shedding is the floor of the ladder, not the only move.
+  * a dispatch exception with no sibling engine fails ONLY that batch's
+    requests (each ticket re-raises it) and the worker keeps serving;
+  * with a DegradationLadder attached, pressure and flap step serving
+    DOWN one reversible rung at a time — shedding is the floor of the
+    ladder, not the only move.
 
 Host phases ride tracing.spans (SERVE_PHASES: serve_enqueue, serve_batch,
 serve_dispatch, serve_fetch), aggregated per phase and drained by
@@ -108,7 +138,9 @@ class Ticket:
     def result(self, timeout: Optional[float] = None):
         """(levels [n, L, d], iters_run, latency_s) for THIS request, or
         re-raises the failure. latency_s is submit-to-resolve wall time —
-        queueing + gathering + dispatch + fetch, the number the user felt."""
+        queueing + gathering + dispatch(es) + fetch, the number the user
+        felt; iters_run is the TOTAL executed column iterations across
+        every hop the request rode (initial dispatch + continuations)."""
         if not self._done.wait(timeout):
             raise TimeoutError(
                 f"request {self.request_id} not served within {timeout}s"
@@ -119,11 +151,28 @@ class Ticket:
 
 
 class _Request:
-    __slots__ = ("img", "ticket")
+    __slots__ = ("img", "ticket", "redispatches")
 
     def __init__(self, img: np.ndarray, ticket: Ticket):
         self.img = img
         self.ticket = ticket
+        self.redispatches = 0  # engine-failover hand-offs so far
+
+
+class _Continuation:
+    """One straggler's warm state between hops: the image (tokens are
+    recomputed — they are noise vs one iteration), the carried [n, L, d]
+    column state, and the budget accounting."""
+
+    __slots__ = ("img", "levels", "ticket", "executed", "hops", "redispatches")
+
+    def __init__(self, img, levels, ticket, executed: int, hops: int):
+        self.img = img
+        self.levels = levels
+        self.ticket = ticket
+        self.executed = executed  # column iterations run so far
+        self.hops = hops          # continuation dispatches so far
+        self.redispatches = 0
 
 
 def _backend_down() -> bool:
@@ -133,29 +182,43 @@ def _backend_down() -> bool:
 
 
 class DynamicBatcher:
-    """The admission scheduler in front of an InferenceEngine.
+    """The admission scheduler in front of one or more InferenceEngines.
 
     Lifecycle: use as a context manager (or start()/stop()). submit() is
-    thread-safe and returns a Ticket; a single worker thread gathers,
-    pads, and dispatches. `engine` needs .infer(imgs, n_valid) ->
-    ServeResult and .pick_bucket(n) — the tests drive the policy with a
-    fake engine, no device required.
+    thread-safe and returns a Ticket; one worker thread PER ENGINE
+    gathers, pads, and dispatches from the shared queue. `engine` needs
+    .infer(imgs, n_valid) -> ServeResult and .pick_bucket(n) — the tests
+    drive the policy with a fake engine, no device required. Pass
+    `engines=[...]` (or a list as the first argument) for multi-engine
+    fan-out behind one admission queue.
     """
 
     def __init__(
         self,
-        engine,
+        engine=None,
         *,
+        engines: Optional[List] = None,
         max_batch: Optional[int] = None,
         max_delay_ms: Optional[float] = None,
         queue_depth: Optional[int] = None,
         writer=None,
         shed_when_down: bool = True,
         ladder=None,
+        engine_fail_threshold: int = 2,
+        max_redispatch: int = 2,
         clock=time.perf_counter,
     ):
-        scfg = getattr(engine, "scfg", None)
-        self.engine = engine
+        if (engine is None) == (engines is None):
+            raise ValueError("exactly one of engine= or engines=[...]")
+        if engines is None:
+            engines = list(engine) if isinstance(engine, (list, tuple)) else [
+                engine
+            ]
+        if not engines:
+            raise ValueError("engines must be non-empty")
+        self.engines = list(engines)
+        self.engine = self.engines[0]  # single-engine compatibility alias
+        scfg = getattr(self.engine, "scfg", None)
         self.max_batch = (
             max_batch if max_batch is not None
             else (scfg.max_batch if scfg else 8)
@@ -170,34 +233,72 @@ class DynamicBatcher:
         )
         if self.max_batch < 1:
             raise ValueError(f"max_batch {self.max_batch} must be >= 1")
+        if engine_fail_threshold < 1:
+            raise ValueError(
+                f"engine_fail_threshold {engine_fail_threshold} must be >= 1"
+            )
         self.writer = writer
         self.shed_when_down = shed_when_down
-        # Degradation ladder (glom_tpu/resilience/ladder.py) — opt-in:
-        # when attached, the worker feeds it queue pressure + backend
-        # state each cycle, a capped_iters-or-worse rung dispatches with
+        self.engine_fail_threshold = engine_fail_threshold
+        self.max_redispatch = max_redispatch
+        # Degradation ladders (glom_tpu/resilience/ladder.py) — PER
+        # ENGINE: each engine's worker feeds its own ladder queue pressure
+        # + backend state, a capped_iters-or-worse rung dispatches with
         # the degraded fixed budget, a bucket_cap-or-worse rung gathers
-        # smaller batches, and the shed rung fails NEW admissions fast
-        # (the last resort, after the cheaper modes). ladder=None
-        # RESOLVES from the engine's ServeConfig (scfg.ladder=True builds
-        # one — a config that asks for the ladder must never be silently
-        # two-mode); pass an explicit instance to own the knobs.
-        if (
-            ladder is None
-            and scfg is not None
-            and getattr(scfg, "ladder", False)
-            and getattr(engine, "cfg", None) is not None
-        ):
-            from glom_tpu.resilience.ladder import DegradationLadder
+        # smaller batches, and admission sheds only when EVERY live
+        # engine's ladder is on its shed rung. ladder=None RESOLVES from
+        # each engine's ServeConfig (scfg.ladder=True builds one — a
+        # config that asks for the ladder must never be silently
+        # two-mode); pass an explicit instance (single-engine only) to
+        # own the knobs.
+        self._ladders = {}
+        for i, eng in enumerate(self.engines):
+            name = self._ename(eng, i)
+            escfg = getattr(eng, "scfg", None)
+            if ladder is not None:
+                if len(self.engines) > 1:
+                    raise ValueError(
+                        "pass ladder= with a single engine only; "
+                        "multi-engine ladders resolve per engine from "
+                        "ServeConfig.ladder"
+                    )
+                self._ladders[name] = ladder
+            elif (
+                escfg is not None
+                and getattr(escfg, "ladder", False)
+                and getattr(eng, "cfg", None) is not None
+            ):
+                from glom_tpu.resilience.ladder import DegradationLadder
 
-            ladder = DegradationLadder.from_config(
-                engine.cfg, scfg, writer=writer
-            )
-        self.ladder = ladder
+                self._ladders[name] = DegradationLadder.from_config(
+                    eng.cfg, escfg, writer=writer
+                )
+            else:
+                self._ladders[name] = None
+        self.ladder = self._ladders[self._ename(self.engines[0], 0)]
         self._clock = clock
         self._q: queue.Queue = queue.Queue(maxsize=depth)
+        # Continuation queue: one GROUP (list of _Continuation sharing a
+        # source dispatch, hence a remaining budget) per entry. Unbounded:
+        # its population is bounded by admitted-but-unresolved requests,
+        # which the admission queue already bounds.
+        self._cont_q: queue.Queue = queue.Queue()
         self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
+        self._threads: List[threading.Thread] = []
         self.spans = SpanAggregator()
+        # Per-engine dispatch bookkeeping. LOCK ORDER: _engine_lock
+        # before _counter_lock (see module docstring) — the nested
+        # acquisition in _note_dispatch/_note_failure is the pattern the
+        # lock-order checker verifies stays acyclic.
+        self._engine_lock = threading.Lock()
+        self._engine_state = {
+            self._ename(eng, i): {
+                "alive": True,
+                "dispatches": 0,
+                "consecutive_failures": 0,
+            }
+            for i, eng in enumerate(self.engines)
+        }
         # Counters for the end-of-run summary record. n_requests counts
         # every submit() ATTEMPT (n_submitted only the admitted ones), so
         # chaos runs can assert conservation: every request is served,
@@ -207,54 +308,82 @@ class DynamicBatcher:
         self.n_served = 0
         self.n_shed = 0
         self.n_failed = 0
-        self.n_degraded = 0  # requests served on a capped-iters rung
+        self.n_degraded = 0   # requests served on a capped-iters rung
+        self.n_continued = 0  # straggler re-bucket hops taken
+        self.n_redispatched = 0  # engine-failover hand-offs
         self.dispatches: List[dict] = []  # one dict per dispatched batch
+        # Per-request accounting, maintained INCREMENTALLY (a long-running
+        # server must not retain one record per resolved request):
+        # histogram of total executed iters, the same split by tier
+        # (0 = resolved by the first dispatch, k = after k continuation
+        # hops), and the running sum for the mean — the measurement units
+        # of the two-tier win.
+        self._iters_hist: dict = {}
+        self._iters_hist_by_tier: dict = {}
+        self._iters_total = 0
         self._counter_lock = threading.Lock()
         self._seq = 0
+
+    @staticmethod
+    def _ename(eng, i: int) -> str:
+        return getattr(eng, "name", None) or f"engine{i}"
 
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "DynamicBatcher":
-        if self._thread is None:
+        if not self._threads:
             self._stop.clear()
-            self._thread = threading.Thread(
-                target=self._worker, name="glom-serve-batcher", daemon=True
-            )
-            self._thread.start()
+            for i, eng in enumerate(self.engines):
+                name = self._ename(eng, i)
+                t = threading.Thread(
+                    target=self._worker,
+                    args=(eng, name),
+                    name=f"glom-serve-batcher-{name}",
+                    daemon=True,
+                )
+                t.start()
+                self._threads.append(t)
         return self
 
     def stop(self, drain: bool = True) -> None:
-        """Stop the worker. drain=True serves what is already queued first
-        (the graceful path); False fails queued requests FAST — the queue
-        is drained and every ticket failed BEFORE waiting on the worker,
-        so at most the one in-flight batch dispatches after the call.
-        Also safe on a never-started batcher: queued tickets are failed
-        (drain=False) — there is no worker to ever resolve them."""
+        """Stop the workers. drain=True serves what is already queued
+        first (the graceful path; stragglers resolve with their current
+        state rather than opening new continuation hops); False fails
+        queued requests FAST — both queues are drained and every ticket
+        failed BEFORE waiting on the workers, so at most the in-flight
+        batches dispatch after the call. Also safe on a never-started
+        batcher: queued tickets are failed (drain=False) — there is no
+        worker to ever resolve them."""
         self._stop.set()
         if not drain:
             self._fail_queued()
-        if self._thread is not None:
-            # drain=True: the worker exits once the stop flag is set AND
-            # the queue is empty — queued work is served on the way out.
-            self._thread.join(timeout=60.0)
-            self._thread = None
+        for t in self._threads:
+            # drain=True: a worker exits once the stop flag is set AND
+            # both queues are empty — queued work is served on the way out.
+            t.join(timeout=60.0)
+        self._threads = []
         # Whatever is STILL queued (drain=True with a dead/timed-out
         # worker, or a never-started batcher) can no longer resolve.
         self._fail_queued()
 
     def _fail_queued(self) -> None:
         while True:
+            got = None
             try:
-                req = self._q.get_nowait()
+                got = [self._q.get_nowait()]
             except queue.Empty:
-                return
+                try:
+                    got = self._cont_q.get_nowait()  # a continuation group
+                except queue.Empty:
+                    return
             # Counted as FAILED: these tickets were admitted (n_submitted
             # incremented) and can no longer resolve — without the count,
             # summary_record()'s conservation (n_served + n_shed +
             # n_failed == n_requests) silently loses them.
-            with self._counter_lock:
-                self.n_failed += 1
-            req.ticket._fail(ShedError("batcher stopped"))
+            for item in got:
+                with self._counter_lock:
+                    self.n_failed += 1
+                item.ticket._fail(ShedError("batcher stopped"))
 
     def __enter__(self) -> "DynamicBatcher":
         return self.start()
@@ -264,13 +393,18 @@ class DynamicBatcher:
 
     # -- submission --------------------------------------------------------
 
+    def _alive_engines(self) -> List[str]:
+        with self._engine_lock:
+            return [n for n, st in self._engine_state.items() if st["alive"]]
+
     def submit(self, img) -> Ticket:
         """Enqueue one [c, H, W] request. Sheds immediately (raises) when
-        the queue is full, the backend is down, or the degradation ladder
-        is on its shed rung — admission never blocks the caller. Requests
-        submitted before start() queue up and are served once the worker
-        runs; stop() fails whatever can no longer resolve, so a ticket is
-        never silently stranded."""
+        the queue is full, the backend is down, every engine is dead, or
+        every live engine's degradation ladder is on its shed rung —
+        admission never blocks the caller. Requests submitted before
+        start() queue up and are served once the workers run; stop()
+        fails whatever can no longer resolve, so a ticket is never
+        silently stranded."""
         with self._counter_lock:
             self._seq += 1
             rid = self._seq
@@ -285,20 +419,34 @@ class DynamicBatcher:
                     "request shed (fast-fail, never a hang)",
                     **detail,
                 )
-            if self.ladder is not None:
+            alive = self._alive_engines()
+            if self._threads and not alive:
+                detail = self._pressure()
+                self._shed(ticket, "no-live-engine", **detail)
+                raise ShedError(
+                    "every engine is dead (failover exhausted); request "
+                    "shed fast rather than stranded",
+                    **detail,
+                )
+            live_ladders = [
+                self._ladders[n] for n in (alive or self._ladders)
+                if self._ladders.get(n) is not None
+            ]
+            if live_ladders:
                 from glom_tpu.resilience.ladder import SHED
 
-                if self.ladder.rung() >= SHED:
+                if min(l.rung() for l in live_ladders) >= SHED:
                     detail = self._pressure()
                     self._shed(ticket, "ladder-shed", **detail)
                     raise LadderShedError(
-                        "degradation ladder at its shed rung (every "
-                        "cheaper serving mode exhausted); retry later",
+                        "degradation ladder at its shed rung on every "
+                        "live engine (every cheaper serving mode "
+                        "exhausted); retry later",
                         **detail,
                     )
             img = np.asarray(img, np.float32)
             # Count the admission BEFORE the put (rolled back on a full
-            # queue): the instant the request is enqueued the worker may
+            # queue): the instant the request is enqueued a worker may
             # serve it, and n_served must never exceed n_submitted even
             # transiently (the race harness caught both orderings that
             # counted after the put as off-by-ones).
@@ -316,18 +464,18 @@ class DynamicBatcher:
                     "backpressure — retry later",
                     **detail,
                 ) from None
-            if self._stop.is_set() and (
-                self._thread is None or not self._thread.is_alive()
+            if self._stop.is_set() and not any(
+                t.is_alive() for t in self._threads
             ):
                 # Race with stop(): the put landed after the (dead or
-                # never-started) worker's final drain — no one will ever
+                # never-started) workers' final drain — no one will ever
                 # dispatch it, so fail it here rather than strand the
                 # ticket. A LIVE draining worker still owns the queue.
                 self._fail_queued()
                 raise ShedError("batcher stopped")
         return ticket
 
-    def _pressure(self) -> dict:
+    def _pressure(self, engine_name: Optional[str] = None) -> dict:
         """The machine-readable WHY of a shed/ladder decision: queue depth
         and capacity, plus the ladder rung when one is attached — these
         fields ride both the stamped record and the raised exception
@@ -335,9 +483,13 @@ class DynamicBatcher:
         detail = {
             "queue_depth": self._q.qsize(),
             "queue_capacity": self._q.maxsize,
+            "continuations_queued": self._cont_q.qsize(),
         }
-        if self.ladder is not None:
-            detail["rung"] = self.ladder.rung_name()
+        ladder = self._ladders.get(
+            engine_name or self._ename(self.engines[0], 0)
+        )
+        if ladder is not None:
+            detail["rung"] = ladder.rung_name()
         return detail
 
     def _shed(self, ticket: Ticket, reason: str, **detail) -> None:
@@ -346,6 +498,7 @@ class DynamicBatcher:
         exc_type = {
             "backend-down": BackendDownError,
             "ladder-shed": LadderShedError,
+            "no-live-engine": ShedError,
         }.get(reason, QueueFullError)
         ticket._fail(exc_type(reason, **detail))
         # The shed decision itself is a "serve" event carrying the why
@@ -372,33 +525,36 @@ class DynamicBatcher:
                 kind="error",
             )
 
-    # -- the worker --------------------------------------------------------
+    # -- the workers -------------------------------------------------------
 
-    def _ladder_observe(self) -> None:
-        """Feed the ladder one (pressure, backend) observation. Runs every
-        worker cycle — INCLUDING idle ones, so a drained queue steps the
-        ladder back up even when no traffic arrives to dispatch."""
-        if self.ladder is None:
+    def _ladder_observe(self, engine_name: str) -> None:
+        """Feed this engine's ladder one (pressure, backend) observation.
+        Runs every worker cycle — INCLUDING idle ones, so a drained queue
+        steps the ladder back up even when no traffic arrives to
+        dispatch."""
+        ladder = self._ladders.get(engine_name)
+        if ladder is None:
             return
         from glom_tpu.telemetry.watchdog import backend_record
 
         fill = self._q.qsize() / max(1, self._q.maxsize)
-        self.ladder.observe(
+        ladder.observe(
             queue_fill=fill,
             backend_state=backend_record().get("backend_state", "unknown"),
         )
 
-    def _gather(self) -> List[_Request]:
+    def _gather(self, engine_name: str) -> List[_Request]:
         """Block for the first request, then gather until max_batch or the
         first request ages past max_delay — the two-knob admission. A
         ladder at bucket_cap or worse gathers smaller batches: smaller,
         faster dispatches drain a backed-up queue in bounded bites."""
         max_batch = self.max_batch
-        if self.ladder is not None:
+        ladder = self._ladders.get(engine_name)
+        if ladder is not None:
             from glom_tpu.resilience.ladder import BUCKET_CAP
 
-            if self.ladder.rung() >= BUCKET_CAP:
-                max_batch = min(max_batch, self.ladder.bucket_cap)
+            if ladder.rung() >= BUCKET_CAP:
+                max_batch = min(max_batch, ladder.bucket_cap)
         try:
             first = self._q.get(timeout=0.05)
         except queue.Empty:
@@ -415,46 +571,204 @@ class DynamicBatcher:
                 break
         return batch
 
-    def _worker(self) -> None:
-        while not (self._stop.is_set() and self._q.empty()):
-            self._ladder_observe()
+    def _worker(self, engine, engine_name: str) -> None:
+        while not (
+            self._stop.is_set()
+            and self._q.empty()
+            and self._cont_q.empty()
+        ):
+            with self._engine_lock:
+                if not self._engine_state[engine_name]["alive"]:
+                    return  # dead engine: its queued work drains to siblings
+            self._ladder_observe(engine_name)
+            # Continuations first: stragglers are the OLDEST requests in
+            # the system, and their groups are already bucket-shaped.
+            try:
+                group = self._cont_q.get_nowait()
+            except queue.Empty:
+                group = None
+            if group is not None:
+                self._dispatch(engine, engine_name, group, warm=True)
+                continue
             with span("serve_batch", aggregator=self.spans):
-                batch = self._gather()
+                batch = self._gather(engine_name)
             if not batch:
                 continue
-            self._dispatch(batch)
+            self._dispatch(engine, engine_name, batch, warm=False)
 
-    def _dispatch(self, batch: List[_Request]) -> None:
+    # -- dispatch ----------------------------------------------------------
+
+    def _note_dispatch(self, engine_name: str, rec: dict, resolved: List[dict],
+                       n_served: int, n_degraded: int, n_continued: int) -> None:
+        """Per-engine + global bookkeeping for one successful dispatch,
+        under BOTH locks in the documented order — the per-engine
+        dispatch count and the conservation counters must be mutually
+        consistent for summary_record()'s snapshot."""
+        with self._engine_lock:  # LOCK ORDER: _engine_lock -> _counter_lock
+            st = self._engine_state[engine_name]
+            st["dispatches"] += 1
+            st["consecutive_failures"] = 0
+            with self._counter_lock:
+                self.n_served += n_served
+                self.n_degraded += n_degraded
+                self.n_continued += n_continued
+                self.dispatches.append(rec)
+                for r in resolved:
+                    key = str(r["iters"])
+                    self._iters_hist[key] = self._iters_hist.get(key, 0) + 1
+                    tier = self._iters_hist_by_tier.setdefault(
+                        str(r["tier"]), {}
+                    )
+                    tier[key] = tier.get(key, 0) + 1
+                    self._iters_total += r["iters"]
+
+    def _note_failure(self, engine_name: str) -> dict:
+        """One dispatch failure's engine-state transition; returns a
+        snapshot {alive, siblings} the failover decision reads."""
+        with self._engine_lock:  # LOCK ORDER: _engine_lock -> _counter_lock
+            st = self._engine_state[engine_name]
+            st["consecutive_failures"] += 1
+            if (
+                st["consecutive_failures"] >= self.engine_fail_threshold
+                and len(self.engines) > 1
+            ):
+                st["alive"] = False
+            siblings = [
+                n
+                for n, s in self._engine_state.items()
+                if n != engine_name and s["alive"]
+            ]
+            return {"alive": st["alive"], "siblings": siblings}
+
+    def _requeue(self, items, warm: bool) -> int:
+        """Hand a failed dispatch's requests to the sibling engines via
+        the shared queues; tickets whose redispatch budget is exhausted
+        fail instead (bounded — a poison batch cannot ping-pong forever).
+        Returns how many were requeued."""
+        requeued = 0
+        survivors = []
+        for item in items:
+            item.redispatches += 1
+            if item.redispatches > self.max_redispatch:
+                with self._counter_lock:
+                    self.n_failed += 1
+                item.ticket._fail(
+                    ShedError(
+                        "redispatch budget exhausted "
+                        f"({self.max_redispatch}) after engine failures"
+                    )
+                )
+            else:
+                survivors.append(item)
+        if warm:
+            if survivors:
+                self._cont_q.put(survivors)
+                requeued = len(survivors)
+        else:
+            for item in survivors:
+                try:
+                    self._q.put_nowait(item)
+                    requeued += 1
+                except queue.Full:
+                    with self._counter_lock:
+                        self.n_failed += 1
+                    item.ticket._fail(
+                        QueueFullError("requeue after engine failure: full")
+                    )
+        with self._counter_lock:
+            self.n_redispatched += requeued
+        return requeued
+
+    def _dispatch(self, engine, engine_name: str, batch, warm: bool) -> None:
         n = len(batch)
         if self.shed_when_down and _backend_down():
             # Gathered but undispatchable: fail every ticket fast with the
             # stamped evidence — never dispatch into a dead backend (the
             # round-5 hang this subsystem exists to never reproduce).
             for req in batch:
-                self._shed(req.ticket, "backend-down", **self._pressure())
+                self._shed(
+                    req.ticket, "backend-down", **self._pressure(engine_name)
+                )
             return
         iters_override = None
         rung_name = None
-        if self.ladder is not None:
+        ladder = self._ladders.get(engine_name)
+        if ladder is not None:
             from glom_tpu.resilience.ladder import CAPPED_ITERS, RUNGS
 
-            rung = self.ladder.rung()
+            rung = ladder.rung()
             rung_name = RUNGS[rung]
             if rung >= CAPPED_ITERS:
-                iters_override = self.ladder.degraded_iters
+                iters_override = ladder.degraded_iters
+        scfg = getattr(engine, "scfg", None)
+        budget = getattr(engine, "auto_budget", None)
+        tiered = (
+            scfg is not None
+            and getattr(scfg, "max_continuations", 0) > 0
+            and getattr(engine, "iters_key", None) == "auto"
+            and iters_override is None
+            and budget is not None
+        )
+        prior = batch[0].executed if warm else 0
         try:
-            bucket = self.engine.pick_bucket(n)
+            bucket = engine.pick_bucket(n)
             imgs = np.zeros((bucket, *batch[0].img.shape), np.float32)
             for i, req in enumerate(batch):
                 imgs[i] = req.img
-            kw = {} if iters_override is None else {
-                "iters_override": iters_override
-            }
+            kw = {}
+            if iters_override is not None:
+                kw["iters_override"] = iters_override
+            if warm:
+                lv0 = np.zeros((bucket, *batch[0].levels.shape),
+                               batch[0].levels.dtype)
+                for i, c in enumerate(batch):
+                    lv0[i] = c.levels
+                kw["levels0"] = lv0
+                # The remaining per-request budget caps the warm hop's
+                # auto route — UNLESS a degraded ladder rung pinned a
+                # fixed iters_override for this dispatch (the engine
+                # rejects the combination: a fixed route has no budget
+                # to cap, and the degraded budget already bounds cost).
+                remaining = max(1, budget - prior) if budget else None
+                if (
+                    iters_override is None
+                    and remaining is not None
+                    and remaining < budget
+                ):
+                    kw["auto_budget"] = remaining
             with span("serve_dispatch", aggregator=self.spans):
-                result = self.engine.infer(imgs, n_valid=n, **kw)
+                result = engine.infer(imgs, n_valid=n, **kw)
             with span("serve_fetch", aggregator=self.spans):
                 levels = np.asarray(result.levels[:n])
         except BaseException as e:  # noqa: BLE001 — relayed per ticket
+            state = self._note_failure(engine_name)
+            if state["siblings"]:
+                # FAILOVER: hand this batch to the siblings instead of
+                # failing it — the multi-engine contract a dead engine's
+                # chaos scenario validates (docs/RESILIENCE.md).
+                n_req = self._requeue(batch, warm)
+                self._emit(
+                    {
+                        "event": "engine_failover",
+                        "engine": engine_name,
+                        "engine_alive": state["alive"],
+                        "n_requeued": n_req,
+                        "n_valid": n,
+                        "warm_state": warm,
+                        "exception": f"{type(e).__name__}: {e}"[:300],
+                    }
+                )
+                if not state["alive"]:
+                    self._emit(
+                        {"event": "engine_dead", "engine": engine_name}
+                    )
+                if not self._alive_engines():
+                    # The sibling snapshot raced a concurrent death: the
+                    # requeued batch landed in queues no live worker will
+                    # drain — fail it (and everything else queued) now
+                    # rather than strand tickets until stop().
+                    self._fail_queued()
+                return
             with self._counter_lock:
                 self.n_failed += len(batch)
             for req in batch:
@@ -462,20 +776,73 @@ class DynamicBatcher:
             self._emit(
                 {
                     "event": "dispatch_error",
+                    "engine": engine_name,
                     "n_valid": n,
                     "exception": f"{type(e).__name__}: {e}"[:300],
                 }
             )
+            if not state["alive"]:
+                self._emit({"event": "engine_dead", "engine": engine_name})
+                if not self._alive_engines():
+                    # The LAST engine just died: nothing will ever drain
+                    # the queues — fail what is waiting rather than
+                    # strand it until stop() (tickets stay terminal).
+                    self._fail_queued()
             return
+
+        # Resolve vs re-bucket, row by row. Stragglers (valid, unconverged,
+        # budget left, hops left) carry their warm state into the
+        # continuation queue as ONE group; everyone else resolves with
+        # their TOTAL executed iterations. Draining stop() opens no new
+        # hops — stragglers resolve with the state they have.
+        executed = prior + result.iters_run
+        conv = result.row_converged
+        stragglers: List[_Continuation] = []
+        resolved: List[dict] = []
+        n_resolved = 0
+        hops = batch[0].hops if warm else 0
+        open_hops = (
+            tiered
+            and conv is not None
+            and not self._stop.is_set()
+            and hops < scfg.max_continuations
+            and executed < budget
+        )
         for i, req in enumerate(batch):
-            req.ticket._resolve(levels[i], result.iters_run)
+            if open_hops and not bool(conv[i]):
+                stragglers.append(
+                    _Continuation(
+                        req.img, np.asarray(result.levels[i]), req.ticket,
+                        executed, hops + 1,
+                    )
+                )
+            else:
+                req.ticket._resolve(levels[i], executed)
+                resolved.append({"iters": executed, "tier": hops})
+                n_resolved += 1
+        if stragglers:
+            self._cont_q.put(stragglers)
+            self._emit(
+                {
+                    "event": "continuation",
+                    "engine": engine_name,
+                    "n_stragglers": len(stragglers),
+                    "executed_iters": executed,
+                    "remaining_budget": budget - executed,
+                    "hop": hops + 1,
+                }
+            )
         rec = {
             "event": "dispatch",
+            "engine": engine_name,
             "bucket": result.bucket,
             "n_valid": n,
+            "warm_state": warm,
+            "tier": hops,
             "pad_fraction": round(1.0 - n / result.bucket, 4),
             "latency_ms": round(1e3 * result.latency_s, 3),
             "iters_run": result.iters_run,
+            "n_stragglers": len(stragglers),
             "compiled": result.compiled,
         }
         if rung_name is not None:
@@ -485,14 +852,16 @@ class DynamicBatcher:
         # The dispatch log is read by summary_record() from the CALLER's
         # thread while this worker appends — glom-lint's lockset checker
         # flagged the bare append (iteration during append is a crash, not
-        # just a stale read), so the batch log rides the counter lock.
-        with self._counter_lock:
-            self.n_served += n
-            if iters_override is not None:
-                self.n_degraded += n
-            self.dispatches.append(rec)
+        # just a stale read), so the batch log rides the counter lock
+        # (nested inside the engine lock: see _note_dispatch).
+        self._note_dispatch(
+            engine_name, rec, resolved,
+            n_served=n_resolved,
+            n_degraded=n_resolved if iters_override is not None else 0,
+            n_continued=len(stragglers),
+        )
         self._emit(rec)
-        self._ladder_observe()
+        self._ladder_observe(engine_name)
 
     # -- telemetry ---------------------------------------------------------
 
@@ -508,23 +877,32 @@ class DynamicBatcher:
 
     def summary_record(self) -> dict:
         """The end-of-run "serve" summary event. The iteration histogram
-        is PER REQUEST (each of a dispatch's n_valid requests ran its
-        batch's iteration count) — the early-exit accounting unit.
-        Snapshot under the counter lock: the worker may still be serving
-        while a caller summarizes, and the counters must be mutually
-        consistent (n_served vs the dispatch log it was derived from)."""
-        with self._counter_lock:
-            dispatches = list(self.dispatches)
-            n_requests = self.n_requests
-            n_submitted = self.n_submitted
-            n_served = self.n_served
-            n_shed = self.n_shed
-            n_failed = self.n_failed
-            n_degraded = self.n_degraded
-        hist: dict = {}
-        for d in dispatches:
-            key = str(d["iters_run"])
-            hist[key] = hist.get(key, 0) + d["n_valid"]
+        is PER REQUEST: each resolved request's TOTAL executed column
+        iterations across all of its hops — the two-tier accounting unit
+        (iters_histogram_by_tier splits it by how many continuation hops
+        the request rode). Snapshot under both locks in the documented
+        order: workers may still be serving while a caller summarizes,
+        and the per-engine counts must be consistent with the global
+        conservation counters."""
+        with self._engine_lock:  # LOCK ORDER: _engine_lock -> _counter_lock
+            engines = {
+                name: dict(st) for name, st in self._engine_state.items()
+            }
+            with self._counter_lock:
+                dispatches = list(self.dispatches)
+                hist = dict(self._iters_hist)
+                by_tier = {
+                    t: dict(h) for t, h in self._iters_hist_by_tier.items()
+                }
+                iters_total = self._iters_total
+                n_requests = self.n_requests
+                n_submitted = self.n_submitted
+                n_served = self.n_served
+                n_shed = self.n_shed
+                n_failed = self.n_failed
+                n_degraded = self.n_degraded
+                n_continued = self.n_continued
+                n_redispatched = self.n_redispatched
         rec = {
             "event": "summary",
             "n_requests": n_requests,
@@ -533,15 +911,39 @@ class DynamicBatcher:
             "n_shed": n_shed,
             "n_failed": n_failed,
             "n_degraded": n_degraded,
+            "n_continued": n_continued,
+            "n_redispatched": n_redispatched,
             "n_dispatches": len(dispatches),
+            # Mean GATHERED batch size: valid rows per dispatch (a warm
+            # continuation hop is a dispatch too) — n_served would skew
+            # it, since a straggler's rows resolve on a LATER dispatch
+            # than the one that gathered them.
             "mean_batch": round(
-                n_served / len(dispatches), 3
+                sum(d["n_valid"] for d in dispatches) / len(dispatches), 3
             ) if dispatches else 0.0,
             "iters_histogram": hist,
+            "iters_histogram_by_tier": by_tier,
+            "mean_executed_iters": round(
+                iters_total / n_served, 3
+            ) if n_served else None,
+            "engines": engines,
         }
-        if self.ladder is not None:
-            rec.update(self.ladder.record())
-        retry = getattr(self.engine, "retry", None)
-        if retry is not None:
-            rec.update(retry.record())
+        # Ladder/retry rollups: flat on a single-engine summary (the PR 6
+        # record shape, pinned by tests), NESTED per engine under
+        # `engines` on fan-out — a flat merge would let the last engine's
+        # ladder_rung/n_retries overwrite every sibling's evidence.
+        for i, eng in enumerate(self.engines):
+            name = self._ename(eng, i)
+            ladder = self._ladders.get(name)
+            retry = getattr(eng, "retry", None)
+            if len(self.engines) == 1:
+                if ladder is not None:
+                    rec.update(ladder.record())
+                if retry is not None:
+                    rec.update(retry.record())
+            else:
+                if ladder is not None:
+                    rec["engines"][name]["ladder"] = ladder.record()
+                if retry is not None:
+                    rec["engines"][name]["retry"] = retry.record()
         return schema.stamp(rec, kind="serve")

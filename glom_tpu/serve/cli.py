@@ -62,7 +62,39 @@ def build_parser() -> argparse.ArgumentParser:
         help="enable the degradation ladder (glom_tpu/resilience/ladder): "
         "under queue pressure or a flapping backend, step down capped-iters "
         "-> capped-buckets -> shed instead of shedding outright "
-        "(docs/RESILIENCE.md)",
+        "(docs/RESILIENCE.md; one ladder per engine)",
+    )
+    p.add_argument(
+        "--engines", type=int, default=1, metavar="N",
+        help="multi-engine fan-out: N InferenceEngines (shared params) "
+        "behind one shared-admission batcher, one worker per engine; a "
+        "failing engine's batches re-dispatch to its siblings",
+    )
+    p.add_argument(
+        "--mesh-data", type=int, default=None, metavar="D",
+        help="serve mesh: shard every bucket's batch rows over a D-way "
+        "'data' axis (parallel/serve_mesh.py; buckets must divide by D)",
+    )
+    p.add_argument(
+        "--mesh-seq", type=int, default=None, metavar="S",
+        help="serve mesh: shard the patch axis over an S-way 'seq' axis",
+    )
+    p.add_argument(
+        "--quorum", type=float, default=None, metavar="Q",
+        help="iters=auto: exit the bucket once ceil(Q * n_valid) valid "
+        "rows have individually converged (two-tier early exit; 1.0 = all)",
+    )
+    p.add_argument(
+        "--max-continuations", type=int, default=None, metavar="M",
+        help="re-bucket unconverged stragglers (warm state, remaining "
+        "budget) up to M hops through the continuation queue; 0 disables",
+    )
+    p.add_argument(
+        "--kill-engine", default=None, metavar="IDX:after=K",
+        help="CHAOS: permanently fail engine IDX's dispatches from its "
+        "K-th call on (a seeded FaultPlan dispatch_fault — every injection "
+        "a stamped 'fault' event), so the kill-serve scenario can validate "
+        "failover from the evidence trail (docs/RESILIENCE.md)",
     )
     p.add_argument(
         "--dispatch-retries", type=int, default=None, metavar="N",
@@ -137,8 +169,19 @@ def main(argv=None) -> int:
         overrides["ladder"] = True
     if args.dispatch_retries is not None:
         overrides["dispatch_retries"] = args.dispatch_retries
+    if args.mesh_data is not None:
+        overrides["mesh_data"] = args.mesh_data
+    if args.mesh_seq is not None:
+        overrides["mesh_seq"] = args.mesh_seq
+    if args.quorum is not None:
+        overrides["exit_quorum"] = args.quorum
+    if args.max_continuations is not None:
+        overrides["max_continuations"] = args.max_continuations
     if overrides:
         scfg = dataclasses.replace(scfg, **overrides)
+    if args.engines < 1:
+        print("--engines must be >= 1", file=sys.stderr)
+        return 2
 
     writer = MetricsWriter(args.out, echo=True)
     fr = None
@@ -153,26 +196,75 @@ def main(argv=None) -> int:
         set_global_flight_recorder(fr)
 
     try:
-        engine = InferenceEngine(cfg, scfg, writer=writer)
-        ladder = None
-        if scfg.ladder:
-            from glom_tpu.resilience.ladder import DegradationLadder
+        # One params init shared by every engine replica (fan-out serves
+        # ONE model), one engine per replica. A serve mesh partitions the
+        # device pool into one contiguous group per engine
+        # (parallel/runtime.make_engine_meshes).
+        import jax
 
-            ladder = DegradationLadder.from_config(cfg, scfg, writer=writer)
+        from glom_tpu.models.core import init_glom
+
+        params = init_glom(jax.random.PRNGKey(0), cfg)
+        if scfg.mesh_data > 1 or scfg.mesh_seq > 1:
+            from glom_tpu.parallel.runtime import make_engine_meshes
+
+            meshes = make_engine_meshes(scfg, args.engines)
+        else:
+            meshes = [None] * args.engines
+        kill_idx, kill_plan = None, None
+        if args.kill_engine is not None:
+            # "IDX:after=K": engine IDX's dispatch hook raises on every
+            # attempt from index K on — the in-process analog of a dead
+            # replica, stamped per injection so the chaos driver can
+            # reconcile failover against the injected ground truth.
+            from glom_tpu.resilience.faults import FaultPlan, dispatch_fault
+
+            idx_s, _, after_s = args.kill_engine.partition(":after=")
+            kill_idx = int(idx_s)
+            if not 0 <= kill_idx < args.engines:
+                print(f"--kill-engine index {kill_idx} outside 0.."
+                      f"{args.engines - 1}", file=sys.stderr)
+                return 2
+            kill_plan = FaultPlan(writer=writer)
+            kill_plan.register(
+                f"engine{kill_idx}-dispatch",
+                rate=1.0,
+                start=int(after_s or 0),
+                fault="engine-dead",
+            )
+        engines = []
+        for i in range(args.engines):
+            hook = None
+            if kill_plan is not None and i == kill_idx:
+                hook = dispatch_fault(kill_plan, f"engine{i}-dispatch")
+            engines.append(
+                InferenceEngine(
+                    cfg, scfg, params=params, writer=writer,
+                    mesh=meshes[i], name=f"engine{i}", fault_hook=hook,
+                )
+            )
+        degraded_iters = None
+        if scfg.ladder:
+            degraded_iters = (
+                scfg.degraded_iters
+                if scfg.degraded_iters is not None
+                else max(1, cfg.default_iters // 2)
+            )
         if not args.no_warmup:
-            engine.warmup()
-            if ladder is not None:
-                # Pre-warm the capped-iters route too: the first degraded
-                # dispatch must not pay a mid-traffic compile on top of
-                # the pressure that degraded it.
-                engine.warmup(iters_override=ladder.degraded_iters)
+            for engine in engines:
+                engine.warmup()
+                if degraded_iters is not None:
+                    # Pre-warm the capped-iters route too: the first
+                    # degraded dispatch must not pay a mid-traffic compile
+                    # on top of the pressure that degraded it.
+                    engine.warmup(iters_override=degraded_iters)
 
         rng_img = lambda seed: np.random.default_rng(seed).normal(
             size=(cfg.channels, cfg.image_size, cfg.image_size)
         ).astype(np.float32)
 
         served = failed = 0
-        with DynamicBatcher(engine, writer=writer, ladder=ladder) as batcher:
+        with DynamicBatcher(engines=engines, writer=writer) as batcher:
             tickets = []
             for rid, seed in _req_source(args):
                 try:
@@ -224,8 +316,9 @@ def main(argv=None) -> int:
             writer.write(serve_rec(batcher.summary_record()))
             for rec in batcher.span_records():
                 writer.write(rec)
-        for rec in engine.stats_records():
-            writer.write(serve_rec(rec))
+        for engine in engines:
+            for rec in engine.stats_records():
+                writer.write(serve_rec(rec))
         return 0 if failed == 0 and served > 0 else 1
     finally:
         writer.close()

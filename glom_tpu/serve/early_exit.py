@@ -35,7 +35,7 @@ INFERENCE form (glom_tpu/serve), not a training path.
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -75,35 +75,7 @@ def masked_level_agreement(
     return jnp.sum(per_image * w, axis=0) / jnp.maximum(jnp.sum(w), 1.0)
 
 
-def glom_forward_auto(
-    params,
-    img: jnp.ndarray,
-    cfg: GlomConfig,
-    *,
-    max_iters: Optional[int] = None,
-    threshold: float = 1e-3,
-    min_iters: int = 1,
-    levels: Optional[jnp.ndarray] = None,
-    valid_mask: Optional[jnp.ndarray] = None,
-    compute_dtype=None,
-    use_pallas: bool = False,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """The early-exit GLOM forward: up to `max_iters` column updates,
-    stopping once the agreement delta drops below `threshold`.
-
-    Returns (final_levels [b, n, L, d], iters_run int32 scalar,
-    agreement [L] float32 of the final state). `min_iters` floors the exit
-    (at least that many updates always run); `threshold=0.0` disables the
-    exit entirely — the strict `delta < threshold` test can then never
-    pass and exactly `max_iters` updates run, bitwise-equal to
-    glom_forward(iters=max_iters).
-
-    use_pallas swaps the grouped-FFW for the fused Pallas kernel (which
-    auto-falls back to the XLA form off-TPU); consensus stays the dense op
-    — the serving engine compiles per bucket, and the reference-layout
-    body keeps the exit witness identical across routes.
-    """
-    T = max_iters if max_iters is not None else cfg.default_iters
+def _validate_auto_args(T: int, min_iters: int, threshold: float) -> None:
     if T < 1:
         raise ValueError(f"max_iters={T} must be >= 1")
     if not 1 <= min_iters <= T:
@@ -111,6 +83,12 @@ def glom_forward_auto(
     if threshold < 0:
         raise ValueError(f"threshold={threshold} must be >= 0")
 
+
+def _build_update_step(params, img, cfg, levels, compute_dtype, use_pallas):
+    """The shared prologue of the auto forwards: cast once, patchify,
+    build the per-iteration update closure. Returns (step(lv) -> new_lv,
+    levels0) with the SAME ops in the same order as glom_forward's — the
+    threshold-0 bitwise contract both loop forms inherit."""
     if use_pallas:
         from glom_tpu.kernels import fused_grouped_ffw
 
@@ -146,6 +124,49 @@ def glom_forward_auto(
         ).astype(tokens.dtype)
 
     divisor = contribution_divisor(cfg.levels, jnp.float32)
+
+    def step(lv):
+        return update_step(
+            params, lv, bottom, pos, divisor,
+            consensus_fn=consensus_fn, ffw_fn=ffw_fn,
+        )
+
+    return step, levels
+
+
+def glom_forward_auto(
+    params,
+    img: jnp.ndarray,
+    cfg: GlomConfig,
+    *,
+    max_iters: Optional[int] = None,
+    threshold: float = 1e-3,
+    min_iters: int = 1,
+    levels: Optional[jnp.ndarray] = None,
+    valid_mask: Optional[jnp.ndarray] = None,
+    compute_dtype=None,
+    use_pallas: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """The early-exit GLOM forward: up to `max_iters` column updates,
+    stopping once the agreement delta drops below `threshold`.
+
+    Returns (final_levels [b, n, L, d], iters_run int32 scalar,
+    agreement [L] float32 of the final state). `min_iters` floors the exit
+    (at least that many updates always run); `threshold=0.0` disables the
+    exit entirely — the strict `delta < threshold` test can then never
+    pass and exactly `max_iters` updates run, bitwise-equal to
+    glom_forward(iters=max_iters).
+
+    use_pallas swaps the grouped-FFW for the fused Pallas kernel (which
+    auto-falls back to the XLA form off-TPU); consensus stays the dense op
+    — the serving engine compiles per bucket, and the reference-layout
+    body keeps the exit witness identical across routes.
+    """
+    T = max_iters if max_iters is not None else cfg.default_iters
+    _validate_auto_args(T, min_iters, threshold)
+    step, levels = _build_update_step(
+        params, img, cfg, levels, compute_dtype, use_pallas
+    )
     thr = jnp.float32(threshold)
 
     def cond(carry):
@@ -154,10 +175,7 @@ def glom_forward_auto(
 
     def body(carry):
         lv, prev_agree, i, _ = carry
-        new = update_step(
-            params, lv, bottom, pos, divisor,
-            consensus_fn=consensus_fn, ffw_fn=ffw_fn,
-        )
+        new = step(lv)
         agree = masked_level_agreement(new, valid_mask)  # [L] f32
         delta = jnp.max(jnp.abs(agree - prev_agree))
         done = jnp.logical_and(i + 1 >= min_iters, delta < thr)
@@ -168,3 +186,112 @@ def glom_forward_auto(
         cond, body, (levels, init_agree, jnp.int32(0), jnp.bool_(False))
     )
     return final, iters_run, agree
+
+
+class TieredAutoResult(NamedTuple):
+    """One tiered auto forward's outcome (all jax arrays, still on device).
+
+    `row_converged`/`row_iters` are PER ROW: whether each row's own
+    agreement delta dropped below threshold, and the update count at which
+    it first did (rows that never converged carry `iters_run`). Every row
+    physically executes `iters_run` updates — row_iters is the *needed*
+    count, iters_run the *executed* one (the number the serving histogram
+    charges)."""
+
+    levels: jnp.ndarray        # [b, n, L, d]
+    iters_run: jnp.ndarray     # int32 scalar
+    agreement: jnp.ndarray     # [L] float32 (valid rows only)
+    row_converged: jnp.ndarray # [b] bool
+    row_iters: jnp.ndarray     # [b] int32
+
+
+def row_agreement_delta(
+    agree_rows: jnp.ndarray, prev_rows: jnp.ndarray
+) -> jnp.ndarray:
+    """Per-row stopping witness: max over levels of the absolute agreement
+    move between consecutive iterations. [b, L] x2 -> [b] float32."""
+    return jnp.max(jnp.abs(agree_rows - prev_rows), axis=-1)
+
+
+def quorum_need(quorum: float, n_valid: jnp.ndarray) -> jnp.ndarray:
+    """ceil(quorum * n_valid) as an int32 scalar, floored at 1 — the
+    converged-row count at which a bucket may exit. Computed in-graph so
+    n_valid can come from a traced mask sum (the sharded form psums it)."""
+    need = jnp.ceil(jnp.float32(quorum) * n_valid.astype(jnp.float32))
+    return jnp.maximum(need.astype(jnp.int32), 1)
+
+
+def glom_forward_tiered(
+    params,
+    img: jnp.ndarray,
+    cfg: GlomConfig,
+    *,
+    max_iters: Optional[int] = None,
+    threshold: float = 1e-3,
+    min_iters: int = 1,
+    quorum: float = 1.0,
+    levels: Optional[jnp.ndarray] = None,
+    valid_mask: Optional[jnp.ndarray] = None,
+    compute_dtype=None,
+    use_pallas: bool = False,
+) -> TieredAutoResult:
+    """The two-tier early-exit forward: the same update loop as
+    glom_forward_auto, with the stopping witness made PER ROW and the exit
+    condition a QUORUM — the bucket exits once ceil(quorum * n_valid)
+    valid rows have individually converged (each row's own max-over-levels
+    agreement delta below `threshold`, after `min_iters`). Converged rows
+    keep updating until the bucket exits (the update is row-independent,
+    so the extra iterations only settle them further); unconverged rows at
+    exit are the STRAGGLERS the batcher re-buckets with their warm state
+    (`levels=`) and the remaining budget.
+
+    threshold=0.0 keeps the PR 4 contract: no row can ever converge
+    (strict `delta < 0`), the loop runs exactly `max_iters`, and the final
+    state is bitwise-equal to glom_forward(iters=max_iters) — the quorum
+    never gets a vote. Pad rows (valid_mask False) neither count toward
+    the quorum nor against it, whatever state they carry.
+    """
+    T = max_iters if max_iters is not None else cfg.default_iters
+    _validate_auto_args(T, min_iters, threshold)
+    step, levels = _build_update_step(
+        params, img, cfg, levels, compute_dtype, use_pallas
+    )
+    b = levels.shape[0]
+    valid = (
+        jnp.ones((b,), bool) if valid_mask is None else valid_mask.astype(bool)
+    )
+    validf = valid.astype(jnp.float32)
+    need = quorum_need(quorum, jnp.sum(validf))
+    thr = jnp.float32(threshold)
+
+    def cond(carry):
+        lv, prev_rows, i, conv, row_iters = carry
+        n_conv = jnp.sum(jnp.logical_and(conv, valid).astype(jnp.int32))
+        return jnp.logical_and(i < T, n_conv < need)
+
+    def body(carry):
+        lv, prev_rows, i, conv, row_iters = carry
+        new = step(lv)
+        agree_rows = batch_agreement(new)  # [b, L] f32
+        delta = row_agreement_delta(agree_rows, prev_rows)  # [b]
+        newly = jnp.logical_and(i + 1 >= min_iters, delta < thr)
+        first = jnp.logical_and(newly, jnp.logical_not(conv))
+        row_iters = jnp.where(first, i + 1, row_iters)
+        return new, agree_rows, i + 1, jnp.logical_or(conv, newly), row_iters
+
+    init_rows = batch_agreement(levels)
+    final, agree_rows, iters_run, conv, row_iters = jax.lax.while_loop(
+        cond,
+        body,
+        (
+            levels,
+            init_rows,
+            jnp.int32(0),
+            jnp.zeros((b,), bool),
+            jnp.full((b,), T, jnp.int32),
+        ),
+    )
+    # Rows that never converged executed (and still need) iters_run.
+    row_iters = jnp.where(conv, row_iters, iters_run)
+    agreement = masked_level_agreement(final, valid_mask)
+    return TieredAutoResult(final, iters_run, agreement, conv, row_iters)

@@ -5,25 +5,33 @@ buffers) applied to serving. `Glom.__call__` jit-compiles on FIRST call —
 fine for a notebook, a multi-second latency cliff for the first user to hit
 a fresh shape in production. The engine inverts that:
 
-  * every (bucket batch, iters route) signature is AOT-compiled — lowered
-    and compiled EXPLICITLY via jax.jit(...).lower(...).compile() from
-    abstract shapes, no dummy batch materialized — either eagerly by
+  * every (bucket batch, iters route, warm/cold) signature is AOT-compiled
+    — lowered and compiled EXPLICITLY via jax.jit(...).lower(...).compile()
+    from abstract shapes, no dummy batch materialized — either eagerly by
     `warmup()` before traffic or lazily on first miss (which emits a
     "serve" warmup event either way, so a mid-traffic compile is always
     attributable in the stream);
   * compiled programs are memoized by signature for the engine's lifetime;
     the batcher only ever dispatches bucket shapes, so steady-state traffic
     never compiles;
-  * the input buffer is donated on TPU (ServeConfig.donate=None resolves
-    by platform) so XLA reuses the padded batch's HBM for outputs;
-  * every forward returns (levels, iters_run): the fixed route stamps its
-    constant, the "auto" route (serve/early_exit) returns the actual
-    iteration count — the consensus early-exit win lands directly in the
-    latency records.
+  * the input buffers (image batch, and the warm levels carry on
+    continuation dispatches) are donated on TPU (ServeConfig.donate=None
+    resolves by platform) so XLA reuses the padded batch's HBM for outputs;
+  * every forward returns (levels, iters_run, row_converged, row_iters):
+    the fixed route stamps its constant (all rows "converged" by fiat),
+    the "auto" route (serve/early_exit.glom_forward_tiered) returns the
+    actual executed count plus PER-ROW convergence — the two-tier early
+    exit's raw material (docs/SERVING.md, "Continuation queue").
+
+Sharded route (parallel/serve_mesh.py): when ServeConfig.mesh_data/.mesh_seq
+describe a mesh, every signature compiles the manual shard_map forward over
+('data', 'seq') instead — same buckets, same warmup, same donation, and the
+compile-time counting trace records the per-dispatch collective wire bytes
+(telemetry/counters.py) onto the signature's stats record.
 
 Latency accounting rides telemetry/sinks.StepTimeStats per signature
 (compile split out, p50/p95/p99/max), drained by `stats_records()` into
-schema-v3 "serve" events.
+schema "serve" events.
 """
 
 from __future__ import annotations
@@ -36,7 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from glom_tpu.models.core import GlomParams, glom_forward, init_glom
-from glom_tpu.serve.early_exit import glom_forward_auto
+from glom_tpu.serve.early_exit import glom_forward_tiered
 from glom_tpu.telemetry import schema
 from glom_tpu.telemetry.sinks import StepTimeStats
 from glom_tpu.utils.config import GlomConfig, ServeConfig
@@ -46,13 +54,18 @@ class ServeResult(NamedTuple):
     """One dispatched batch's outcome. `levels` is the full padded
     [bucket, n, L, d] state (callers slice their valid rows); `iters_run`
     is a host int (the auto route's early-exit count, or the fixed
-    budget); `latency_s` is dispatch-to-fetch wall time for the batch."""
+    budget); `latency_s` is dispatch-to-fetch wall time for the batch.
+    `row_converged`/`row_iters` are the PER-ROW tiered-exit outcome
+    ([bucket] host arrays; fixed-route dispatches mark every row
+    converged — there are no stragglers without a witness)."""
 
     levels: jax.Array
     iters_run: int
     latency_s: float
     bucket: int
     compiled: bool  # True when this call paid the signature's compile
+    row_converged: Optional[np.ndarray] = None
+    row_iters: Optional[np.ndarray] = None
 
 
 def _resolve_donate(donate: Optional[bool]) -> bool:
@@ -65,10 +78,12 @@ class InferenceEngine:
     """Owns params + memoized AOT-compiled forwards per bucket signature.
 
     The engine is the device-side half of the serving stack (the host-side
-    half is serve/batcher.DynamicBatcher, which owns admission and
-    padding). It is thread-compatible the way jax itself is: compiled
-    executables may be CALLED from any thread; `warmup`/first-miss
-    compilation is serialized by the GIL + dict memoization.
+    half is serve/batcher.DynamicBatcher, which owns admission, padding,
+    and the continuation queue). It is thread-compatible the way jax
+    itself is: compiled executables may be CALLED from any thread;
+    `warmup`/first-miss compilation is serialized by the GIL + dict
+    memoization. `name` labels this engine's records in multi-engine
+    fan-out deployments (one engine per replica behind one batcher).
     """
 
     def __init__(
@@ -81,9 +96,12 @@ class InferenceEngine:
         writer=None,
         retry=None,
         fault_hook=None,
+        mesh=None,
+        name: str = "engine0",
     ):
         self.cfg = cfg
         self.scfg = scfg = scfg if scfg is not None else ServeConfig()
+        self.name = name
         if params is None:
             key = key if key is not None else jax.random.PRNGKey(0)
             params = init_glom(key, cfg)
@@ -93,8 +111,22 @@ class InferenceEngine:
         self._compute_dtype = (
             jnp.bfloat16 if scfg.compute_dtype == "bfloat16" else None
         )
+        # Serve mesh: an explicit mesh wins; else resolve from the config
+        # (mesh axes of 1 mean the single-device route).
+        if mesh is None and (scfg.mesh_data > 1 or scfg.mesh_seq > 1):
+            from glom_tpu.parallel.serve_mesh import make_serve_mesh
+
+            mesh = make_serve_mesh(scfg)
+        self.mesh = mesh
+        if mesh is not None and cfg.num_patches % scfg.mesh_seq != 0:
+            raise ValueError(
+                f"patches {cfg.num_patches} not divisible by "
+                f"mesh_seq={scfg.mesh_seq}"
+            )
         self._compiled: Dict[Tuple, object] = {}
         self._stats: Dict[Tuple, StepTimeStats] = {}
+        self._comm: Dict[Tuple, dict] = {}  # sharded route: counted wire bytes
+        self._shardings: Dict[bool, Tuple] = {}  # warm -> (in_sh, out_sh)
         # Transient-dispatch retry (glom_tpu/resilience/retry.py): None
         # resolves from the config (scfg.dispatch_retries; 0 disables).
         # The policy is watchdog-aware — a FLAPPING backend retries (the
@@ -106,7 +138,7 @@ class InferenceEngine:
                 retries=scfg.dispatch_retries,
                 backoff_s=scfg.retry_backoff_ms / 1e3,
                 writer=writer,
-                site="engine-dispatch",
+                site=f"{name}-dispatch",
             )
         self.retry = retry
         # Chaos seam (glom_tpu/resilience/faults.dispatch_fault): called
@@ -129,6 +161,18 @@ class InferenceEngine:
             else self.cfg.default_iters
         )
 
+    @property
+    def auto_budget(self) -> int:
+        """The auto route's full iteration budget — the per-REQUEST cap
+        the two-tier continuation path never exceeds (a straggler's
+        continuation runs the REMAINING budget, so initial + continuation
+        iterations total at most this)."""
+        return (
+            self.scfg.max_auto_iters
+            if self.scfg.max_auto_iters is not None
+            else self.cfg.default_iters
+        )
+
     def pick_bucket(self, n: int) -> int:
         """Smallest precompile bucket admitting n requests. n above the
         largest bucket is the BATCHER's invariant to maintain (it never
@@ -143,65 +187,127 @@ class InferenceEngine:
             f"n={n} exceeds the largest bucket {max(self.scfg.buckets)}"
         )
 
-    def signature(self, bucket: int, iters_override: Optional[int] = None) -> Tuple:
-        route = iters_override if iters_override is not None else self.iters_key
-        return (bucket, route, self.scfg.use_pallas)
+    def signature(
+        self,
+        bucket: int,
+        iters_override: Optional[int] = None,
+        *,
+        auto_budget: Optional[int] = None,
+        warm: bool = False,
+    ) -> Tuple:
+        if iters_override is not None:
+            route = iters_override
+        elif auto_budget is not None and self.iters_key == "auto":
+            route = f"auto:{auto_budget}"
+        else:
+            route = self.iters_key
+        return (bucket, route, self.scfg.use_pallas, warm)
 
     # -- compilation -------------------------------------------------------
 
-    def _build_fn(self, bucket: int, iters_override: Optional[int] = None):
-        """The pure forward for one bucket: (params, img [bucket,c,H,W],
-        mask [bucket]) -> (levels [bucket,n,L,d], iters_run int32). The
-        mask only matters on the auto route (pad rows must not vote on the
-        early-exit witness); the fixed route carries it for a uniform
-        calling convention.
+    def _build_fn(
+        self,
+        bucket: int,
+        iters_override: Optional[int] = None,
+        *,
+        auto_budget: Optional[int] = None,
+        warm: bool = False,
+    ):
+        """The pure forward for one signature: (params, img [bucket,c,H,W],
+        mask [bucket][, levels0 [bucket,n,L,d]]) -> (levels
+        [bucket,n,L,d], iters_run int32, row_converged [bucket] bool,
+        row_iters [bucket] int32). The mask only matters on the auto route
+        (pad rows must not vote on the early-exit witness or the quorum);
+        the fixed route carries it for a uniform calling convention.
 
         iters_override (the degradation ladder's capped_iters rung) pins
-        a FIXED budget regardless of the configured route — a degraded
-        dispatch costs a bounded, smaller iteration count, compiled and
-        memoized as its own signature like any bucket."""
+        a FIXED budget regardless of the configured route; auto_budget
+        caps the auto route's max_iters (a continuation dispatch runs its
+        stragglers' REMAINING budget); warm compiles the variant taking a
+        carried-in levels state. Each is its own memoized signature."""
         cfg, scfg = self.cfg, self.scfg
         compute_dtype = self._compute_dtype
-
-        if iters_override is None and self.iters_key == "auto":
+        auto = iters_override is None and self.iters_key == "auto"
+        if auto:
             max_iters = (
-                scfg.max_auto_iters
-                if scfg.max_auto_iters is not None
-                else cfg.default_iters
+                auto_budget if auto_budget is not None else self.auto_budget
+            )
+        else:
+            max_iters = (
+                iters_override if iters_override is not None else self.iters_key
             )
 
-            def fn(params, img, mask):
-                final, iters_run, _ = glom_forward_auto(
+        if self.mesh is not None:
+            from glom_tpu.parallel.serve_mesh import make_serve_forward
+
+            return make_serve_forward(
+                self.mesh, cfg,
+                route="auto" if auto else max_iters,
+                max_iters=max_iters if auto else None,
+                threshold=scfg.exit_threshold,
+                min_iters=min(scfg.min_iters, max_iters),
+                quorum=scfg.exit_quorum,
+                compute_dtype=compute_dtype,
+                use_pallas=scfg.use_pallas,
+                warm=warm,
+            )
+
+        if auto:
+
+            def fn(params, img, mask, levels0=None):
+                res = glom_forward_tiered(
                     params, img, cfg,
                     max_iters=max_iters,
                     threshold=scfg.exit_threshold,
-                    min_iters=scfg.min_iters,
+                    min_iters=min(scfg.min_iters, max_iters),
+                    quorum=scfg.exit_quorum,
+                    levels=levels0,
                     valid_mask=mask,
                     compute_dtype=compute_dtype,
                     use_pallas=scfg.use_pallas,
                 )
-                return final, iters_run
+                return res.levels, res.iters_run, res.row_converged, res.row_iters
 
         else:
-            iters = (
-                iters_override if iters_override is not None else self.iters_key
-            )
+            iters = max_iters
 
-            def fn(params, img, mask):
+            def fn(params, img, mask, levels0=None):
                 del mask  # pad rows are harmless on the fixed route
                 final = glom_forward(
                     params, img, cfg, iters=iters,
+                    levels=levels0,
                     compute_dtype=compute_dtype,
                     use_pallas=scfg.use_pallas,
                 )
-                return final, jnp.int32(iters)
+                b = final.shape[0]
+                return (
+                    final,
+                    jnp.int32(iters),
+                    jnp.ones((b,), bool),
+                    jnp.full((b,), iters, jnp.int32),
+                )
 
-        return fn
+        if warm:
+            return fn
+        return lambda params, img, mask: fn(params, img, mask)
 
-    def _compile(self, bucket: int, iters_override: Optional[int] = None):
+    def _compile(
+        self,
+        bucket: int,
+        iters_override: Optional[int] = None,
+        *,
+        auto_budget: Optional[int] = None,
+        warm: bool = False,
+    ):
         """AOT-compile one bucket signature from abstract shapes and emit
-        the "serve" warmup event (compile seconds attributed per bucket)."""
-        sig = self.signature(bucket, iters_override)
+        the "serve" warmup event (compile seconds attributed per bucket).
+        Sharded signatures additionally run the lowering inside a
+        collective-counting context, so the per-dispatch wire bytes land
+        on the signature's stats record (while-loop sites price the
+        BUDGET — see parallel/serve_mesh.py)."""
+        sig = self.signature(
+            bucket, iters_override, auto_budget=auto_budget, warm=warm
+        )
         if sig in self._compiled:
             return self._compiled[sig]
         cfg = self.cfg
@@ -212,13 +318,37 @@ class InferenceEngine:
         params_abs = jax.tree_util.tree_map(
             lambda t: jax.ShapeDtypeStruct(t.shape, t.dtype), self.params
         )
-        donate = (1,) if self._donate else ()
-        t0 = time.perf_counter()
-        compiled = (
-            jax.jit(self._build_fn(bucket, iters_override), donate_argnums=donate)
-            .lower(params_abs, img_abs, mask_abs)
-            .compile()
+        lv_dtype = (
+            self._compute_dtype if self._compute_dtype is not None
+            else jnp.float32
         )
+        lv_abs = jax.ShapeDtypeStruct(
+            (bucket, cfg.num_patches, cfg.levels, cfg.dim), lv_dtype
+        )
+        abstract = (params_abs, img_abs, mask_abs) + ((lv_abs,) if warm else ())
+        # Donate the image batch, and the warm levels carry with it.
+        donate = ((1, 3) if warm else (1,)) if self._donate else ()
+        fn = self._build_fn(
+            bucket, iters_override, auto_budget=auto_budget, warm=warm
+        )
+        jit_kw = {"donate_argnums": donate}
+        if self.mesh is not None:
+            in_sh, out_sh = self._serve_shardings(warm)
+            jit_kw.update(in_shardings=in_sh, out_shardings=out_sh)
+        t0 = time.perf_counter()
+        if self.mesh is not None:
+            from glom_tpu.telemetry.counters import (
+                CollectiveCounters,
+                recording,
+            )
+
+            counters = CollectiveCounters()
+            with recording(counters):
+                lowered = jax.jit(fn, **jit_kw).lower(*abstract)
+            self._comm[sig] = counters.totals()
+        else:
+            lowered = jax.jit(fn, **jit_kw).lower(*abstract)
+        compiled = lowered.compile()
         dt = time.perf_counter() - t0
         self._compiled[sig] = compiled
         self._stats.setdefault(sig, StepTimeStats()).observe(dt, is_compile=True)
@@ -227,7 +357,9 @@ class InferenceEngine:
                 "event": "warmup",
                 "bucket": bucket,
                 "iters": sig[1],
+                "warm_state": warm,
                 "degraded": iters_override is not None,
+                "sharded": self.mesh is not None,
                 "use_pallas": self.scfg.use_pallas,
                 "compile_time_s": round(dt, 4),
             }
@@ -239,22 +371,46 @@ class InferenceEngine:
         buckets: Optional[Tuple[int, ...]] = None,
         *,
         iters_override: Optional[int] = None,
+        warm: bool = False,
     ) -> dict:
         """Precompile every bucket signature BEFORE traffic. Returns
         {bucket: compile_seconds}; already-compiled signatures are free.
-        Call a second time with iters_override=<degraded budget> to also
-        pre-warm the ladder's capped_iters route (otherwise the first
-        degraded dispatch pays an attributable mid-traffic compile)."""
+        Call again with iters_override=<degraded budget> to pre-warm the
+        ladder's capped_iters route, or warm=True for the continuation
+        path's warm-state shape (continuation dispatches at partial
+        budgets still compile on first miss — each remaining budget is
+        its own signature, attributable in the warmup stream)."""
         out = {}
         for b in buckets if buckets is not None else self.scfg.buckets:
-            sig = self.signature(b, iters_override)
+            sig = self.signature(b, iters_override, warm=warm)
             already = sig in self._compiled
             t0 = time.perf_counter()
-            self._compile(b, iters_override)
+            self._compile(b, iters_override, warm=warm)
             out[b] = 0.0 if already else time.perf_counter() - t0
         return out
 
     # -- dispatch ----------------------------------------------------------
+
+    def _serve_shardings(self, warm: bool) -> Tuple:
+        """Memoized (in_shardings, out_shardings) for the sharded route —
+        resolved once per (engine, warm) rather than per dispatch (the
+        param tree_map is pure overhead in the request hot path)."""
+        if warm not in self._shardings:
+            from glom_tpu.parallel.serve_mesh import serve_shardings
+
+            self._shardings[warm] = serve_shardings(
+                self.mesh, self.params, warm=warm
+            )
+        return self._shardings[warm]
+
+    def _device_input(self, src, sharding_spec=None):
+        """One fresh device buffer per attempt (donation invalidates the
+        previous one). On the sharded route the host array device_puts
+        straight into its NamedSharding; single-device keeps the plain
+        transfer."""
+        if self.mesh is not None and sharding_spec is not None:
+            return jax.device_put(np.asarray(src), sharding_spec)
+        return jnp.asarray(src)
 
     def infer(
         self,
@@ -262,6 +418,8 @@ class InferenceEngine:
         n_valid: Optional[int] = None,
         *,
         iters_override: Optional[int] = None,
+        levels0=None,
+        auto_budget: Optional[int] = None,
     ) -> ServeResult:
         """Run one padded batch. `imgs` is [b, c, H, W] (numpy or jax) with
         b equal to a bucket size — callers that batch themselves pass an
@@ -270,17 +428,29 @@ class InferenceEngine:
 
         iters_override pins a fixed iteration budget for THIS dispatch
         (the degradation ladder's capped_iters rung); None runs the
-        configured route. Transient dispatch failures retry per the
-        engine's RetryPolicy — a failed attempt against an up-or-flapping
-        backend backs off and re-dispatches from a FRESH input buffer
-        (donation invalidates the old one), while a down backend raises
-        straight into the batcher's shed path."""
+        configured route. levels0 [b, n, L, d] carries warm column state
+        in (the continuation path), and auto_budget caps the auto route's
+        max_iters to the stragglers' remaining budget. Transient dispatch
+        failures retry per the engine's RetryPolicy — a failed attempt
+        against an up-or-flapping backend backs off and re-dispatches from
+        FRESH input buffers (donation invalidates the old ones), while a
+        down backend raises straight into the batcher's shed path."""
         if iters_override is not None and (
             not isinstance(iters_override, int) or iters_override < 1
         ):
             raise ValueError(
                 f"iters_override={iters_override!r}: an int >= 1 or None"
             )
+        if auto_budget is not None:
+            if not isinstance(auto_budget, int) or auto_budget < 1:
+                raise ValueError(
+                    f"auto_budget={auto_budget!r}: an int >= 1 or None"
+                )
+            if iters_override is not None:
+                raise ValueError(
+                    "auto_budget composes with the auto route only, not "
+                    "with a fixed iters_override"
+                )
         b = np.shape(imgs)[0]
         if b not in self.scfg.buckets:
             raise ValueError(
@@ -290,26 +460,57 @@ class InferenceEngine:
         n_valid = b if n_valid is None else n_valid
         if not 1 <= n_valid <= b:
             raise ValueError(f"n_valid={n_valid} outside 1..{b}")
-        if self._donate:
-            # Every ATTEMPT needs a fresh device buffer: the compiled call
-            # donates its input, so a retry after a failed dispatch must
-            # never reuse a possibly-invalidated array. Hold the source on
-            # the host (numpy transfers copy; a caller-held jax array is
-            # deep-copied per attempt).
-            src = imgs if isinstance(imgs, jax.Array) else np.asarray(
-                imgs, np.float32
+        warm = levels0 is not None
+        if warm and np.shape(levels0)[0] != b:
+            raise ValueError(
+                f"levels0 batch {np.shape(levels0)[0]} != bucket {b}"
             )
-            if isinstance(src, jax.Array):
-                make_input = lambda: jnp.array(src, jnp.float32, copy=True)
-            else:
-                make_input = lambda: jnp.asarray(src, jnp.float32)
+        lv_dtype = (
+            self._compute_dtype if self._compute_dtype is not None
+            else np.float32
+        )
+        img_sh = mask_sh = lv_sh = None
+        if self.mesh is not None:
+            in_sh, _ = self._serve_shardings(warm)
+            img_sh, mask_sh = in_sh[1], in_sh[2]
+            lv_sh = in_sh[3] if warm else None
+        if self._donate:
+            # Every ATTEMPT needs fresh device buffers: the compiled call
+            # donates its inputs, so a retry after a failed dispatch must
+            # never reuse a possibly-invalidated array. Hold the sources
+            # on the HOST (np.asarray of a caller-held jax array fetches a
+            # copy, so the caller's buffer is never the donated one) and
+            # re-transfer per attempt.
+            src = np.asarray(imgs, np.float32)
+            make_input = lambda: self._device_input(src, img_sh)
+            lv_src = None if not warm else np.asarray(levels0, lv_dtype)
+            make_levels = (
+                None if not warm
+                else (lambda: self._device_input(lv_src, lv_sh))
+            )
         else:
-            dev = jnp.asarray(imgs, jnp.float32)
+            dev = self._device_input(np.asarray(imgs, np.float32), img_sh)
             make_input = lambda: dev
-        mask = jnp.arange(b) < n_valid
-        sig = self.signature(b, iters_override)
+            if warm:
+                lv_dev = self._device_input(
+                    np.asarray(levels0, lv_dtype), lv_sh
+                )
+                make_levels = lambda: lv_dev
+            else:
+                make_levels = None
+        mask_host = np.arange(b) < n_valid
+        mask = (
+            jax.device_put(mask_host, mask_sh)
+            if mask_sh is not None
+            else jnp.asarray(mask_host)
+        )
+        sig = self.signature(
+            b, iters_override, auto_budget=auto_budget, warm=warm
+        )
         compiled_before = sig in self._compiled
-        fn = self._compile(b, iters_override)
+        fn = self._compile(
+            b, iters_override, auto_budget=auto_budget, warm=warm
+        )
         stats = self._stats.setdefault(sig, StepTimeStats())
         attempts = [0]
 
@@ -319,20 +520,27 @@ class InferenceEngine:
                 self._fault_hook(
                     {"bucket": b, "n_valid": n_valid, "attempt": attempts[0]}
                 )
-            levels, iters_run = fn(self.params, make_input(), mask)
+            args = (self.params, make_input(), mask)
+            if warm:
+                args = args + (make_levels(),)
+            levels, iters_run, conv, row_iters = fn(*args)
             iters_host = int(jax.device_get(iters_run))  # syncs: serving
             # is request/response — the caller needs the answer now, and
             # the fetch IS the latency being measured.
             levels.block_until_ready()
-            return levels, iters_host
+            return (
+                levels,
+                iters_host,
+                np.asarray(jax.device_get(conv)),
+                np.asarray(jax.device_get(row_iters)),
+            )
 
         t0 = time.perf_counter()
         if self.retry is not None:
-            levels, iters_host = self.retry.run(
-                attempt, bucket=b, n_valid=n_valid
-            )
+            out = self.retry.run(attempt, bucket=b, n_valid=n_valid)
         else:
-            levels, iters_host = attempt()
+            out = attempt()
+        levels, iters_host, conv, row_iters = out
         dt = time.perf_counter() - t0
         stats.observe(dt, is_compile=False)
         return ServeResult(
@@ -341,6 +549,8 @@ class InferenceEngine:
             latency_s=dt,
             bucket=b,
             compiled=not compiled_before,
+            row_converged=conv,
+            row_iters=row_iters,
         )
 
     # -- telemetry ---------------------------------------------------------
@@ -348,25 +558,28 @@ class InferenceEngine:
     def _emit(self, rec: dict) -> None:
         from glom_tpu.serve.events import emit_serve
 
-        emit_serve(self.writer, rec)
+        emit_serve(self.writer, dict(rec, engine=self.name))
 
     def stats_records(self) -> list:
         """One stamped "serve" event per compiled signature with the
-        per-bucket latency histogram (p50/p95/p99/max, compile split)."""
+        per-bucket latency histogram (p50/p95/p99/max, compile split) and,
+        on the sharded route, the counted per-dispatch collective wire
+        bytes from the lowering trace."""
         out = []
-        for (bucket, iters_key, pallas), stats in sorted(
+        for sig, stats in sorted(
             self._stats.items(), key=lambda kv: str(kv[0])
         ):
-            out.append(
-                schema.stamp(
-                    {
-                        "event": "bucket_stats",
-                        "bucket": bucket,
-                        "iters": iters_key,
-                        "use_pallas": pallas,
-                        **stats.summary(),
-                    },
-                    kind="serve",
-                )
-            )
+            bucket, iters_key, pallas, warm = sig
+            rec = {
+                "event": "bucket_stats",
+                "engine": self.name,
+                "bucket": bucket,
+                "iters": iters_key,
+                "warm_state": warm,
+                "use_pallas": pallas,
+                **stats.summary(),
+            }
+            if sig in self._comm:
+                rec.update(self._comm[sig])
+            out.append(schema.stamp(rec, kind="serve"))
         return out

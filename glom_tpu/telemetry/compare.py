@@ -182,11 +182,43 @@ def compare_records(
     return results
 
 
-def compare_files(base_path: str, new_path: str, *, threshold: float = 0.05):
-    with open(base_path) as fh:
-        bm, bu = load_bench_records(fh)
-    with open(new_path) as fh:
-        nm, nu = load_bench_records(fh)
+class SchemaArtifactError(ValueError):
+    pass
+
+
+def artifact_lines(path: str) -> List[str]:
+    """The bench JSONL lines inside one driver round artifact
+    (BENCH_r0x.json: a single JSON object whose "tail" field carries the
+    bench's final stdout lines). Legacy rounds' value-0.0 dead zeros are
+    classified unmeasured by load_bench_records like any other stream —
+    the artifact is just a different container for the same rows."""
+    with open(path) as fh:
+        obj = json.load(fh)
+    if not isinstance(obj, dict):
+        raise SchemaArtifactError(f"{path}: not a driver artifact object")
+    tail = obj.get("tail") or ""
+    lines = [l for l in tail.splitlines() if l.strip()]
+    parsed = obj.get("parsed")
+    if not lines and isinstance(parsed, dict):
+        lines = [json.dumps(parsed)]
+    return lines
+
+
+def compare_files(
+    base_path: str,
+    new_path: str,
+    *,
+    threshold: float = 0.05,
+    artifacts: bool = False,
+):
+    if artifacts:
+        bm, bu = load_bench_records(artifact_lines(base_path))
+        nm, nu = load_bench_records(artifact_lines(new_path))
+    else:
+        with open(base_path) as fh:
+            bm, bu = load_bench_records(fh)
+        with open(new_path) as fh:
+            nm, nu = load_bench_records(fh)
     return compare_records(bm, bu, nm, nu, threshold=threshold)
 
 
@@ -211,8 +243,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         "entirely (UNMEASURED rows still only warn — they are missing by "
         "design, not silently dropped)",
     )
+    ap.add_argument(
+        "--bench-artifact", action="store_true",
+        help="BASE/NEW are driver round artifacts (BENCH_r0x.json: one "
+        "JSON object whose 'tail' carries the bench rows) instead of raw "
+        "JSONL — the round-over-round trajectory gate",
+    )
     args = ap.parse_args(argv)
-    results = compare_files(args.base, args.new, threshold=args.threshold)
+    results = compare_files(
+        args.base, args.new,
+        threshold=args.threshold, artifacts=args.bench_artifact,
+    )
 
     counts: Dict[str, int] = {}
     for r in results:

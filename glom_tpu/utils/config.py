@@ -125,6 +125,26 @@ class ServeConfig:
     exit_threshold: float = 1e-3
     min_iters: int = 1
     max_auto_iters: Optional[int] = None  # None -> model default (2L)
+    # Two-tier early exit (serve/early_exit.glom_forward_tiered,
+    # docs/SERVING.md "Continuation queue"): the bucket exits once this
+    # FRACTION of its valid rows has individually converged (per-row
+    # witness; ceil(quorum * n_valid) rows). 1.0 = every valid row must
+    # converge before the bucket exits (the strictest quorum — batch-level
+    # behavior). Unconverged stragglers at bucket exit re-bucket into the
+    # batcher's continuation queue — carried as warm column state with the
+    # REMAINING iteration budget — up to max_continuations hops; 0 hops
+    # disables re-bucketing (stragglers resolve with the state they have,
+    # exactly the pre-two-tier contract).
+    exit_quorum: float = 1.0
+    max_continuations: int = 0
+    # Serve mesh (parallel/serve_mesh.py): axis sizes > 1 route every
+    # bucket signature through the manual shard_map forward over
+    # (data, seq) — batch rows sharded over 'data', the patch axis over
+    # 'seq' — with the early-exit witness collectives legal inside the
+    # while_loop body. Every bucket must be divisible by mesh_data (the
+    # engine validates; a non-divisible bucket would silently pad-shard).
+    mesh_data: int = 1
+    mesh_seq: int = 1
     compute_dtype: str = "float32"  # "bfloat16" for MXU-native serving
     use_pallas: bool = False
     # Donate the input buffer to each compiled call so XLA reuses it for
@@ -176,6 +196,27 @@ class ServeConfig:
             raise ValueError(f"exit_threshold {self.exit_threshold} must be >= 0")
         if self.min_iters < 1:
             raise ValueError(f"min_iters {self.min_iters} must be >= 1")
+        if not 0.0 < self.exit_quorum <= 1.0:
+            raise ValueError(
+                f"exit_quorum {self.exit_quorum} outside (0, 1] (1.0 = all "
+                "valid rows must converge before the bucket exits)"
+            )
+        if self.max_continuations < 0:
+            raise ValueError(
+                f"max_continuations {self.max_continuations} must be >= 0"
+            )
+        if self.mesh_data < 1 or self.mesh_seq < 1:
+            raise ValueError(
+                f"mesh_data={self.mesh_data} mesh_seq={self.mesh_seq}: "
+                "serve mesh axes must be >= 1"
+            )
+        if self.mesh_data > 1 and any(
+            b % self.mesh_data for b in self.buckets
+        ):
+            raise ValueError(
+                f"every bucket {self.buckets} must be divisible by "
+                f"mesh_data={self.mesh_data} (batch rows shard over 'data')"
+            )
         if self.dispatch_retries < 0:
             raise ValueError(
                 f"dispatch_retries {self.dispatch_retries} must be >= 0"

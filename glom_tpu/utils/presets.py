@@ -153,8 +153,10 @@ _register(
         mesh=MeshConfig(data=8),
         # The flagship serving config: bf16 fused forward, a deeper bucket
         # ladder (heavy traffic fills big buckets; the small ones cover the
-        # tail), and consensus early exit — converged images stop settling
-        # before the full 2L budget (docs/SERVING.md).
+        # tail), and TWO-TIER consensus early exit — a bucket exits when
+        # its fastest three-quarters quorum converges, stragglers
+        # re-bucket through the continuation queue with their remaining
+        # budget (docs/SERVING.md).
         serve=ServeConfig(
             buckets=(1, 2, 4, 8, 16),
             max_batch=16,
@@ -163,6 +165,8 @@ _register(
             iters="auto",
             exit_threshold=1e-3,
             min_iters=4,
+            exit_quorum=0.75,
+            max_continuations=2,
             compute_dtype="bfloat16",
             use_pallas=True,
         ),
@@ -197,6 +201,25 @@ _register(
         # (L=12 divides seq=2; measured 1.46x over ring at n=256/seq=2 —
         # results/sp_crossover.jsonl).
         sp_strategy="auto",
+        # Pod-scale serving: each engine replica is an 8-chip (data=4 x
+        # seq=2) serve mesh (parallel/serve_mesh.py) — the d=1024/L=12
+        # model batched 32-deep does not serve interactively on one chip.
+        # Buckets divide by mesh_data=4; a v5e-256 pod fans out 32 such
+        # replicas behind shared admission (runtime.make_engine_meshes).
+        serve=ServeConfig(
+            buckets=(4, 8, 16, 32),
+            max_batch=32,
+            max_delay_ms=5.0,
+            queue_depth=512,
+            iters="auto",
+            exit_threshold=1e-3,
+            min_iters=4,
+            exit_quorum=0.75,
+            max_continuations=2,
+            mesh_data=4,
+            mesh_seq=2,
+            compute_dtype="bfloat16",
+        ),
     )
 )
 

@@ -119,6 +119,18 @@ step chaos 1200 python -m glom_tpu.resilience --scenario kill-train \
 #     step 11b.
 step bench_serve 2400 python -u bench_serve.py
 
+# 9e. Pod-scale serving (this round's tentpole, docs/SERVING.md): the
+#     two-tier exit A/B over heterogeneous traffic with 2-engine fan-out
+#     (the serve_mean_executed_iters pair is the measured per-request
+#     early-exit win), then the SHARDED engine route — every bucket
+#     through the (data=4) serve mesh with the while-loop witness
+#     collectives counted on the bucket_stats records. First live window:
+#     read the sharded ceiling vs 9d's single-chip ceiling (the
+#     serve-mesh wire cost is provisioned at the budget, so the delta is
+#     the real witness-psum price), then baseline both via step 11b.
+step bench_serve_two_tier 2400 python -u bench_serve.py --engines 2 --two-tier-ab --hetero 0.5
+step bench_serve_sharded 2400 python -u bench_serve.py --mesh-data 4
+
 # 10. Schema lint: every JSON row this queue produced must validate
 #     against the versioned event schema (glom_tpu/telemetry/schema.py).
 #     Shell noise in the logs is skipped; --allow-unstamped because the
@@ -144,14 +156,18 @@ grep -ah '^{' results/hw_queue/bench.log > results/bench_baseline.jsonl 2>/dev/n
 # 11b. Serving-trajectory gate: the SLO rows (latency percentiles regress
 #      UP, throughput/ceiling regress DOWN, auto-iters regress UP — unit-
 #      derived) against the last good serve baseline; refresh on pass.
+grep -ah '^{' results/hw_queue/bench_serve.log \
+    results/hw_queue/bench_serve_two_tier.log \
+    results/hw_queue/bench_serve_sharded.log \
+    > results/hw_queue/serve_candidate.jsonl 2>/dev/null || true
 if [ -f results/serve_baseline.jsonl ]; then
     step serve_compare 300 python -m glom_tpu.telemetry compare \
-        results/serve_baseline.jsonl results/hw_queue/bench_serve.log || {
+        results/serve_baseline.jsonl results/hw_queue/serve_candidate.jsonl || {
         log "serve trajectory REGRESSION (results/hw_queue/serve_compare.log)"
         exit 1
     }
 fi
-grep -ah '^{' results/hw_queue/bench_serve.log > results/serve_baseline.jsonl 2>/dev/null || true
+cp results/hw_queue/serve_candidate.jsonl results/serve_baseline.jsonl 2>/dev/null || true
 
 log "queue complete — paste numbers into results/profiles/PROFILE.md, "
 log "docs/PARALLELISM.md (pod anchor + ZeRO table), results/batch_curve.jsonl,"

@@ -1,0 +1,72 @@
+"""Seeded acceptance pair for the lock-order checker (analysis/lockset.py
+LockOrder): DeadlockyCoordinator takes its two locks in BOTH orders — the
+textbook AB/BA deadlock — while OrderedCoordinator does the same work with
+one global order and must scan clean. The multi-engine DynamicBatcher
+(serve/batcher.py) is the shipped pattern this pair protects: _engine_lock
+before _counter_lock, never the reverse.
+
+NOT imported by production code; tests/test_analysis.py runs the checker
+over this file and asserts the cycle is flagged at file:line on the racy
+class only. The `transfer` / `audit` pair below WILL deadlock under real
+threads the moment their critical sections interleave — which is exactly
+why the runtime race harness can't be the only guard: a deadlock hangs
+the suite instead of failing it.
+"""
+
+import threading
+
+
+class DeadlockyCoordinator:
+    """AB in transfer(), BA in audit() — the cycle the checker must flag.
+    audit() also reaches the cycle TRANSITIVELY: it calls _tally() while
+    holding the stats lock, and _tally() takes the ledger lock."""
+
+    def __init__(self):
+        self._ledger_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self.ledger = {}
+        self.stats = {}
+
+    def transfer(self, key, amount):
+        with self._ledger_lock:           # ledger -> stats
+            self.ledger[key] = self.ledger.get(key, 0) + amount
+            with self._stats_lock:
+                self.stats["n_transfers"] = (
+                    self.stats.get("n_transfers", 0) + 1
+                )
+
+    def _tally(self):
+        with self._ledger_lock:
+            return sum(self.ledger.values())
+
+    def audit(self):
+        with self._stats_lock:            # stats -> ledger (via _tally)
+            total = self._tally()
+            self.stats["audited_total"] = total
+            return dict(self.stats)
+
+
+class OrderedCoordinator:
+    """The clean twin: identical behavior, ONE order (ledger -> stats
+    everywhere; audit snapshots under ledger first). Must scan clean."""
+
+    def __init__(self):
+        self._ledger_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self.ledger = {}
+        self.stats = {}
+
+    def transfer(self, key, amount):
+        with self._ledger_lock:           # ledger -> stats
+            self.ledger[key] = self.ledger.get(key, 0) + amount
+            with self._stats_lock:
+                self.stats["n_transfers"] = (
+                    self.stats.get("n_transfers", 0) + 1
+                )
+
+    def audit(self):
+        with self._ledger_lock:           # same order: ledger -> stats
+            total = sum(self.ledger.values())
+            with self._stats_lock:
+                self.stats["audited_total"] = total
+                return dict(self.stats)

@@ -644,3 +644,130 @@ class TestFramework:
         assert baseline_mod.unreviewed(data) == []
         new, _stale = baseline_mod.apply(findings, data)
         assert new == [], "\n".join(f.render() for f in new)
+
+
+class TestLockOrder:
+    def test_fixture_pair_flags_only_the_deadlocky_class(self):
+        """The seeded acceptance pair (tests/fixtures/lock_order.py):
+        DeadlockyCoordinator's AB/BA cycle is flagged at file:line on
+        BOTH edges (including the one formed transitively through
+        _tally), OrderedCoordinator scans clean."""
+        fs = by_checker(
+            run([str(FIXTURES / "lock_order.py")]), "lock-order"
+        )
+        assert len(fs) == 2
+        assert all("DeadlockyCoordinator" in f.symbol for f in fs)
+        keys = {f.key for f in fs}
+        assert keys == {
+            "lock-order-_ledger_lock-_stats_lock",
+            "lock-order-_stats_lock-_ledger_lock",
+        }
+        assert all(f.line > 0 for f in fs)
+
+    def test_nested_two_locks_one_order_clean(self, tmp_path):
+        src = (
+            "import threading\n"
+            "class W:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "        self.x = 0\n"
+            "    def one(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                self.x += 1\n"
+            "    def two(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                return self.x\n"
+        )
+        assert by_checker(lint(tmp_path, src), "lock-order") == []
+
+    def test_reverse_nesting_flagged(self, tmp_path):
+        src = (
+            "import threading\n"
+            "class W:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "        self.x = 0\n"
+            "    def one(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                self.x += 1\n"
+            "    def two(self):\n"
+            "        with self._b:\n"
+            "            with self._a:\n"
+            "                return self.x\n"
+        )
+        fs = by_checker(lint(tmp_path, src), "lock-order")
+        assert len(fs) == 2
+        assert {f.line for f in fs} == {9, 13}
+
+    def test_sequential_acquisition_is_not_an_order(self, tmp_path):
+        """Taking A, releasing it, then taking B imposes no order — only
+        NESTED holds build edges."""
+        src = (
+            "import threading\n"
+            "class W:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "        self.x = 0\n"
+            "    def one(self):\n"
+            "        with self._a:\n"
+            "            self.x += 1\n"
+            "        with self._b:\n"
+            "            self.x += 1\n"
+            "    def two(self):\n"
+            "        with self._b:\n"
+            "            self.x += 1\n"
+            "        with self._a:\n"
+            "            return self.x\n"
+        )
+        assert by_checker(lint(tmp_path, src), "lock-order") == []
+
+    def test_transitive_cycle_through_call_flagged(self, tmp_path):
+        src = (
+            "import threading\n"
+            "class W:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "        self.x = 0\n"
+            "    def _take_b(self):\n"
+            "        with self._b:\n"
+            "            self.x += 1\n"
+            "    def one(self):\n"
+            "        with self._a:\n"
+            "            self._take_b()\n"
+            "    def two(self):\n"
+            "        with self._b:\n"
+            "            with self._a:\n"
+            "                return self.x\n"
+        )
+        fs = by_checker(lint(tmp_path, src), "lock-order")
+        assert len(fs) == 2
+
+    def test_single_lock_class_has_no_order_contract(self, tmp_path):
+        src = (
+            "import threading\n"
+            "class W:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self.x = 0\n"
+            "    def one(self):\n"
+            "        with self._a:\n"
+            "            with self._a:\n"
+            "                self.x += 1\n"
+        )
+        assert by_checker(lint(tmp_path, src), "lock-order") == []
+
+    def test_shipped_batcher_two_lock_pattern_is_acyclic(self):
+        """The multi-engine DynamicBatcher's documented order
+        (_engine_lock -> _counter_lock) scans clean — the target this
+        checker ships alongside."""
+        import glom_tpu.serve.batcher as batcher_mod
+
+        fs = by_checker(run([batcher_mod.__file__]), "lock-order")
+        assert fs == []

@@ -15,6 +15,7 @@ unfiltered, and `python -m glom_tpu.resilience` drives the same kill
 scenario against the REAL training CLI.
 """
 
+import json
 import os
 import signal
 import subprocess
@@ -461,3 +462,47 @@ class TestServeFlapBurst:
                 assert schema.validate_record(rec) == [], rec
         finally:
             set_global_watchdog(None)
+
+
+class TestKillServe:
+    @pytest.mark.slow  # subprocess serve run; CI chaos job runs it
+    def test_kill_serve_scenario_validates_failover_evidence(self, tmp_path):
+        """The serve-side chaos acceptance: `python -m glom_tpu.resilience
+        --scenario kill-serve` permanently fails engine 0 of a 2-engine
+        micro-server via the seeded dispatch_fault seam and must prove,
+        from the evidence alone, that every queued ticket re-dispatched
+        to the sibling (rc 0, stamped faults + engine_failover +
+        engine_dead, exact ticket conservation, lint-clean stream)."""
+        import subprocess
+        import sys
+
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "glom_tpu.resilience",
+                "--scenario", "kill-serve",
+                "--dir", str(tmp_path),
+                "--requests", "8",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=500,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        driver = [
+            json.loads(l)
+            for l in proc.stdout.splitlines()
+            if l.strip().startswith("{")
+        ]
+        summary = [r for r in driver if r.get("event") == "chaos-summary"]
+        assert summary and summary[0]["ok"] is True
+        assert summary[0]["n_failovers"] >= 1
+        metrics = tmp_path / "serve_metrics.jsonl"
+        recs = [
+            json.loads(l)
+            for l in metrics.read_text().splitlines()
+            if l.strip().startswith("{")
+        ]
+        s = [r for r in recs if r.get("event") == "summary"][-1]
+        assert s["n_served"] == 8 and s["n_failed"] == 0
+        assert not s["engines"]["engine0"]["alive"]

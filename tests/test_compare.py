@@ -235,3 +235,42 @@ class TestCli:
         results = compare_files(FIXTURE_BASE, FIXTURE_NEW)
         statuses = {r["status"] for r in results}
         assert "regression" in statuses and "unmeasured-in-new" in statuses
+
+
+class TestBenchArtifacts:
+    def test_artifact_tail_rows_compare(self, tmp_path):
+        """BENCH_r0x.json round artifacts (one JSON object, bench rows in
+        "tail") ride the same gate; legacy value-0.0 + error dead zeros
+        classify UNMEASURED, never zero."""
+        import json
+
+        from glom_tpu.telemetry.compare import artifact_lines, compare_files
+
+        base = tmp_path / "BENCH_r01.json"
+        new = tmp_path / "BENCH_r02.json"
+        row = {"metric": "fwd x", "value": 100.0, "unit": "col/s",
+               "kind": "bench", "schema_version": 4}
+        dead = {"metric": "train y (UNMEASURED)", "value": 0.0,
+                "unit": "col/s", "error": "backend-init-unavailable"}
+        base.write_text(json.dumps(
+            {"n": 1, "tail": json.dumps(row) + "\n" + json.dumps(dead)}
+        ))
+        slower = dict(row, value=50.0)
+        new.write_text(json.dumps({"n": 2, "tail": json.dumps(slower)}))
+        assert len(artifact_lines(str(base))) == 2
+        results = compare_files(str(base), str(new), artifacts=True)
+        by_metric = {r["metric"]: r for r in results}
+        assert by_metric["fwd x"]["status"] == "regression"
+        assert by_metric["train y (UNMEASURED)"]["status"] == "unmeasured-both"
+
+    def test_parsed_fallback_when_tail_empty(self, tmp_path):
+        import json
+
+        from glom_tpu.telemetry.compare import artifact_lines
+
+        p = tmp_path / "BENCH_r03.json"
+        p.write_text(json.dumps(
+            {"parsed": {"metric": "m", "value": 1.0, "unit": "x"}}
+        ))
+        lines = artifact_lines(str(p))
+        assert len(lines) == 1 and json.loads(lines[0])["metric"] == "m"

@@ -533,7 +533,11 @@ class TestBatcherLadder:
         batcher.submit(np.zeros((3, 8, 8), np.float32))  # fills depth-1
         with pytest.raises(QueueFullError) as ei:
             batcher.submit(np.zeros((3, 8, 8), np.float32))
-        assert ei.value.detail == {"queue_depth": 1, "queue_capacity": 1}
+        assert ei.value.detail == {
+            "queue_depth": 1,
+            "queue_capacity": 1,
+            "continuations_queued": 0,
+        }
         shed = [r for r in w.records if r.get("event") == "shed"]
         assert shed[0]["queue_depth"] == 1
         assert shed[0]["reason"] == "queue-full"

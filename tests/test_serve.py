@@ -23,6 +23,7 @@ from glom_tpu.serve.batcher import (
     BackendDownError,
     DynamicBatcher,
     QueueFullError,
+    ShedError,
 )
 from glom_tpu.serve.early_exit import (
     glom_forward_auto,
@@ -492,3 +493,393 @@ class TestServeCli:
         assert len(responses) == 3 and all(r["ok"] for r in responses)
         assert any(r.get("event") == "summary" for r in recs)
         assert any(r.get("event") == "warmup" for r in recs)
+
+
+# ---------------------------------------------------------------------------
+# two-tier early exit (glom_forward_tiered + the continuation queue)
+# ---------------------------------------------------------------------------
+
+
+class TestTieredExit:
+    def test_threshold_zero_is_bitwise_fixed_iters(self, params, img):
+        """The PR 4 contract survives the per-row witness: at threshold 0
+        no row can ever converge, the quorum never votes, exactly
+        max_iters run, and the output is bitwise the fixed forward's."""
+        from glom_tpu.serve.early_exit import glom_forward_tiered
+
+        fixed = jax.jit(
+            lambda p, x: glom_forward(p, x, CFG, iters=6)
+        )(params, img)
+        res = jax.jit(
+            lambda p, x: glom_forward_tiered(
+                p, x, CFG, max_iters=6, threshold=0.0
+            )
+        )(params, img)
+        assert int(res.iters_run) == 6
+        assert not np.asarray(res.row_converged).any()
+        assert np.array_equal(np.asarray(fixed), np.asarray(res.levels))
+
+    @pytest.mark.slow  # one more while_loop compile; CI serve job runs it
+    def test_quorum_exits_before_all_rows_converge(self, params, img):
+        """quorum=0.5 over two settled rows + two cold rows: the bucket
+        exits once the settled half converges, with the cold rows
+        reported unconverged — the straggler set the batcher re-buckets."""
+        from glom_tpu.serve.early_exit import glom_forward_tiered
+
+        settled = glom_forward(params, img, CFG, iters=40)
+        lv0 = jnp.concatenate(
+            [
+                settled,
+                jnp.broadcast_to(
+                    jnp.asarray(params.init_levels)[None, None],
+                    settled.shape,
+                ).astype(settled.dtype),
+            ],
+            axis=0,
+        )
+        both = jnp.concatenate([img, img], axis=0)
+        res = jax.jit(
+            lambda p, x, lv: glom_forward_tiered(
+                p, x, CFG, max_iters=12, threshold=1e-3, quorum=0.5,
+                levels=lv,
+            )
+        )(params, both, lv0)
+        conv = np.asarray(res.row_converged)
+        assert int(res.iters_run) < 12
+        assert conv[:2].all()          # the settled half carried the quorum
+        assert not conv[2:].all()      # cold rows are the stragglers
+
+    @pytest.mark.slow  # compiles its own warm engine route; CI runs it
+    def test_warm_pad_rows_never_vote(self, params, img):
+        """A continuation bucket's PAD rows carry arbitrary warm-state
+        garbage; the masked witness must keep the exit identical whatever
+        occupies them — the warm twin of the cold pad-row lock."""
+        from glom_tpu.serve.early_exit import glom_forward_tiered
+
+        settled = glom_forward(params, img, CFG, iters=40)
+        pad_imgs = jnp.concatenate([img, jnp.zeros_like(img)], axis=0)
+        mask = jnp.asarray([True, True, False, False])
+        fn = jax.jit(
+            lambda p, x, lv, m: glom_forward_tiered(
+                p, x, CFG, max_iters=8, threshold=1e-2, levels=lv,
+                valid_mask=m,
+            )
+        )
+        lv_a = jnp.concatenate([settled, jnp.zeros_like(settled)], axis=0)
+        lv_b = jnp.concatenate(
+            [settled, 100.0 * jnp.ones_like(settled)], axis=0
+        )
+        res_a = fn(params, pad_imgs, lv_a, mask)
+        res_b = fn(params, pad_imgs, lv_b, mask)
+        assert int(res_a.iters_run) == int(res_b.iters_run)
+        assert np.array_equal(
+            np.asarray(res_a.levels[:2]), np.asarray(res_b.levels[:2])
+        )
+
+    @pytest.mark.slow  # several engine compiles; CI serve job runs it
+    def test_continuation_bitwise_parity_and_iter_conservation(self, params):
+        """THE two-tier correctness lock: a straggler exited at the quorum
+        and continued from its warm state must land on BITWISE the same
+        final columns, after the same TOTAL iteration count, as the same
+        request run to convergence in one batch (threshold-0 discipline:
+        row updates are batch-independent, the witness only ever decides
+        when to stop)."""
+        from glom_tpu.serve.engine import InferenceEngine
+
+        rng = np.random.default_rng(0)
+        easy = [
+            rng.normal(size=(3, 8, 8)).astype(np.float32) for _ in range(2)
+        ]
+        hard = (100.0 * rng.normal(size=(3, 8, 8))).astype(np.float32)
+        scfg = ServeConfig(
+            buckets=(1, 2, 4), max_batch=4, max_delay_ms=100.0,
+            iters="auto", exit_threshold=1e-3, max_auto_iters=16,
+            exit_quorum=0.5, max_continuations=3,
+        )
+        eng = InferenceEngine(CFG, scfg, params=params)
+        with DynamicBatcher(eng) as b:
+            tickets = [
+                b.submit(easy[0]), b.submit(hard), b.submit(easy[1]),
+            ]
+            outs = [t.result(timeout=120.0) for t in tickets]
+            summary = b.summary_record()
+        # Conservation across the re-bucketing: every ticket terminal,
+        # each request resolved exactly once.
+        assert summary["n_served"] == 3 and summary["n_failed"] == 0
+        assert sum(summary["iters_histogram"].values()) == 3
+        assert summary["n_continued"] >= 1  # the hard row re-bucketed
+        # Reference: the hard request alone, to convergence, in ONE batch.
+        ref_scfg = ServeConfig(
+            buckets=(1, 2, 4), max_batch=4, iters="auto",
+            exit_threshold=1e-3, max_auto_iters=16,
+        )
+        ref = InferenceEngine(CFG, ref_scfg, params=params).infer(
+            hard[None], n_valid=1
+        )
+        levels, total_iters, _ = outs[1]
+        assert total_iters == ref.iters_run
+        assert np.array_equal(levels, np.asarray(ref.levels[0]))
+
+
+class TieredFakeEngine:
+    """Host-side two-tier policy probe: first (cold) dispatch reports the
+    last `n_stragglers` valid rows unconverged; warm dispatches converge
+    everyone. Records every call's kind."""
+
+    def __init__(self, n_stragglers=1, buckets=(1, 2, 4), fail=None,
+                 name="fake0"):
+        self.scfg = ServeConfig(
+            buckets=buckets, max_batch=max(buckets), max_delay_ms=5.0,
+            queue_depth=8, iters="auto", max_auto_iters=12,
+            exit_quorum=0.5, max_continuations=2, dispatch_retries=0,
+        )
+        self.iters_key = "auto"
+        self.auto_budget = 12
+        self.n_stragglers = n_stragglers
+        self.fail = fail
+        self.name = name
+        self.calls = []
+
+    def pick_bucket(self, n):
+        for b in self.scfg.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"n={n} exceeds the largest bucket")
+
+    def infer(self, imgs, n_valid=None, levels0=None, auto_budget=None,
+              **kw):
+        if self.fail is not None:
+            raise self.fail
+        b = imgs.shape[0]
+        warm = levels0 is not None
+        self.calls.append(
+            {"bucket": b, "n_valid": n_valid, "warm": warm,
+             "auto_budget": auto_budget}
+        )
+        iters = 4 if not warm else (auto_budget or 8)
+        conv = np.ones((b,), bool)
+        if not warm:
+            conv[max(0, n_valid - self.n_stragglers):n_valid] = False
+        return ServeResult(
+            levels=np.zeros((b, 16, 3, 16), np.float32),
+            iters_run=iters,
+            latency_s=0.0,
+            bucket=b,
+            compiled=False,
+            row_converged=conv,
+            row_iters=np.full((b,), iters, np.int32),
+        )
+
+
+class TestContinuationQueue:
+    def test_straggler_rebuckets_and_tickets_conserve(self):
+        """3 requests, 1 straggler: the straggler's ticket resolves after
+        its warm continuation with the SUMMED executed iterations; the
+        histograms split by tier and conservation holds."""
+        eng = TieredFakeEngine(n_stragglers=1)
+        sink = Sink()
+        with DynamicBatcher(eng, max_batch=4, max_delay_ms=10.0,
+                            writer=sink) as b:
+            tickets = [b.submit(IMG) for _ in range(3)]
+            outs = [t.result(timeout=10.0) for t in tickets]
+            summary = b.summary_record()
+        # Two fast rows resolved at tier 0 with 4 executed iters; the
+        # straggler rode one warm hop: 4 + remaining (12 - 4 = 8) = 12.
+        assert sorted(o[1] for o in outs) == [4, 4, 12]
+        assert summary["n_served"] == 3 and summary["n_failed"] == 0
+        assert summary["n_continued"] == 1
+        assert summary["iters_histogram"] == {"4": 2, "12": 1}
+        assert summary["iters_histogram_by_tier"] == {
+            "0": {"4": 2}, "1": {"12": 1},
+        }
+        warm_calls = [c for c in eng.calls if c["warm"]]
+        assert len(warm_calls) == 1
+        assert warm_calls[0]["auto_budget"] == 8  # the REMAINING budget
+        cont = [r for r in sink.records if r.get("event") == "continuation"]
+        assert cont and cont[0]["n_stragglers"] == 1
+        for r in sink.records + [summary]:
+            assert schema.validate_record(r) == [], r
+
+    def test_continuation_hops_are_bounded(self):
+        """A row that never converges resolves once max_continuations is
+        exhausted — two-tier must not orbit forever."""
+
+        class NeverConverges(TieredFakeEngine):
+            def infer(self, imgs, n_valid=None, levels0=None,
+                      auto_budget=None, **kw):
+                res = super().infer(
+                    imgs, n_valid=n_valid, levels0=levels0,
+                    auto_budget=auto_budget, **kw
+                )
+                conv = np.zeros((imgs.shape[0],), bool)
+                return res._replace(row_converged=conv, iters_run=2)
+
+        eng = NeverConverges()
+        with DynamicBatcher(eng, max_batch=2, max_delay_ms=10.0) as b:
+            t = b.submit(IMG)
+            _, iters_run, _ = t.result(timeout=10.0)
+            summary = b.summary_record()
+        # initial + max_continuations hops, 2 iters each
+        assert iters_run == 2 * (1 + eng.scfg.max_continuations)
+        assert summary["n_served"] == 1
+        assert summary["n_continued"] == eng.scfg.max_continuations
+
+
+class TestMultiEngineFanOut:
+    def test_failover_redispatches_to_sibling_and_conserves(self):
+        """A permanently failing engine's batches hand over to the
+        sibling; the dead engine is marked, every ticket resolves, and
+        conservation holds — the kill-serve chaos contract, host-side."""
+        sink = Sink()
+        bad = FakeEngine()
+        bad.fail = RuntimeError("engine0 boom")
+        bad.name = "bad"
+        good = FakeEngine()
+        good.name = "good"
+        with DynamicBatcher(engines=[bad, good], max_batch=2,
+                            max_delay_ms=10.0, writer=sink) as b:
+            tickets = [b.submit(IMG) for _ in range(6)]
+            outs = [t.result(timeout=10.0) for t in tickets]
+            summary = b.summary_record()
+        assert all(o[1] == 6 for o in outs)
+        assert summary["n_served"] == 6 and summary["n_failed"] == 0
+        assert summary["n_redispatched"] >= 1
+        assert not summary["engines"]["bad"]["alive"]
+        assert summary["engines"]["bad"]["dispatches"] == 0
+        assert summary["engines"]["good"]["dispatches"] >= 1
+        events = [r.get("event") for r in sink.records]
+        assert "engine_failover" in events and "engine_dead" in events
+        assert not bad.calls and good.calls
+
+    def test_single_engine_dispatch_error_still_fails_fast(self):
+        """With no sibling there is no failover: the batch fails fast
+        exactly as before (the PR 4 contract unchanged)."""
+        eng = FakeEngine(fail=RuntimeError("XLA boom"))
+        with DynamicBatcher(eng, max_batch=2, max_delay_ms=10.0) as b:
+            t = b.submit(IMG)
+            with pytest.raises(RuntimeError, match="XLA boom"):
+                t.result(timeout=10.0)
+
+    def test_all_engines_dead_sheds_new_admissions(self):
+        bad1 = FakeEngine(fail=RuntimeError("boom1"))
+        bad1.name = "b1"
+        bad2 = FakeEngine(fail=RuntimeError("boom2"))
+        bad2.name = "b2"
+        with DynamicBatcher(engines=[bad1, bad2], max_batch=1,
+                            max_delay_ms=5.0, max_redispatch=1) as b:
+            tickets = [b.submit(IMG) for _ in range(4)]
+            for t in tickets:
+                with pytest.raises(Exception):
+                    t.result(timeout=10.0)
+            # Both engines dead: admission now sheds fast, never strands.
+            deadline = time.perf_counter() + 5.0
+            while b._alive_engines() and time.perf_counter() < deadline:
+                time.sleep(0.01)
+            with pytest.raises(ShedError):
+                b.submit(IMG)
+        # Summary AFTER stop(): whatever could no longer resolve has been
+        # failed, so conservation is exact.
+        summary = b.summary_record()
+        assert summary["n_served"] == 0
+        total = (summary["n_failed"] + summary["n_shed"])
+        assert total == summary["n_requests"]
+
+    def test_explicit_ladder_rejected_with_multiple_engines(self):
+        from glom_tpu.resilience.ladder import DegradationLadder
+
+        ladder = DegradationLadder(degraded_iters=2, bucket_cap=1)
+        with pytest.raises(ValueError, match="single engine"):
+            DynamicBatcher(
+                engines=[FakeEngine(), FakeEngine()], ladder=ladder
+            )
+
+
+class TestReviewRegressions:
+    def test_warm_hop_under_degraded_ladder_uses_fixed_budget(self):
+        """A ladder that degrades to capped_iters BETWEEN a straggler's
+        cold dispatch and its warm hop: the warm dispatch must ride the
+        fixed degraded route (no auto_budget — the engine rejects the
+        combination), resolving the ticket instead of failing it."""
+
+        class StrictTiered(TieredFakeEngine):
+            def infer(self, imgs, n_valid=None, levels0=None,
+                      auto_budget=None, iters_override=None, **kw):
+                if auto_budget is not None and iters_override is not None:
+                    raise ValueError(
+                        "auto_budget composes with the auto route only"
+                    )
+                if iters_override is not None:
+                    b = imgs.shape[0]
+                    self.calls.append(
+                        {"bucket": b, "n_valid": n_valid,
+                         "warm": levels0 is not None,
+                         "iters_override": iters_override}
+                    )
+                    return ServeResult(
+                        levels=np.zeros((b, 16, 3, 16), np.float32),
+                        iters_run=iters_override, latency_s=0.0,
+                        bucket=b, compiled=False,
+                        row_converged=np.ones((b,), bool),
+                        row_iters=np.full((b,), iters_override, np.int32),
+                    )
+                return super().infer(
+                    imgs, n_valid=n_valid, levels0=levels0,
+                    auto_budget=auto_budget, **kw
+                )
+
+        eng = StrictTiered(n_stragglers=1)
+
+        class FlipLadder:
+            """NORMAL until the first (cold) dispatch lands, then
+            capped_iters — the degradation racing the continuation."""
+
+            degraded_iters = 3
+            bucket_cap = 4
+
+            def rung(self):
+                from glom_tpu.resilience.ladder import CAPPED_ITERS, NORMAL
+
+                return CAPPED_ITERS if eng.calls else NORMAL
+
+            def rung_name(self):
+                from glom_tpu.resilience.ladder import RUNGS
+
+                return RUNGS[self.rung()]
+
+            def observe(self, **kw):
+                return self.rung()
+
+            def record(self):
+                return {"ladder_rung": self.rung_name()}
+
+        with DynamicBatcher(eng, max_batch=2, max_delay_ms=10.0,
+                            ladder=FlipLadder()) as b:
+            tickets = [b.submit(IMG), b.submit(IMG)]
+            outs = [t.result(timeout=10.0) for t in tickets]
+            summary = b.summary_record()
+        assert summary["n_served"] == 2 and summary["n_failed"] == 0
+        warm_calls = [c for c in eng.calls if c.get("warm")]
+        assert warm_calls and warm_calls[0]["iters_override"] == 3
+
+    def test_multi_engine_summary_nests_retry_records_per_engine(self):
+        """Fan-out summaries must not let one engine's retry/ladder
+        rollup overwrite a sibling's: they nest under engines[name]."""
+        from glom_tpu.resilience.retry import RetryPolicy
+
+        e0, e1 = FakeEngine(), FakeEngine()
+        e0.name, e1.name = "e0", "e1"
+        e0.retry = RetryPolicy(retries=1, site="e0-dispatch")
+        e1.retry = RetryPolicy(retries=1, site="e1-dispatch")
+        with DynamicBatcher(engines=[e0, e1], max_batch=1,
+                            max_delay_ms=5.0) as b:
+            for t in [b.submit(IMG) for _ in range(4)]:
+                t.result(timeout=10.0)
+            summary = b.summary_record()
+        assert "retry_site" not in summary  # no flat (last-wins) merge
+        sites = {
+            name: st.get("retry", {}).get("retry_site")
+            for name, st in summary["engines"].items()
+        }
+        assert set(sites.values()) <= {"e0-dispatch", "e1-dispatch", None}
+        assert any(v for v in sites.values())
+        assert schema.validate_record(summary) == []
