@@ -360,6 +360,137 @@ def run_two_tier_ab(cfg, scfg, label: str, *, n_requests: int,
     return means
 
 
+def run_temporal(cfg, scfg, label: str, *, n_streams: int, n_frames: int,
+                 perturb: float, n_engines: int = 1) -> dict:
+    """Frame-sequence (streaming) traffic: warm-start vs cold-start A/B.
+
+    S streams, F frames each; every frame is a small perturbation of its
+    stream's base image (hard 100x-scale bases — the convergence-depth
+    lever from the hetero mode, so a cold start runs near the budget).
+    Frames advance in lockstep rounds — frame t of every stream resolves
+    before frame t+1 submits, the temporal contract a video frontend
+    provides — and the same traffic is served twice:
+
+      * cold — column cache disabled: every frame pays full convergence;
+      * warm — cache sized for all S streams: frame t+1 dispatches from
+        frame t's converged columns (the engine's warm levels0 route).
+
+    The measured number is mean executed column-iters/request per arm
+    (`serve_temporal_mean_iters`); the warm arm's summary additionally
+    carries the cache rollup, whose `bytes_peak <= budget_bytes` the CI
+    gate asserts. Returns {arm: mean} so CI can assert warm < cold as a
+    measured fact."""
+    import dataclasses
+
+    import numpy as np
+
+    from glom_tpu.serve.batcher import DynamicBatcher, ShedError
+    from glom_tpu.serve.column_cache import column_state_bytes
+    from glom_tpu.telemetry.sinks import emit
+
+    if scfg.iters != "auto":
+        emit(
+            {"note": "temporal A/B skipped: the configured route is not "
+             "iters='auto' (a fixed budget saves no iterations warm)"},
+            kind="note",
+        )
+        return {}
+    rng = np.random.default_rng(11)
+    shape = (cfg.channels, cfg.image_size, cfg.image_size)
+    bases = [
+        (100.0 * rng.normal(size=shape)).astype(np.float32)
+        for _ in range(n_streams)
+    ]
+    frames = [
+        [
+            (bases[s] + perturb * rng.normal(size=shape)).astype(np.float32)
+            for _ in range(n_frames)
+        ]
+        for s in range(n_streams)
+    ]
+
+    budget_bytes = (n_streams + 1) * column_state_bytes(cfg, scfg)
+    arms = (
+        ("cold", dataclasses.replace(scfg, column_cache_bytes=0)),
+        ("warm", dataclasses.replace(scfg, column_cache_bytes=budget_bytes)),
+    )
+    means: dict = {}
+    for arm, arm_scfg in arms:
+        engines = _make_engines(cfg, arm_scfg, n_engines)
+        for eng in engines:
+            eng.warmup()
+        served = 0
+        with DynamicBatcher(engines=engines) as batcher:
+            for f in range(n_frames):
+                tickets = []
+                for s in range(n_streams):
+                    try:
+                        tickets.append(
+                            batcher.submit(frames[s][f], session_id=f"s{s}")
+                        )
+                    except ShedError:
+                        continue
+                for t in tickets:
+                    try:
+                        t.result(timeout=600.0)
+                        served += 1
+                    except Exception:
+                        continue
+            summary = batcher.summary_record()
+        mean = summary.get("mean_executed_iters")
+        emit(
+            {
+                "event": "temporal_summary",
+                "arm": arm,
+                "config": label,
+                "budget": engines[0].auto_budget,
+                "n_streams": n_streams,
+                "n_frames": n_frames,
+                "perturb": perturb,
+                "n": served,
+                "iters_histogram": summary["iters_histogram"],
+                "column_cache": summary.get("column_cache"),
+            },
+            kind="serve",
+        )
+        if mean is None:
+            emit(
+                {
+                    "metric": f"serve_temporal_mean_iters ({arm}, {label})",
+                    "value": None,
+                    "unit": "iters/request",
+                    "error": "no-requests-served",
+                    "note": f"UNMEASURED: temporal {arm} arm served nothing",
+                },
+                kind="error",
+            )
+        else:
+            means[arm] = mean
+            emit(
+                {
+                    "metric": f"serve_temporal_mean_iters ({arm}, {label})",
+                    "value": mean,
+                    "unit": "iters/request",
+                    "n_streams": n_streams,
+                    "n_frames": n_frames,
+                    "served": served,
+                }
+            )
+    if "cold" in means and "warm" in means and means["cold"] > 0:
+        emit(
+            {
+                "metric": f"serve_temporal_iters_saved ({label})",
+                "value": round(
+                    100.0 * (1.0 - means["warm"] / means["cold"]), 2
+                ),
+                "unit": "%",
+                "cold_mean": means["cold"],
+                "warm_mean": means["warm"],
+            }
+        )
+    return means
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
     ap.add_argument("--requests", type=int, default=None,
@@ -379,6 +510,18 @@ def main(argv=None) -> int:
     ap.add_argument("--hetero", type=float, default=0.5, metavar="FRAC",
                     help="fraction of HARD (slow-converging) requests in "
                     "the two-tier A/B's synthetic traffic (default 0.5)")
+    ap.add_argument("--temporal", action="store_true",
+                    help="run the streaming warm-vs-cold A/B INSTEAD of "
+                    "the load sweep: frame-sequence traffic per stream "
+                    "through the session column cache, measuring mean "
+                    "executed iters/request per arm (docs/SERVING.md)")
+    ap.add_argument("--streams", type=int, default=4, metavar="S",
+                    help="temporal mode: number of concurrent streams")
+    ap.add_argument("--frames", type=int, default=4, metavar="F",
+                    help="temporal mode: frames per stream")
+    ap.add_argument("--perturb", type=float, default=0.05, metavar="P",
+                    help="temporal mode: per-frame perturbation scale "
+                    "relative to the stream's base image (default 0.05)")
     args = ap.parse_args(argv)
 
     from glom_tpu.telemetry.sinks import bench_bootstrap, emit
@@ -449,6 +592,15 @@ def main(argv=None) -> int:
     if scfg.mesh_data > 1 or scfg.mesh_seq > 1:
         label = f"{label}, mesh={scfg.mesh_data}x{scfg.mesh_seq}"
     del jax  # imported to fail fast before any measurement if broken
+    if args.temporal:
+        run_temporal(
+            cfg, scfg, label,
+            n_streams=args.streams,
+            n_frames=args.frames,
+            perturb=args.perturb,
+            n_engines=args.engines,
+        )
+        return 0
     run_sweep(
         cfg, scfg, label,
         n_requests=n_requests,

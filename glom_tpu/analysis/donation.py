@@ -22,10 +22,32 @@ Intra-function analysis:
   * any later read of a dead name (before it is re-assigned) is a
     finding.
 
-Branch structure is ignored (statement order by line); cross-function
-flows (a compiled handle stashed in a dict and fetched elsewhere, as the
-engine's memoization does) are out of reach — the runtime copy-guard in
-engine.infer stays the defense there, and docs/ANALYSIS.md says so.
+Memoized-handle taint (intra-CLASS): the engine's real dispatch pattern —
+`self._compiled[sig] = jax.jit(...).lower(...).compile()` in one method,
+`fn = self._compile(...)` then `fn(imgs)` in another — was a PR 5 blind
+spot: the donating callable crosses a method boundary through an
+attribute, so the intra-function pass never saw the dispatch. The pass
+now tracks, per class:
+
+  * HANDLE ATTRS — `self.<attr>` / `self.<attr>[key]` assigned (in any
+    method) from a donating jit chain, following the chain across local
+    statements (`lowered = jax.jit(...).lower(...)` then
+    `lowered.compile()`); a jit call whose kwargs arrive via `**splat`
+    (the engine's `jit(fn, **jit_kw)`) is conservatively treated as
+    donating EVERY positional argument on this path only — the direct
+    intra-function rule is unchanged;
+  * PROVIDER METHODS — methods that return a donating handle (a tainted
+    local name, or a load of a handle attr), so
+    `fn = self._compile(...)` taints `fn`;
+  * at calls of a tainted name, a handle-attr load (`self._compiled[sig]
+    (...)`, `self._step(...)`), the use-after-donation rule applies as
+    in the intra-function case.
+
+Branch structure is ignored (statement order by line); `*args` splats at
+call sites are skipped (positions unknowable — the runtime copy-guard in
+engine.infer stays the defense there), and cross-MODULE handle flows
+remain out of reach; docs/ANALYSIS.md says so. The seeded acceptance
+pair is tests/fixtures/donation_memo.py.
 """
 
 from __future__ import annotations
@@ -44,18 +66,30 @@ from glom_tpu.analysis.core import Checker, Context, Finding, SourceModule
 ALL_POSITIONS = -1  # sentinel: unresolvable argnums — treat all as donated
 
 
-def _jit_donation(call: ast.Call) -> Optional[object]:
+def _jit_donation(
+    call: ast.Call, conservative_splat: bool = False
+) -> Optional[object]:
     """Donated-position spec if `call` is a jit(...) with donation: a
-    tuple of ints, ALL_POSITIONS, or None (no donation / not a jit)."""
+    tuple of ints, ALL_POSITIONS, or None (no donation / not a jit).
+    `conservative_splat=True` (the memoized-handle path only) treats a
+    jit whose kwargs arrive via `**splat` as donating every position —
+    the engine builds `jit(fn, **jit_kw)` with the donation inside the
+    dict, invisible to a literal scan."""
     name = call_name(call) or ""
     if name.split(".")[-1] not in ("jit", "pjit"):
         return None
+    saw_splat = False
     for kw in call.keywords:
+        if kw.arg is None:
+            saw_splat = True
+            continue
         if kw.arg in ("donate_argnums", "donate_argnames"):
             spec = literal_int_tuple(kw.value)
             if kw.arg == "donate_argnames":
                 return ALL_POSITIONS  # names don't map to positions here
             return spec if spec is not None else ALL_POSITIONS
+    if saw_splat and conservative_splat:
+        return ALL_POSITIONS
     return None
 
 
@@ -76,15 +110,171 @@ def _root_jit_call(node: ast.AST) -> Optional[ast.Call]:
     return None
 
 
+def _method_class(info: FuncInfo) -> Optional[str]:
+    """The class name when `info` is a method (qualname 'Cls.method',
+    first parameter 'self'); None otherwise."""
+    parts = info.qualname.split(".")
+    if len(parts) < 2:
+        return None
+    params = info.params
+    if not params or params[0] != "self":
+        return None
+    return parts[-2]
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'attr' for a bare `self.attr` expression."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _self_attr_subscript(node: ast.AST) -> Optional[str]:
+    """'attr' for a `self.attr[key]` expression (the memo-dict shape)."""
+    if isinstance(node, ast.Subscript):
+        return _self_attr(node.value)
+    return None
+
+
+def _chain_spec(expr: ast.AST, known: Dict[str, object]) -> Optional[object]:
+    """Donation spec of an expression that is (a chain off) a donating
+    jit: `jax.jit(...)[.lower(...).compile()]` directly, or
+    `name.lower(...)` / `name.compile()` where `name` is already known
+    donating — the cross-STATEMENT half of the engine's AOT idiom."""
+    node = expr
+    while True:
+        if isinstance(node, ast.Call):
+            jit_call = _root_jit_call(node)
+            if jit_call is not None:
+                return _jit_donation(jit_call, conservative_splat=True)
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr in (
+                "lower",
+                "compile",
+            ):
+                node = func.value
+                continue
+            return None
+        if isinstance(node, ast.Name):
+            return known.get(node.id)
+        return None
+
+
+def _merge_spec(prev: Optional[object], spec: object) -> object:
+    return spec if prev is None or prev == spec else ALL_POSITIONS
+
+
+def _ordered(nodes) -> List[ast.AST]:
+    return sorted(nodes, key=lambda n: getattr(n, "lineno", 0))
+
+
 class DonationSafety(Checker):
     name = "donation-safety"
     description = "no use of a caller-held array after a donated dispatch"
 
     def check(self, module: SourceModule, ctx: Context) -> List[Finding]:
+        handles = self._memo_handles(module)
+        providers = self._providers(module, handles)
         findings: List[Finding] = []
         for info in module.index.functions.values():
-            findings.extend(self._check_function(module, info))
+            findings.extend(
+                self._check_function(module, info, handles, providers)
+            )
         return findings
+
+    def _memo_handles(self, module: SourceModule) -> Dict[Tuple[str, str], object]:
+        """(class, attr) -> donation spec for `self.attr` / `self.attr[k]`
+        targets assigned from a donating jit chain anywhere in the
+        class — the memoized dispatch-handle table."""
+        handles: Dict[Tuple[str, str], object] = {}
+        for info in module.index.functions.values():
+            cls = _method_class(info)
+            if cls is None:
+                continue
+            known: Dict[str, object] = {}
+            for stmt in _ordered(
+                n for n in info.body_nodes() if isinstance(n, ast.Assign)
+            ):
+                spec = _chain_spec(stmt.value, known)
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        if spec is not None:
+                            known[t.id] = spec
+                        else:
+                            known.pop(t.id, None)  # rebind clears taint
+                        continue
+                    attr = _self_attr_subscript(t) or _self_attr(t)
+                    if attr is not None and spec is not None:
+                        handles[(cls, attr)] = _merge_spec(
+                            handles.get((cls, attr)), spec
+                        )
+        return handles
+
+    def _providers(
+        self,
+        module: SourceModule,
+        handles: Dict[Tuple[str, str], object],
+    ) -> Dict[Tuple[str, str], object]:
+        """(class, method) -> spec for methods that RETURN a donating
+        handle (a tainted local, or a handle-attr load) — the engine's
+        `_compile` shape, so `fn = self._compile(...)` taints `fn` at the
+        caller."""
+        providers: Dict[Tuple[str, str], object] = {}
+        for info in module.index.functions.values():
+            cls = _method_class(info)
+            if cls is None:
+                continue
+            known: Dict[str, object] = {}
+            for stmt in _ordered(
+                n
+                for n in info.body_nodes()
+                if isinstance(n, (ast.Assign, ast.Return))
+            ):
+                if isinstance(stmt, ast.Assign):
+                    spec = self._value_spec(stmt.value, known, cls, handles, {})
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            if spec is not None:
+                                known[t.id] = spec
+                            else:
+                                known.pop(t.id, None)
+                    continue
+                spec = self._value_spec(stmt.value, known, cls, handles, {})
+                if spec is not None:
+                    method = info.qualname.split(".")[-1]
+                    providers[(cls, method)] = _merge_spec(
+                        providers.get((cls, method)), spec
+                    )
+        return providers
+
+    @staticmethod
+    def _value_spec(
+        value: Optional[ast.AST],
+        known: Dict[str, object],
+        cls: Optional[str],
+        handles: Dict[Tuple[str, str], object],
+        providers: Dict[Tuple[str, str], object],
+    ) -> Optional[object]:
+        """Donation spec of a right-hand side / return value: a jit
+        chain, a tainted name, a handle-attr load, or a provider call."""
+        if value is None:
+            return None
+        spec = _chain_spec(value, known)
+        if spec is not None:
+            return spec
+        if cls is not None:
+            attr = _self_attr_subscript(value) or _self_attr(value)
+            if attr is not None and (cls, attr) in handles:
+                return handles[(cls, attr)]
+            if isinstance(value, ast.Call):
+                meth = _self_attr(value.func)
+                if meth is not None and (cls, meth) in providers:
+                    return providers[(cls, meth)]
+        return None
 
     def _donating_names(self, info: FuncInfo) -> Dict[str, object]:
         """name -> donated-position spec for callables bound inside this
@@ -143,26 +333,64 @@ class DonationSafety(Checker):
         return donating
 
     def _check_function(
-        self, module: SourceModule, info: FuncInfo
+        self,
+        module: SourceModule,
+        info: FuncInfo,
+        handles: Optional[Dict[Tuple[str, str], object]] = None,
+        providers: Optional[Dict[Tuple[str, str], object]] = None,
     ) -> List[Finding]:
+        handles = handles or {}
+        providers = providers or {}
+        cls = _method_class(info)
         donating = self._donating_names(info)
-        if not donating:
+        # Memoized-handle taint: names bound from a handle-attr load or a
+        # provider-method call become donating callables too (the
+        # `fn = self._compile(...)` shape), tracked in statement order so
+        # a rebind to something untainted clears the name.
+        if cls is not None and (handles or providers):
+            for stmt in _ordered(
+                n for n in info.body_nodes() if isinstance(n, ast.Assign)
+            ):
+                spec = self._value_spec(
+                    stmt.value, donating, cls, handles, providers
+                )
+                for t in stmt.targets:
+                    if not isinstance(t, ast.Name):
+                        continue
+                    if spec is not None:
+                        donating[t.id] = spec
+                    else:
+                        # Rebinding to a non-donating value clears the
+                        # taint — `fn = plain_fn` after
+                        # `fn = self._compile(...)` must not flag
+                        # plain_fn's call sites.
+                        donating.pop(t.id, None)
+        has_handle_calls = cls is not None and handles
+        if not donating and not has_handle_calls:
             return []
         # events in line order: donations (name killed at line) and uses
         donations: List[Tuple[int, str, str]] = []  # (line, var, callee)
         rebinds: Dict[str, List[int]] = {}
         uses: List[Tuple[int, int, ast.Name]] = []
         for node in info.body_nodes():
-            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
-                spec = donating.get(node.func.id)
+            if isinstance(node, ast.Call):
+                spec = callee = None
+                if isinstance(node.func, ast.Name):
+                    spec = donating.get(node.func.id)
+                    callee = node.func.id
+                elif cls is not None:
+                    # Direct dispatch through the memo table:
+                    # `self._compiled[sig](params, imgs)`.
+                    attr = _self_attr_subscript(node.func)
+                    if attr is not None and (cls, attr) in handles:
+                        spec = handles[(cls, attr)]
+                        callee = f"self.{attr}[...]"
                 if spec is not None:
                     for pos, arg in enumerate(node.args):
                         if isinstance(arg, ast.Name) and (
                             spec == ALL_POSITIONS or pos in spec
                         ):
-                            donations.append(
-                                (node.lineno, arg.id, node.func.id)
-                            )
+                            donations.append((node.lineno, arg.id, callee))
             if isinstance(node, ast.Name):
                 if isinstance(node.ctx, ast.Store):
                     rebinds.setdefault(node.id, []).append(node.lineno)

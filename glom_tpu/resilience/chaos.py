@@ -56,7 +56,8 @@ def build_parser() -> argparse.ArgumentParser:
         "(docs/RESILIENCE.md)",
     )
     p.add_argument(
-        "--scenario", choices=["kill-train", "preempt-train", "kill-serve"],
+        "--scenario",
+        choices=["kill-train", "preempt-train", "kill-serve", "rejoin-serve"],
         default="kill-train",
         help="kill-train = SIGKILL mid-run (uncatchable; resume must come "
         "from the last committed checkpoint); preempt-train = SIGTERM (the "
@@ -64,7 +65,10 @@ def build_parser() -> argparse.ArgumentParser:
         "resume); kill-serve = permanently fail one engine of a "
         "multi-engine serve run (seeded dispatch_fault) and require its "
         "queued tickets to re-dispatch to a sibling with a reconciling "
-        "evidence trail",
+        "evidence trail; rejoin-serve = kill engine 0 for a BOUNDED fault "
+        "window, then require probation to re-admit it (stamped "
+        "engine_rejoin) and the run to finish with engine 0 alive and "
+        "serving again",
     )
     p.add_argument("--dir", required=True, help="scenario working directory")
     p.add_argument("--preset", default="mnist")
@@ -180,23 +184,41 @@ def run_kill_serve(args) -> int:
         )
         return 1
     paths["metrics"].unlink(missing_ok=True)
+    rejoin = args.scenario == "rejoin-serve"
     cmd = [
         sys.executable, "-u", "-m", "glom_tpu.serve",
         "--preset", args.preset,
         "--synthetic", str(args.requests),
         "--engines", str(args.engines),
-        "--kill-engine", "0:after=0",
         "--dispatch-retries", "0",
         "--iters", "auto",
         "--buckets", "1,2,4",
         "--max-batch", "4",
         "--out", str(paths["metrics"]),
     ]
-    _note("chaos kill-serve: launching micro-server", cmd=" ".join(cmd),
-          workdir=str(workdir))
+    if rejoin:
+        # BOUNDED fault window: engine0's first 2 dispatch attempts fail
+        # (exactly the batcher's default death threshold), every attempt
+        # after recovers — so probation's health dispatches succeed and
+        # the fast 2-probe rejoin lands early in the run. The request gap
+        # paces traffic NEAR the per-dispatch service time: the live
+        # sibling is busy when the next request arrives, so the revived
+        # engine (the idle waiter) must pick up work — the scenario
+        # stays deterministic instead of racing worker wakeup order.
+        cmd += [
+            "--kill-engine", "0:after=0,until=2",
+            "--rejoin", "2",
+            "--rejoin-interval-ms", "50",
+            "--request-gap-ms", "20",
+        ]
+    else:
+        cmd += ["--kill-engine", "0:after=0"]
+    _note(f"chaos {args.scenario}: launching micro-server",
+          cmd=" ".join(cmd), workdir=str(workdir))
     _emit(
         {"fault": "engine-dead", "site": "engine0-dispatch",
-         "scenario": "kill-serve", "engines": args.engines},
+         "scenario": args.scenario, "engines": args.engines,
+         "fault_window": [0, 2] if rejoin else [0, None]},
         kind="fault",
     )
     proc = _spawn(cmd, paths["log"])
@@ -235,6 +257,7 @@ def run_kill_serve(args) -> int:
                         "the injection itself left no ground truth")
     failovers = [r for r in recs if r.get("event") == "engine_failover"]
     dead = [r for r in recs if r.get("event") == "engine_dead"]
+    rejoins = [r for r in recs if r.get("event") == "engine_rejoin"]
     if not failovers:
         failures.append("no engine_failover event: the dead engine's "
                         "batches were never handed to a sibling")
@@ -253,18 +276,36 @@ def run_kill_serve(args) -> int:
                 f"(want n_served == {args.requests}, n_failed == 0)"
             )
         eng0 = (s.get("engines") or {}).get("engine0", {})
-        if eng0.get("alive") or eng0.get("dispatches"):
+        if rejoin:
+            # The rejoin contract: probation re-admitted engine0 AND it
+            # served again — recovery proven by the evidence, not luck.
+            if not any(r.get("engine") == "engine0" for r in rejoins):
+                failures.append(
+                    "no stamped engine_rejoin event for engine0: "
+                    "probation never re-admitted the recovered engine"
+                )
+            if not eng0.get("alive") or not eng0.get("rejoins"):
+                failures.append(
+                    f"engine0 state does not reconcile with a rejoin: {eng0}"
+                )
+            if not eng0.get("dispatches"):
+                failures.append(
+                    "engine0 completed no dispatches after rejoin — it "
+                    f"was re-admitted but never re-served: {eng0}"
+                )
+        elif eng0.get("alive") or eng0.get("dispatches"):
             failures.append(
                 f"engine0 state does not reconcile with the kill: {eng0}"
             )
     failures.extend(_lint([paths["metrics"]]))
     summary = {
         "event": "chaos-summary",
-        "scenario": "kill-serve",
+        "scenario": args.scenario,
         "ok": not failures,
         "requests": args.requests,
         "n_fault_events": len(faults),
         "n_failovers": len(failovers),
+        "n_rejoins": len(rejoins),
         "failures": failures[:10],
     }
     _emit(summary, kind="summary")
@@ -276,7 +317,7 @@ def run_kill_serve(args) -> int:
 
 
 def run_scenario(args) -> int:
-    if args.scenario == "kill-serve":
+    if args.scenario in ("kill-serve", "rejoin-serve"):
         return run_kill_serve(args)
     workdir = Path(args.dir)
     paths = {

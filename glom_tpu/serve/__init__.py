@@ -14,6 +14,10 @@ between that checkpoint and traffic (docs/SERVING.md). Layers:
                  continuation queue (two-tier stragglers re-bucketed
                  warm), multi-engine fan-out with dead-engine failover,
                  and the fast-fail shed path wired to the watchdog
+    column_cache — ColumnCache: session-keyed warm-start column state
+                 (streaming: frame t+1 dispatches from frame t's
+                 converged columns), LRU under an HBM-priced byte
+                 budget, TTL, invalidation on engine failure
     early_exit — glom_forward_auto / glom_forward_tiered: lax.while_loop
                  over column updates with the consensus-agreement delta
                  as the stopping witness (iters="auto"; the tiered form
@@ -35,6 +39,9 @@ _EXPORTS = {
     "QueueFullError": "batcher",
     "ShedError": "batcher",
     "Ticket": "batcher",
+    "ColumnCache": "column_cache",
+    "column_state_bytes": "column_cache",
+    "resolve_column_cache": "column_cache",
     "TieredAutoResult": "early_exit",
     "batch_agreement": "early_exit",
     "glom_forward_auto": "early_exit",
@@ -43,7 +50,8 @@ _EXPORTS = {
     "emit_serve": "events",
     "stamp_serve": "events",
 }
-_SUBMODULES = ("batcher", "cli", "early_exit", "engine", "events")
+_SUBMODULES = ("batcher", "cli", "column_cache", "early_exit", "engine",
+               "events")
 
 __all__ = sorted([*_EXPORTS, *_SUBMODULES])
 

@@ -29,6 +29,30 @@ a static bucket and budget; what varies is which program a request's NEXT
 hop runs. Ticket conservation holds across hops — a request resolves
 exactly once, with the SUM of its dispatches' executed iterations.
 
+MIXED WARM/COLD BUCKETS: a dispatch is built row by row — each row is
+cold (the forward's own init), warm from the SESSION CACHE, or warm as a
+continuation straggler — via a per-row `levels0` select (cold rows ride
+the engine's `cold_levels()`, bitwise the init the forward would build
+itself). A continuation group therefore FOLDS waiting fresh traffic into
+its bucket's pad slots instead of dispatching alone, and the auto route's
+budget caps at the tightest row's remainder (rows capped short of their
+own budget simply re-enter the continuation queue with the difference).
+
+STREAMING (ServeConfig.column_cache_bytes > 0, serve/column_cache.py):
+submit(img, session_id=...) marks a request as one frame of a stream. At
+dispatch the worker warm-starts the row from the session's cached
+converged columns (hit/miss stamped on the dispatch record); on resolve
+the new converged columns write back under the key, LRU-evicted under
+the HBM-priced byte budget and TTL-expired when the stream goes quiet. A
+dispatch failure invalidates the failing engine's entries BEFORE any
+requeue, so stale or dead-engine state never warm-starts a request.
+
+ENGINE REJOIN (ServeConfig.rejoin_threshold > 0): a dead engine's worker
+hands off to a probation thread that health-dispatches the smallest
+bucket until N CONSECUTIVE successes re-admit the engine (stamped
+engine_probation / engine_rejoin events); a failed probe restarts the
+count. 0 keeps death terminal until restart — the pre-rejoin contract.
+
 MULTI-ENGINE FAN-OUT (engines=[...]): one worker thread per engine pulls
 from the SHARED admission queue — least-queue-depth dispatch by
 construction (an idle engine takes the next batch; a busy one doesn't
@@ -150,29 +174,34 @@ class Ticket:
         return self._levels, self._iters_run, self._latency_s
 
 
-class _Request:
-    __slots__ = ("img", "ticket", "redispatches")
+class _Item:
+    """One request's dispatch-side state, COLD or WARM in one shape (the
+    per-row `levels0` select needs rows of both kinds in one batch):
 
-    def __init__(self, img: np.ndarray, ticket: Ticket):
+      * cold — `levels is None`: the forward builds its own init;
+      * warm from the SESSION CACHE — `warm_src == "cache"`: levels is
+        the stream's previous converged state, full budget remains;
+      * warm as a CONTINUATION straggler — `warm_src == "cont"`: levels
+        is this request's own mid-flight state, `executed` iterations
+        already run, `hops` continuation dispatches taken.
+
+    The image rides every hop (tokens are recomputed — they are noise vs
+    one iteration); `redispatches` counts engine-failover hand-offs."""
+
+    __slots__ = (
+        "img", "ticket", "session", "levels", "executed", "hops",
+        "redispatches", "warm_src",
+    )
+
+    def __init__(self, img: np.ndarray, ticket: Ticket, session=None):
         self.img = img
         self.ticket = ticket
-        self.redispatches = 0  # engine-failover hand-offs so far
-
-
-class _Continuation:
-    """One straggler's warm state between hops: the image (tokens are
-    recomputed — they are noise vs one iteration), the carried [n, L, d]
-    column state, and the budget accounting."""
-
-    __slots__ = ("img", "levels", "ticket", "executed", "hops", "redispatches")
-
-    def __init__(self, img, levels, ticket, executed: int, hops: int):
-        self.img = img
-        self.levels = levels
-        self.ticket = ticket
-        self.executed = executed  # column iterations run so far
-        self.hops = hops          # continuation dispatches so far
+        self.session = session
+        self.levels: Optional[np.ndarray] = None
+        self.executed = 0  # column iterations run so far
+        self.hops = 0      # continuation dispatches so far
         self.redispatches = 0
+        self.warm_src: Optional[str] = None  # None | "cache" | "cont"
 
 
 def _backend_down() -> bool:
@@ -206,6 +235,9 @@ class DynamicBatcher:
         ladder=None,
         engine_fail_threshold: int = 2,
         max_redispatch: int = 2,
+        column_cache=None,
+        rejoin_threshold: Optional[int] = None,
+        rejoin_interval_ms: Optional[float] = None,
         clock=time.perf_counter,
     ):
         if (engine is None) == (engines is None):
@@ -241,6 +273,31 @@ class DynamicBatcher:
         self.shed_when_down = shed_when_down
         self.engine_fail_threshold = engine_fail_threshold
         self.max_redispatch = max_redispatch
+        # Streaming warm-start column cache (serve/column_cache.py):
+        # None RESOLVES from the lead engine's ServeConfig
+        # (column_cache_bytes > 0 builds one) — the ladder pattern. Pass
+        # an explicit ColumnCache to own the knobs/clock (tests do).
+        if column_cache is None:
+            from glom_tpu.serve.column_cache import resolve_column_cache
+
+            column_cache = resolve_column_cache(scfg, writer=writer)
+        self.cache = column_cache
+        # Engine REJOIN after recovery: a dead engine's worker hands off
+        # to a PROBATION thread that health-dispatches until
+        # rejoin_threshold consecutive successes re-admit the engine
+        # (stamped engine_rejoin). 0 (the default) keeps death terminal.
+        self._rejoin_threshold = (
+            rejoin_threshold if rejoin_threshold is not None
+            else (getattr(scfg, "rejoin_threshold", 0) if scfg else 0)
+        )
+        self._rejoin_interval_s = (
+            rejoin_interval_ms if rejoin_interval_ms is not None
+            else (getattr(scfg, "rejoin_interval_ms", 200.0) if scfg else 200.0)
+        ) / 1e3
+        if self._rejoin_threshold < 0:
+            raise ValueError(
+                f"rejoin_threshold {self._rejoin_threshold} must be >= 0"
+            )
         # Degradation ladders (glom_tpu/resilience/ladder.py) — PER
         # ENGINE: each engine's worker feeds its own ladder queue pressure
         # + backend state, a capped_iters-or-worse rung dispatches with
@@ -278,8 +335,8 @@ class DynamicBatcher:
         self.ladder = self._ladders[self._ename(self.engines[0], 0)]
         self._clock = clock
         self._q: queue.Queue = queue.Queue(maxsize=depth)
-        # Continuation queue: one GROUP (list of _Continuation sharing a
-        # source dispatch, hence a remaining budget) per entry. Unbounded:
+        # Continuation queue: one GROUP (list of warm _Item sharing a
+        # source dispatch) per entry. Unbounded:
         # its population is bounded by admitted-but-unresolved requests,
         # which the admission queue already bounds.
         self._cont_q: queue.Queue = queue.Queue()
@@ -296,6 +353,8 @@ class DynamicBatcher:
                 "alive": True,
                 "dispatches": 0,
                 "consecutive_failures": 0,
+                "probation": False,
+                "rejoins": 0,
             }
             for i, eng in enumerate(self.engines)
         }
@@ -311,6 +370,13 @@ class DynamicBatcher:
         self.n_degraded = 0   # requests served on a capped-iters rung
         self.n_continued = 0  # straggler re-bucket hops taken
         self.n_redispatched = 0  # engine-failover hand-offs
+        self.n_folded = 0     # fresh rows folded into warm-group dispatches
+        self.n_rejoined = 0   # engines re-admitted after probation
+        # The most recent request's [c, H, W] shape — what the probation
+        # health probe dispatches (engine-agnostic: the batcher never
+        # assumes a model config). Guarded by _counter_lock: submit()
+        # writes it, the probation thread reads it.
+        self._probe_shape = None
         self.dispatches: List[dict] = []  # one dict per dispatched batch
         # Per-request accounting, maintained INCREMENTALLY (a long-running
         # server must not retain one record per resolved request):
@@ -331,7 +397,9 @@ class DynamicBatcher:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "DynamicBatcher":
-        if not self._threads:
+        with self._counter_lock:
+            started = bool(self._threads)
+        if not started:
             self._stop.clear()
             for i, eng in enumerate(self.engines):
                 name = self._ename(eng, i)
@@ -342,7 +410,11 @@ class DynamicBatcher:
                     daemon=True,
                 )
                 t.start()
-                self._threads.append(t)
+                # _threads rides _counter_lock everywhere: the probation
+                # path appends a revived engine's worker from ITS thread,
+                # so the list is no longer caller-thread-only.
+                with self._counter_lock:
+                    self._threads.append(t)
         return self
 
     def stop(self, drain: bool = True) -> None:
@@ -353,15 +425,19 @@ class DynamicBatcher:
         failed BEFORE waiting on the workers, so at most the in-flight
         batches dispatch after the call. Also safe on a never-started
         batcher: queued tickets are failed (drain=False) — there is no
-        worker to ever resolve them."""
+        worker to ever resolve them. Probation threads (engine rejoin)
+        observe the stop flag and exit on their next tick."""
         self._stop.set()
         if not drain:
             self._fail_queued()
-        for t in self._threads:
+        with self._counter_lock:
+            threads = list(self._threads)
+        for t in threads:
             # drain=True: a worker exits once the stop flag is set AND
             # both queues are empty — queued work is served on the way out.
             t.join(timeout=60.0)
-        self._threads = []
+        with self._counter_lock:
+            self._threads = []
         # Whatever is STILL queued (drain=True with a dead/timed-out
         # worker, or a never-started batcher) can no longer resolve.
         self._fail_queued()
@@ -397,14 +473,21 @@ class DynamicBatcher:
         with self._engine_lock:
             return [n for n, st in self._engine_state.items() if st["alive"]]
 
-    def submit(self, img) -> Ticket:
+    def submit(self, img, session_id=None) -> Ticket:
         """Enqueue one [c, H, W] request. Sheds immediately (raises) when
         the queue is full, the backend is down, every engine is dead, or
         every live engine's degradation ladder is on its shed rung —
         admission never blocks the caller. Requests submitted before
         start() queue up and are served once the workers run; stop()
         fails whatever can no longer resolve, so a ticket is never
-        silently stranded."""
+        silently stranded.
+
+        `session_id` marks the request as one frame of a STREAM: at
+        dispatch the worker warm-starts it from the session's cached
+        column state when one is resident (serve/column_cache.py), and
+        on resolve the converged columns are written back under the key
+        for the stream's next frame. None (the default) is the
+        stateless cold path, bit-for-bit the pre-streaming contract."""
         with self._counter_lock:
             self._seq += 1
             rid = self._seq
@@ -420,7 +503,9 @@ class DynamicBatcher:
                     **detail,
                 )
             alive = self._alive_engines()
-            if self._threads and not alive:
+            with self._counter_lock:
+                started = bool(self._threads)
+            if started and not alive:
                 detail = self._pressure()
                 self._shed(ticket, "no-live-engine", **detail)
                 raise ShedError(
@@ -452,8 +537,9 @@ class DynamicBatcher:
             # counted after the put as off-by-ones).
             with self._counter_lock:
                 self.n_submitted += 1
+                self._probe_shape = img.shape
             try:
-                self._q.put_nowait(_Request(img, ticket))
+                self._q.put_nowait(_Item(img, ticket, session_id))
             except queue.Full:
                 with self._counter_lock:
                     self.n_submitted -= 1
@@ -464,8 +550,10 @@ class DynamicBatcher:
                     "backpressure — retry later",
                     **detail,
                 ) from None
+            with self._counter_lock:
+                threads = list(self._threads)
             if self._stop.is_set() and not any(
-                t.is_alive() for t in self._threads
+                t.is_alive() for t in threads
             ):
                 # Race with stop(): the put landed after the (dead or
                 # never-started) workers' final drain — no one will ever
@@ -543,18 +631,12 @@ class DynamicBatcher:
             backend_state=backend_record().get("backend_state", "unknown"),
         )
 
-    def _gather(self, engine_name: str) -> List[_Request]:
+    def _gather(self, engine_name: str) -> List[_Item]:
         """Block for the first request, then gather until max_batch or the
         first request ages past max_delay — the two-knob admission. A
         ladder at bucket_cap or worse gathers smaller batches: smaller,
         faster dispatches drain a backed-up queue in bounded bites."""
-        max_batch = self.max_batch
-        ladder = self._ladders.get(engine_name)
-        if ladder is not None:
-            from glom_tpu.resilience.ladder import BUCKET_CAP
-
-            if ladder.rung() >= BUCKET_CAP:
-                max_batch = min(max_batch, ladder.bucket_cap)
+        max_batch = self._effective_max_batch(engine_name)
         try:
             first = self._q.get(timeout=0.05)
         except queue.Empty:
@@ -571,6 +653,39 @@ class DynamicBatcher:
                 break
         return batch
 
+    def _effective_max_batch(self, engine_name: str) -> int:
+        """max_batch under the ladder's bucket cap (shared by _gather and
+        the warm-group top-up, so both gathering paths degrade alike)."""
+        max_batch = self.max_batch
+        ladder = self._ladders.get(engine_name)
+        if ladder is not None:
+            from glom_tpu.resilience.ladder import BUCKET_CAP
+
+            if ladder.rung() >= BUCKET_CAP:
+                max_batch = min(max_batch, ladder.bucket_cap)
+        return max_batch
+
+    def _top_up(self, engine_name: str, have: int) -> List[_Item]:
+        """MIXED warm/cold buckets: fold whatever fresh traffic is
+        ALREADY waiting into a warm continuation group, up to the
+        admission ceiling — a lone straggler no longer dispatches into a
+        mostly-pad bucket, and the fresh rows it pulls in skip their own
+        gathering delay. Non-blocking on purpose: stragglers are the
+        oldest requests in the system, so the fold never ADDS latency
+        waiting for company (an empty queue keeps the lone-group
+        dispatch, the pre-fold contract)."""
+        added: List[_Item] = []
+        limit = self._effective_max_batch(engine_name)
+        while have + len(added) < limit:
+            try:
+                added.append(self._q.get_nowait())
+            except queue.Empty:
+                break
+        if added:
+            with self._counter_lock:
+                self.n_folded += len(added)
+        return added
+
     def _worker(self, engine, engine_name: str) -> None:
         while not (
             self._stop.is_set()
@@ -579,22 +694,129 @@ class DynamicBatcher:
         ):
             with self._engine_lock:
                 if not self._engine_state[engine_name]["alive"]:
-                    return  # dead engine: its queued work drains to siblings
+                    break  # dead: queued work drains to siblings
             self._ladder_observe(engine_name)
             # Continuations first: stragglers are the OLDEST requests in
-            # the system, and their groups are already bucket-shaped.
+            # the system; waiting fresh rows fold into their bucket's pad
+            # slots (per-row levels0 select in _dispatch).
             try:
                 group = self._cont_q.get_nowait()
             except queue.Empty:
                 group = None
             if group is not None:
-                self._dispatch(engine, engine_name, group, warm=True)
+                batch = list(group)
+                batch.extend(self._top_up(engine_name, len(batch)))
+                self._dispatch(engine, engine_name, batch)
                 continue
             with span("serve_batch", aggregator=self.spans):
                 batch = self._gather(engine_name)
             if not batch:
                 continue
-            self._dispatch(engine, engine_name, batch, warm=False)
+            self._dispatch(engine, engine_name, batch)
+        else:
+            return  # normal stop-drain exit
+        # Dead-engine exit: hand off to probation when rejoin is enabled
+        # (N consecutive successful health dispatches re-admit the
+        # engine); otherwise death stays terminal until restart.
+        if self._rejoin_threshold > 0 and not self._stop.is_set():
+            self._start_probation(engine, engine_name)
+
+    # -- engine rejoin (probation re-admit) --------------------------------
+
+    def _start_probation(self, engine, engine_name: str) -> None:
+        """Spawn the probation thread for a just-died engine (at most one
+        per engine). The thread health-dispatches the smallest bucket
+        until `rejoin_threshold` CONSECUTIVE successes re-admit the
+        engine — a flapping engine that fails a probe starts its count
+        over, so rejoin certifies sustained health, not one lucky call."""
+        # Registration is ATOMIC with stop()'s thread snapshot (both ride
+        # _counter_lock, nested in the documented engine->counter order):
+        # either stop() already set the flag and nothing spawns, or the
+        # thread lands in _threads before the snapshot and stop() joins
+        # it — a probe thread can never outlive stop() untracked.
+        with self._engine_lock:
+            st = self._engine_state[engine_name]
+            if st["alive"] or st["probation"]:
+                return
+            with self._counter_lock:
+                if self._stop.is_set():
+                    return
+                st["probation"] = True
+                t = threading.Thread(
+                    target=self._probation,
+                    args=(engine, engine_name),
+                    name=f"glom-serve-probation-{engine_name}",
+                    daemon=True,
+                )
+                t.start()
+                self._threads.append(t)
+        self._emit(
+            {
+                "event": "engine_probation",
+                "engine": engine_name,
+                "need": self._rejoin_threshold,
+            }
+        )
+
+    def _probation(self, engine, engine_name: str) -> None:
+        ok = 0
+        while not self._stop.wait(self._rejoin_interval_s):
+            with self._counter_lock:
+                shape = self._probe_shape
+            if shape is None:
+                continue  # no traffic seen yet: nothing to probe with
+            try:
+                bucket = engine.pick_bucket(1)
+                engine.infer(np.zeros((bucket, *shape), np.float32), n_valid=1)
+                ok += 1
+            except BaseException:  # noqa: BLE001 — a failed probe is data
+                ok = 0
+                continue
+            if ok < self._rejoin_threshold:
+                continue
+            # Re-admit: alive again with a clean failure count, its cache
+            # entries long invalidated (death dropped them) — the engine
+            # re-earns warm state from fresh write-backs. The stop-check,
+            # the alive flip, and the worker's start+registration are ONE
+            # critical section shared with stop()'s snapshot (engine ->
+            # counter lock order): a stop() that already snapshotted
+            # cannot miss the new worker, and a stop() that already set
+            # the flag gets no worker at all — no duplicate or orphan
+            # worker can survive a stop()/rejoin race (review-caught).
+            with self._engine_lock:
+                with self._counter_lock:
+                    if self._stop.is_set():
+                        self._engine_state[engine_name]["probation"] = False
+                        return
+                    st = self._engine_state[engine_name]
+                    st["alive"] = True
+                    st["consecutive_failures"] = 0
+                    st["probation"] = False
+                    st["rejoins"] += 1
+                    self.n_rejoined += 1
+                    worker = threading.Thread(
+                        target=self._worker,
+                        args=(engine, engine_name),
+                        name=f"glom-serve-batcher-{engine_name}",
+                        daemon=True,
+                    )
+                    # Started INSIDE the critical section: its first loop
+                    # step blocks on _engine_lock until we release, and a
+                    # joiner can never see a registered-but-unstarted
+                    # thread.
+                    worker.start()
+                    self._threads.append(worker)
+            self._emit(
+                {
+                    "event": "engine_rejoin",
+                    "engine": engine_name,
+                    "health_dispatches": ok,
+                }
+            )
+            return
+        # Stopped while still on probation: leave the engine dead.
+        with self._engine_lock:
+            self._engine_state[engine_name]["probation"] = False
 
     # -- dispatch ----------------------------------------------------------
 
@@ -640,13 +862,19 @@ class DynamicBatcher:
             ]
             return {"alive": st["alive"], "siblings": siblings}
 
-    def _requeue(self, items, warm: bool) -> int:
+    def _requeue(self, items) -> int:
         """Hand a failed dispatch's requests to the sibling engines via
         the shared queues; tickets whose redispatch budget is exhausted
         fail instead (bounded — a poison batch cannot ping-pong forever).
-        Returns how many were requeued."""
+        Mixed batches split per row: continuation stragglers keep their
+        mid-flight warm state (it is THEIR computed progress) and rejoin
+        the continuation queue as one group; cache-warmed rows DROP their
+        warmth back to cold — the failing engine's cache entries are
+        being invalidated right now, and a re-dispatch must re-decide
+        against the post-invalidation cache, never ride state read before
+        the failure. Returns how many were requeued."""
         requeued = 0
-        survivors = []
+        warm_survivors: List[_Item] = []
         for item in items:
             item.redispatches += 1
             if item.redispatches > self.max_redispatch:
@@ -658,28 +886,30 @@ class DynamicBatcher:
                         f"({self.max_redispatch}) after engine failures"
                     )
                 )
-            else:
-                survivors.append(item)
-        if warm:
-            if survivors:
-                self._cont_q.put(survivors)
-                requeued = len(survivors)
-        else:
-            for item in survivors:
-                try:
-                    self._q.put_nowait(item)
-                    requeued += 1
-                except queue.Full:
-                    with self._counter_lock:
-                        self.n_failed += 1
-                    item.ticket._fail(
-                        QueueFullError("requeue after engine failure: full")
-                    )
+                continue
+            if item.warm_src == "cache":
+                item.levels = None
+                item.warm_src = None
+            if item.levels is not None:
+                warm_survivors.append(item)
+                continue
+            try:
+                self._q.put_nowait(item)
+                requeued += 1
+            except queue.Full:
+                with self._counter_lock:
+                    self.n_failed += 1
+                item.ticket._fail(
+                    QueueFullError("requeue after engine failure: full")
+                )
+        if warm_survivors:
+            self._cont_q.put(warm_survivors)
+            requeued += len(warm_survivors)
         with self._counter_lock:
             self.n_redispatched += requeued
         return requeued
 
-    def _dispatch(self, engine, engine_name: str, batch, warm: bool) -> None:
+    def _dispatch(self, engine, engine_name: str, batch) -> None:
         n = len(batch)
         if self.shed_when_down and _backend_down():
             # Gathered but undispatchable: fail every ticket fast with the
@@ -709,7 +939,33 @@ class DynamicBatcher:
             and iters_override is None
             and budget is not None
         )
-        prior = batch[0].executed if warm else 0
+        # Session warm-start: a cold row carrying a session_id rides the
+        # stream's cached columns when one is resident (full budget — a
+        # new frame, not a continuation). Decided HERE, at dispatch, so
+        # the state is the freshest write-back and a cache invalidated
+        # since submit can never warm-start the row.
+        n_cache_warm = n_cache_miss = 0
+        if self.cache is not None:
+            for it in batch:
+                if it.levels is None and it.session is not None:
+                    hit = self.cache.lookup(it.session)
+                    if hit is not None:
+                        it.levels = hit
+                        it.warm_src = "cache"
+                        n_cache_warm += 1
+                    else:
+                        n_cache_miss += 1
+        warm = any(it.levels is not None for it in batch)
+        # The remaining per-request budget caps the auto route at the
+        # TIGHTEST row (min over rows of budget - executed; cold and
+        # cache-warm rows have the full budget) — UNLESS a degraded
+        # ladder rung pinned a fixed iters_override for this dispatch
+        # (the engine rejects the combination: a fixed route has no
+        # budget to cap, and the degraded budget already bounds cost).
+        # Rows capped below their own remaining budget simply re-enter
+        # the continuation queue with the difference — per-request
+        # totals never exceed the budget.
+        prior = max((it.executed for it in batch), default=0)
         try:
             bucket = engine.pick_bucket(n)
             imgs = np.zeros((bucket, *batch[0].img.shape), np.float32)
@@ -719,16 +975,22 @@ class DynamicBatcher:
             if iters_override is not None:
                 kw["iters_override"] = iters_override
             if warm:
-                lv0 = np.zeros((bucket, *batch[0].levels.shape),
-                               batch[0].levels.dtype)
-                for i, c in enumerate(batch):
-                    lv0[i] = c.levels
+                # Per-row levels0 select — the mixed warm/cold bucket:
+                # warm rows carry their cached/mid-flight state, cold
+                # rows the engine's own cold init (bitwise what the
+                # forward would build itself; pad rows stay zeros — the
+                # mask keeps them out of the witness either way).
+                proto = next(it.levels for it in batch if it.levels is not None)
+                lv0 = np.zeros((bucket, *proto.shape), proto.dtype)
+                cold = None
+                for i, it in enumerate(batch):
+                    if it.levels is not None:
+                        lv0[i] = it.levels
+                    else:
+                        if cold is None:
+                            cold = np.asarray(engine.cold_levels())
+                        lv0[i] = cold
                 kw["levels0"] = lv0
-                # The remaining per-request budget caps the warm hop's
-                # auto route — UNLESS a degraded ladder rung pinned a
-                # fixed iters_override for this dispatch (the engine
-                # rejects the combination: a fixed route has no budget
-                # to cap, and the degraded budget already bounds cost).
                 remaining = max(1, budget - prior) if budget else None
                 if (
                     iters_override is None
@@ -742,11 +1004,17 @@ class DynamicBatcher:
                 levels = np.asarray(result.levels[:n])
         except BaseException as e:  # noqa: BLE001 — relayed per ticket
             state = self._note_failure(engine_name)
+            if self.cache is not None:
+                # A failing engine's cache entries are suspect the moment
+                # the failure is observed: drop them BEFORE any requeue
+                # re-decides warmth, so stale or dead-engine state can
+                # never warm-start a request (docs/SERVING.md).
+                self.cache.invalidate_engine(engine_name)
             if state["siblings"]:
                 # FAILOVER: hand this batch to the siblings instead of
                 # failing it — the multi-engine contract a dead engine's
                 # chaos scenario validates (docs/RESILIENCE.md).
-                n_req = self._requeue(batch, warm)
+                n_req = self._requeue(batch)
                 self._emit(
                     {
                         "event": "engine_failover",
@@ -793,43 +1061,53 @@ class DynamicBatcher:
         # Resolve vs re-bucket, row by row. Stragglers (valid, unconverged,
         # budget left, hops left) carry their warm state into the
         # continuation queue as ONE group; everyone else resolves with
-        # their TOTAL executed iterations. Draining stop() opens no new
-        # hops — stragglers resolve with the state they have.
-        executed = prior + result.iters_run
+        # their TOTAL executed iterations (per row now — a mixed bucket's
+        # rows entered with different priors) and, when the row carries a
+        # session, writes its converged columns back to the cache for the
+        # stream's next frame. Draining stop() opens no new hops —
+        # stragglers resolve with the state they have.
         conv = result.row_converged
-        stragglers: List[_Continuation] = []
+        stragglers: List[_Item] = []
         resolved: List[dict] = []
         n_resolved = 0
-        hops = batch[0].hops if warm else 0
-        open_hops = (
-            tiered
-            and conv is not None
-            and not self._stop.is_set()
-            and hops < scfg.max_continuations
-            and executed < budget
-        )
-        for i, req in enumerate(batch):
-            if open_hops and not bool(conv[i]):
-                stragglers.append(
-                    _Continuation(
-                        req.img, np.asarray(result.levels[i]), req.ticket,
-                        executed, hops + 1,
-                    )
-                )
+        entry_tier = max((it.hops for it in batch), default=0)
+        for i, it in enumerate(batch):
+            executed_i = it.executed + result.iters_run
+            open_hop = (
+                tiered
+                and conv is not None
+                and not self._stop.is_set()
+                and it.hops < scfg.max_continuations
+                and executed_i < budget
+            )
+            if open_hop and not bool(conv[i]):
+                it.levels = np.array(levels[i])
+                it.executed = executed_i
+                it.hops += 1
+                it.warm_src = "cont"
+                stragglers.append(it)
             else:
-                req.ticket._resolve(levels[i], executed)
-                resolved.append({"iters": executed, "tier": hops})
+                # Write-back BEFORE resolve: the moment the caller sees
+                # frame t's response it may submit frame t+1, and that
+                # frame must find the cache already warm.
+                if self.cache is not None and it.session is not None:
+                    self.cache.store(
+                        it.session, np.array(levels[i]), engine=engine_name
+                    )
+                it.ticket._resolve(levels[i], executed_i)
+                resolved.append({"iters": executed_i, "tier": it.hops})
                 n_resolved += 1
         if stragglers:
             self._cont_q.put(stragglers)
+            worst = max(it.executed for it in stragglers)
             self._emit(
                 {
                     "event": "continuation",
                     "engine": engine_name,
                     "n_stragglers": len(stragglers),
-                    "executed_iters": executed,
-                    "remaining_budget": budget - executed,
-                    "hop": hops + 1,
+                    "executed_iters": worst,
+                    "remaining_budget": budget - worst,
+                    "hop": max(it.hops for it in stragglers),
                 }
             )
         rec = {
@@ -838,11 +1116,13 @@ class DynamicBatcher:
             "bucket": result.bucket,
             "n_valid": n,
             "warm_state": warm,
-            "tier": hops,
+            "tier": entry_tier,
             "pad_fraction": round(1.0 - n / result.bucket, 4),
             "latency_ms": round(1e3 * result.latency_s, 3),
             "iters_run": result.iters_run,
             "n_stragglers": len(stragglers),
+            "n_cache_warm": n_cache_warm,
+            "n_cache_miss": n_cache_miss,
             "compiled": result.compiled,
         }
         if rung_name is not None:
@@ -903,6 +1183,8 @@ class DynamicBatcher:
                 n_degraded = self.n_degraded
                 n_continued = self.n_continued
                 n_redispatched = self.n_redispatched
+                n_folded = self.n_folded
+                n_rejoined = self.n_rejoined
         rec = {
             "event": "summary",
             "n_requests": n_requests,
@@ -913,6 +1195,8 @@ class DynamicBatcher:
             "n_degraded": n_degraded,
             "n_continued": n_continued,
             "n_redispatched": n_redispatched,
+            "n_folded": n_folded,
+            "n_rejoined": n_rejoined,
             "n_dispatches": len(dispatches),
             # Mean GATHERED batch size: valid rows per dispatch (a warm
             # continuation hop is a dispatch too) — n_served would skew
@@ -928,6 +1212,11 @@ class DynamicBatcher:
             ) if n_served else None,
             "engines": engines,
         }
+        if self.cache is not None:
+            # The streaming column cache's rollup (hits/misses/evictions/
+            # bytes vs budget) — the temporal bench and its CI gate read
+            # this nest (docs/OBSERVABILITY.md, cache metrics).
+            rec["column_cache"] = self.cache.record()
         # Ladder/retry rollups: flat on a single-engine summary (the PR 6
         # record shape, pinned by tests), NESTED per engine under
         # `engines` on fan-out — a flat merge would let the last engine's

@@ -18,6 +18,7 @@ import argparse
 import dataclasses
 import json
 import sys
+import time
 from typing import Iterable, Tuple
 
 
@@ -90,11 +91,47 @@ def build_parser() -> argparse.ArgumentParser:
         "budget) up to M hops through the continuation queue; 0 disables",
     )
     p.add_argument(
-        "--kill-engine", default=None, metavar="IDX:after=K",
-        help="CHAOS: permanently fail engine IDX's dispatches from its "
-        "K-th call on (a seeded FaultPlan dispatch_fault — every injection "
-        "a stamped 'fault' event), so the kill-serve scenario can validate "
-        "failover from the evidence trail (docs/RESILIENCE.md)",
+        "--kill-engine", default=None, metavar="IDX:after=K[,until=M]",
+        help="CHAOS: fail engine IDX's dispatches from its K-th call on "
+        "(a seeded FaultPlan dispatch_fault — every injection a stamped "
+        "'fault' event), so the kill-serve scenario can validate failover "
+        "from the evidence trail (docs/RESILIENCE.md). ',until=M' bounds "
+        "the fault window — calls from M on succeed again, the recovered-"
+        "replica shape the rejoin-serve scenario drives",
+    )
+    p.add_argument(
+        "--rejoin", type=int, default=None, metavar="N",
+        help="re-admit a dead engine after N consecutive successful "
+        "probation health dispatches (stamped engine_rejoin; "
+        "docs/RESILIENCE.md). Default: preset's rejoin_threshold (0 = "
+        "death stays terminal)",
+    )
+    p.add_argument(
+        "--rejoin-interval-ms", type=float, default=None, metavar="MS",
+        help="pace the probation health dispatches (default: preset's)",
+    )
+    p.add_argument(
+        "--streams", type=int, default=None, metavar="S",
+        help="synthetic mode: spread requests over S temporal STREAMS — "
+        "each request is a perturbed frame of its stream's base image and "
+        "carries session id 's<k>', so the warm-start column cache "
+        "(--column-cache-bytes) serves frame t+1 from frame t's converged "
+        "columns (docs/SERVING.md, Streaming)",
+    )
+    p.add_argument(
+        "--column-cache-bytes", type=int, default=None, metavar="B",
+        help="session column-cache HBM budget in bytes (LRU eviction; "
+        "0 disables streaming warm-start). Default: preset's",
+    )
+    p.add_argument(
+        "--column-cache-ttl", type=float, default=None, metavar="S",
+        help="expire a quiet stream's cached columns after S seconds",
+    )
+    p.add_argument(
+        "--request-gap-ms", type=float, default=0.0, metavar="G",
+        help="pace request submission G ms apart (0 = submit as fast as "
+        "admission allows) — chaos scenarios use it to keep traffic "
+        "flowing across a fault window",
     )
     p.add_argument(
         "--dispatch-retries", type=int, default=None, metavar="N",
@@ -109,11 +146,16 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _req_source(args) -> Iterable[Tuple[object, int]]:
-    """(request id, seed) pairs from --synthetic or --requests."""
+def _req_source(args) -> Iterable[Tuple[object, int, object]]:
+    """(request id, seed, session id) triples from --synthetic or
+    --requests. Synthetic with --streams S deals requests round-robin
+    over S sessions ('s0'..'s{S-1}'); request files carry an optional
+    "session" field per line."""
     if args.synthetic is not None:
+        streams = args.streams or 0
         for i in range(args.synthetic):
-            yield i, i
+            session = f"s{i % streams}" if streams > 0 else None
+            yield i, i, session
         return
     fh = sys.stdin if args.requests == "-" else open(args.requests)
     try:
@@ -122,7 +164,7 @@ def _req_source(args) -> Iterable[Tuple[object, int]]:
             if not line or not line.startswith("{"):
                 continue
             rec = json.loads(line)
-            yield rec.get("id"), int(rec.get("seed", 0))
+            yield rec.get("id"), int(rec.get("seed", 0)), rec.get("session")
     finally:
         if fh is not sys.stdin:
             fh.close()
@@ -177,6 +219,14 @@ def main(argv=None) -> int:
         overrides["exit_quorum"] = args.quorum
     if args.max_continuations is not None:
         overrides["max_continuations"] = args.max_continuations
+    if args.rejoin is not None:
+        overrides["rejoin_threshold"] = args.rejoin
+    if args.rejoin_interval_ms is not None:
+        overrides["rejoin_interval_ms"] = args.rejoin_interval_ms
+    if args.column_cache_bytes is not None:
+        overrides["column_cache_bytes"] = args.column_cache_bytes
+    if args.column_cache_ttl is not None:
+        overrides["column_cache_ttl_s"] = args.column_cache_ttl
     if overrides:
         scfg = dataclasses.replace(scfg, **overrides)
     if args.engines < 1:
@@ -219,17 +269,19 @@ def main(argv=None) -> int:
             # reconcile failover against the injected ground truth.
             from glom_tpu.resilience.faults import FaultPlan, dispatch_fault
 
-            idx_s, _, after_s = args.kill_engine.partition(":after=")
+            idx_s, _, window = args.kill_engine.partition(":after=")
             kill_idx = int(idx_s)
             if not 0 <= kill_idx < args.engines:
                 print(f"--kill-engine index {kill_idx} outside 0.."
                       f"{args.engines - 1}", file=sys.stderr)
                 return 2
+            after_s, _, until_s = window.partition(",until=")
             kill_plan = FaultPlan(writer=writer)
             kill_plan.register(
                 f"engine{kill_idx}-dispatch",
                 rate=1.0,
                 start=int(after_s or 0),
+                stop=int(until_s) if until_s else None,
                 fault="engine-dead",
             )
         engines = []
@@ -259,16 +311,35 @@ def main(argv=None) -> int:
                     # on top of the pressure that degraded it.
                     engine.warmup(iters_override=degraded_iters)
 
+        shape = (cfg.channels, cfg.image_size, cfg.image_size)
         rng_img = lambda seed: np.random.default_rng(seed).normal(
-            size=(cfg.channels, cfg.image_size, cfg.image_size)
+            size=shape
         ).astype(np.float32)
 
+        def frame_img(seed, session):
+            # A stream's frames are small perturbations of ITS base image
+            # (the temporal-coherence assumption the column cache
+            # exploits); stateless requests stay pure seeded gaussians.
+            if session is None:
+                return rng_img(seed)
+            import zlib  # deterministic across processes, unlike hash()
+
+            base = rng_img(zlib.crc32(str(session).encode()) & 0x7FFFFFFF)
+            return base + 0.05 * rng_img((1 << 20) + seed)
+
         served = failed = 0
+        gap_s = max(0.0, args.request_gap_ms) / 1e3
         with DynamicBatcher(engines=engines, writer=writer) as batcher:
             tickets = []
-            for rid, seed in _req_source(args):
+            for rid, seed, session in _req_source(args):
+                if gap_s and tickets:
+                    time.sleep(gap_s)
                 try:
-                    tickets.append((rid, batcher.submit(rng_img(seed))))
+                    tickets.append(
+                        (rid, batcher.submit(
+                            frame_img(seed, session), session_id=session
+                        ))
+                    )
                 except ShedError as e:
                     failed += 1
                     writer.write(

@@ -1,0 +1,297 @@
+"""Session-keyed warm-start column cache: carry converged columns across
+temporal frames.
+
+GLOM's "islands of agreement" persist across the frames of a stream — a
+request that starts from the PREVIOUS frame's converged column state is
+already sitting near the consensus attractor, so the `iters="auto"` exit
+fires in a fraction of the cold-start budget. This module is the O(1)
+state-reuse pattern from the compiler-first autoregressive-caching
+literature (PAPERS.md) applied to consensus state: the cached unit is one
+session's `[n, L, d]` column tensor, written back after every resolved
+request that carries a `session_id` and read at the NEXT dispatch as the
+warm `levels0` init (the engine's existing warm-signature machinery — no
+new compiled programs).
+
+Residency discipline:
+
+  * PRICED — every entry costs `column_state_bytes(cfg, scfg)` of the
+    serving replica's HBM while a warm dispatch stages it (the same
+    analytic live-bytes accounting utils/metrics.py prices train state
+    with); the cache holds the HOST copy (device buffers are donated per
+    dispatch and cannot be retained), but the budget is an HBM budget:
+    entries beyond `ServeConfig.column_cache_bytes` evict LRU-first, and
+    total resident bytes NEVER exceed the budget — an entry larger than
+    the whole budget is rejected outright, not "temporarily" overcommitted;
+  * TTL — a stream that went quiet is stale state, not warmth:
+    `column_cache_ttl_s` expires an entry at lookup time (a hit on an
+    expired entry is a MISS plus an eviction, stamped as such);
+  * INVALIDATED on engine death/failover — entries are tagged with the
+    engine that produced them, and the batcher drops an engine's entries
+    the moment a dispatch on it fails (`invalidate_engine`), so a stale
+    or dead-engine entry can never warm-start a request;
+  * OBSERVED — hits/misses/evictions/expirations/invalidations and the
+    live byte count are counters on `record()` (rolled into the batcher's
+    summary), and every eviction/expiry/invalidation is a stamped "serve"
+    event through the usual writer-else-flight delivery.
+
+Thread-safe: lookups run on the batcher's per-engine worker threads while
+stores/invalidations run on workers and the caller; one lock guards the
+LRU map and every counter (events are emitted OUTSIDE the lock — the
+writer may block on IO).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import List, Optional
+
+import numpy as np
+
+
+def column_state_bytes(cfg, scfg) -> int:
+    """The live-bytes price of ONE session's cached column state: the
+    `[num_patches, levels, dim]` tensor in the serving compute dtype —
+    the same analytic form the HBM accounting prices the warm `levels0`
+    staging buffer with. This is what `ServeConfig.column_cache_bytes`
+    is divided by when sizing a deployment (docs/SERVING.md,
+    "Streaming")."""
+    itemsize = 2 if scfg.compute_dtype == "bfloat16" else 4
+    return cfg.num_patches * cfg.levels * cfg.dim * itemsize
+
+
+class _Entry:
+    __slots__ = ("levels", "nbytes", "engine", "t_write")
+
+    def __init__(self, levels: np.ndarray, engine: str, t_write: float):
+        self.levels = levels
+        self.nbytes = int(levels.nbytes)
+        self.engine = engine
+        self.t_write = t_write
+
+
+class ColumnCache:
+    """LRU column-state cache keyed by session id, bounded in bytes.
+
+    `budget_bytes` is the hard residency ceiling (HBM-priced via
+    column_state_bytes); `ttl_s=None` disables expiry. The clock is
+    injectable so TTL tests never sleep."""
+
+    def __init__(
+        self,
+        budget_bytes: int,
+        *,
+        ttl_s: Optional[float] = None,
+        writer=None,
+        clock=time.monotonic,
+    ):
+        if budget_bytes < 1:
+            raise ValueError(f"budget_bytes {budget_bytes} must be >= 1")
+        if ttl_s is not None and ttl_s <= 0:
+            raise ValueError(f"ttl_s {ttl_s} must be > 0 or None")
+        self.budget_bytes = int(budget_bytes)
+        self.ttl_s = ttl_s
+        self.writer = writer
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
+        self._bytes = 0
+        self._peak_bytes = 0
+        self.n_hits = 0
+        self.n_misses = 0
+        self.n_writes = 0
+        self.n_evictions = 0
+        self.n_expirations = 0
+        self.n_invalidations = 0
+        self.n_rejects = 0
+
+    # -- the request path --------------------------------------------------
+
+    def lookup(self, session_id: str) -> Optional[np.ndarray]:
+        """The session's cached column state (freshest-first LRU touch),
+        or None on miss. An entry past its TTL is dropped HERE — an
+        expired stream must never warm-start a request — and counts as
+        one expiration plus the miss."""
+        events: List[dict] = []
+        with self._lock:
+            entry = self._entries.get(session_id)
+            if entry is None:
+                self.n_misses += 1
+                return None
+            if (
+                self.ttl_s is not None
+                and self._clock() - entry.t_write > self.ttl_s
+            ):
+                self._drop(session_id, entry)
+                self.n_expirations += 1
+                self.n_misses += 1
+                events.append(
+                    {
+                        "event": "cache_expire",
+                        "session": session_id,
+                        "bytes": entry.nbytes,
+                        "age_s": round(self._clock() - entry.t_write, 3),
+                    }
+                )
+                levels = None
+            else:
+                self._entries.move_to_end(session_id)
+                self.n_hits += 1
+                levels = entry.levels
+        self._flush(events)
+        return levels
+
+    def store(self, session_id: str, levels, *, engine: str) -> bool:
+        """Write one resolved request's converged columns back under its
+        session key (the warm init for the stream's NEXT frame), evicting
+        LRU entries until the byte budget holds. Returns False when the
+        entry alone exceeds the whole budget (rejected, stamped — the
+        budget is a ceiling, never overcommitted)."""
+        levels = np.asarray(levels)
+        now = self._clock()
+        events: List[dict] = []
+        with self._lock:
+            if int(levels.nbytes) > self.budget_bytes:
+                self.n_rejects += 1
+                events.append(
+                    {
+                        "event": "cache_reject",
+                        "session": session_id,
+                        "bytes": int(levels.nbytes),
+                        "budget_bytes": self.budget_bytes,
+                    }
+                )
+                stored = False
+            else:
+                old = self._entries.pop(session_id, None)
+                if old is not None:
+                    self._bytes -= old.nbytes
+                entry = _Entry(levels, engine, now)
+                self._entries[session_id] = entry
+                self._bytes += entry.nbytes
+                self.n_writes += 1
+                while self._bytes > self.budget_bytes:
+                    victim_id, victim = next(iter(self._entries.items()))
+                    self._drop(victim_id, victim)
+                    self.n_evictions += 1
+                    events.append(
+                        {
+                            "event": "cache_evict",
+                            "session": victim_id,
+                            "bytes": victim.nbytes,
+                            "bytes_in_use": self._bytes,
+                            "budget_bytes": self.budget_bytes,
+                        }
+                    )
+                self._peak_bytes = max(self._peak_bytes, self._bytes)
+                stored = True
+        self._flush(events)
+        return stored
+
+    # -- invalidation ------------------------------------------------------
+
+    def invalidate(self, session_id: str, *, reason: str = "explicit") -> bool:
+        """Drop one session's entry (stream ended, client reset)."""
+        events: List[dict] = []
+        with self._lock:
+            entry = self._entries.get(session_id)
+            if entry is None:
+                return False
+            self._drop(session_id, entry)
+            self.n_invalidations += 1
+            events.append(
+                {
+                    "event": "cache_invalidate",
+                    "session": session_id,
+                    "reason": reason,
+                    "bytes": entry.nbytes,
+                }
+            )
+        self._flush(events)
+        return True
+
+    def invalidate_engine(self, engine: str, *, reason: str = "engine-failover") -> int:
+        """Drop EVERY entry the named engine wrote — called by the
+        batcher on a dispatch failure / engine death, so state produced
+        near the failure can never warm-start a request. Returns how many
+        entries were dropped."""
+        events: List[dict] = []
+        with self._lock:
+            victims = [
+                (sid, e) for sid, e in self._entries.items()
+                if e.engine == engine
+            ]
+            for sid, entry in victims:
+                self._drop(sid, entry)
+                self.n_invalidations += 1
+            if victims:
+                events.append(
+                    {
+                        "event": "cache_invalidate",
+                        "engine": engine,
+                        "reason": reason,
+                        "n_entries": len(victims),
+                        "bytes": sum(e.nbytes for _, e in victims),
+                    }
+                )
+        self._flush(events)
+        return len(victims)
+
+    # -- internals ---------------------------------------------------------
+
+    def _drop(self, session_id: str, entry: _Entry) -> None:
+        # Caller holds the lock.
+        self._entries.pop(session_id, None)
+        self._bytes -= entry.nbytes
+
+    def _flush(self, events: List[dict]) -> None:
+        from glom_tpu.serve.events import emit_serve
+
+        for rec in events:
+            emit_serve(self.writer, rec)
+
+    # -- observability -----------------------------------------------------
+
+    def bytes_in_use(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def record(self) -> dict:
+        """The cache rollup the batcher nests under its summary record:
+        counters plus live/peak bytes against the budget — the numbers
+        the temporal bench's acceptance reads (`bytes_peak` must never
+        exceed `budget_bytes`)."""
+        with self._lock:
+            return {
+                "n_sessions": len(self._entries),
+                "bytes_in_use": self._bytes,
+                "bytes_peak": self._peak_bytes,
+                "budget_bytes": self.budget_bytes,
+                "ttl_s": self.ttl_s,
+                "n_hits": self.n_hits,
+                "n_misses": self.n_misses,
+                "n_writes": self.n_writes,
+                "n_evictions": self.n_evictions,
+                "n_expirations": self.n_expirations,
+                "n_invalidations": self.n_invalidations,
+                "n_rejects": self.n_rejects,
+            }
+
+
+def resolve_column_cache(scfg, *, writer=None) -> Optional[ColumnCache]:
+    """The one config -> cache resolution: `column_cache_bytes > 0`
+    builds the cache with the configured TTL, 0 disables streaming
+    warm-start entirely (every request cold-starts — the pre-PR 8
+    contract)."""
+    if getattr(scfg, "column_cache_bytes", 0) <= 0:
+        return None
+    return ColumnCache(
+        scfg.column_cache_bytes,
+        ttl_s=scfg.column_cache_ttl_s,
+        writer=writer,
+    )

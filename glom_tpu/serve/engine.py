@@ -124,6 +124,7 @@ class InferenceEngine:
                 f"mesh_seq={scfg.mesh_seq}"
             )
         self._compiled: Dict[Tuple, object] = {}
+        self._cold_levels: Optional[np.ndarray] = None
         self._stats: Dict[Tuple, StepTimeStats] = {}
         self._comm: Dict[Tuple, dict] = {}  # sharded route: counted wire bytes
         self._shardings: Dict[bool, Tuple] = {}  # warm -> (in_sh, out_sh)
@@ -172,6 +173,27 @@ class InferenceEngine:
             if self.scfg.max_auto_iters is not None
             else self.cfg.default_iters
         )
+
+    def cold_levels(self) -> np.ndarray:
+        """The cold-start column state for ONE row — `init_levels`
+        broadcast to [n_patches, L, d] in the serving dtype, exactly the
+        init the forward builds when no `levels0` is carried. The batcher
+        uses it to fold COLD rows into a warm-signature dispatch (mixed
+        warm/cold buckets): a cold row whose levels0 is this state lands
+        on bitwise the same columns as a cold dispatch, because the
+        forward's own init IS this broadcast (locked by tests). Host
+        array, memoized (read-only — callers copy into their staging
+        buffer)."""
+        if self._cold_levels is None:
+            lv_dtype = (
+                self._compute_dtype if self._compute_dtype is not None
+                else np.float32
+            )
+            init = np.asarray(self.params.init_levels, lv_dtype)  # [L, d]
+            self._cold_levels = np.ascontiguousarray(
+                np.broadcast_to(init[None], (self.cfg.num_patches, *init.shape))
+            )
+        return self._cold_levels
 
     def pick_bucket(self, n: int) -> int:
         """Smallest precompile bucket admitting n requests. n above the

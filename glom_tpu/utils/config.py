@@ -168,6 +168,26 @@ class ServeConfig:
     degraded_max_batch: Optional[int] = None
     ladder_high_water: float = 0.75  # queue fill that steps DOWN a rung
     ladder_low_water: float = 0.25   # queue fill that steps back UP
+    # Streaming warm-start column cache (glom_tpu/serve/column_cache.py,
+    # docs/SERVING.md "Streaming"): requests carrying a session_id write
+    # their converged [n, L, d] columns back under the session key and the
+    # NEXT frame of the stream dispatches warm from that state (the
+    # engine's warm levels0 signature), exiting iters="auto" in a fraction
+    # of the cold budget. column_cache_bytes is the HARD residency budget
+    # (LRU eviction, priced per entry by column_state_bytes — the
+    # live-bytes model); 0 disables streaming entirely. column_cache_ttl_s
+    # expires a quiet stream's entry at lookup (None = no expiry); entries
+    # are additionally invalidated the moment a dispatch on their source
+    # engine fails, so stale or dead-engine state never warm-starts.
+    column_cache_bytes: int = 0
+    column_cache_ttl_s: Optional[float] = None
+    # Engine REJOIN after recovery (docs/RESILIENCE.md): a fan-out engine
+    # marked dead re-enters service only after rejoin_threshold
+    # CONSECUTIVE successful probation health dispatches (stamped
+    # engine_rejoin event); 0 keeps death terminal until restart (the
+    # pre-PR 8 contract). rejoin_interval_ms paces the probation probes.
+    rejoin_threshold: int = 0
+    rejoin_interval_ms: float = 200.0
 
     def __post_init__(self):
         if not self.buckets:
@@ -238,6 +258,25 @@ class ServeConfig:
             raise ValueError(
                 f"need 0 <= ladder_low_water ({self.ladder_low_water}) < "
                 f"ladder_high_water ({self.ladder_high_water}) <= 1"
+            )
+        if self.column_cache_bytes < 0:
+            raise ValueError(
+                f"column_cache_bytes {self.column_cache_bytes} must be >= 0 "
+                "(0 disables the streaming column cache)"
+            )
+        if self.column_cache_ttl_s is not None and self.column_cache_ttl_s <= 0:
+            raise ValueError(
+                f"column_cache_ttl_s {self.column_cache_ttl_s} must be > 0 "
+                "or None"
+            )
+        if self.rejoin_threshold < 0:
+            raise ValueError(
+                f"rejoin_threshold {self.rejoin_threshold} must be >= 0 "
+                "(0 keeps engine death terminal)"
+            )
+        if self.rejoin_interval_ms <= 0:
+            raise ValueError(
+                f"rejoin_interval_ms {self.rejoin_interval_ms} must be > 0"
             )
 
 

@@ -156,7 +156,11 @@ _register(
         # tail), and TWO-TIER consensus early exit — a bucket exits when
         # its fastest three-quarters quorum converges, stragglers
         # re-bucket through the continuation queue with their remaining
-        # budget (docs/SERVING.md).
+        # budget (docs/SERVING.md). Streaming: 1 GiB of HBM buys ~680
+        # concurrent warm sessions (column_state_bytes = 256 patches x 6
+        # levels x 512 dim x bf16 ~= 1.5 MiB/stream); a stream quiet for
+        # a minute cold-starts its next frame. Dead engines re-admit
+        # after 3 clean probation dispatches.
         serve=ServeConfig(
             buckets=(1, 2, 4, 8, 16),
             max_batch=16,
@@ -169,6 +173,9 @@ _register(
             max_continuations=2,
             compute_dtype="bfloat16",
             use_pallas=True,
+            column_cache_bytes=1 << 30,
+            column_cache_ttl_s=60.0,
+            rejoin_threshold=3,
         ),
     )
 )
@@ -219,6 +226,14 @@ _register(
             mesh_data=4,
             mesh_seq=2,
             compute_dtype="bfloat16",
+            # Streaming at pod scale: 2 GiB/replica of column cache
+            # (d=1024/L=12 columns cost ~6 MiB/stream -> ~340 streams per
+            # 8-chip replica, 32 replicas behind shared admission), and
+            # probation rejoin so a recovered replica re-enters the
+            # fan-out without a restart (docs/RESILIENCE.md).
+            column_cache_bytes=2 << 30,
+            column_cache_ttl_s=60.0,
+            rejoin_threshold=3,
         ),
     )
 )

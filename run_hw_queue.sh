@@ -131,6 +131,15 @@ step bench_serve 2400 python -u bench_serve.py
 step bench_serve_two_tier 2400 python -u bench_serve.py --engines 2 --two-tier-ab --hetero 0.5
 step bench_serve_sharded 2400 python -u bench_serve.py --mesh-data 4
 
+# 9f. Streaming warm-start A/B (this round's tentpole, docs/SERVING.md
+#     "Streaming"): frame-sequence traffic per stream through the
+#     session column cache vs cold-start — the
+#     serve_temporal_mean_iters pair plus serve_temporal_iters_saved is
+#     the measured per-request win on real hardware (bf16 flagship
+#     route: the warm levels0 staging and donation actually resolve
+#     here, unlike the CPU smoke). Baselined via step 11b.
+step bench_serve_temporal 2400 python -u bench_serve.py --temporal --streams 8 --frames 6
+
 # 10. Schema lint: every JSON row this queue produced must validate
 #     against the versioned event schema (glom_tpu/telemetry/schema.py).
 #     Shell noise in the logs is skipped; --allow-unstamped because the
@@ -159,6 +168,7 @@ grep -ah '^{' results/hw_queue/bench.log > results/bench_baseline.jsonl 2>/dev/n
 grep -ah '^{' results/hw_queue/bench_serve.log \
     results/hw_queue/bench_serve_two_tier.log \
     results/hw_queue/bench_serve_sharded.log \
+    results/hw_queue/bench_serve_temporal.log \
     > results/hw_queue/serve_candidate.jsonl 2>/dev/null || true
 if [ -f results/serve_baseline.jsonl ]; then
     step serve_compare 300 python -m glom_tpu.telemetry compare \

@@ -1,0 +1,78 @@
+"""Seeded acceptance pair for donation-safety's memoized-handle taint
+(analysis/donation.py): LeakyMemoEngine stores a donating compiled
+forward in `self._compiled[sig]`, fetches it through a provider method,
+and then READS the batch it donated — the exact cross-method shape that
+was a PR 5 blind spot (the intra-function pass never saw the dispatch
+because the handle crossed a method boundary through an attribute).
+SafeMemoEngine does the same dispatch but holds the source on the HOST
+and re-reads only the host copy — the runtime copy-guard discipline
+serve/engine.py ships — and must scan clean.
+
+NOT imported by production code; tests/test_analysis.py runs the checker
+over this file and asserts the use-after-donation is flagged at
+file:line on the leaky class only. On TPU the leaky reads raise
+`RuntimeError: Array has been deleted`; on CPU they pass silently, which
+is why the static check exists.
+"""
+
+import jax
+import numpy as np
+
+
+class LeakyMemoEngine:
+    """Donating handle memoized in an attr, dispatched elsewhere, donated
+    buffer read after — both the provider-call and the direct-subscript
+    dispatch shapes."""
+
+    def __init__(self):
+        self._compiled = {}
+
+    def _fwd(self, params, imgs):
+        return imgs * 2
+
+    def _compile(self, sig, abstract):
+        if sig in self._compiled:
+            return self._compiled[sig]
+        lowered = jax.jit(self._fwd, donate_argnums=(1,)).lower(
+            abstract, abstract
+        )
+        compiled = lowered.compile()
+        self._compiled[sig] = compiled
+        return compiled
+
+    def infer(self, sig, abstract, params, imgs):
+        fn = self._compile(sig, abstract)
+        out = fn(params, imgs)
+        return out, imgs.mean()  # BUG: imgs was donated to fn(...)
+
+    def infer_direct(self, sig, params, imgs):
+        out = self._compiled[sig](params, imgs)
+        return out, imgs.sum()  # BUG: donated through the memo table
+
+
+class SafeMemoEngine:
+    """Same memoized dispatch, host-copy discipline: the donated device
+    buffer is born fresh per call and never re-read."""
+
+    def __init__(self):
+        self._compiled = {}
+
+    def _fwd(self, params, imgs):
+        return imgs * 2
+
+    def _compile(self, sig, abstract):
+        if sig in self._compiled:
+            return self._compiled[sig]
+        lowered = jax.jit(self._fwd, donate_argnums=(1,)).lower(
+            abstract, abstract
+        )
+        compiled = lowered.compile()
+        self._compiled[sig] = compiled
+        return compiled
+
+    def infer(self, sig, abstract, params, imgs):
+        src = np.asarray(imgs)  # host copy outlives the donation
+        fn = self._compile(sig, abstract)
+        dev = jax.numpy.asarray(src)
+        out = fn(params, dev)
+        return out, src.mean()  # reads the HOST copy, never the donated buffer

@@ -291,6 +291,115 @@ class TestDonationSafety:
         fs = by_checker(lint(tmp_path, src), "donation-safety")
         assert len(fs) == 1 and "imgs" in fs[0].message
 
+    # -- memoized-handle taint (the PR 5 blind spot, closed) ---------------
+
+    def test_memoized_handle_via_provider_method_flagged(self, tmp_path):
+        """The engine's real shape: the donating compiled handle is
+        stored in self._compiled by one method, fetched through a
+        provider method by another, and the donated batch is read after
+        the dispatch — invisible to the intra-function pass, caught by
+        the class-level taint."""
+        src = (
+            "import jax\n"
+            "class Engine:\n"
+            "    def _compile(self, sig, abstract):\n"
+            "        lowered = jax.jit(\n"
+            "            lambda p, x: x, donate_argnums=(1,)\n"
+            "        ).lower(abstract, abstract)\n"
+            "        compiled = lowered.compile()\n"
+            "        self._compiled[sig] = compiled\n"
+            "        return compiled\n"
+            "    def infer(self, sig, abstract, params, imgs):\n"
+            "        fn = self._compile(sig, abstract)\n"
+            "        out = fn(params, imgs)\n"
+            "        return out, imgs.mean()\n"
+        )
+        fs = by_checker(lint(tmp_path, src), "donation-safety")
+        assert len(fs) == 1 and fs[0].line == 13
+        assert "imgs" in fs[0].message
+
+    def test_memoized_handle_direct_subscript_call_flagged(self, tmp_path):
+        src = (
+            "import jax\n"
+            "class Engine:\n"
+            "    def _compile(self, sig):\n"
+            "        self._compiled[sig] = jax.jit(\n"
+            "            lambda p, x: x, donate_argnums=(1,)\n"
+            "        )\n"
+            "    def infer(self, sig, params, imgs):\n"
+            "        out = self._compiled[sig](params, imgs)\n"
+            "        return out, imgs.sum()\n"
+        )
+        fs = by_checker(lint(tmp_path, src), "donation-safety")
+        assert len(fs) == 1 and fs[0].line == 9
+        assert "self._compiled" in fs[0].message
+
+    def test_memoized_handle_splat_kwargs_conservative(self, tmp_path):
+        """`jax.jit(fn, **jit_kw)` hides the donation inside the dict —
+        on the HANDLE path every position is conservatively donated (the
+        direct intra-function rule is unchanged: no class, no handle, no
+        finding)."""
+        src = (
+            "import jax\n"
+            "class Engine:\n"
+            "    def _compile(self, sig, jit_kw):\n"
+            "        self._compiled[sig] = jax.jit(lambda x: x, **jit_kw)\n"
+            "    def infer(self, sig, imgs):\n"
+            "        out = self._compiled[sig](imgs)\n"
+            "        return out, imgs.sum()\n"
+        )
+        fs = by_checker(lint(tmp_path, src), "donation-safety")
+        assert len(fs) == 1 and "imgs" in fs[0].message
+
+    def test_memoized_handle_rebind_clears_the_taint(self, tmp_path):
+        """Rebinding the handle name to a NON-donating callable clears
+        the taint: the plain callable's call sites must not inherit the
+        memoized handle's donation spec (review-caught false positive)."""
+        src = (
+            "import jax\n"
+            "class Engine:\n"
+            "    def _compile(self, sig):\n"
+            "        self._compiled[sig] = jax.jit(\n"
+            "            lambda p, x: x, donate_argnums=(1,)\n"
+            "        )\n"
+            "        return self._compiled[sig]\n"
+            "    def infer(self, sig, plain_fn, params, imgs):\n"
+            "        fn = self._compile(sig)\n"
+            "        fn = plain_fn\n"
+            "        out = fn(params, imgs)\n"
+            "        return out, imgs.mean()\n"
+        )
+        assert by_checker(lint(tmp_path, src), "donation-safety") == []
+
+    def test_memoized_handle_non_donated_position_clean(self, tmp_path):
+        src = (
+            "import jax\n"
+            "class Engine:\n"
+            "    def _compile(self, sig):\n"
+            "        self._compiled[sig] = jax.jit(\n"
+            "            lambda p, x: x, donate_argnums=(1,)\n"
+            "        )\n"
+            "    def infer(self, sig, params, imgs):\n"
+            "        out = self._compiled[sig](params, imgs)\n"
+            "        return out, params\n"
+        )
+        assert by_checker(lint(tmp_path, src), "donation-safety") == []
+
+    def test_memoized_handle_fixture_pair(self):
+        """The seeded acceptance pair (tests/fixtures/donation_memo.py):
+        both leaky dispatch shapes flagged, the host-copy twin clean."""
+        from glom_tpu.analysis import run
+
+        fs = by_checker(
+            run([str(FIXTURES / "donation_memo.py")]), "donation-safety"
+        )
+        symbols = {f.symbol for f in fs}
+        assert symbols == {
+            "LeakyMemoEngine.infer",
+            "LeakyMemoEngine.infer_direct",
+        }, fs
+        assert all("Safe" not in f.symbol for f in fs)
+
 
 # ---------------------------------------------------------------------------
 # schema-emit

@@ -1,0 +1,539 @@
+"""Streaming warm-start column cache (glom_tpu/serve/column_cache.py) +
+the batcher's session request path and mixed warm/cold buckets
+(docs/SERVING.md, "Streaming").
+
+The acceptance locks:
+  * cache residency NEVER exceeds the byte budget (LRU eviction, reject
+    of over-budget entries), TTL expiry is a miss at lookup, and two
+    sessions never share column state;
+  * a dispatch failure invalidates the failing engine's entries before
+    any requeue — stale/dead-engine state never warm-starts a request;
+  * warm-start through the batcher is BITWISE the engine dispatched
+    directly from the cached state, and a mixed warm/cold bucket at
+    threshold 0 is bitwise the lone-group dispatches it folded together.
+
+Host-side tests drive fake engines (no device); the real-engine parity
+locks compile the tiny CFG and are slow-marked per the serve-suite
+precedent (CI's serve job runs them unfiltered).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from glom_tpu.serve.batcher import DynamicBatcher
+from glom_tpu.serve.column_cache import (
+    ColumnCache,
+    column_state_bytes,
+    resolve_column_cache,
+)
+from glom_tpu.serve.engine import ServeResult
+from glom_tpu.telemetry import schema
+from glom_tpu.utils.config import GlomConfig, ServeConfig
+
+CFG = GlomConfig(dim=16, levels=3, image_size=8, patch_size=2)  # n=16, tiny
+IMG = np.zeros((3, 8, 8), np.float32)
+
+
+class Sink:
+    def __init__(self):
+        self.records = []
+
+    def write(self, rec):
+        self.records.append(rec)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _state(fill, n=4, L=2, d=4, dtype=np.float32):
+    return np.full((n, L, d), fill, dtype)
+
+
+# ---------------------------------------------------------------------------
+# ColumnCache semantics (host-side, fake clock)
+# ---------------------------------------------------------------------------
+
+
+class TestColumnCache:
+    def test_miss_then_hit_roundtrip(self):
+        c = ColumnCache(budget_bytes=1 << 20)
+        assert c.lookup("s0") is None
+        assert c.store("s0", _state(1.0), engine="e0")
+        got = c.lookup("s0")
+        assert got is not None and np.array_equal(got, _state(1.0))
+        rec = c.record()
+        assert rec["n_hits"] == 1 and rec["n_misses"] == 1
+        assert rec["bytes_in_use"] == _state(1.0).nbytes
+
+    def test_session_isolation(self):
+        """Two streams never share columns: each key returns exactly what
+        IT wrote, and invalidating one leaves the other resident."""
+        c = ColumnCache(budget_bytes=1 << 20)
+        c.store("a", _state(1.0), engine="e0")
+        c.store("b", _state(2.0), engine="e0")
+        assert np.array_equal(c.lookup("a"), _state(1.0))
+        assert np.array_equal(c.lookup("b"), _state(2.0))
+        assert c.invalidate("a")
+        assert c.lookup("a") is None
+        assert np.array_equal(c.lookup("b"), _state(2.0))
+
+    def test_ttl_expiry_is_a_miss_at_lookup(self):
+        clock = FakeClock()
+        c = ColumnCache(budget_bytes=1 << 20, ttl_s=10.0, clock=clock)
+        c.store("s", _state(1.0), engine="e0")
+        clock.t = 9.0
+        assert c.lookup("s") is not None  # inside TTL
+        clock.t = 20.0
+        assert c.lookup("s") is None  # expired: dropped, never served
+        rec = c.record()
+        assert rec["n_expirations"] == 1
+        assert rec["n_sessions"] == 0 and rec["bytes_in_use"] == 0
+
+    def test_lru_eviction_under_budget(self):
+        """Budget for exactly two entries: the LEAST-recently-used one
+        evicts, a lookup refreshes recency, and bytes_in_use / bytes_peak
+        never exceed the budget."""
+        entry = _state(0.0).nbytes
+        c = ColumnCache(budget_bytes=2 * entry)
+        c.store("a", _state(1.0), engine="e0")
+        c.store("b", _state(2.0), engine="e0")
+        assert np.array_equal(c.lookup("a"), _state(1.0))  # a is now MRU
+        c.store("c", _state(3.0), engine="e0")  # evicts b, not a
+        assert c.lookup("b") is None
+        assert np.array_equal(c.lookup("a"), _state(1.0))
+        assert np.array_equal(c.lookup("c"), _state(3.0))
+        rec = c.record()
+        assert rec["n_evictions"] == 1
+        assert rec["bytes_in_use"] <= rec["budget_bytes"]
+        assert rec["bytes_peak"] <= rec["budget_bytes"]
+
+    def test_over_budget_entry_rejected_not_overcommitted(self):
+        entry = _state(0.0).nbytes
+        c = ColumnCache(budget_bytes=entry // 2)
+        assert not c.store("s", _state(1.0), engine="e0")
+        assert c.lookup("s") is None
+        rec = c.record()
+        assert rec["n_rejects"] == 1 and rec["bytes_in_use"] == 0
+
+    def test_store_same_key_replaces_without_double_count(self):
+        entry = _state(0.0).nbytes
+        c = ColumnCache(budget_bytes=2 * entry)
+        c.store("s", _state(1.0), engine="e0")
+        c.store("s", _state(2.0), engine="e0")
+        assert np.array_equal(c.lookup("s"), _state(2.0))
+        assert c.record()["bytes_in_use"] == entry
+
+    def test_invalidate_engine_drops_only_its_entries(self):
+        c = ColumnCache(budget_bytes=1 << 20)
+        c.store("a", _state(1.0), engine="e0")
+        c.store("b", _state(2.0), engine="e1")
+        assert c.invalidate_engine("e0") == 1
+        assert c.lookup("a") is None
+        assert np.array_equal(c.lookup("b"), _state(2.0))
+        assert c.record()["n_invalidations"] == 1
+
+    def test_events_are_stamped_serve_records(self):
+        sink = Sink()
+        entry = _state(0.0).nbytes
+        clock = FakeClock()
+        c = ColumnCache(
+            budget_bytes=entry, ttl_s=1.0, writer=sink, clock=clock
+        )
+        c.store("a", _state(1.0), engine="e0")
+        c.store("b", _state(2.0), engine="e0")  # evicts a
+        clock.t = 5.0
+        c.lookup("b")  # expires b
+        c.store("c", _state(3.0), engine="e0")
+        c.invalidate_engine("e0")
+        events = [r.get("event") for r in sink.records]
+        assert "cache_evict" in events
+        assert "cache_expire" in events
+        assert "cache_invalidate" in events
+        for r in sink.records:
+            assert r["kind"] == "serve"
+            assert schema.validate_record(r) == [], r
+
+    def test_column_state_bytes_prices_the_real_entry(self):
+        scfg32 = ServeConfig()
+        scfg16 = ServeConfig(compute_dtype="bfloat16")
+        n, L, d = CFG.num_patches, CFG.levels, CFG.dim
+        assert column_state_bytes(CFG, scfg32) == n * L * d * 4
+        assert column_state_bytes(CFG, scfg16) == n * L * d * 2
+        real = np.zeros((n, L, d), np.float32)
+        assert real.nbytes == column_state_bytes(CFG, scfg32)
+
+    def test_resolve_from_config(self):
+        assert resolve_column_cache(ServeConfig()) is None
+        c = resolve_column_cache(
+            ServeConfig(column_cache_bytes=1 << 16, column_cache_ttl_s=5.0)
+        )
+        assert c is not None
+        assert c.budget_bytes == 1 << 16 and c.ttl_s == 5.0
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="column_cache_bytes"):
+            ServeConfig(column_cache_bytes=-1)
+        with pytest.raises(ValueError, match="column_cache_ttl_s"):
+            ServeConfig(column_cache_ttl_s=0.0)
+        with pytest.raises(ValueError, match="budget_bytes"):
+            ColumnCache(budget_bytes=0)
+
+    def test_thread_safety_conserves_entries(self):
+        """Concurrent stores/lookups/invalidations over shared keys: the
+        byte count must reconcile exactly with the surviving entries."""
+        entry = _state(0.0).nbytes
+        c = ColumnCache(budget_bytes=8 * entry)
+
+        def churn(tid):
+            for i in range(200):
+                c.store(f"s{(tid + i) % 12}", _state(float(i)), engine="e0")
+                c.lookup(f"s{i % 12}")
+                if i % 17 == 0:
+                    c.invalidate(f"s{i % 12}")
+
+        threads = [
+            threading.Thread(target=churn, args=(t,)) for t in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        rec = c.record()
+        assert rec["bytes_in_use"] == len(c) * entry
+        assert rec["bytes_in_use"] <= rec["budget_bytes"]
+        assert rec["bytes_peak"] <= rec["budget_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# batcher integration (host-side, fake engine)
+# ---------------------------------------------------------------------------
+
+
+class SessionFakeEngine:
+    """Two-tier-shaped engine probe that records each dispatch's levels0
+    rows and returns DISTINGUISHABLE per-row states (row i of dispatch k
+    resolves to a constant k+1), so the tests can assert exactly which
+    cached state warmed which row."""
+
+    def __init__(self, buckets=(1, 2, 4), n_stragglers=0, scfg=None,
+                 name="fake0"):
+        self.scfg = scfg if scfg is not None else ServeConfig(
+            buckets=buckets, max_batch=max(buckets), max_delay_ms=5.0,
+            queue_depth=16, iters="auto", max_auto_iters=12,
+            exit_quorum=0.5, max_continuations=2, dispatch_retries=0,
+        )
+        self.iters_key = "auto"
+        self.auto_budget = 12
+        self.n_stragglers = n_stragglers
+        self.fail = None
+        self.name = name
+        self.calls = []
+        self.shape = (4, 2, 4)  # [n, L, d]
+
+    def cold_levels(self):
+        return np.zeros(self.shape, np.float32)
+
+    def pick_bucket(self, n):
+        for b in self.scfg.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"n={n} exceeds the largest bucket")
+
+    def infer(self, imgs, n_valid=None, levels0=None, auto_budget=None,
+              **kw):
+        if self.fail is not None:
+            raise self.fail
+        b = imgs.shape[0]
+        self.calls.append(
+            {
+                "bucket": b,
+                "n_valid": n_valid,
+                "levels0": None if levels0 is None else np.array(levels0),
+                "auto_budget": auto_budget,
+            }
+        )
+        k = len(self.calls)
+        iters = 4
+        conv = np.ones((b,), bool)
+        if self.n_stragglers and levels0 is None:
+            conv[max(0, n_valid - self.n_stragglers):n_valid] = False
+        return ServeResult(
+            levels=np.full((b, *self.shape), float(k), np.float32),
+            iters_run=iters,
+            latency_s=0.0,
+            bucket=b,
+            compiled=False,
+            row_converged=conv,
+            row_iters=np.full((b,), iters, np.int32),
+        )
+
+
+class TestBatcherSessionPath:
+    def _batcher(self, eng, **kw):
+        cache = ColumnCache(budget_bytes=1 << 20)
+        b = DynamicBatcher(
+            eng, max_batch=kw.pop("max_batch", 1),
+            max_delay_ms=kw.pop("max_delay_ms", 5.0),
+            column_cache=cache, **kw,
+        )
+        return b, cache
+
+    def test_first_frame_misses_second_warm_starts(self):
+        eng = SessionFakeEngine()
+        b, cache = self._batcher(eng)
+        with b:
+            b.submit(IMG, session_id="s0").result(timeout=10.0)
+            b.submit(IMG, session_id="s0").result(timeout=10.0)
+            summary = b.summary_record()
+        assert len(eng.calls) == 2
+        assert eng.calls[0]["levels0"] is None  # frame 0: cold (miss)
+        lv0 = eng.calls[1]["levels0"]
+        assert lv0 is not None  # frame 1: warm from the session cache
+        # ... from exactly frame 0's converged state (dispatch 1 -> 1.0).
+        assert np.array_equal(lv0[0], np.full(eng.shape, 1.0, np.float32))
+        cc = summary["column_cache"]
+        assert cc["n_hits"] == 1 and cc["n_misses"] == 1
+        assert cc["n_writes"] == 2
+        dispatches = [
+            d for d in summary["engines"].values()
+        ]  # engine state sanity only
+        assert dispatches[0]["dispatches"] == 2
+
+    def test_sessionless_requests_never_touch_the_cache(self):
+        eng = SessionFakeEngine()
+        b, cache = self._batcher(eng)
+        with b:
+            b.submit(IMG).result(timeout=10.0)
+            b.submit(IMG).result(timeout=10.0)
+        assert len(cache) == 0
+        rec = cache.record()
+        assert rec["n_hits"] == rec["n_misses"] == rec["n_writes"] == 0
+
+    def test_two_streams_warm_start_from_their_own_state(self):
+        eng = SessionFakeEngine()
+        b, cache = self._batcher(eng)
+        with b:
+            b.submit(IMG, session_id="a").result(timeout=10.0)  # -> 1.0
+            b.submit(IMG, session_id="b").result(timeout=10.0)  # -> 2.0
+            b.submit(IMG, session_id="a").result(timeout=10.0)
+            b.submit(IMG, session_id="b").result(timeout=10.0)
+        assert np.array_equal(
+            eng.calls[2]["levels0"][0], np.full(eng.shape, 1.0, np.float32)
+        )
+        assert np.array_equal(
+            eng.calls[3]["levels0"][0], np.full(eng.shape, 2.0, np.float32)
+        )
+
+    def test_dispatch_failure_invalidates_engine_entries(self):
+        """The staleness rule: after a dispatch failure on the engine, its
+        cached entries are gone — the next frame is a MISS (cold), never
+        a warm start from pre-failure state."""
+        eng = SessionFakeEngine()
+        b, cache = self._batcher(eng)
+        with b:
+            b.submit(IMG, session_id="s0").result(timeout=10.0)
+            assert len(cache) == 1
+            eng.fail = RuntimeError("engine boom")
+            t = b.submit(IMG, session_id="s0")
+            with pytest.raises(RuntimeError):
+                t.result(timeout=10.0)
+            assert len(cache) == 0  # invalidated with the failure
+            eng.fail = None
+            b.submit(IMG, session_id="s0").result(timeout=10.0)
+            summary = b.summary_record()
+        last = eng.calls[-1]
+        assert last["levels0"] is None  # cold restart, not stale warmth
+        assert summary["column_cache"]["n_invalidations"] >= 1
+
+    def test_dispatch_records_carry_cache_counters_and_lint(self):
+        eng = SessionFakeEngine()
+        sink = Sink()
+        cache = ColumnCache(budget_bytes=1 << 20, writer=sink)
+        with DynamicBatcher(eng, max_batch=1, max_delay_ms=5.0,
+                            column_cache=cache, writer=sink) as b:
+            b.submit(IMG, session_id="s0").result(timeout=10.0)
+            b.submit(IMG, session_id="s0").result(timeout=10.0)
+            summary = b.summary_record()
+        dispatches = [r for r in sink.records if r.get("event") == "dispatch"]
+        assert [d["n_cache_warm"] for d in dispatches] == [0, 1]
+        assert [d["n_cache_miss"] for d in dispatches] == [1, 0]
+        for r in sink.records + [summary]:
+            assert schema.validate_record(r) == [], r
+
+
+class TestMixedWarmColdBuckets:
+    def test_straggler_folds_into_fresh_bucket(self):
+        """The padding-cost eraser: a lone straggler's continuation hop
+        picks up waiting fresh traffic instead of dispatching alone — one
+        mixed dispatch whose levels0 selects per row (warm state for the
+        straggler, engine cold init for the fresh row)."""
+        eng = SessionFakeEngine(n_stragglers=1)
+        sink = Sink()
+        b = DynamicBatcher(eng, max_batch=2, max_delay_ms=10.0, writer=sink)
+        # Two fresh requests queue BEFORE the worker starts: the first
+        # dispatch gathers both, reports one straggler; the straggler's
+        # hop folds the third (still-waiting) request into its bucket.
+        t1 = b.submit(IMG)
+        t2 = b.submit(IMG)
+        t3 = b.submit(IMG)
+        b.start()
+        for t in (t1, t2, t3):
+            t.result(timeout=10.0)
+        summary = b.summary_record()
+        b.stop()
+        warm_calls = [c for c in eng.calls if c["levels0"] is not None]
+        assert len(warm_calls) == 1
+        mixed = warm_calls[0]
+        assert mixed["n_valid"] == 2  # straggler + folded fresh row
+        # Row 0 carries the straggler's warm state (dispatch 1 -> 1.0),
+        # row 1 the engine's cold init — the per-row levels0 select.
+        assert np.array_equal(
+            mixed["levels0"][0], np.full(eng.shape, 1.0, np.float32)
+        )
+        assert np.array_equal(mixed["levels0"][1], eng.cold_levels())
+        assert summary["n_folded"] == 1
+        assert summary["n_served"] == 3 and summary["n_failed"] == 0
+
+    def test_empty_queue_keeps_lone_group_dispatch(self):
+        eng = SessionFakeEngine(n_stragglers=1)
+        with DynamicBatcher(eng, max_batch=2, max_delay_ms=10.0) as b:
+            t1 = b.submit(IMG)
+            t2 = b.submit(IMG)
+            t1.result(timeout=10.0)
+            t2.result(timeout=10.0)
+            summary = b.summary_record()
+        warm_calls = [c for c in eng.calls if c["levels0"] is not None]
+        assert len(warm_calls) == 1 and warm_calls[0]["n_valid"] == 1
+        assert summary["n_folded"] == 0
+
+    def test_mixed_dispatch_budget_caps_at_tightest_row(self):
+        """A folded fresh row rides the straggler group's REMAINING
+        budget (min over rows) and re-enters the continuation queue with
+        its own difference — per-request totals never exceed the
+        budget."""
+        eng = SessionFakeEngine(n_stragglers=1)
+        b = DynamicBatcher(eng, max_batch=2, max_delay_ms=10.0)
+        t1 = b.submit(IMG)
+        t2 = b.submit(IMG)
+        t3 = b.submit(IMG)
+        b.start()
+        outs = [t.result(timeout=10.0) for t in (t1, t2, t3)]
+        b.stop()
+        warm_calls = [c for c in eng.calls if c["levels0"] is not None]
+        # Straggler executed 4 of 12 -> every warm hop runs the remaining
+        # budget of its tightest row.
+        assert warm_calls[0]["auto_budget"] == 8
+        # Every request resolved within the per-request budget.
+        assert all(iters <= eng.auto_budget for _, iters, _ in outs)
+
+
+# ---------------------------------------------------------------------------
+# real-engine parity locks (compile-heavy: slow-marked, CI runs them)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def real_engine():
+    import jax
+
+    from glom_tpu.serve.engine import InferenceEngine
+
+    scfg = ServeConfig(
+        buckets=(1, 2), max_batch=2, max_delay_ms=5.0,
+        iters="auto", exit_threshold=1e-3, max_auto_iters=8,
+        dispatch_retries=0, column_cache_bytes=1 << 20,
+    )
+    return InferenceEngine(CFG, scfg, key=jax.random.PRNGKey(3))
+
+
+@pytest.mark.slow  # compiles warm+cold auto signatures; CI serve job runs it
+class TestWarmStartParity:
+    def test_batcher_warm_start_bitwise_matches_direct_dispatch(
+        self, real_engine
+    ):
+        """The streaming acceptance lock: frame 2 served through the
+        batcher (cache hit -> warm levels0) lands on BITWISE the same
+        columns as the engine dispatched directly from the cached state —
+        the cache only chooses the init, never perturbs the compute."""
+        rng = np.random.default_rng(5)
+        frame1 = rng.normal(size=(3, 8, 8)).astype(np.float32)
+        frame2 = (frame1 + 0.05 * rng.normal(size=(3, 8, 8))).astype(
+            np.float32
+        )
+        with DynamicBatcher(real_engine, max_batch=1, max_delay_ms=5.0) as b:
+            assert b.cache is not None  # resolved from ServeConfig
+            lv1, iters1, _ = b.submit(frame1, session_id="s").result(
+                timeout=60.0
+            )
+            cached = np.array(b.cache.lookup("s"))
+            assert np.array_equal(cached, np.asarray(lv1))
+            lv2, iters2, _ = b.submit(frame2, session_id="s").result(
+                timeout=60.0
+            )
+        direct = real_engine.infer(
+            frame2[None], n_valid=1, levels0=cached[None]
+        )
+        assert np.array_equal(np.asarray(lv2), np.asarray(direct.levels[0]))
+        assert iters2 == direct.iters_run
+        # And the warm start genuinely saves iterations on a coherent
+        # frame — the tentpole's measured win, locked at test scale.
+        assert iters2 < iters1
+
+    def test_mixed_bucket_threshold0_bitwise_vs_lone_dispatch(self):
+        """Satellite lock: at threshold 0 a mixed warm/cold dispatch is
+        bitwise the lone dispatches it folded — the warm row equals the
+        lone continuation (same remaining budget), the cold row equals a
+        lone cold dispatch capped at the same budget, and total iters
+        conserve."""
+        import jax
+
+        from glom_tpu.serve.batcher import _Item, Ticket
+        from glom_tpu.serve.engine import InferenceEngine
+
+        scfg = ServeConfig(
+            buckets=(1, 2), max_batch=2, max_delay_ms=5.0,
+            iters="auto", exit_threshold=0.0, max_auto_iters=6,
+            max_continuations=2, dispatch_retries=0,
+        )
+        engine = InferenceEngine(CFG, scfg, key=jax.random.PRNGKey(4))
+        rng = np.random.default_rng(6)
+        img_w = rng.normal(size=(3, 8, 8)).astype(np.float32)
+        img_c = rng.normal(size=(3, 8, 8)).astype(np.float32)
+        # The warm row: 3 of 6 iterations already executed.
+        first = engine.infer(img_w[None], n_valid=1, auto_budget=3)
+        warm_state = np.asarray(first.levels[0])
+
+        item_w = _Item(img_w, Ticket(1))
+        item_w.levels = np.array(warm_state)
+        item_w.executed = 3
+        item_w.hops = 1
+        item_w.warm_src = "cont"
+        item_c = _Item(img_c, Ticket(2))
+        b = DynamicBatcher(engine, max_batch=2, max_delay_ms=5.0)
+        b._dispatch(engine, "engine0", [item_w, item_c])
+
+        # Warm row: resolved at the full budget, bitwise the lone
+        # continuation of the same state with the same remaining budget.
+        lv_w, iters_w, _ = item_w.ticket.result(timeout=60.0)
+        lone_w = engine.infer(
+            img_w[None], n_valid=1, levels0=warm_state[None], auto_budget=3
+        )
+        assert np.array_equal(np.asarray(lv_w), np.asarray(lone_w.levels[0]))
+        assert iters_w == 6  # 3 executed + 3 remaining: exact conservation
+        # Cold row: capped at the straggler's remaining budget (3 of 6),
+        # unconverged at threshold 0 -> re-bucketed warm with its OWN
+        # remainder; its mid-flight state is bitwise a lone cold dispatch
+        # at the same cap (cold init select == the forward's own init).
+        assert not item_c.ticket.done()
+        group = b._cont_q.get_nowait()
+        assert group == [item_c] and item_c.executed == 3
+        lone_c = engine.infer(img_c[None], n_valid=1, auto_budget=3)
+        assert np.array_equal(item_c.levels, np.asarray(lone_c.levels[0]))
+        item_c.ticket._fail(RuntimeError("test cleanup"))

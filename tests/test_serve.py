@@ -794,6 +794,179 @@ class TestMultiEngineFanOut:
             )
 
 
+class TestEngineRejoin:
+    """Probation re-admit of a dead engine (ServeConfig.rejoin_threshold,
+    docs/RESILIENCE.md): N consecutive successful health dispatches bring
+    a recovered engine back behind the shared queue."""
+
+    def _kill(self, b, bad, good, n=6):
+        """Drive traffic until the bad engine is marked dead."""
+        deadline = time.perf_counter() + 10.0
+        while time.perf_counter() < deadline:
+            try:
+                b.submit(IMG).result(timeout=10.0)
+            except Exception:
+                pass
+            with b._engine_lock:
+                if not b._engine_state[bad.name]["alive"]:
+                    return
+        raise AssertionError("bad engine never died")
+
+    def _await_rejoin(self, b, name, timeout=10.0):
+        deadline = time.perf_counter() + timeout
+        while time.perf_counter() < deadline:
+            with b._engine_lock:
+                st = dict(b._engine_state[name])
+            if st["alive"]:
+                return st
+            time.sleep(0.01)
+        raise AssertionError(f"{name} never rejoined")
+
+    def test_probation_readmits_and_reserves(self):
+        sink = Sink()
+        bad = FakeEngine()
+        bad.name = "bad"
+        bad.fail = RuntimeError("boom")
+        good = FakeEngine(latency_s=0.005)
+        good.name = "good"
+        with DynamicBatcher(
+            engines=[bad, good], max_batch=1, max_delay_ms=5.0,
+            writer=sink, rejoin_threshold=2, rejoin_interval_ms=15.0,
+        ) as b:
+            self._kill(b, bad, good)
+            bad.fail = None  # the replica recovered
+            st = self._await_rejoin(b, "bad")
+            assert st["rejoins"] == 1 and not st["probation"]
+            # The re-admitted engine serves real traffic again: keep the
+            # good engine busy so the revived worker must pick up work.
+            n_before = len(bad.calls)
+            deadline = time.perf_counter() + 10.0
+            while len(bad.calls) <= n_before + 2:
+                b.submit(IMG).result(timeout=10.0)
+                assert time.perf_counter() < deadline, "bad never re-served"
+            summary = b.summary_record()
+        events = [r.get("event") for r in sink.records]
+        assert "engine_probation" in events and "engine_rejoin" in events
+        rejoin = next(
+            r for r in sink.records if r.get("event") == "engine_rejoin"
+        )
+        assert rejoin["engine"] == "bad"
+        assert rejoin["health_dispatches"] == 2
+        assert schema.validate_record(rejoin) == []
+        assert summary["n_rejoined"] == 1
+        assert summary["engines"]["bad"]["alive"]
+        assert summary["engines"]["bad"]["dispatches"] >= 3
+        assert summary["n_failed"] == 0  # failover covered the dead window
+
+    def test_failed_probe_resets_the_consecutive_count(self):
+        """A flapping engine must not rejoin on interleaved successes:
+        the probation count restarts at every failed health dispatch."""
+        flaky = FakeEngine()
+        flaky.name = "flaky"
+        flaky.fail = RuntimeError("boom")
+        good = FakeEngine()
+        good.name = "good"
+
+        calls = {"n": 0}
+        orig_infer = flaky.infer
+
+        def infer(imgs, n_valid=None, **kw):
+            calls["n"] += 1
+            # Post-death probes: fail every second probe until probe 6 —
+            # consecutive-success never reaches 3 before that.
+            if flaky.fail is None and calls["n"] < 6 and calls["n"] % 2:
+                raise RuntimeError("still flapping")
+            return orig_infer(imgs, n_valid=n_valid)
+
+        flaky.infer = infer
+        with DynamicBatcher(
+            engines=[flaky, good], max_batch=1, max_delay_ms=5.0,
+            rejoin_threshold=3, rejoin_interval_ms=10.0,
+        ) as b:
+            TestEngineRejoin._kill(self, b, flaky, good)
+            flaky.fail = None
+            st = self._await_rejoin(b, "flaky")
+            assert st["rejoins"] == 1
+        # 3 consecutive successes require surviving past the flap window.
+        assert calls["n"] >= 6
+
+    def test_rejoin_disabled_keeps_death_terminal(self):
+        bad = FakeEngine()
+        bad.name = "bad"
+        bad.fail = RuntimeError("boom")
+        good = FakeEngine()
+        good.name = "good"
+        sink = Sink()
+        with DynamicBatcher(
+            engines=[bad, good], max_batch=1, max_delay_ms=5.0, writer=sink
+        ) as b:
+            self._kill(b, bad, good)
+            bad.fail = None
+            time.sleep(0.2)  # ample probation time, were there any
+            with b._engine_lock:
+                st = dict(b._engine_state["bad"])
+        assert not st["alive"] and not st["probation"]
+        assert "engine_probation" not in [
+            r.get("event") for r in sink.records
+        ]
+
+    def test_stop_racing_rejoin_never_leaks_a_worker(self):
+        """Review-caught race: a rejoin landing concurrently with stop()
+        must either register its worker BEFORE stop()'s join snapshot
+        (joined) or observe the stop flag and never spawn — across many
+        seeds, no batcher thread survives stop() and a restart never
+        yields duplicate workers."""
+        import threading as th
+
+        for i in range(15):
+            bad = FakeEngine()
+            bad.name = f"bad{i}"
+            bad.fail = RuntimeError("boom")
+            good = FakeEngine()
+            good.name = f"good{i}"
+            b = DynamicBatcher(
+                engines=[bad, good], max_batch=1, max_delay_ms=5.0,
+                rejoin_threshold=1, rejoin_interval_ms=1.0,
+            )
+            b.start()
+            self._kill(b, bad, good)
+            bad.fail = None  # rejoin becomes possible ...
+            time.sleep(0.001 * (i % 4))  # ... racing the stop below
+            b.stop()
+            with b._counter_lock:
+                assert b._threads == []
+            mine = [
+                t for t in th.enumerate()
+                if t.name.endswith(f"-bad{i}") or t.name.endswith(f"-good{i}")
+            ]
+            deadline = time.perf_counter() + 2.0
+            while any(t.is_alive() for t in mine):
+                assert time.perf_counter() < deadline, (
+                    f"leaked batcher thread(s) after stop(): "
+                    f"{[t.name for t in mine if t.is_alive()]}"
+                )
+                time.sleep(0.01)
+            with b._engine_lock:
+                st = dict(b._engine_state[f"bad{i}"])
+            assert not st["probation"]
+
+    def test_stop_during_probation_exits_cleanly(self):
+        bad = FakeEngine()
+        bad.name = "bad"
+        bad.fail = RuntimeError("boom")
+        good = FakeEngine()
+        good.name = "good"
+        b = DynamicBatcher(
+            engines=[bad, good], max_batch=1, max_delay_ms=5.0,
+            rejoin_threshold=50, rejoin_interval_ms=10.0,
+        )
+        b.start()
+        self._kill(b, bad, good)
+        b.stop()  # probation still counting: must not block or leak
+        with b._engine_lock:
+            assert not b._engine_state["bad"]["probation"]
+
+
 class TestReviewRegressions:
     def test_warm_hop_under_degraded_ladder_uses_fixed_budget(self):
         """A ladder that degrades to capped_iters BETWEEN a straggler's
