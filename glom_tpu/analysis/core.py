@@ -217,6 +217,7 @@ def default_checkers() -> List[Checker]:
     from glom_tpu.analysis.lockset import LockOrder, Lockset
     from glom_tpu.analysis.purity import TracePurity
     from glom_tpu.analysis.schema_emit import SchemaEmit
+    from glom_tpu.analysis.sighandler import SignalSafety
 
     return [
         CollectiveCoverage(),
@@ -225,6 +226,7 @@ def default_checkers() -> List[Checker]:
         SchemaEmit(),
         Lockset(),
         LockOrder(),
+        SignalSafety(),
     ]
 
 

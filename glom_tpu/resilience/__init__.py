@@ -12,19 +12,33 @@ events against the stamped faults:
   * ladder   — the serving degradation ladder (normal -> capped iters ->
                capped buckets -> shed; every rung reversible + stamped);
   * chaos    — end-to-end scenarios (`python -m glom_tpu.resilience`):
-               kill a real training worker, require resume.
+               kill a real training worker, require resume;
+  * coordinator — pod-coordinated preemption (two-phase save barrier,
+               gang supervision, cross-host restore reconciliation via
+               utils/checkpoint's pod mode).
 
 The training-side restart loop lives with the trainers
 (glom_tpu/train/supervise.fit_supervised); the checkpoint integrity layer
 with the checkpoints (glom_tpu/utils/checkpoint.py).
 """
 
+from glom_tpu.resilience.coordinator import (
+    BarrierAbort,
+    DirectoryTransport,
+    GangRestart,
+    PodCoordinator,
+    peer_host_dirs,
+    pod_preemption_save,
+    read_pod_commit,
+)
 from glom_tpu.resilience.faults import (
     FaultPlan,
     InjectedFault,
+    barrier_delay,
     dispatch_fault,
     emit_fault,
     emit_recovery,
+    message_loss,
     nan_storm,
     probe_flap,
     queue_stall,
@@ -43,9 +57,18 @@ from glom_tpu.resilience.retry import RetryPolicy
 __all__ = [
     "FaultPlan",
     "InjectedFault",
+    "BarrierAbort",
+    "DirectoryTransport",
+    "GangRestart",
+    "PodCoordinator",
+    "peer_host_dirs",
+    "pod_preemption_save",
+    "read_pod_commit",
+    "barrier_delay",
     "dispatch_fault",
     "emit_fault",
     "emit_recovery",
+    "message_loss",
     "nan_storm",
     "probe_flap",
     "queue_stall",

@@ -57,12 +57,20 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--scenario",
-        choices=["kill-train", "preempt-train", "kill-serve", "rejoin-serve"],
+        choices=[
+            "kill-train", "preempt-train", "preempt-pod",
+            "kill-serve", "rejoin-serve",
+        ],
         default="kill-train",
         help="kill-train = SIGKILL mid-run (uncatchable; resume must come "
         "from the last committed checkpoint); preempt-train = SIGTERM (the "
         "grace path: deadline-bounded checkpoint + flight dump, then "
-        "resume); kill-serve = permanently fail one engine of a "
+        "resume); preempt-pod = SIGTERM a strict subset of an N-process "
+        "pod, then all of it — every host must commit ONE common step "
+        "through the two-phase save barrier inside the grace deadline "
+        "(or abort loudly, both stamped), and the relaunched gang must "
+        "resume from that step with a continuous per-host train_step "
+        "sequence; kill-serve = permanently fail one engine of a "
         "multi-engine serve run (seeded dispatch_fault) and require its "
         "queued tickets to re-dispatch to a sibling with a reconciling "
         "evidence trail; rejoin-serve = kill engine 0 for a BOUNDED fault "
@@ -91,6 +99,22 @@ def build_parser() -> argparse.ArgumentParser:
         "--engines", type=int, default=2, metavar="N",
         help="kill-serve: engine replicas behind the shared batcher "
         "(engine 0 is the one killed; >= 2 so a sibling exists)",
+    )
+    p.add_argument(
+        "--hosts", type=int, default=2, metavar="N",
+        help="preempt-pod: real train subprocesses in the gang (>= 2; "
+        "host 0 is the strict subset SIGTERM'd first)",
+    )
+    p.add_argument(
+        "--kill-gap", type=float, default=0.5, metavar="SECONDS",
+        help="preempt-pod: delay between the subset SIGTERM and the rest "
+        "(the window where early-signaled hosts wait in the barrier "
+        "while the others still train)",
+    )
+    p.add_argument(
+        "--preempt-deadline", type=float, default=30.0, metavar="SECONDS",
+        help="preempt-pod: the workers' SIGTERM grace budget (the barrier "
+        "round must complete — or abort — inside it)",
     )
     return p
 
@@ -316,9 +340,272 @@ def run_kill_serve(args) -> int:
     return 0
 
 
+def _pod_worker_cmd(args, workdir: Path, host: int) -> List[str]:
+    return [
+        sys.executable, "-u", "-m", "glom_tpu.train.cli",
+        "--preset", args.preset,
+        "--steps", str(args.steps),
+        "--batch-size", str(args.batch_size),
+        "--data", "gaussian",
+        "--log-every", "1",
+        "--checkpoint-dir", str(workdir / "ckpt" / f"host_{host}"),
+        "--checkpoint-every", "1",
+        "--checkpoint-keep", "50",
+        "--resume",
+        "--pod-index", str(host),
+        "--pod-count", str(args.hosts),
+        "--pod-dir", str(workdir / "coord"),
+        "--preempt-deadline", str(args.preempt_deadline),
+        "--metrics-file", str(workdir / f"metrics_h{host}.jsonl"),
+        "--flight-recorder", str(workdir / f"flight_h{host}"),
+    ]
+
+
+def run_preempt_pod(args) -> int:
+    """The pod-preemption acceptance: N REAL train subprocesses under the
+    coordinated save barrier. SIGTERM a STRICT SUBSET first (those hosts
+    propose and wait inside the barrier while the rest keep training),
+    then the rest — the round must commit ONE common step on every host
+    inside the grace deadline (or abort loudly; both outcomes stamped).
+    Relaunch the gang: every host must resume from exactly that step and
+    the per-host train_step sequences must be continuous — all proven
+    from the JSONL evidence alone."""
+    if args.hosts < 2:
+        _emit(
+            {"error": "no-pod", "value": None,
+             "note": f"--hosts {args.hosts}: preempt-pod needs >= 2 "
+             "processes (one host is preempt-train)"},
+            kind="error",
+        )
+        return 1
+    workdir = Path(args.dir)
+    workdir.mkdir(parents=True, exist_ok=True)
+    hosts = list(range(args.hosts))
+    ckpt_dirs = {h: workdir / "ckpt" / f"host_{h}" for h in hosts}
+    metrics = {h: workdir / f"metrics_h{h}.jsonl" for h in hosts}
+    flights = {h: workdir / f"flight_h{h}" for h in hosts}
+    cmds = {h: _pod_worker_cmd(args, workdir, h) for h in hosts}
+    _note(
+        f"chaos preempt-pod: launching {args.hosts}-host gang",
+        cmd=" ".join(cmds[0]), workdir=str(workdir),
+    )
+
+    # Phase 1: run until every host committed >= kill-after manifests,
+    # SIGTERM the strict subset (host 0), then — inside the grace window,
+    # while the subset waits in the barrier — the rest.
+    procs = {h: _spawn(cmds[h], workdir / f"run1_h{h}.log") for h in hosts}
+    deadline = time.monotonic() + args.timeout
+    try:
+        for h in hosts:
+            if not _wait_for_checkpoints(
+                procs[h], ckpt_dirs[h], args.kill_after, deadline
+            ):
+                _emit(
+                    {"error": "worker-never-checkpointed", "value": None,
+                     "note": f"host {h}: no {args.kill_after} committed "
+                     f"checkpoints within {args.timeout}s "
+                     f"(rc={procs[h].poll()}); see run1_h{h}.log"},
+                    kind="error",
+                )
+                return 1
+        if any(procs[h].poll() is not None for h in hosts):
+            _emit(
+                {"error": "kill-window-missed", "value": None,
+                 "note": "a host exited before the fault landed; lower "
+                 f"--kill-after (now {args.kill_after}) or raise --steps "
+                 f"(now {args.steps})"},
+                kind="error",
+            )
+            return 1
+        subset = hosts[:1]  # the STRICT subset: host 0 alone
+        for h in subset:
+            os.kill(procs[h].pid, signal.SIGTERM)
+            _emit(
+                {"fault": "sigterm", "site": "pod-worker",
+                 "host": h, "pid": procs[h].pid, "wave": "subset",
+                 "manifests_at_kill": _manifest_count(ckpt_dirs[h]),
+                 "wall_time_s": round(time.time(), 3)},
+                kind="fault",
+            )
+        time.sleep(args.kill_gap)
+        for h in hosts:
+            if h in subset:
+                continue
+            os.kill(procs[h].pid, signal.SIGTERM)
+            _emit(
+                {"fault": "sigterm", "site": "pod-worker",
+                 "host": h, "pid": procs[h].pid, "wave": "all",
+                 "manifests_at_kill": _manifest_count(ckpt_dirs[h]),
+                 "wall_time_s": round(time.time(), 3)},
+                kind="fault",
+            )
+        rcs = {}
+        for h in hosts:
+            try:
+                rcs[h] = procs[h].wait(timeout=min(120.0, args.timeout))
+            except subprocess.TimeoutExpired:
+                _emit(
+                    {"error": "worker-outlived-kill", "value": None,
+                     "note": f"host {h} pid {procs[h].pid} still alive "
+                     "after SIGTERM + grace; hard-killing"},
+                    kind="error",
+                )
+                return 1
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30.0)
+    if any(rc == 0 for rc in rcs.values()):
+        _emit(
+            {"error": "kill-window-missed", "value": None,
+             "note": f"a host exited 0 despite SIGTERM (rcs={rcs}); "
+             "lower --kill-after or raise --steps"},
+            kind="error",
+        )
+        return 1
+    _note(f"phase 1 done: gang SIGTERM'd (rcs={rcs})")
+
+    # The barrier's verdict: the pod commit marker is written by host 0
+    # only when EVERY host acked the committed step.
+    from glom_tpu.resilience.coordinator import read_pod_commit
+
+    commit = read_pod_commit(workdir / "coord")
+    if commit is None:
+        _emit(
+            {"error": "no-pod-commit", "value": None,
+             "note": "no pod_commit_<step>.json under the coordination "
+             "dir: the barrier never completed (an abort should be "
+             "stamped in the flight dumps — this smoke injects no "
+             "faults, so a commit was required)"},
+            kind="error",
+        )
+        return 1
+    common = int(commit["step"])
+    _note(f"barrier committed common step {common}",
+          proposals=commit.get("proposals"))
+
+    # Phase 2: relaunch the whole gang; every host must reconcile to the
+    # common step and run to completion.
+    procs2 = {h: _spawn(cmds[h], workdir / f"run2_h{h}.log") for h in hosts}
+    for h in hosts:
+        try:
+            rc2 = procs2[h].wait(timeout=args.timeout)
+        except subprocess.TimeoutExpired:
+            for p in procs2.values():
+                if p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=30.0)
+            _emit(
+                {"error": "resume-hung", "value": None,
+                 "note": f"relaunched host {h} exceeded {args.timeout}s"},
+                kind="error",
+            )
+            return 1
+        if rc2 != 0:
+            _emit(
+                {"error": "resume-failed", "value": None,
+                 "note": f"relaunched host {h} rc={rc2}; see run2_h{h}.log"},
+                kind="error",
+            )
+            return 1
+    _note("phase 2 done: gang resumed and ran to completion")
+
+    # Phase 3: the evidence must prove ONE common resume step and
+    # per-host continuity.
+    failures: List[str] = []
+    dumps_all: List[Path] = []
+    for h in hosts:
+        recs = _records(metrics[h])
+        resumes = [
+            r for r in recs
+            if r.get("kind") == "recovery"
+            and r.get("action") == "resume-from-checkpoint"
+        ]
+        if not resumes:
+            failures.append(f"host {h}: no stamped resume-from-checkpoint")
+        else:
+            got = {int(r["step"]) for r in resumes
+                   if isinstance(r.get("step"), (int, float))}
+            if got != {common}:
+                failures.append(
+                    f"host {h}: resumed from {sorted(got)}, want the "
+                    f"committed common step {{{common}}}"
+                )
+        steps = sorted(
+            {int(r["step"]) for r in recs
+             if r.get("kind") == "train_step"
+             and isinstance(r.get("step"), (int, float))}
+        )
+        want = set(range(args.steps))
+        # The grace save commits PAST the last flushed record on the host
+        # that WAS the min (its in-flight step's record died with the
+        # process; the training is in the checkpoint) — exactly one
+        # missing step, the committed step minus one, same as
+        # preempt-train. Hosts past the min re-train and re-log the gap.
+        missing = want - set(steps)
+        if not steps or not missing <= {common - 1}:
+            failures.append(
+                f"host {h}: train_step sequence not continuous: got "
+                f"{steps}, missing {sorted(missing)}, allowed gap "
+                f"{{{common - 1}}}"
+            )
+        dumps = sorted(flights[h].glob("flight_*.jsonl"))
+        dumps_all.extend(dumps)
+        if not dumps:
+            failures.append(f"host {h}: no flight dumps")
+            continue
+        drecs = [r for d in dumps for r in _records(d)]
+        barrier = [r for r in drecs if r.get("kind") == "barrier"]
+        phases = {r.get("phase") for r in barrier}
+        if not {"propose", "commit", "saved", "complete"} <= phases:
+            failures.append(
+                f"host {h}: barrier round incomplete in the evidence "
+                f"(phases {sorted(phases)})"
+            )
+        commits = {r.get("step") for r in barrier
+                   if r.get("phase") == "commit"}
+        if commits != {common}:
+            failures.append(
+                f"host {h}: stamped barrier commit {sorted(commits)} != "
+                f"pod marker step {common}"
+            )
+        preempt = [
+            r for r in drecs
+            if r.get("kind") == "recovery"
+            and r.get("action") == "preemption-checkpoint"
+        ]
+        if not any(r.get("ok") and r.get("pod") for r in preempt):
+            failures.append(
+                f"host {h}: no successful POD preemption-checkpoint "
+                "recovery event in the flight dumps"
+            )
+    failures.extend(_lint([*metrics.values(), *dumps_all]))
+
+    summary = {
+        "event": "chaos-summary",
+        "scenario": args.scenario,
+        "ok": not failures,
+        "hosts": args.hosts,
+        "steps": args.steps,
+        "committed_common_step": common,
+        "proposals": commit.get("proposals"),
+        "n_flight_dumps": len(dumps_all),
+        "failures": failures[:10],
+    }
+    _emit(summary, kind="summary")
+    if failures:
+        for f in failures:
+            print(f"CHAOS FAIL: {f}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def run_scenario(args) -> int:
     if args.scenario in ("kill-serve", "rejoin-serve"):
         return run_kill_serve(args)
+    if args.scenario == "preempt-pod":
+        return run_preempt_pod(args)
     workdir = Path(args.dir)
     paths = {
         "ckpt": workdir / "ckpt",

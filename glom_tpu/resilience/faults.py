@@ -289,6 +289,49 @@ def nan_storm(
             yield batch
 
 
+def message_loss(plan: FaultPlan, site: str = "barrier-msg"):
+    """Barrier-message-loss injector for the pod coordinator's transport
+    (DirectoryTransport(fault_hook=...)): on scheduled posts the message
+    is silently DROPPED — the sender is not told, exactly like a lossy
+    link — and the waiting peers must abort the round loudly at the
+    deadline (coordinator.BarrierAbort, stamped). ctx carries the
+    round/phase/host so the stamped fault reconciles one-to-one against
+    the abort it caused."""
+
+    def hook(ctx: dict) -> bool:
+        return plan.fires(
+            site,
+            **{k: ctx.get(k) for k in ("round", "phase", "host")},
+        )
+
+    return hook
+
+
+def barrier_delay(
+    plan: FaultPlan,
+    site: str = "barrier-delay",
+    *,
+    delay_s: float = 0.5,
+    sleep: Callable[[float], None] = time.sleep,
+):
+    """Deadline-overrun injector for the same transport seam: scheduled
+    posts are STALLED by `delay_s` before the message lands (the message
+    is not lost — it is late). Sized past the round's grace deadline
+    this forces the waiting peers into the loud abort path; sized under
+    it, it proves slow-but-alive hosts still commit."""
+
+    def hook(ctx: dict) -> bool:
+        if plan.fires(
+            site,
+            delay_s=delay_s,
+            **{k: ctx.get(k) for k in ("round", "phase", "host")},
+        ):
+            sleep(delay_s)
+        return False  # never dropped — only delayed
+
+    return hook
+
+
 def truncate_newest_checkpoint(
     directory, *, writer=None
 ) -> Optional[Tuple[int, str]]:

@@ -377,6 +377,23 @@ class DynamicBatcher:
         # assumes a model config). Guarded by _counter_lock: submit()
         # writes it, the probation thread reads it.
         self._probe_shape = None
+        # FAIRNESS (ROADMAP item 1, observed while building rejoin-serve):
+        # under slow paced traffic one worker can win EVERY 50ms-timeout
+        # first-get race for seconds at a time — its loop re-enters get()
+        # microseconds after a dispatch while the sibling's expired wait
+        # re-queues behind it, and per-engine utilization phase-locks on
+        # one engine. Two deterministic counters break the lock: the
+        # worker that won the LAST first-get defers a small handicap when
+        # the queue is idle (so an already-waiting sibling is first in
+        # the queue's waiter list when the next request lands), and each
+        # worker's first-get timeout carries a per-engine jitter so
+        # equally-idle workers never expire in phase. _last_pickup rides
+        # _counter_lock (worker threads write AND read it).
+        self._last_pickup: Optional[str] = None
+        self._pickup_handicap_s = 0.004
+        self._engine_index = {
+            self._ename(eng, i): i for i, eng in enumerate(self.engines)
+        }
         self.dispatches: List[dict] = []  # one dict per dispatched batch
         # Per-request accounting, maintained INCREMENTALLY (a long-running
         # server must not retain one record per resolved request):
@@ -631,16 +648,49 @@ class DynamicBatcher:
             backend_state=backend_record().get("backend_state", "unknown"),
         )
 
+    def _first_get_timeout(self, engine_name: str) -> float:
+        """Per-engine jittered first-get timeout: 50ms base plus a
+        deterministic per-engine offset (prime-stepped, bounded at +40%)
+        so idle workers' timeout expiries drift apart instead of
+        re-queueing in the same order forever."""
+        idx = self._engine_index.get(engine_name, 0)
+        return 0.05 * (1.0 + 0.4 * ((idx * 7) % 10) / 10.0)
+
+    def _defer_pickup(self, engine_name: str) -> bool:
+        """True when this worker should yield the next first-get: it won
+        the last one, the queue is idle (the handicap must never slow a
+        backed-up queue), and a live sibling exists to take the hand-off.
+        Locks are taken SEQUENTIALLY in the documented engine->counter
+        order (never nested here)."""
+        if len(self.engines) < 2 or not self._q.empty():
+            return False
+        with self._engine_lock:
+            has_sibling = any(
+                st["alive"]
+                for n, st in self._engine_state.items()
+                if n != engine_name
+            )
+        if not has_sibling:
+            return False
+        with self._counter_lock:
+            return self._last_pickup == engine_name
+
     def _gather(self, engine_name: str) -> List[_Item]:
         """Block for the first request, then gather until max_batch or the
         first request ages past max_delay — the two-knob admission. A
         ladder at bucket_cap or worse gathers smaller batches: smaller,
-        faster dispatches drain a backed-up queue in bounded bites."""
+        faster dispatches drain a backed-up queue in bounded bites. The
+        first get is fairness-rotated (see __init__): last winner defers
+        a handicap on an idle queue, timeouts carry per-engine jitter."""
         max_batch = self._effective_max_batch(engine_name)
+        if self._defer_pickup(engine_name):
+            time.sleep(self._pickup_handicap_s)
         try:
-            first = self._q.get(timeout=0.05)
+            first = self._q.get(timeout=self._first_get_timeout(engine_name))
         except queue.Empty:
             return []
+        with self._counter_lock:
+            self._last_pickup = engine_name
         batch = [first]
         deadline = self._clock() + self.max_delay_s
         while len(batch) < max_batch:

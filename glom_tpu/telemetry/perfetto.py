@@ -127,6 +127,7 @@ def to_trace_events(records: Iterable[dict]) -> List[dict]:
                 "error": f"error: {rec.get('error', '?')}",
                 "serve": f"serve:{rec.get('event', '?')}",
                 "recovery": f"recovery:{rec.get('action', '?')}",
+                "barrier": f"barrier:{rec.get('phase', '?')}",
             }.get(kind, kind)
             raw.append(
                 {

@@ -32,7 +32,12 @@ from typing import Iterable, List, Optional, Tuple
 # against exactly what was injected) and the "recovery" kind (one recovery
 # decision or action: checkpoint resume, dispatch retry, torn-checkpoint
 # skip, preemption save — docs/RESILIENCE.md).
-SCHEMA_VERSION = 4
+# v5 added the "barrier" kind (glom_tpu/resilience/coordinator.py: one
+# phase of a pod-coordination round — a preemption save barrier's
+# propose/commit/saved/complete/abort, a gang-restart rendezvous — so a
+# multi-process chaos run can reconcile every host's view of the SAME
+# round from the per-host evidence streams alone).
+SCHEMA_VERSION = 5
 
 _NUM = (int, float)
 _STR = (str,)
@@ -74,9 +79,18 @@ KINDS = {
     "fault": {"fault": _STR},
     # One recovery decision or action (docs/RESILIENCE.md): `action` names
     # it — "resume-from-checkpoint", "restart", "dispatch-retry",
-    # "skip-torn-checkpoint", "preemption-checkpoint", "give-up". Extra
-    # fields (step, attempt, backoff_s, ...) ride per action.
+    # "skip-torn-checkpoint", "preemption-checkpoint", "give-up",
+    # "quarantine-half-step", "gang-stop". Extra fields (step, attempt,
+    # backoff_s, ...) ride per action.
     "recovery": {"action": _STR},
+    # One phase of a pod-coordination round (resilience/coordinator.py):
+    # `phase` names it — "propose" (this host's highest dispatchable
+    # step), "commit" (the round's agreed min), "saved" (this host landed
+    # the committed step), "complete" (every host acked), "abort" (the
+    # deadline passed or a peer aborted — NO partial pod checkpoint may
+    # masquerade as complete), "arrive" (gang-restart rendezvous).
+    # `round` identifies the round; host/n_hosts/step ride per phase.
+    "barrier": {"phase": _STR, "round": _STR},
 }
 
 WATCHDOG_STATES = ("unknown", "up", "down", "flapping")
@@ -90,6 +104,8 @@ def infer_kind(rec: dict) -> str:
     """Best-effort kind for legacy records written before stamping."""
     if "fault" in rec:
         return "fault"
+    if "phase" in rec and "round" in rec:
+        return "barrier"
     if "backend_state" in rec and ("t" in rec or "event" in rec):
         return "watchdog"
     if "name" in rec and "dur_s" in rec:

@@ -156,7 +156,9 @@ class FlightRecorder:
         the exit). The outcome is stamped as a schema "recovery" event
         (action "preemption-checkpoint", ok/step/elapsed_s) into the ring
         ahead of the dump, so the postmortem records whether a resumable
-        step was left behind. Installed separately from
+        step was left behind. A hook may return a dict instead of a bare
+        step — its fields merge into the recovery record (the pod save
+        barrier returns step/round/n_hosts that way). Installed separately from
         install_process_hooks because the trainer/manager usually exist
         only after the hooks do (train/cli.py installs hooks first thing).
         Pass None to remove."""
@@ -198,10 +200,29 @@ class FlightRecorder:
                 "elapsed_s": round(elapsed, 3),
                 "wall_time_s": round(time.time(), 3),
             }
-            if result[0] is not None:
+            if isinstance(result[0], dict):
+                # Pod-mode hooks (resilience/coordinator.pod_preemption_
+                # save) return the whole barrier outcome — committed
+                # step, round id, n_hosts — which rides the recovery
+                # record so one stamped event tells the coordinated
+                # story; plain hooks keep returning the bare step.
+                rec.update(result[0])
+            elif result[0] is not None:
                 rec["step"] = result[0]
             if worker.is_alive():
                 rec["note"] = "save overran the deadline; dumping anyway"
+                # The postmortem's first question is "stuck WHERE": snap
+                # the overrunning thread's live stack into the record
+                # (sys._current_frames is a point-in-time copy, no pause).
+                import sys
+                import traceback
+
+                frame = sys._current_frames().get(worker.ident)
+                if frame is not None:
+                    rec["stuck_at"] = [
+                        ln.strip()
+                        for ln in traceback.format_stack(frame)[-4:]
+                    ]
             elif result[1] is not None:
                 rec["note"] = f"{type(result[1]).__name__}: {result[1]}"[:300]
             self.observe(schema.stamp(rec, kind="recovery"))

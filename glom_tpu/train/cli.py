@@ -93,7 +93,30 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=100)
+    p.add_argument(
+        "--checkpoint-keep", type=int, default=3, metavar="N",
+        help="checkpoint retention (orbax max_to_keep). Pod runs keep "
+        "more: the preemption barrier commits the gang MIN step, and a "
+        "host past it must still RETAIN it (docs/RESILIENCE.md)",
+    )
     p.add_argument("--resume", action="store_true", help="resume from latest ckpt")
+    p.add_argument(
+        "--pod-index", type=int, default=None, metavar="I",
+        help="this process's index in a multi-process pod (0-based); "
+        "enables the coordinated preemption barrier + cross-host restore "
+        "reconciliation (docs/RESILIENCE.md). Requires --pod-count, "
+        "--pod-dir, and a --checkpoint-dir named host_<I> under a shared "
+        "pod root",
+    )
+    p.add_argument(
+        "--pod-count", type=int, default=None, metavar="N",
+        help="total processes in the pod (>= 2 for coordination)",
+    )
+    p.add_argument(
+        "--pod-dir", default=None, metavar="DIR",
+        help="shared coordination directory for the pod rendezvous "
+        "(barrier messages + the pod commit marker)",
+    )
     p.add_argument(
         "--supervise", type=_nonneg_int, default=None, metavar="RESTARTS",
         help="run under the fit_supervised restart loop (docs/RESILIENCE.md): "
@@ -253,6 +276,43 @@ def main(argv=None) -> int:
             set_global_flight_recorder(None)
 
 
+def _pod_setup(args, writer):
+    """(PodCoordinator, peer host dirs) for a pod run; (None, None) for
+    the single-host path. Partial pod flags fail loudly — a pod member
+    that silently fell back to single-host preemption is exactly the
+    inconsistent-resume hazard the coordinator exists to close."""
+    pod_args = (args.pod_index, args.pod_count, args.pod_dir)
+    if all(a is None for a in pod_args):
+        return None, None
+    if any(a is None for a in pod_args):
+        raise SystemExit(
+            "--pod-index/--pod-count/--pod-dir come together (pod "
+            "coordination, docs/RESILIENCE.md)"
+        )
+    if args.pod_count < 2:
+        raise SystemExit("--pod-count must be >= 2 (one host is the "
+                         "single-host path; drop the pod flags)")
+    if not args.checkpoint_dir:
+        raise SystemExit("pod coordination requires --checkpoint-dir "
+                         "(the pod root's host_<i> dir)")
+    from glom_tpu.resilience.coordinator import (
+        DirectoryTransport,
+        PodCoordinator,
+        peer_host_dirs,
+    )
+
+    try:
+        peers = peer_host_dirs(
+            args.checkpoint_dir, args.pod_index, args.pod_count
+        )
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
+    transport = DirectoryTransport(
+        args.pod_dir, args.pod_index, args.pod_count
+    )
+    return PodCoordinator(transport, writer=writer), peers
+
+
 def _train_body(args, preset, cfg, tcfg, writer) -> int:
     from glom_tpu.data import gaussian_dataset, shapes_dataset
     from glom_tpu.train import Trainer
@@ -267,6 +327,8 @@ def _train_body(args, preset, cfg, tcfg, writer) -> int:
             )
     else:
         make_data = shapes_dataset if args.data == "shapes" else gaussian_dataset
+
+    pod_coord, pod_peers = _pod_setup(args, writer)
 
     if args.supervise is not None:
         # The restart loop owns trainer/data/checkpoint lifecycle per
@@ -307,7 +369,10 @@ def _train_body(args, preset, cfg, tcfg, writer) -> int:
             log_every=args.log_every,
             supervisor=TrainSupervisor(max_restarts=args.supervise, writer=writer),
             metrics_writer=writer,
+            max_to_keep=args.checkpoint_keep,
             preemption_deadline_s=args.preempt_deadline,
+            gang=pod_coord,
+            pod_peers=pod_peers,
         )
         return 0
 
@@ -356,7 +421,12 @@ def _train_body(args, preset, cfg, tcfg, writer) -> int:
     if args.checkpoint_dir:
         from glom_tpu.utils.checkpoint import CheckpointManager, abstract_like
 
-        ckpt = CheckpointManager(args.checkpoint_dir, metrics_writer=writer)
+        ckpt = CheckpointManager(
+            args.checkpoint_dir,
+            metrics_writer=writer,
+            max_to_keep=args.checkpoint_keep,
+            pod_peers=pod_peers,
+        )
         if args.resume and ckpt.latest_step() is not None:
             start_step, trainer.state = ckpt.restore(
                 abstract_state=abstract_like(trainer.state)
@@ -378,14 +448,36 @@ def _train_body(args, preset, cfg, tcfg, writer) -> int:
         fr_live = get_global_flight_recorder()
         if fr_live is not None:
             # Preemption grace path: SIGTERM saves the live state bounded
-            # by --preempt-deadline, then dumps the flight ring.
-            def _preempt_save(trainer=trainer):
-                from glom_tpu.utils.checkpoint import preemption_save
+            # by --preempt-deadline, then dumps the flight ring. In pod
+            # mode the save rides the two-phase barrier instead — every
+            # host commits ONE common step or the round aborts loudly.
+            if pod_coord is not None:
 
-                return preemption_save(
-                    args.checkpoint_dir, trainer.state,
-                    int(trainer.state.step), metrics_writer=writer,
-                )
+                def _preempt_save(trainer=trainer, start=start_step):
+                    from glom_tpu.resilience.coordinator import (
+                        pod_preemption_save,
+                    )
+
+                    return pod_preemption_save(
+                        pod_coord, args.checkpoint_dir, trainer.state,
+                        int(trainer.state.step),
+                        # The barrier budget sits INSIDE the hook's join
+                        # deadline so an abort stamps before the dump
+                        # gives up on the hook thread.
+                        deadline_s=args.preempt_deadline * 0.8,
+                        round_id=f"preempt-g{int(start)}",
+                        metrics_writer=writer,
+                    )
+
+            else:
+
+                def _preempt_save(trainer=trainer):
+                    from glom_tpu.utils.checkpoint import preemption_save
+
+                    return preemption_save(
+                        args.checkpoint_dir, trainer.state,
+                        int(trainer.state.step), metrics_writer=writer,
+                    )
 
             fr_live.set_checkpoint_hook(
                 _preempt_save, deadline_s=args.preempt_deadline

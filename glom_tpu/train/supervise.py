@@ -177,7 +177,11 @@ def fit_supervised(
     supervisor: Optional[TrainSupervisor] = None,
     metrics_writer=None,
     checkpoint_async: bool = False,
+    max_to_keep: int = 3,
     preemption_deadline_s: float = 30.0,
+    gang=None,
+    pod_peers=None,
+    gang_barrier_deadline_s: float = 30.0,
 ) -> List[dict]:
     """Run `num_steps` updates under the restart supervisor; returns the
     concatenated fit history across attempts.
@@ -199,7 +203,19 @@ def fit_supervised(
     checkpoint_async=False by default: the supervised loop's reason to
     exist is surviving kills, and a synchronous save is committed the
     moment the span ends — the async overlap win belongs to unsupervised
-    throughput runs."""
+    throughput runs. max_to_keep is the retention knob (--checkpoint-keep
+    on the CLI); pod gangs should raise it — retention bounds the step
+    drift the preemption barrier can bridge.
+
+    GANG MODE (`gang=` a resilience.coordinator.PodCoordinator,
+    `pod_peers=` the sibling hosts' checkpoint dirs): the gang restarts
+    as ONE unit. Each attempt rendezvous at the restart barrier before
+    restoring, the restore reconciles to the newest step valid on EVERY
+    host (pod-mode CheckpointManager), and any member's failure posts a
+    gang-wide stop — the others raise GangRestart at their next
+    checkpoint-span boundary, so the whole gang falls back together and
+    resumes from the reconciled common step. Epochs are the attempt
+    numbers, which the stop-flag propagation keeps in lockstep."""
     from glom_tpu.tracing.flight import get_global_flight_recorder
     from glom_tpu.utils.checkpoint import CheckpointManager
 
@@ -207,6 +223,9 @@ def fit_supervised(
         raise ValueError(f"num_steps {num_steps} must be >= 1")
     if checkpoint_every < 1:
         raise ValueError(f"checkpoint_every {checkpoint_every} must be >= 1")
+    if gang is None and pod_peers:
+        raise ValueError("pod_peers without gang= (pod restore needs the "
+                         "coordinator's restart rendezvous)")
     sup = (
         supervisor
         if supervisor is not None
@@ -218,10 +237,21 @@ def fit_supervised(
         ckpt = CheckpointManager(
             checkpoint_dir,
             async_save=checkpoint_async,
+            max_to_keep=max_to_keep,
             metrics_writer=metrics_writer,
+            pod_peers=pod_peers,
         )
         fr = get_global_flight_recorder()
         try:
+            if gang is not None:
+                # Rendezvous BEFORE reconciling: every member must have
+                # stopped writing its previous attempt's checkpoints, or
+                # the common-step walk races live saves. Arrival messages
+                # persist per epoch, so a member deep in backoff sails
+                # through a barrier its peers already filled.
+                gang.gang_barrier(
+                    "restart", attempt, deadline_s=gang_barrier_deadline_s
+                )
             trainer = make_trainer()
             start = 0
             latest = ckpt.latest_step()
@@ -238,26 +268,50 @@ def fit_supervised(
                     },
                 )
             if start >= num_steps:
+                if gang is not None:
+                    gang.signal_gang_done(num_steps)
                 return history
             data = make_data()
             for _ in range(start):
                 next(data)  # realign the deterministic stream
             if fr is not None:
+                if gang is not None:
 
-                def preempt_save():
-                    from glom_tpu.utils.checkpoint import preemption_save
+                    def preempt_save(start=start):
+                        from glom_tpu.resilience.coordinator import (
+                            pod_preemption_save,
+                        )
 
-                    return preemption_save(
-                        checkpoint_dir, trainer.state,
-                        int(np.asarray(trainer.state.step)),
-                        metrics_writer=metrics_writer,
-                    )
+                        return pod_preemption_save(
+                            gang, checkpoint_dir, trainer.state,
+                            int(np.asarray(trainer.state.step)),
+                            deadline_s=preemption_deadline_s * 0.8,
+                            round_id=f"preempt-g{int(start)}",
+                            metrics_writer=metrics_writer,
+                        )
+
+                else:
+
+                    def preempt_save():
+                        from glom_tpu.utils.checkpoint import preemption_save
+
+                        return preemption_save(
+                            checkpoint_dir, trainer.state,
+                            int(np.asarray(trainer.state.step)),
+                            metrics_writer=metrics_writer,
+                        )
 
                 fr.set_checkpoint_hook(
                     preempt_save, deadline_s=preemption_deadline_s
                 )
             done = start
             while done < num_steps:
+                if gang is not None and gang.gang_stop_requested(attempt):
+                    from glom_tpu.resilience.coordinator import GangRestart
+
+                    raise GangRestart(
+                        f"gang stop requested in epoch {attempt}"
+                    )
                 span = min(checkpoint_every, num_steps - done)
                 history.extend(
                     trainer.fit(data, num_steps=span, log_every=log_every)
@@ -265,10 +319,26 @@ def fit_supervised(
                 done += span
                 ckpt.save(done, trainer.state)
             ckpt.wait()
+            if gang is not None:
+                # A finished member leaves the gang: the persistent done
+                # flag excuses it from future restart barriers, so a
+                # peer that crashes AFTER we return can still recover
+                # (waiting for us would deadlock its every attempt).
+                gang.signal_gang_done(num_steps)
             return history
         except (KeyboardInterrupt, SystemExit):
             raise
         except Exception as e:  # noqa: BLE001 — the supervisor classifies
+            if gang is not None:
+                from glom_tpu.resilience.coordinator import GangRestart
+
+                if not isinstance(e, GangRestart):
+                    # OUR failure becomes the gang's: peers raise
+                    # GangRestart at their next span boundary and the
+                    # whole gang meets at the next restart barrier.
+                    gang.signal_gang_stop(
+                        attempt, f"{type(e).__name__}: {e}"[:300]
+                    )
             if sup.on_failure(e) is None:
                 raise
         finally:

@@ -33,7 +33,7 @@ import tempfile
 import time
 import threading
 from pathlib import Path
-from typing import Any, Dict, List, Optional, Set, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 import jax
 import numpy as np
@@ -135,6 +135,84 @@ def verify_manifest(step_dir: Path, manifest: Dict[str, Any]) -> List[str]:
     return errs
 
 
+# -- pure-file pod helpers (no Orbax manager: these run against PEER host
+# directories, whose managers live in other processes) ----------------------
+
+
+# step_valid_in_dir result cache keyed by the manifest's (mtime_ns, size)
+# signature — the same staleness contract as CheckpointManager's
+# _verify_cache, held at module level because the pod read side sweeps
+# PEER dirs (valid_steps × peers × retained steps) on every reconcile and
+# would otherwise re-sha256 multi-GB checkpoints per call. The
+# manifest-absent fallback is never cached (it is one is_dir()), which
+# also keeps the preemption retention poll live while an async commit is
+# still landing.
+_step_valid_cache: Dict[Tuple[str, int], Tuple[Tuple[int, int], bool]] = {}
+
+
+def step_valid_in_dir(directory, step: int) -> bool:
+    """True when `step` is safe to restore from `directory`, judged from
+    files alone: a present manifest must verify bit-for-bit; an absent
+    manifest falls back to the commit marker (the same contract as
+    CheckpointManager.verify_step, manager-free so it can judge a PEER
+    host's dir)."""
+    directory = Path(directory)
+    step_dir = directory / str(int(step))
+    mpath = directory / f"manifest_{int(step)}.json"
+    try:
+        st = mpath.stat()
+    except OSError:
+        return step_dir.is_dir()
+    sig = (st.st_mtime_ns, st.st_size)
+    key = (str(directory), int(step))
+    cached = _step_valid_cache.get(key)
+    if cached is not None and cached[0] == sig:
+        return cached[1]
+    try:
+        with open(mpath) as fh:
+            manifest = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        ok = False
+    else:
+        ok = not verify_manifest(step_dir, manifest)
+    _step_valid_cache[key] = (sig, ok)
+    return ok
+
+
+def quarantine_step_in_dir(directory, step: int) -> Optional[str]:
+    """Move one step OUT of a host dir's step namespace (to the hidden
+    `.quarantine/<step>_<ts>`, Orbax's scanner never sees it) and drop
+    its manifest — the pure-file half of _quarantine_torn, callable
+    against PEER dirs during pod reconciliation. Tolerant of races: N
+    relaunched hosts reconcile concurrently over shared storage, and the
+    sibling that moved the dir first wins (ENOENT here is success, not
+    failure). Returns the quarantine path (None when already gone)."""
+    directory = Path(directory)
+    step = int(step)
+    step_dir = directory / str(step)
+    dest: Optional[Path] = None
+    if step_dir.is_dir():
+        qdir = directory / ".quarantine"
+        try:
+            qdir.mkdir(exist_ok=True)
+            dest = qdir / f"{step}_{time.strftime('%Y%m%d_%H%M%S')}"
+            step_dir.rename(dest)
+        except OSError:
+            dest = None
+        if dest is None and step_dir.is_dir():
+            # The rename failed with the step dir STILL IN PLACE
+            # (EACCES/EBUSY on shared storage — not a sibling winning the
+            # race): keep the manifest. It is the evidence that marks the
+            # step invalid; dropping it would flip step_valid_in_dir's
+            # absent-manifest fallback to "valid" on a known-bad step.
+            return None
+    try:
+        (directory / f"manifest_{step}.json").unlink()
+    except OSError:
+        pass
+    return str(dest) if dest is not None else None
+
+
 class _SpanSink:
     """Writer shim for the checkpoint spans: forwards to the manager's
     metrics_writer when one is attached, else straight to the global
@@ -175,9 +253,19 @@ class CheckpointManager:
         save_interval_steps: int = 1,
         async_save: bool = True,
         metrics_writer=None,
+        pod_peers: Optional[Sequence[str]] = None,
     ):
         if not HAVE_ORBAX:
             raise RuntimeError("orbax-checkpoint is not available")
+        # POD MODE (docs/RESILIENCE.md, coordinated preemption):
+        # `pod_peers` names the SIBLING hosts' checkpoint dirs on shared
+        # storage. The read side then only hands out steps whose per-host
+        # manifests are ALL valid, and a half-committed step (valid here,
+        # torn or absent on a peer — the signature of an uncoordinated or
+        # aborted pod save) is quarantined on EVERY host so no later
+        # Orbax bookkeeping can resurrect it. None = the single-host
+        # contract, bit-for-bit unchanged.
+        self.pod_peers: List[Path] = [Path(p) for p in (pod_peers or [])]
         self.directory = Path(directory).absolute()
         self.directory.mkdir(parents=True, exist_ok=True)
         options = ocp.CheckpointManagerOptions(
@@ -322,12 +410,18 @@ class CheckpointManager:
 
     def valid_steps(self) -> List[int]:
         """Ascending steps that pass verification — the only steps the
-        restore path will ever hand out."""
+        restore path will ever hand out. In pod mode a step must verify
+        on EVERY host (here by manifest, on peers by the pure-file
+        check): latest_step() then reports the newest COMMON step, which
+        is what a gang resume must agree on."""
         with self._op_lock:
             self._mgr.wait_until_finished()
             self._finalize_pending()
             return [
-                s for s in sorted(self._mgr.all_steps()) if self.verify_step(s)
+                s
+                for s in sorted(self._mgr.all_steps())
+                if self.verify_step(s)
+                and all(step_valid_in_dir(p, s) for p in self.pod_peers)
             ]
 
     # -- save / restore ----------------------------------------------------
@@ -372,6 +466,12 @@ class CheckpointManager:
         "recovery" event and the previous one restores; the recovery loop
         never dies on a torn file. An EXPLICIT step that fails
         verification raises CheckpointCorruptError instead.
+
+        POD MODE (`pod_peers=`): step=None additionally requires the
+        candidate to be valid on every peer host dir; a half-committed
+        step is quarantined on EVERY host (stamped
+        "quarantine-half-step") and the walk falls back to the newest
+        common step — the reconciled step a relaunched gang agrees on.
         Returns (step, state) or (step, (state, levels)).
         """
         with self._op_lock:
@@ -397,15 +497,51 @@ class CheckpointManager:
         last_exc: Optional[BaseException] = None
         for s in candidates:
             if step is None and not self.verify_step(s):
-                self._emit_recovery(
-                    {
-                        "action": "skip-torn-checkpoint",
-                        "step": int(s),
-                        "note": "manifest verification failed",
-                        "quarantined": self._quarantine_torn(s),
+                rec = {
+                    "action": "skip-torn-checkpoint",
+                    "step": int(s),
+                    "note": "manifest verification failed",
+                    "quarantined": self._quarantine_torn(s),
+                }
+                if self.pod_peers:
+                    # A step torn HERE is a half-committed step for the
+                    # whole pod: the peers' (possibly pristine) copies
+                    # must go with it, or their next resume lands on a
+                    # step this host no longer has.
+                    rec["peer_quarantined"] = {
+                        str(p): quarantine_step_in_dir(p, s)
+                        for p in self.pod_peers
                     }
-                )
+                self._emit_recovery(rec)
                 continue
+            if step is None and self.pod_peers:
+                invalid = [
+                    str(p)
+                    for p in self.pod_peers
+                    if not step_valid_in_dir(p, s)
+                ]
+                if invalid:
+                    # Half-committed pod step: valid here, torn or absent
+                    # on a peer — quarantine it on EVERY host (the
+                    # multi-host twin of the torn-step path: keeping any
+                    # copy would let that host's Orbax bookkeeping hold
+                    # the latest-step slot at a step the pod cannot
+                    # agree on) and fall back to the previous candidate.
+                    self._emit_recovery(
+                        {
+                            "action": "quarantine-half-step",
+                            "step": int(s),
+                            "invalid_hosts": invalid,
+                            "quarantined": {
+                                "self": self._quarantine_torn(s),
+                                **{
+                                    str(p): quarantine_step_in_dir(p, s)
+                                    for p in self.pod_peers
+                                },
+                            },
+                        }
+                    )
+                    continue
             items = {"state": ocp.args.StandardRestore(abstract_state)}
             if abstract_levels is not None:
                 items["levels"] = ocp.args.StandardRestore(abstract_levels)

@@ -112,6 +112,17 @@ step chaos 1200 python -m glom_tpu.resilience --scenario kill-train \
     --dir results/hw_queue/chaos --steps 6 || {
     log "chaos kill-and-resume FAILED — not sweeping a serving stack that cannot recover"; exit 1; }
 
+# 9d--. Pod-preemption gate (docs/RESILIENCE.md, coordinated preemption):
+#       SIGTERM a strict subset of a 2-process pod, then all of it — the
+#       two-phase save barrier must commit ONE common step on every host
+#       inside the grace deadline and the relaunched gang must resume
+#       from it. A pod about to burn a real multi-host window must first
+#       prove its grace save cannot leave hosts committed at different
+#       steps (the silent-inconsistent-resume failure class).
+step chaos_pod 1200 python -m glom_tpu.resilience --scenario preempt-pod \
+    --dir results/hw_queue/chaos_pod --steps 8 --hosts 2 || {
+    log "pod-preemption barrier FAILED — an uncoordinated pod checkpoint would corrupt the window's resume"; exit 1; }
+
 # 9d. Serving SLO sweep (glom_tpu/serve, docs/SERVING.md): AOT warmup per
 #     bucket, closed-loop throughput ceiling, offered-load p50/p95/p99
 #     latency rows, and the consensus early-exit iteration histogram on

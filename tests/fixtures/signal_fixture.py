@@ -1,0 +1,66 @@
+"""Seeded fixture pair for the signal-safety checker (glom-lint).
+
+DeadlockySignalDumper is the PR 6 hazard, distilled: its SIGTERM handler
+path acquires a NON-reentrant threading.Lock (the paused main thread may
+hold it — a paused owner never releases), joins its worker with no
+timeout, and blocks on a queue get. SafeSignalDumper is the twin built
+the way tracing/flight.py actually ships: RLock, bounded join,
+non-blocking queue drain. The checker must flag every Deadlocky site at
+file:line and stay silent on the twin — pinned by tests/test_analysis.py.
+
+NOT importable production code — exercised as AST text only.
+"""
+
+import queue
+import signal
+import threading
+import time
+
+
+class DeadlockySignalDumper:
+    def __init__(self):
+        self._lock = threading.Lock()  # non-reentrant: the hazard
+        self._q = queue.Queue()
+        self._worker = threading.Thread(target=self._drain, daemon=True)
+
+    def install(self):
+        signal.signal(signal.SIGTERM, self._on_term)
+
+    def _on_term(self, signum, frame):
+        self._flush()
+        self._worker.join()  # unbounded: a wedged worker stalls the exit
+        time.sleep(1.0)  # unbounded-ish stall inside the grace window
+
+    def _flush(self):
+        with self._lock:  # main thread may be paused HOLDING this
+            item = self._q.get()  # blocking get: no timeout
+            return item
+
+    def _drain(self):
+        while True:
+            self._q.get()
+
+
+class SafeSignalDumper:
+    def __init__(self):
+        self._lock = threading.RLock()  # reentrant: handler-safe
+        self._q = queue.Queue()
+        self._worker = threading.Thread(target=self._drain, daemon=True)
+
+    def install(self):
+        signal.signal(signal.SIGTERM, self._on_term)
+
+    def _on_term(self, signum, frame):
+        self._flush()
+        self._worker.join(timeout=5.0)  # bounded: the grace-window form
+
+    def _flush(self):
+        with self._lock:  # RLock: the paused owner IS this thread
+            try:
+                return self._q.get_nowait()
+            except queue.Empty:
+                return None
+
+    def _drain(self):
+        while True:
+            self._q.get(timeout=1.0)

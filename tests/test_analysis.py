@@ -880,3 +880,107 @@ class TestLockOrder:
 
         fs = by_checker(run([batcher_mod.__file__]), "lock-order")
         assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# signal-safety
+# ---------------------------------------------------------------------------
+
+
+class TestSignalSafety:
+    """Code reachable from a signal.signal-registered handler must not
+    acquire non-reentrant Locks or call the blocking-IO denylist — the
+    PR 6 'sharing the loop's manager deadlocks' lesson, made static."""
+
+    def test_fixture_pair_flags_only_the_deadlocky_class(self):
+        fs = by_checker(
+            run([str(FIXTURES / "signal_fixture.py")]), "signal-safety"
+        )
+        assert fs and all("Deadlocky" in f.symbol for f in fs), fs
+        keys = {f.key for f in fs}
+        assert keys == {
+            "handler-lock-self._lock",
+            "handler-join-unbounded",
+            "handler-blocking-time.sleep",
+            "handler-blocking-queue-get",
+        }, keys
+        assert all(f.line > 0 for f in fs)
+
+    def test_nested_handler_lock_flagged(self, tmp_path):
+        """The flight.py registration shape: a NESTED def handed to
+        signal.signal, reaching a module-level helper that takes a plain
+        Lock."""
+        src = (
+            "import signal\n"
+            "import threading\n"
+            "LOCK = threading.Lock()\n"
+            "def flush():\n"
+            "    with LOCK:\n"
+            "        pass\n"
+            "def install():\n"
+            "    def _handler(signum, frame):\n"
+            "        flush()\n"
+            "    signal.signal(signal.SIGTERM, _handler)\n"
+        )
+        fs = by_checker(lint(tmp_path, src), "signal-safety")
+        assert len(fs) == 1 and fs[0].line == 5
+        assert "LOCK" in fs[0].message
+
+    def test_rlock_and_bounded_join_exempt(self, tmp_path):
+        """The shipped mitigations are NOT findings: RLock reacquisition
+        succeeds for the paused owner, and a bounded join is the
+        grace-window form."""
+        src = (
+            "import signal\n"
+            "import threading\n"
+            "LOCK = threading.RLock()\n"
+            "def handler(signum, frame):\n"
+            "    with LOCK:\n"
+            "        w = threading.Thread(target=print)\n"
+            "        w.start()\n"
+            "        w.join(timeout=5.0)\n"
+            "signal.signal(signal.SIGTERM, handler)\n"
+        )
+        assert by_checker(lint(tmp_path, src), "signal-safety") == []
+
+    def test_unregistered_code_never_flagged(self, tmp_path):
+        """The same hazardous shapes OUTSIDE a handler path are some
+        other checker's business (lockset), not this one's."""
+        src = (
+            "import threading\n"
+            "LOCK = threading.Lock()\n"
+            "def flush():\n"
+            "    with LOCK:\n"
+            "        pass\n"
+        )
+        assert by_checker(lint(tmp_path, src), "signal-safety") == []
+
+    def test_thread_target_is_not_handler_context(self, tmp_path):
+        """Work moved to a spawned thread is the sanctioned escape hatch
+        (the PR 6 fix): the target's body is not handler-reachable."""
+        src = (
+            "import signal\n"
+            "import threading\n"
+            "LOCK = threading.Lock()\n"
+            "def worker():\n"
+            "    with LOCK:\n"
+            "        pass\n"
+            "def handler(signum, frame):\n"
+            "    t = threading.Thread(target=worker)\n"
+            "    t.start()\n"
+            "    t.join(timeout=3.0)\n"
+            "signal.signal(signal.SIGTERM, handler)\n"
+        )
+        assert by_checker(lint(tmp_path, src), "signal-safety") == []
+
+    def test_shipped_flight_recorder_handler_path_is_clean(self):
+        """The self-host acceptance the satellite names: flight.py's
+        SIGTERM path (RLock ring + bounded daemon-thread join) and the
+        new pod coordinator's handler-side save both scan clean."""
+        import glom_tpu.resilience.coordinator as coord_mod
+        import glom_tpu.tracing.flight as flight_mod
+
+        fs = by_checker(
+            run([flight_mod.__file__, coord_mod.__file__]), "signal-safety"
+        )
+        assert fs == [], fs

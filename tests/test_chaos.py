@@ -129,6 +129,29 @@ class TestFitSupervised:
             np.asarray(got["w"]), np.asarray(clean.state["w"])
         )
 
+    def test_max_to_keep_plumbs_to_retention(self, tmp_path):
+        """--checkpoint-keep must reach the supervised loop's manager:
+        pod gangs raise retention precisely because it bounds the step
+        drift the preemption barrier can bridge — a silently-default 3
+        would garbage-collect the very step a barrier commits."""
+        from glom_tpu.train.supervise import fit_supervised
+
+        fit_supervised(
+            lambda: FlakyTrainer(),
+            _data_factory(),
+            6,
+            checkpoint_dir=str(tmp_path / "ckpt"),
+            checkpoint_every=1,
+            log_every=1,
+            max_to_keep=6,
+        )
+        kept = sorted(
+            int(p.name)
+            for p in (tmp_path / "ckpt").iterdir()
+            if p.name.isdigit()
+        )
+        assert kept == [1, 2, 3, 4, 5, 6]  # default 3 keeps only [4, 5, 6]
+
     def test_budget_exhausted_gives_up_and_reraises(self, tmp_path):
         from glom_tpu.train.supervise import TrainSupervisor, fit_supervised
 
@@ -462,6 +485,68 @@ class TestServeFlapBurst:
                 assert schema.validate_record(rec) == [], rec
         finally:
             set_global_watchdog(None)
+
+
+class TestPreemptPod:
+    @pytest.mark.slow  # 2x2 real train subprocesses; CI chaos job runs it
+    def test_preempt_pod_commits_one_common_step_and_gang_resumes(
+        self, tmp_path
+    ):
+        """The pod-preemption acceptance: `python -m glom_tpu.resilience
+        --scenario preempt-pod` SIGTERMs a strict subset of a 2-process
+        pod, then all of it; the two-phase barrier must commit ONE
+        common step on both hosts inside the grace deadline, and the
+        relaunched gang must resume from exactly that step with
+        continuous per-host train_step sequences — proven from the JSONL
+        evidence alone (stamped barrier phases, pod commit marker,
+        resume events, lint-clean streams)."""
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "glom_tpu.resilience",
+                "--scenario", "preempt-pod",
+                "--dir", str(tmp_path),
+                "--steps", "8",
+                "--hosts", "2",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=500,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        driver = [
+            json.loads(l)
+            for l in proc.stdout.splitlines()
+            if l.strip().startswith("{")
+        ]
+        summary = [r for r in driver if r.get("event") == "chaos-summary"]
+        assert summary and summary[0]["ok"] is True, summary
+        common = summary[0]["committed_common_step"]
+        # Both SIGTERM waves were stamped as faults, subset first.
+        waves = [r.get("wave") for r in driver if r.get("kind") == "fault"]
+        assert waves == ["subset", "all"], waves
+        # The commit marker is the completeness authority.
+        from glom_tpu.resilience import read_pod_commit
+
+        marker = read_pod_commit(tmp_path / "coord")
+        assert marker and marker["step"] == common
+        assert len(marker["proposals"]) == 2
+        assert common == min(int(s) for s in marker["proposals"].values())
+        # Per-host evidence: ONE common resume step, continuous steps.
+        for h in (0, 1):
+            recs = [
+                json.loads(l)
+                for l in (tmp_path / f"metrics_h{h}.jsonl")
+                .read_text().splitlines()
+                if l.strip().startswith("{")
+            ]
+            resumes = {r["step"] for r in recs
+                       if r.get("action") == "resume-from-checkpoint"}
+            assert resumes == {common}, (h, resumes, common)
+            steps = sorted({int(r["step"]) for r in recs
+                            if r.get("kind") == "train_step"})
+            missing = set(range(8)) - set(steps)
+            assert missing <= {common - 1}, (h, steps)
 
 
 class TestKillServe:

@@ -1,0 +1,581 @@
+"""Pod coordination (glom_tpu/resilience/coordinator.py): the two-phase
+preemption save barrier, its fault injectors (message loss, deadline
+overrun), the pod-mode grace save, and gang-supervised recovery through
+fit_supervised.
+
+All host-only (threads simulate hosts over a shared tmp dir; np pytrees
+through real Orbax managers) — tier-1 fast. The subprocess end-to-end
+ride is the chaos `preempt-pod` scenario (tests/test_chaos.py slow +
+CI's chaos job).
+"""
+
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from glom_tpu.resilience import (
+    BarrierAbort,
+    DirectoryTransport,
+    FaultPlan,
+    InjectedFault,
+    PodCoordinator,
+    barrier_delay,
+    message_loss,
+    peer_host_dirs,
+    pod_preemption_save,
+    read_pod_commit,
+)
+from glom_tpu.telemetry import schema
+
+
+class ListWriter:
+    def __init__(self):
+        self.records = []
+        self._lock = threading.Lock()
+
+    def write(self, rec):
+        with self._lock:
+            self.records.append(rec)
+
+    def all(self):
+        with self._lock:
+            return list(self.records)
+
+
+def _run_hosts(n, fn, timeout=30.0):
+    """Run fn(host) on n threads; re-raise the first failure."""
+    errs = {}
+
+    def wrap(h):
+        try:
+            fn(h)
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errs[h] = e
+
+    threads = [
+        threading.Thread(target=wrap, args=(h,), daemon=True)
+        for h in range(n)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+        assert not t.is_alive(), "a simulated host hung (the barrier must \
+never hang past its deadline)"
+    return errs
+
+
+class TestDirectoryTransport:
+    def test_post_and_read_roundtrip(self, tmp_path):
+        a = DirectoryTransport(tmp_path, 0, 2)
+        b = DirectoryTransport(tmp_path, 1, 2)
+        assert a.post("r1", "propose", {"step": 3})
+        assert b.post("r1", "propose", {"step": 5})
+        msgs = a.read_all("r1", "propose")
+        assert msgs == {0: {"host": 0, "step": 3}, 1: {"host": 1, "step": 5}}
+        assert a.read_all("r2", "propose") == {}  # rounds are disjoint
+
+    def test_fault_hook_drops_the_message(self, tmp_path):
+        plan = FaultPlan(seed=0)
+        plan.register("barrier-msg", at=(0,), fault="barrier-message-loss")
+        t = DirectoryTransport(tmp_path, 0, 1, fault_hook=message_loss(plan))
+        assert not t.post("r1", "propose", {"step": 3})  # dropped
+        assert t.read_all("r1", "propose") == {}
+        assert t.post("r1", "saved", {"step": 3})  # off-schedule: lands
+        assert [e["fault"] for e in plan.events()] == ["barrier-message-loss"]
+
+    def test_bad_host_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            DirectoryTransport(tmp_path, 2, 2)
+
+
+class TestPreemptionBarrier:
+    def _coord(self, tmp_path, h, n, writer=None, hook=None):
+        return PodCoordinator(
+            DirectoryTransport(tmp_path, h, n, fault_hook=hook),
+            writer=writer, poll_s=0.01,
+        )
+
+    def test_commits_the_min_proposal_on_every_host(self, tmp_path):
+        w = ListWriter()
+        proposals = {0: 5, 1: 3, 2: 4}
+        results, saves = {}, {}
+
+        def host(h):
+            c = self._coord(tmp_path, h, 3, writer=w)
+            results[h] = c.preemption_barrier(
+                "preempt-g0", proposals[h],
+                lambda commit: saves.__setitem__(h, commit),
+                deadline_s=10.0,
+            )
+
+        assert _run_hosts(3, host) == {}
+        assert results == {0: 3, 1: 3, 2: 3}
+        assert saves == {0: 3, 1: 3, 2: 3}  # every host landed THE step
+        marker = read_pod_commit(tmp_path)
+        assert marker["step"] == 3 and marker["n_hosts"] == 3
+        assert marker["proposals"] == {"0": 5, "1": 3, "2": 4}
+        recs = w.all()
+        for r in recs:
+            assert schema.validate_record(r) == [], r
+        barrier = [r for r in recs if r["kind"] == "barrier"]
+        phases = {(r["host"], r["phase"]) for r in barrier}
+        for h in range(3):
+            assert {(h, "propose"), (h, "commit"), (h, "saved"),
+                    (h, "complete")} <= phases
+        assert {r["step"] for r in barrier if r["phase"] == "commit"} == {3}
+
+    def test_message_loss_aborts_loudly_on_every_host(self, tmp_path):
+        """The fault-injector acceptance: drop host 1's propose — the
+        waiting peers (and host 1 itself, short its own message) must
+        abort at the deadline with stamped abort events and NO pod
+        commit marker."""
+        w = ListWriter()
+        plan = FaultPlan(seed=0, writer=w)
+        plan.register("barrier-msg", at=(0,), fault="barrier-message-loss")
+        hook = message_loss(plan)
+        errs = {}
+
+        def host(h):
+            c = self._coord(
+                tmp_path, h, 2, writer=w, hook=hook if h == 1 else None
+            )
+            try:
+                c.preemption_barrier(
+                    "preempt-g0", 3, lambda s: None, deadline_s=0.4
+                )
+            except BarrierAbort as e:
+                errs[h] = e
+
+        _run_hosts(2, host)
+        assert set(errs) == {0, 1}
+        assert read_pod_commit(tmp_path) is None
+        recs = w.all()
+        faults = [r for r in recs if r.get("kind") == "fault"]
+        assert faults and faults[0]["fault"] == "barrier-message-loss"
+        aborts = [r for r in recs if r.get("kind") == "barrier"
+                  and r["phase"] == "abort"]
+        assert {r["host"] for r in aborts} == {0, 1}
+        assert all("deadline" in r["reason"] or "abort" in r["reason"]
+                   for r in aborts)
+
+    def test_deadline_overrun_aborts_and_writes_no_marker(self, tmp_path):
+        """Stall host 1's 'saved' post past the grace deadline: host 0
+        aborts waiting, and host 1 — limping in late — must NOT declare
+        the aborted round complete."""
+        plan = FaultPlan(seed=0)
+        plan.register("barrier-delay", at=(1,), fault="deadline-overrun")
+        hook = barrier_delay(plan, delay_s=1.0)
+        errs = {}
+
+        def host(h):
+            c = self._coord(tmp_path, h, 2, hook=hook if h == 1 else None)
+            try:
+                c.preemption_barrier(
+                    "preempt-g0", 3, lambda s: None, deadline_s=0.4
+                )
+            except BarrierAbort as e:
+                errs[h] = e
+
+        _run_hosts(2, host)
+        assert set(errs) == {0, 1}, errs
+        assert read_pod_commit(tmp_path) is None
+
+    def test_failed_save_aborts_the_whole_round(self, tmp_path):
+        errs = {}
+
+        def host(h):
+            c = self._coord(tmp_path, h, 2)
+
+            def save_fn(commit):
+                if h == 1:
+                    raise InjectedFault("disk full")
+
+            try:
+                c.preemption_barrier(
+                    "preempt-g0", 3, save_fn, deadline_s=5.0
+                )
+            except BarrierAbort as e:
+                errs[h] = e
+
+        _run_hosts(2, host)
+        assert set(errs) == {0, 1}
+        assert "disk full" in str(errs[1])
+        assert read_pod_commit(tmp_path) is None
+
+    def test_sub_deadline_delay_still_commits(self, tmp_path):
+        """A slow-but-alive host (delay INSIDE the deadline) is not an
+        abort — the round waits and commits."""
+        plan = FaultPlan(seed=0)
+        plan.register("barrier-delay", at=(0,), fault="slow-host")
+        hook = barrier_delay(plan, delay_s=0.1)
+        results = {}
+
+        def host(h):
+            c = self._coord(tmp_path, h, 2, hook=hook if h == 1 else None)
+            results[h] = c.preemption_barrier(
+                "preempt-g0", 3 + h, lambda s: None, deadline_s=10.0
+            )
+
+        assert _run_hosts(2, host) == {}
+        assert results == {0: 3, 1: 3}
+        assert read_pod_commit(tmp_path)["step"] == 3
+
+    def test_relaunch_purges_stale_round_messages(self, tmp_path):
+        """Round ids derive from the resume step, so a relaunch after an
+        aborted (or zero-progress) round REUSES the id. The previous
+        lifetime's abort must not poison the new round, and its stale
+        propose/saved must not complete one: each host purges its own
+        messages at transport construction (= process start)."""
+        # Previous lifetime: a round that aborted, leaving every message
+        # kind behind under the id the relaunch will reuse.
+        old0 = DirectoryTransport(tmp_path, 0, 2)
+        old1 = DirectoryTransport(tmp_path, 1, 2)
+        old0.post("preempt-g0", "propose", {"step": 9})
+        old1.post("preempt-g0", "propose", {"step": 9})
+        old1.post("preempt-g0", "saved", {"step": 9})
+        old1.post("preempt-g0", "abort", {"reason": "deadline passed"})
+        results = {}
+
+        def host(h):
+            c = self._coord(tmp_path, h, 2)  # the relaunch: fresh transport
+            results[h] = c.preemption_barrier(
+                "preempt-g0", 3 + h, lambda s: None, deadline_s=10.0
+            )
+
+        assert _run_hosts(2, host) == {}
+        assert results == {0: 3, 1: 3}  # min of the NEW proposals, not 9
+        assert read_pod_commit(tmp_path)["step"] == 3
+
+    def test_gang_barrier_excuses_a_done_member(self, tmp_path):
+        """A member that finished every step exits the gang: it posts
+        the persistent done flag, and a surviving member's restart
+        barrier must complete without it — waiting would deadlock every
+        recovery attempt until the restart budget died."""
+        done = PodCoordinator(DirectoryTransport(tmp_path, 1, 2), poll_s=0.01)
+        done.signal_gang_done(8)
+        survivor = PodCoordinator(
+            DirectoryTransport(tmp_path, 0, 2), poll_s=0.01
+        )
+        survivor.gang_barrier("restart", 2, deadline_s=5.0)  # no abort
+        # The survivor's own arrival is never excused: a fresh host 1
+        # waiting on an all-done-peers barrier still posts and passes.
+        done2 = PodCoordinator(DirectoryTransport(tmp_path, 1, 2), poll_s=0.01)
+        with pytest.raises(BarrierAbort):
+            # ... but a barrier whose only live member never arrives
+            # (host 0 posted nothing for THIS epoch) still aborts.
+            done2.gang_barrier("restart", 3, deadline_s=0.3)
+
+
+class TestPodPreemptionSave:
+    STATE = {"w": np.arange(8, dtype=np.float32),
+             "step": np.zeros((), np.int32)}
+
+    def _save_steps(self, directory, steps):
+        from glom_tpu.utils.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(directory), async_save=False)
+        for s in steps:
+            assert mgr.save(
+                s, {"w": self.STATE["w"] + s, "step": np.asarray(s, np.int32)}
+            )
+        mgr.close()
+
+    def test_min_host_grace_saves_ahead_host_proves_retention(self, tmp_path):
+        """The two host roles: host 0 AT the min grace-saves its live
+        state; host 1 past the min proves the committed step is still on
+        disk. Both return the SAME committed step on the recovery
+        record."""
+        coord_dir = tmp_path / "coord"
+        dirs = {h: tmp_path / "ckpt" / f"host_{h}" for h in range(2)}
+        for d in dirs.values():
+            d.mkdir(parents=True)
+        # host 1 ran ahead to step 4 but retains step 2 (per-step saves)
+        self._save_steps(dirs[1], [1, 2, 3, 4])
+        results = {}
+
+        def host(h):
+            c = PodCoordinator(
+                DirectoryTransport(coord_dir, h, 2), poll_s=0.01
+            )
+            step = 2 if h == 0 else 4
+            state = {"w": self.STATE["w"] + step,
+                     "step": np.asarray(step, np.int32)}
+            results[h] = pod_preemption_save(
+                c, dirs[h], state, step,
+                deadline_s=20.0, round_id="preempt-g0",
+            )
+
+        assert _run_hosts(2, host) == {}
+        for h in range(2):
+            assert results[h]["step"] == 2 and results[h]["pod"] is True
+        assert results[0]["proposed_step"] == 2
+        assert results[1]["proposed_step"] == 4
+        # host 0's grace save landed step 2; host 1's retention held
+        from glom_tpu.utils.checkpoint import step_valid_in_dir
+
+        assert step_valid_in_dir(dirs[0], 2)
+        assert step_valid_in_dir(dirs[1], 2)
+        assert read_pod_commit(coord_dir)["step"] == 2
+
+    def test_ahead_host_without_retention_aborts_the_round(self, tmp_path):
+        """A host past the min that does not retain the committed step
+        cannot satisfy the round: it polls for a bounded slice of the
+        grace budget (the step may be an async commit still landing),
+        then aborts LOUDLY (and so does the peer) — never a pod
+        checkpoint with a hole in it."""
+        coord_dir = tmp_path / "coord"
+        dirs = {h: tmp_path / "ckpt" / f"host_{h}" for h in range(2)}
+        for d in dirs.values():
+            d.mkdir(parents=True)
+        self._save_steps(dirs[1], [3, 4])  # step 2 NOT retained
+        errs = {}
+
+        def host(h):
+            c = PodCoordinator(
+                DirectoryTransport(coord_dir, h, 2), poll_s=0.01
+            )
+            step = 2 if h == 0 else 4
+            state = {"w": self.STATE["w"], "step": np.asarray(step, np.int32)}
+            try:
+                pod_preemption_save(
+                    c, dirs[h], state, step,
+                    deadline_s=3.0, round_id="preempt-g0",
+                )
+            except BarrierAbort as e:
+                errs[h] = e
+
+        _run_hosts(2, host)
+        assert set(errs) == {0, 1}
+        assert "does not retain" in str(errs[1])
+        assert read_pod_commit(coord_dir) is None
+
+    def test_ahead_host_waits_for_in_flight_async_commit(self, tmp_path):
+        """SIGTERM races the loop's ASYNC save: the committed step may
+        not be on disk YET when the ahead host checks — its commit
+        thread is not paused by the signal handler, so the step lands
+        while the host watches. The retention check must poll (bounded),
+        not abort on the first look — the flake that motivated it left a
+        2-host chaos run aborting on a step that committed 200ms later."""
+        coord_dir = tmp_path / "coord"
+        dirs = {h: tmp_path / "ckpt" / f"host_{h}" for h in range(2)}
+        for d in dirs.values():
+            d.mkdir(parents=True)
+        self._save_steps(dirs[1], [3, 4])  # step 2 not on disk yet
+        results = {}
+
+        def host(h):
+            c = PodCoordinator(
+                DirectoryTransport(coord_dir, h, 2), poll_s=0.01
+            )
+            step = 2 if h == 0 else 4
+            state = {"w": self.STATE["w"] + step,
+                     "step": np.asarray(step, np.int32)}
+            if h == 1:
+                # The "async commit" lands AFTER host 1 first checks:
+                # Orbax's commit is the atomic rename of the step dir,
+                # so a bare int-named dir is the landing.
+                def land():
+                    time.sleep(0.4)
+                    (dirs[1] / "2").mkdir()
+
+                threading.Thread(target=land, daemon=True).start()
+            results[h] = pod_preemption_save(
+                c, dirs[h], state, step,
+                deadline_s=20.0, round_id="preempt-g0",
+            )
+
+        assert _run_hosts(2, host) == {}
+        assert results[0]["step"] == results[1]["step"] == 2
+        assert read_pod_commit(coord_dir)["step"] == 2
+
+
+class TestPeerHostDirs:
+    def test_convention_and_loud_mismatch(self, tmp_path):
+        d = tmp_path / "pod" / "host_1"
+        assert peer_host_dirs(d, 1, 3) == [
+            str(tmp_path / "pod" / "host_0"),
+            str(tmp_path / "pod" / "host_2"),
+        ]
+        with pytest.raises(ValueError, match="host_0"):
+            peer_host_dirs(tmp_path / "pod" / "ckpt", 0, 2)
+
+
+# ---------------------------------------------------------------------------
+# gang-supervised recovery (fit_supervised gang mode, in-process)
+# ---------------------------------------------------------------------------
+
+
+class GangTrainer:
+    """Host-only trainer honoring the fit_supervised protocol (the
+    FlakyTrainer recipe): 'training' folds each batch's mean into w, so
+    a gang-restarted, reconciled, realigned run must be bit-identical to
+    an unfaulted one. `crash_gate` (host 0 only) BLOCKS until the peer
+    has committed a checkpoint, then raises — the deterministic
+    interleaving the gang test needs."""
+
+    def __init__(self, crash_gate=None, pause_gate=None):
+        self.state = {
+            "w": np.zeros((), np.float64),
+            "step": np.zeros((), np.int32),
+        }
+        self.crash_gate = crash_gate
+        self.pause_gate = pause_gate
+
+    def fit(self, data, num_steps, log_every=10):
+        hist = []
+        for _ in range(num_steps):
+            batch = next(data)
+            step = int(np.asarray(self.state["step"]))
+            if self.crash_gate is not None and self.crash_gate(step):
+                raise InjectedFault("injected gang-member crash")
+            if self.pause_gate is not None:
+                self.pause_gate(step)
+            self.state = {
+                "w": np.asarray(
+                    np.asarray(self.state["w"]) + float(np.mean(batch)),
+                    np.float64,
+                ),
+                "step": np.asarray(step + 1, np.int32),
+            }
+            hist.append({"step": step, "loss": 1.0})
+        return hist
+
+
+def _data_factory(host):
+    def make():
+        return iter(
+            np.full((2,), float(1000 * host + i)) for i in range(1000)
+        )
+
+    return make
+
+
+class TestGangSupervisedRecovery:
+    def test_one_crash_restarts_the_gang_from_the_common_step(self, tmp_path):
+        """The gang acceptance: host 0 crashes mid-span AFTER both hosts
+        committed step 2 — host 1 (which may have raced ahead and
+        committed more) must see the gang stop, fall back, rendezvous at
+        the restart barrier, and BOTH hosts must resume from the SAME
+        reconciled common step and finish bit-identical to unfaulted
+        runs. Newer half-committed steps are quarantined on every
+        host."""
+        from glom_tpu.train.supervise import TrainSupervisor, fit_supervised
+
+        root = tmp_path
+        dirs = {h: root / "ckpt" / f"host_{h}" for h in range(2)}
+        coord_dir = root / "coord"
+        w = {h: ListWriter() for h in range(2)}
+        results, errors = {}, {}
+
+        def crash_gate(step):
+            if step < 3:
+                return False
+            # Block until the PEER committed its step-2 manifest: the
+            # crash then happens at a point where a common step EXISTS,
+            # making the reconciled resume step deterministic (2).
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                if (dirs[1] / "manifest_2.json").is_file():
+                    return True
+                time.sleep(0.01)
+            raise AssertionError("peer never committed step 2")
+
+        def pause_gate(step):
+            # Host 1 holds at step 4 until host 0's gang stop is POSTED:
+            # host 1 is then deterministically mid-attempt when the stop
+            # arrives, and notices it at its next span boundary — no
+            # race against host 1 finishing the run first.
+            if step != 4:
+                return
+            stop_file = coord_dir / "rounds" / "gang-e1" / "stop_0.json"
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                if stop_file.is_file():
+                    return
+                time.sleep(0.01)
+            raise AssertionError("host 0 never signaled the gang stop")
+
+        def host(h):
+            crashed = [False]
+
+            def make_trainer():
+                if h == 0 and not crashed[0]:
+                    crashed[0] = True
+                    return GangTrainer(crash_gate=crash_gate)
+                return GangTrainer(
+                    pause_gate=pause_gate if h == 1 else None
+                )
+
+            coord = PodCoordinator(
+                DirectoryTransport(coord_dir, h, 2),
+                writer=w[h], poll_s=0.01,
+            )
+            try:
+                results[h] = fit_supervised(
+                    make_trainer,
+                    _data_factory(h),
+                    8,
+                    checkpoint_dir=str(dirs[h]),
+                    checkpoint_every=2,
+                    log_every=1,
+                    supervisor=TrainSupervisor(
+                        max_restarts=2, backoff_s=0.0, writer=w[h]
+                    ),
+                    metrics_writer=w[h],
+                    gang=coord,
+                    pod_peers=peer_host_dirs(dirs[h], h, 2),
+                    gang_barrier_deadline_s=20.0,
+                )
+            except BaseException as e:  # noqa: BLE001 — asserted below
+                errors[h] = e
+
+        errs = _run_hosts(2, host, timeout=60.0)
+        assert errs == {} and errors == {}, (errs, errors)
+        # Both hosts trained every step (continuity across the restart).
+        for h in range(2):
+            assert sorted({r["step"] for r in results[h]}) == list(range(8))
+        # ONE common resume step, stamped identically on both hosts.
+        resumes = {
+            h: [r for r in w[h].all()
+                if r.get("action") == "resume-from-checkpoint"]
+            for h in range(2)
+        }
+        assert resumes[0] and resumes[1]
+        assert {r["step"] for r in resumes[0]} == {2}
+        assert {r["step"] for r in resumes[1]} == {2}
+        # host 0 stamped the gang stop; host 1 restarted on GangRestart.
+        stops = [r for r in w[0].all() if r.get("action") == "gang-stop"]
+        assert stops and stops[0]["host"] == 0
+        restarts = [r for r in w[1].all() if r.get("action") == "restart"]
+        assert restarts and "GangRestart" in restarts[0]["exception"]
+        # The restart rendezvous is on the record for BOTH hosts.
+        for h in range(2):
+            arrivals = [r for r in w[h].all() if r.get("kind") == "barrier"
+                        and r["phase"] == "arrive"]
+            assert any(r["round"] == "restart-e2" for r in arrivals), (
+                h, arrivals,
+            )
+        # Bit-identical to unfaulted runs: reconciliation + realign is
+        # exact on every host.
+        from glom_tpu.utils.checkpoint import CheckpointManager, abstract_like
+
+        for h in range(2):
+            clean = GangTrainer()
+            clean.fit(_data_factory(h)(), 8, log_every=1)
+            mgr = CheckpointManager(str(dirs[h]))
+            step, got = mgr.restore(
+                abstract_state=abstract_like(clean.state)
+            )
+            mgr.close()
+            assert step == 8
+            np.testing.assert_array_equal(
+                np.asarray(got["w"]), np.asarray(clean.state["w"])
+            )
+        # Every stamped record on both hosts validates.
+        for h in range(2):
+            for r in w[h].all():
+                assert schema.validate_record(r) == [], r

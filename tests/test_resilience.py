@@ -303,6 +303,195 @@ class TestManifestVerifiedCheckpoints:
         mgr.close()
 
 
+class TestPodRestoreReconciliation:
+    """The multi-host twin of TestManifestVerifiedCheckpoints (pod mode,
+    docs/RESILIENCE.md): restore(None) only hands out steps whose
+    per-host manifests are ALL valid, and a half-committed step — torn,
+    checksum-failed, or missing on any one host of N — is quarantined on
+    EVERY host with the decision stamped. Host-only np pytrees keep this
+    tier-1 fast."""
+
+    STATE = {
+        "w": np.arange(32, dtype=np.float32),
+        "step": np.zeros((), np.int32),
+    }
+
+    def _build_pod(self, root, n_hosts=3, steps=(1, 2, 3)):
+        from glom_tpu.utils.checkpoint import CheckpointManager
+
+        dirs = [root / "ckpt" / f"host_{i}" for i in range(n_hosts)]
+        for d in dirs:
+            mgr = CheckpointManager(str(d), async_save=False)
+            for s in steps:
+                state = {
+                    "w": self.STATE["w"] + s,
+                    "step": np.asarray(s, np.int32),
+                }
+                assert mgr.save(s, state)
+            mgr.close()
+        return dirs
+
+    def _pod_mgr(self, dirs, host=0, writer=None):
+        from glom_tpu.utils.checkpoint import CheckpointManager
+
+        peers = [str(d) for i, d in enumerate(dirs) if i != host]
+        return CheckpointManager(
+            str(dirs[host]), pod_peers=peers, metrics_writer=writer
+        )
+
+    def _abstract(self):
+        from glom_tpu.utils.checkpoint import abstract_like
+
+        return abstract_like(self.STATE)
+
+    def test_torn_on_one_host_falls_back_and_quarantines_everywhere(
+        self, tmp_path
+    ):
+        """THE satellite case: step 3 torn on exactly one host of 3 —
+        the pod restore lands on step 2 and step 3 is quarantined on
+        every host, stamped."""
+        from glom_tpu.resilience import truncate_newest_checkpoint
+
+        dirs = self._build_pod(tmp_path)
+        step, _path = truncate_newest_checkpoint(dirs[1])
+        assert step == 3
+        records = []
+
+        class W:
+            def write(self, rec):
+                records.append(rec)
+
+        mgr = self._pod_mgr(dirs, host=0, writer=W())
+        assert mgr.latest_step() == 2  # newest COMMON valid step
+        got_step, got = mgr.restore(abstract_state=self._abstract())
+        mgr.close()
+        assert got_step == 2
+        np.testing.assert_allclose(np.asarray(got["w"]), self.STATE["w"] + 2)
+        q = [r for r in records if r.get("action") == "quarantine-half-step"]
+        assert q and q[0]["step"] == 3
+        assert q[0]["invalid_hosts"] == [str(dirs[1])]
+        for d in dirs:  # quarantined on EVERY host, forensics preserved
+            assert not (d / "3").exists(), d
+            assert list((d / ".quarantine").glob("3_*")), d
+            assert not (d / "manifest_3.json").exists(), d
+        from glom_tpu.telemetry import schema
+
+        for r in records:
+            assert schema.validate_record(r) == [], r
+
+    def test_step_missing_on_one_host_is_half_committed(self, tmp_path):
+        """A step one host never committed (killed before its save — no
+        tear, just absence) is equally half-committed: fall back and
+        quarantine the other hosts' copies."""
+        dirs = self._build_pod(tmp_path, n_hosts=3, steps=(1, 2))
+        # hosts 0 and 2 committed step 3; host 1 never did
+        for h in (0, 2):
+            from glom_tpu.utils.checkpoint import CheckpointManager
+
+            mgr = CheckpointManager(str(dirs[h]), async_save=False)
+            assert mgr.save(3, {"w": self.STATE["w"] + 3,
+                                "step": np.asarray(3, np.int32)})
+            mgr.close()
+        mgr = self._pod_mgr(dirs, host=0)
+        got_step, _ = mgr.restore(abstract_state=self._abstract())
+        mgr.close()
+        assert got_step == 2
+        assert not (dirs[0] / "3").exists()
+        assert not (dirs[2] / "3").exists()
+
+    def test_own_torn_step_also_quarantines_peer_copies(self, tmp_path):
+        """The inverse orientation: the RESTORING host's copy is the
+        torn one — its skip-torn path must take the peers' pristine
+        copies with it (they are halves of the same unusable pod
+        step)."""
+        from glom_tpu.resilience import truncate_newest_checkpoint
+
+        dirs = self._build_pod(tmp_path)
+        truncate_newest_checkpoint(dirs[0])
+        records = []
+
+        class W:
+            def write(self, rec):
+                records.append(rec)
+
+        mgr = self._pod_mgr(dirs, host=0, writer=W())
+        got_step, _ = mgr.restore(abstract_state=self._abstract())
+        mgr.close()
+        assert got_step == 2
+        skips = [r for r in records
+                 if r.get("action") == "skip-torn-checkpoint"]
+        assert skips and skips[0]["step"] == 3
+        assert set(skips[0]["peer_quarantined"]) == {
+            str(dirs[1]), str(dirs[2])
+        }
+        for d in dirs:
+            assert not (d / "3").exists(), d
+
+    def test_all_hosts_valid_restores_the_newest_step(self, tmp_path):
+        dirs = self._build_pod(tmp_path)
+        mgr = self._pod_mgr(dirs, host=0)
+        got_step, got = mgr.restore(abstract_state=self._abstract())
+        mgr.close()
+        assert got_step == 3
+        np.testing.assert_allclose(np.asarray(got["w"]), self.STATE["w"] + 3)
+
+    def test_failed_quarantine_rename_keeps_the_manifest(
+        self, tmp_path, monkeypatch
+    ):
+        """A quarantine rename that fails with the step dir STILL IN
+        PLACE (EACCES/EBUSY on shared storage) must not drop the
+        manifest: the manifest is the evidence that marks the torn step
+        invalid, and dropping it would flip step_valid_in_dir's
+        absent-manifest fallback to "valid" on a known-bad step."""
+        from pathlib import Path
+
+        from glom_tpu.resilience import truncate_newest_checkpoint
+        from glom_tpu.utils.checkpoint import (
+            quarantine_step_in_dir,
+            step_valid_in_dir,
+        )
+
+        dirs = self._build_pod(tmp_path, n_hosts=1)
+        truncate_newest_checkpoint(dirs[0])
+        assert not step_valid_in_dir(dirs[0], 3)
+
+        def deny_rename(self, dst):
+            raise OSError("EBUSY: device or resource busy")
+
+        monkeypatch.setattr(Path, "rename", deny_rename)
+        assert quarantine_step_in_dir(dirs[0], 3) is None
+        monkeypatch.undo()
+        assert (dirs[0] / "manifest_3.json").is_file()
+        assert (dirs[0] / "3").is_dir()
+        assert not step_valid_in_dir(dirs[0], 3)  # still judged torn
+
+    def test_single_host_shape_unchanged_without_pod_peers(self, tmp_path):
+        """The acceptance guard: no pod_peers means the PR 6 contract
+        bit-for-bit — same events, same fields (no peer_quarantined
+        key)."""
+        from glom_tpu.resilience import truncate_newest_checkpoint
+        from glom_tpu.utils.checkpoint import CheckpointManager
+
+        dirs = self._build_pod(tmp_path, n_hosts=1)
+        truncate_newest_checkpoint(dirs[0])
+        records = []
+
+        class W:
+            def write(self, rec):
+                records.append(rec)
+
+        mgr = CheckpointManager(str(dirs[0]), metrics_writer=W())
+        got_step, _ = mgr.restore(abstract_state=self._abstract())
+        mgr.close()
+        assert got_step == 2
+        skips = [r for r in records
+                 if r.get("action") == "skip-torn-checkpoint"]
+        assert skips and "peer_quarantined" not in skips[0]
+        assert not any(
+            r.get("action") == "quarantine-half-step" for r in records
+        )
+
+
 _WORKER = r"""
 import sys
 import jax

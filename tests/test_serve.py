@@ -794,6 +794,47 @@ class TestMultiEngineFanOut:
             )
 
 
+class TestPickupFairness:
+    """The ROADMAP fairness item (observed while building rejoin-serve):
+    under slow paced traffic one worker could win EVERY 50ms-timeout
+    first-get race for seconds — its loop re-entered get() microseconds
+    after each dispatch while the sibling's expired wait re-queued behind
+    it. The rotation fix: the last winner defers a small handicap on an
+    idle queue (an already-waiting sibling is then first in the waiter
+    list) and first-get timeouts carry deterministic per-engine jitter."""
+
+    def test_paced_traffic_dispatches_on_both_workers(self):
+        a, b = FakeEngine(), FakeEngine()
+        a.name, b.name = "a", "b"
+        with DynamicBatcher(engines=[a, b], max_batch=1,
+                            max_delay_ms=1.0) as bat:
+            for _ in range(12):
+                t = bat.submit(IMG)
+                t.result(timeout=10.0)
+                # Paced WELL past the pickup handicap: each request is
+                # resolved (and both workers idle-waiting again) before
+                # the next arrives — exactly the traffic shape that
+                # phase-locked before the rotation.
+                time.sleep(0.012)
+            summary = bat.summary_record()
+        eng = summary["engines"]
+        assert eng["a"]["dispatches"] > 0 and eng["b"]["dispatches"] > 0, (
+            f"paced pickup phase-locked on one engine: {eng}"
+        )
+        assert summary["n_served"] == 12 and summary["n_failed"] == 0
+
+    def test_first_get_timeouts_are_jittered_and_deterministic(self):
+        engs = [FakeEngine() for _ in range(3)]
+        for i, e in enumerate(engs):
+            e.name = f"e{i}"
+        bat = DynamicBatcher(engines=engs, max_batch=1)  # never started
+        touts = [bat._first_get_timeout(f"e{i}") for i in range(3)]
+        assert len(set(touts)) == 3, touts  # pairwise distinct
+        assert all(0.05 <= t <= 0.07 for t in touts), touts
+        assert touts == [bat._first_get_timeout(f"e{i}") for i in range(3)]
+        bat.stop(drain=False)
+
+
 class TestEngineRejoin:
     """Probation re-admit of a dead engine (ServeConfig.rejoin_threshold,
     docs/RESILIENCE.md): N consecutive successful health dispatches bring
