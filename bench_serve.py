@@ -491,6 +491,102 @@ def run_temporal(cfg, scfg, label: str, *, n_streams: int, n_frames: int,
     return means
 
 
+def run_trace_ab(cfg, scfg, label: str, *, n_requests: int,
+                 n_engines: int = 1, repeats: int = 3) -> dict:
+    """Request-tracing overhead A/B (docs/OBSERVABILITY.md, Request
+    tracing): the same closed-loop traffic served with trace stamping ON
+    (ids minted per submit, per-dispatch scope, per-request resolve
+    leaves) vs OFF (context keys stamp as null, no resolve leaves), both
+    arms writing through a real MetricsWriter so serialization is priced.
+    Arms alternate per repeat and each keeps its BEST mean (min-of-noise,
+    the bench convention), emitting `serve_trace_mean_latency` per arm
+    and `serve_trace_overhead` in percent — the <2% bar run_hw_queue's
+    step 9g gates. Returns {arm: mean_ms}."""
+    import numpy as np
+
+    from glom_tpu.serve.batcher import DynamicBatcher, ShedError
+    from glom_tpu.telemetry.sinks import emit
+    from glom_tpu.utils.metrics import MetricsWriter
+
+    rng = np.random.default_rng(3)
+    shape = (cfg.channels, cfg.image_size, cfg.image_size)
+    imgs = [
+        rng.normal(size=shape).astype(np.float32) for _ in range(n_requests)
+    ]
+    # ONE engine set serves both arms: tracing is purely host-side, and a
+    # per-arm engine would hand the A/B a compiled-program / allocator
+    # state difference far larger than the stamping cost being measured.
+    engines = _make_engines(cfg, scfg, n_engines)
+    for eng in engines:
+        eng.warmup()
+    window = max(1, min(scfg.queue_depth // 2, 16))
+    best: dict = {}
+    for rep in range(repeats + 1):
+        for arm, flag in (("trace-off", False), ("trace-on", True)):
+            writer = MetricsWriter(None, echo=False)
+            lat = []
+            with DynamicBatcher(
+                engines=engines, writer=writer, trace=flag
+            ) as batcher:
+                for start in range(0, n_requests, window):
+                    tickets = []
+                    for i in range(start, min(start + window, n_requests)):
+                        try:
+                            tickets.append(batcher.submit(imgs[i]))
+                        except ShedError:
+                            continue
+                    for t in tickets:
+                        try:
+                            _, _, latency_s = t.result(timeout=600.0)
+                        except Exception:
+                            continue
+                        lat.append(latency_s)
+            writer.close()
+            if rep == 0:
+                continue  # warm-up pass: first-touch noise, not data
+            if lat:
+                mean_ms = 1e3 * sum(lat) / len(lat)
+                if arm not in best or mean_ms < best[arm]:
+                    best[arm] = mean_ms
+    for arm in ("trace-off", "trace-on"):
+        if arm in best:
+            emit(
+                {
+                    "metric": f"serve_trace_mean_latency ({arm}, {label})",
+                    "value": round(best[arm], 4),
+                    "unit": "ms",
+                    "requests": n_requests,
+                    "repeats": repeats,
+                }
+            )
+        else:
+            emit(
+                {
+                    "metric": f"serve_trace_mean_latency ({arm}, {label})",
+                    "value": None,
+                    "unit": "ms",
+                    "error": "no-requests-served",
+                    "note": f"UNMEASURED: trace A/B {arm} arm served nothing",
+                },
+                kind="error",
+            )
+    if "trace-off" in best and "trace-on" in best and best["trace-off"] > 0:
+        overhead = 100.0 * (best["trace-on"] - best["trace-off"]) / best[
+            "trace-off"
+        ]
+        emit(
+            {
+                "metric": f"serve_trace_overhead ({label})",
+                "value": round(overhead, 2),
+                "unit": "percent",
+                "trace_off_ms": round(best["trace-off"], 4),
+                "trace_on_ms": round(best["trace-on"], 4),
+                "budget_percent": 2.0,
+            }
+        )
+    return best
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
     ap.add_argument("--requests", type=int, default=None,
@@ -522,6 +618,12 @@ def main(argv=None) -> int:
     ap.add_argument("--perturb", type=float, default=0.05, metavar="P",
                     help="temporal mode: per-frame perturbation scale "
                     "relative to the stream's base image (default 0.05)")
+    ap.add_argument("--trace-ab", action="store_true",
+                    help="run the request-tracing overhead A/B INSTEAD of "
+                    "the load sweep: the same closed-loop traffic with "
+                    "trace stamping on vs off, emitting the per-arm mean "
+                    "latency and serve_trace_overhead in percent — the "
+                    "<2% bar (docs/OBSERVABILITY.md, Request tracing)")
     args = ap.parse_args(argv)
 
     from glom_tpu.telemetry.sinks import bench_bootstrap, emit
@@ -592,6 +694,13 @@ def main(argv=None) -> int:
     if scfg.mesh_data > 1 or scfg.mesh_seq > 1:
         label = f"{label}, mesh={scfg.mesh_data}x{scfg.mesh_seq}"
     del jax  # imported to fail fast before any measurement if broken
+    if args.trace_ab:
+        run_trace_ab(
+            cfg, scfg, label,
+            n_requests=n_requests,
+            n_engines=args.engines,
+        )
+        return 0
     if args.temporal:
         run_temporal(
             cfg, scfg, label,

@@ -24,6 +24,7 @@ not guessed at — the runtime linter still owns those.
 from __future__ import annotations
 
 import ast
+from functools import lru_cache
 from typing import List, Optional, Set
 
 from glom_tpu.analysis.astutil import call_name, qualname_at
@@ -53,6 +54,25 @@ _FALLBACK_KINDS = {
     "train_step", "bench", "watchdog", "anomaly", "summary", "note",
     "span", "error", "serve", "fault", "recovery",
 }
+
+# Serve events that are REQUEST-scoped and must stamp trace context on
+# every v6 record (the schema registry owns the real list; this frozen
+# fallback mirrors it for partial checkouts).
+_FALLBACK_TRACE_EVENTS = (
+    "dispatch", "continuation", "shed", "resolve", "engine_failover",
+    "dispatch_error", "response",
+)
+_TRACE_KEYS = ("trace_id", "trace_ids")
+
+
+@lru_cache(maxsize=1)
+def _load_trace_events() -> tuple:
+    try:
+        from glom_tpu.telemetry.schema import TRACE_REQUIRED_EVENTS
+
+        return tuple(TRACE_REQUIRED_EVENTS)
+    except Exception:
+        return _FALLBACK_TRACE_EVENTS
 
 
 def _load_kinds(ctx: Context) -> Set[str]:
@@ -159,6 +179,31 @@ class SchemaEmit(Checker):
                                 f"registry {sorted(kinds)}",
                                 "unknown-kind",
                             )
+                ev = self._value_of(record, "event")
+                if (
+                    kind_value in (None, "serve")
+                    and isinstance(ev, ast.Constant)
+                    and ev.value in _load_trace_events()
+                    and not any(k is None for k in record.keys)  # **splat
+                    and not any(
+                        self._has_key(record, k) for k in _TRACE_KEYS
+                    )
+                ):
+                    # The schema-v6 request-tracing contract, enforced at
+                    # the emit site: a request-scoped serve event literal
+                    # that stamps neither trace key (nor merges one in via
+                    # a **splat) writes records that can never join their
+                    # request's causal tree — the runtime linter will
+                    # reject every one of them.
+                    add(
+                        ev,
+                        f"serve event {ev.value!r} record stamps no trace "
+                        f"context ({'/'.join(_TRACE_KEYS)}) — schema v6 "
+                        "requires request-scoped serve records to carry "
+                        "it (telemetry/tracectx.py; null = explicitly "
+                        "untraced is fine, absent is not)",
+                        "trace-context",
+                    )
                 if self._has_key(record, "error"):
                     value = self._value_of(record, "value")
                     if (

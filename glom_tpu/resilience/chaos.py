@@ -321,6 +321,45 @@ def run_kill_serve(args) -> int:
             failures.append(
                 f"engine0 state does not reconcile with the kill: {eng0}"
             )
+    # TRACE-TREE checks (schema v6, telemetry/tracectx.py): the evidence
+    # is no longer a bag of events — every served request must
+    # reconstruct as ONE causal tree whose per-hop executed iters and
+    # wall spans conserve exactly against its resolve leaf, and the
+    # failover hand-off must be VISIBLE inside at least one tree (the
+    # injected dead engine's requests rode failover -> sibling dispatch).
+    from glom_tpu.telemetry import tracectx
+
+    traces = tracectx.list_traces(recs)
+    resolved_traces = [
+        t for t, info in sorted(traces.items()) if info["resolved"]
+    ]
+    if not resolved_traces:
+        failures.append(
+            "no resolved trace trees in the evidence: the v6 trace "
+            "context never made it through the serve stack"
+        )
+    bad_conservation = []
+    for t in resolved_traces:
+        check = tracectx.conservation(recs, t)
+        if not check["ok"]:
+            bad_conservation.append(f"{t}: {check.get('why', '?')}")
+    if bad_conservation:
+        failures.append(
+            "trace conservation broken (a hop's evidence is missing or "
+            "double-counted): " + "; ".join(bad_conservation[:3])
+        )
+    crossed_failover = [
+        t for t in resolved_traces
+        if any(
+            r.get("event") == "engine_failover"
+            for r in tracectx.records_for(recs, t)
+        )
+    ]
+    if failovers and not crossed_failover:
+        failures.append(
+            "no resolved trace tree contains the engine_failover hop — "
+            "the hand-off happened but cannot be joined to any request"
+        )
     failures.extend(_lint([paths["metrics"]]))
     summary = {
         "event": "chaos-summary",
@@ -330,6 +369,8 @@ def run_kill_serve(args) -> int:
         "n_fault_events": len(faults),
         "n_failovers": len(failovers),
         "n_rejoins": len(rejoins),
+        "n_traces_resolved": len(resolved_traces),
+        "n_traces_crossing_failover": len(crossed_failover),
         "failures": failures[:10],
     }
     _emit(summary, kind="summary")

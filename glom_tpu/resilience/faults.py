@@ -58,9 +58,15 @@ def emit_recovery(writer, rec: dict) -> dict:
     for every "recovery" emit site (the restart loop, the retry policy,
     the checkpoint torn-step skip) — the serve/events.emit_serve lesson
     applied to this kind. Returns the stamped record."""
+    from glom_tpu.telemetry import tracectx
     from glom_tpu.tracing.flight import write_or_observe
 
     stamped = schema.stamp(rec, kind="recovery")
+    # A recovery emitted from under a serve dispatch (a dispatch-retry,
+    # say) inherits that dispatch's trace context, so the retry attempt
+    # appears in the request's causal tree (telemetry/tracectx.py).
+    if not any(k in stamped for k in ("trace_id", "trace_ids")):
+        stamped.update(tracectx.current_fields())
     write_or_observe(writer, stamped)
     return stamped
 
@@ -237,9 +243,15 @@ def dispatch_fault(
     so `at=(0,)` means 'first attempt fails, the retry lands')."""
 
     def hook(ctx: dict) -> None:
+        from glom_tpu.telemetry import tracectx
+
+        # An injection that lands under a dispatch scope stamps the
+        # victim requests' trace context on the fault event, so a chaos
+        # run's trace trees show WHICH requests each injection hit.
         if plan.fires(
             site,
             **{k: ctx.get(k) for k in ("bucket", "n_valid", "attempt")},
+            **tracectx.current_fields(),
         ):
             raise exc_type(f"injected dispatch fault at {site}")
 

@@ -105,7 +105,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from glom_tpu.telemetry import schema
+from glom_tpu.telemetry import schema, tracectx
 from glom_tpu.tracing.spans import SpanAggregator, span
 
 
@@ -134,10 +134,21 @@ class LadderShedError(ShedError):
 
 
 class Ticket:
-    """One request's future: result() blocks until served or failed."""
+    """One request's future: result() blocks until served or failed.
 
-    def __init__(self, request_id):
+    `trace_id`/`span_id` are the request's minted trace context
+    (telemetry/tracectx.py; None when ServeConfig.trace_requests is off):
+    trace_id names the request's causal tree across every hop it rides,
+    span_id is the submit root every first-hop record parents to. After
+    resolve, `hops` and `dispatch_ms` carry the served totals the trace
+    tree's conservation check reconciles against."""
+
+    def __init__(self, request_id, trace_id=None, span_id=None):
         self.request_id = request_id
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.hops: Optional[int] = None
+        self.dispatch_ms: Optional[float] = None
         self._done = threading.Event()
         self._levels: Optional[np.ndarray] = None
         self._iters_run: Optional[int] = None
@@ -145,9 +156,11 @@ class Ticket:
         self._error: Optional[BaseException] = None
         self.t_submit = time.perf_counter()
 
-    def _resolve(self, levels, iters_run):
+    def _resolve(self, levels, iters_run, hops=None, dispatch_ms=None):
         self._levels = levels
         self._iters_run = iters_run
+        self.hops = hops
+        self.dispatch_ms = dispatch_ms
         self._latency_s = time.perf_counter() - self.t_submit
         self._done.set()
 
@@ -186,11 +199,15 @@ class _Item:
         already run, `hops` continuation dispatches taken.
 
     The image rides every hop (tokens are recomputed — they are noise vs
-    one iteration); `redispatches` counts engine-failover hand-offs."""
+    one iteration); `redispatches` counts engine-failover hand-offs.
+    `parent_span` is the span this item's NEXT record parents to — the
+    submit root initially, then the last dispatch/failover span it rode;
+    `dispatch_ms` accumulates the rounded per-hop dispatch latencies so
+    the resolve leaf's total reconciles EXACTLY with the hop records."""
 
     __slots__ = (
         "img", "ticket", "session", "levels", "executed", "hops",
-        "redispatches", "warm_src",
+        "redispatches", "warm_src", "parent_span", "dispatch_ms",
     )
 
     def __init__(self, img: np.ndarray, ticket: Ticket, session=None):
@@ -202,6 +219,8 @@ class _Item:
         self.hops = 0      # continuation dispatches so far
         self.redispatches = 0
         self.warm_src: Optional[str] = None  # None | "cache" | "cont"
+        self.parent_span = ticket.span_id
+        self.dispatch_ms = 0.0
 
 
 def _backend_down() -> bool:
@@ -238,6 +257,7 @@ class DynamicBatcher:
         column_cache=None,
         rejoin_threshold: Optional[int] = None,
         rejoin_interval_ms: Optional[float] = None,
+        trace: Optional[bool] = None,
         clock=time.perf_counter,
     ):
         if (engine is None) == (engines is None):
@@ -273,6 +293,14 @@ class DynamicBatcher:
         self.shed_when_down = shed_when_down
         self.engine_fail_threshold = engine_fail_threshold
         self.max_redispatch = max_redispatch
+        # Request-scoped tracing (telemetry/tracectx.py): None resolves
+        # from the lead engine's ServeConfig (trace_requests, default ON).
+        # When off, the trace-context keys still stamp as null — an
+        # explicitly UNTRACED record lints; an absent key would not.
+        self._trace = (
+            trace if trace is not None
+            else bool(getattr(scfg, "trace_requests", True)) if scfg else True
+        )
         # Streaming warm-start column cache (serve/column_cache.py):
         # None RESOLVES from the lead engine's ServeConfig
         # (column_cache_bytes > 0 builds one) — the ladder pattern. Pass
@@ -509,10 +537,24 @@ class DynamicBatcher:
             self._seq += 1
             rid = self._seq
             self.n_requests += 1
-        ticket = Ticket(rid)
+        # Mint the request's trace context HERE, at admission: trace_id
+        # names the causal tree, span_id is the submit root every
+        # first-hop record parents to (telemetry/tracectx.py). Tracing
+        # off mints nothing — downstream records stamp the keys as null.
+        if self._trace:
+            ticket = Ticket(
+                rid,
+                trace_id=tracectx.new_trace_id(),
+                span_id=tracectx.new_span_id(),
+            )
+        else:
+            ticket = Ticket(rid)
         with span("serve_enqueue", aggregator=self.spans):
             if self.shed_when_down and _backend_down():
-                detail = self._pressure()
+                # trace_id rides the exception's detail too, so a caller
+                # stamping its own failure record (the CLI's response)
+                # can join it to the shed leaf without holding the ticket.
+                detail = dict(self._pressure(), trace_id=ticket.trace_id)
                 self._shed(ticket, "backend-down", **detail)
                 raise BackendDownError(
                     "backend watchdog reports the accelerator down; "
@@ -523,7 +565,7 @@ class DynamicBatcher:
             with self._counter_lock:
                 started = bool(self._threads)
             if started and not alive:
-                detail = self._pressure()
+                detail = dict(self._pressure(), trace_id=ticket.trace_id)
                 self._shed(ticket, "no-live-engine", **detail)
                 raise ShedError(
                     "every engine is dead (failover exhausted); request "
@@ -538,7 +580,7 @@ class DynamicBatcher:
                 from glom_tpu.resilience.ladder import SHED
 
                 if min(l.rung() for l in live_ladders) >= SHED:
-                    detail = self._pressure()
+                    detail = dict(self._pressure(), trace_id=ticket.trace_id)
                     self._shed(ticket, "ladder-shed", **detail)
                     raise LadderShedError(
                         "degradation ladder at its shed rung on every "
@@ -560,7 +602,7 @@ class DynamicBatcher:
             except queue.Full:
                 with self._counter_lock:
                     self.n_submitted -= 1
-                detail = self._pressure()
+                detail = dict(self._pressure(), trace_id=ticket.trace_id)
                 self._shed(ticket, "queue-full", **detail)
                 raise QueueFullError(
                     f"request queue at capacity ({self._q.maxsize}); "
@@ -600,6 +642,7 @@ class DynamicBatcher:
     def _shed(self, ticket: Ticket, reason: str, **detail) -> None:
         with self._counter_lock:
             self.n_shed += 1
+        detail.setdefault("trace_id", ticket.trace_id)
         exc_type = {
             "backend-down": BackendDownError,
             "ladder-shed": LadderShedError,
@@ -607,24 +650,30 @@ class DynamicBatcher:
         }.get(reason, QueueFullError)
         ticket._fail(exc_type(reason, **detail))
         # The shed decision itself is a "serve" event carrying the why
-        # (queue depth / ladder rung; stamp_serve merges backend_state);
-        # a backend-down shed ALSO emits the schema "error" record (value
-        # null, machine-readable cause) — the same UNMEASURED discipline
-        # as the benches.
-        self._emit(
-            {
-                "event": "shed",
-                "reason": reason,
-                "request_id": ticket.request_id,
-                **detail,
-            }
-        )
+        # (queue depth / ladder rung; stamp_serve merges backend_state)
+        # plus the request's trace context — a shed is this trace's
+        # terminal leaf, so `telemetry trace` shows WHY the request never
+        # resolved. A backend-down shed ALSO emits the schema "error"
+        # record (value null, machine-readable cause) — the same
+        # UNMEASURED discipline as the benches.
+        rec = {
+            "event": "shed",
+            "reason": reason,
+            "request_id": ticket.request_id,
+            "trace_id": ticket.trace_id,
+            **detail,
+        }
+        if ticket.trace_id is not None:
+            rec.setdefault("span_id", tracectx.new_span_id())
+            rec.setdefault("parent_span", ticket.span_id)
+        self._emit(rec)
         if reason == "backend-down":
             self._emit(
                 {
                     "error": "backend-down",
                     "value": None,
                     "request_id": ticket.request_id,
+                    "trace_id": ticket.trace_id,
                     "note": "request shed: backend watchdog reports down",
                 },
                 kind="error",
@@ -960,7 +1009,6 @@ class DynamicBatcher:
         return requeued
 
     def _dispatch(self, engine, engine_name: str, batch) -> None:
-        n = len(batch)
         if self.shed_when_down and _backend_down():
             # Gathered but undispatchable: fail every ticket fast with the
             # stamped evidence — never dispatch into a dead backend (the
@@ -970,6 +1018,36 @@ class DynamicBatcher:
                     req.ticket, "backend-down", **self._pressure(engine_name)
                 )
             return
+        if self._trace:
+            # One span per dispatch ATTEMPT: the batch-level records of
+            # this dispatch (dispatch/continuation/failover) share it, and
+            # the thread-local scope hands it to every nested sink (retry
+            # recovery events, cache evictions, lazy warmup compiles, host
+            # spans) without signature threading. parent_spans is row-
+            # aligned with the batch: each row parents to ITS previous hop
+            # (the submit root on the first).
+            dspan = tracectx.new_span_id()
+            tfields = {
+                "span_id": dspan,
+                "trace_ids": [it.ticket.trace_id for it in batch],
+                "parent_spans": [it.parent_span for it in batch],
+            }
+            with tracectx.dispatch_scope(
+                dspan, tfields["trace_ids"], tfields["parent_spans"]
+            ):
+                self._dispatch_batch(engine, engine_name, batch, dspan, tfields)
+        else:
+            # Untraced: the context keys still stamp — as null, so the
+            # schema's presence contract holds (an explicitly untraced
+            # record lints; an absent key would not).
+            self._dispatch_batch(
+                engine, engine_name, batch, None, {"trace_ids": None}
+            )
+
+    def _dispatch_batch(
+        self, engine, engine_name: str, batch, dspan, tfields
+    ) -> None:
+        n = len(batch)
         iters_override = None
         rung_name = None
         ladder = self._ladders.get(engine_name)
@@ -1063,7 +1141,14 @@ class DynamicBatcher:
             if state["siblings"]:
                 # FAILOVER: hand this batch to the siblings instead of
                 # failing it — the multi-engine contract a dead engine's
-                # chaos scenario validates (docs/RESILIENCE.md).
+                # chaos scenario validates (docs/RESILIENCE.md). The
+                # failover record takes this attempt's span (the failed
+                # dispatch emitted no record of its own), and the items
+                # re-parent to it, so the redispatch hop is a CHILD of
+                # the failover in each request's causal tree.
+                if dspan is not None:
+                    for item in batch:
+                        item.parent_span = dspan
                 n_req = self._requeue(batch)
                 self._emit(
                     {
@@ -1074,6 +1159,7 @@ class DynamicBatcher:
                         "n_valid": n,
                         "warm_state": warm,
                         "exception": f"{type(e).__name__}: {e}"[:300],
+                        **tfields,
                     }
                 )
                 if not state["alive"]:
@@ -1097,6 +1183,7 @@ class DynamicBatcher:
                     "engine": engine_name,
                     "n_valid": n,
                     "exception": f"{type(e).__name__}: {e}"[:300],
+                    **tfields,
                 }
             )
             if not state["alive"]:
@@ -1121,8 +1208,18 @@ class DynamicBatcher:
         resolved: List[dict] = []
         n_resolved = 0
         entry_tier = max((it.hops for it in batch), default=0)
+        # This hop's wall span, as the dispatch record will carry it: the
+        # items accumulate EXACTLY these rounded values, in hop order, so
+        # the resolve leaf's dispatch_ms_total equals the sum of its
+        # trace's per-hop latency_ms fields bit-for-bit (the conservation
+        # check in telemetry/tracectx.py is exact, not approximate).
+        latency_ms = round(1e3 * result.latency_s, 3)
+        to_resolve: List[tuple] = []  # (item, row index, total iters)
         for i, it in enumerate(batch):
             executed_i = it.executed + result.iters_run
+            it.dispatch_ms += latency_ms
+            if dspan is not None:
+                it.parent_span = dspan  # the next record parents HERE
             open_hop = (
                 tiered
                 and conv is not None
@@ -1144,22 +1241,28 @@ class DynamicBatcher:
                     self.cache.store(
                         it.session, np.array(levels[i]), engine=engine_name
                     )
-                it.ticket._resolve(levels[i], executed_i)
+                to_resolve.append((it, i, executed_i))
                 resolved.append({"iters": executed_i, "tier": it.hops})
                 n_resolved += 1
         if stragglers:
             self._cont_q.put(stragglers)
             worst = max(it.executed for it in stragglers)
-            self._emit(
-                {
-                    "event": "continuation",
-                    "engine": engine_name,
-                    "n_stragglers": len(stragglers),
-                    "executed_iters": worst,
-                    "remaining_budget": budget - worst,
-                    "hop": max(it.hops for it in stragglers),
-                }
-            )
+            cont = {
+                "event": "continuation",
+                "engine": engine_name,
+                "n_stragglers": len(stragglers),
+                "executed_iters": worst,
+                "remaining_budget": budget - worst,
+                "hop": max(it.hops for it in stragglers),
+                "trace_ids": (
+                    [it.ticket.trace_id for it in stragglers]
+                    if self._trace else None
+                ),
+            }
+            if self._trace:
+                cont["span_id"] = tracectx.new_span_id()
+                cont["parent_spans"] = [dspan] * len(stragglers)
+            self._emit(cont)
         rec = {
             "event": "dispatch",
             "engine": engine_name,
@@ -1168,12 +1271,13 @@ class DynamicBatcher:
             "warm_state": warm,
             "tier": entry_tier,
             "pad_fraction": round(1.0 - n / result.bucket, 4),
-            "latency_ms": round(1e3 * result.latency_s, 3),
+            "latency_ms": latency_ms,
             "iters_run": result.iters_run,
             "n_stragglers": len(stragglers),
             "n_cache_warm": n_cache_warm,
             "n_cache_miss": n_cache_miss,
             "compiled": result.compiled,
+            **tfields,
         }
         if rung_name is not None:
             rec["rung"] = rung_name
@@ -1190,6 +1294,35 @@ class DynamicBatcher:
             n_degraded=n_resolved if iters_override is not None else 0,
             n_continued=len(stragglers),
         )
+        # Tickets resolve AFTER the counters: the instant result() returns
+        # a caller may read summary_record(), and its conservation
+        # (n_served + n_shed + n_failed == n_requests) must already hold.
+        for it, i, executed_i in to_resolve:
+            it.ticket._resolve(
+                levels[i], executed_i,
+                hops=it.hops, dispatch_ms=it.dispatch_ms,
+            )
+            if self._trace:
+                # The RESOLVE leaf: one per-request record carrying the
+                # served totals the trace tree must conserve against
+                # (summed hop iters_run / latency_ms == these exactly).
+                # Only minted when tracing — it exists for the tree, and
+                # the trace-ab gate prices it.
+                self._emit(
+                    {
+                        "event": "resolve",
+                        "request_id": it.ticket.request_id,
+                        "engine": engine_name,
+                        "iters_total": executed_i,
+                        "dispatch_ms_total": it.dispatch_ms,
+                        "hops": it.hops,
+                        "redispatches": it.redispatches,
+                        "latency_ms": round(1e3 * it.ticket._latency_s, 3),
+                        "trace_id": it.ticket.trace_id,
+                        "span_id": tracectx.new_span_id(),
+                        "parent_span": dspan,
+                    }
+                )
         self._emit(rec)
         self._ladder_observe(engine_name)
 

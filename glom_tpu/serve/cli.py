@@ -342,6 +342,9 @@ def main(argv=None) -> int:
                     )
                 except ShedError as e:
                     failed += 1
+                    # The shed exception's detail carries the minted
+                    # trace_id (serve/batcher.submit), so even a rejected
+                    # request's response joins its trace's shed leaf.
                     writer.write(
                         serve_rec(
                             {
@@ -349,6 +352,9 @@ def main(argv=None) -> int:
                                 "id": rid,
                                 "ok": False,
                                 "reason": f"{type(e).__name__}: {e}"[:200],
+                                "trace_id": getattr(e, "detail", {}).get(
+                                    "trace_id"
+                                ),
                             }
                         )
                     )
@@ -364,11 +370,16 @@ def main(argv=None) -> int:
                                 "id": rid,
                                 "ok": False,
                                 "reason": f"{type(e).__name__}: {e}"[:200],
+                                "trace_id": ticket.trace_id,
+                                "parent_span": ticket.span_id,
                             }
                         )
                     )
                     continue
                 served += 1
+                # The response is the trace's user-visible leaf: it
+                # parents to the submit root (the serve-side resolve leaf
+                # carries the per-hop conservation totals).
                 writer.write(
                     serve_rec(
                         {
@@ -381,6 +392,8 @@ def main(argv=None) -> int:
                                 float(np.linalg.norm(levels[:, -1]) / levels.shape[0]),
                                 4,
                             ),
+                            "trace_id": ticket.trace_id,
+                            "parent_span": ticket.span_id,
                         }
                     )
                 )

@@ -13,12 +13,22 @@ from glom_tpu.telemetry import schema
 
 
 def stamp_serve(rec: dict, kind: str = "serve") -> dict:
-    """Stamped copy of `rec` carrying kind + the watchdog backend state."""
+    """Stamped copy of `rec` carrying kind + the watchdog backend state +
+    (when this thread is inside a batcher dispatch scope) the dispatch's
+    trace context — so retry events, cache evictions, and lazy mid-traffic
+    warmup compiles emitted from under a dispatch join that request's
+    trace tree without any signature threading (telemetry/tracectx.py).
+    Keys already present always win."""
+    from glom_tpu.telemetry import tracectx
     from glom_tpu.telemetry.watchdog import backend_record
 
     stamped = schema.stamp(rec, kind=kind)
     for k, v in backend_record().items():
         stamped.setdefault(k, v)
+    if not any(k in stamped for k in ("trace_id", "trace_ids")):
+        # Records that carry their OWN trace identity (a per-request
+        # resolve leaf, say) are never widened to the whole batch scope.
+        stamped.update(tracectx.current_fields())
     return stamped
 
 

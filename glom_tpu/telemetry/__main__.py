@@ -1,6 +1,6 @@
 """`python -m glom_tpu.telemetry ...` — the telemetry CLI.
 
-Three subcommands sharing one entry point (all pure stdlib — they must run
+Six subcommands sharing one entry point (all pure stdlib — they must run
 in a jax-broken environment, the exact wedged-image scenario they exist
 for):
 
@@ -10,6 +10,13 @@ for):
                                                     regression gate
     python -m glom_tpu.telemetry perfetto FILE...   span/flight JSONL ->
                                                     Perfetto JSON trace
+    python -m glom_tpu.telemetry trace FILE...      reconstruct one
+                                                    request's causal tree
+                                                    (+ conservation check)
+    python -m glom_tpu.telemetry aggregate PATH...  merge N hosts' streams
+                                                    into one pod rollup
+    python -m glom_tpu.telemetry watch DIR --slo R=T  live SLO monitor,
+                                                    stamps slo_breach
 
 (`-m ...telemetry.schema` / `-m ...telemetry.compare` work too but trip
 runpy's already-imported warning.)
@@ -27,6 +34,18 @@ if __name__ == "__main__":
         from glom_tpu.telemetry.perfetto import main as perfetto_main
 
         sys.exit(perfetto_main(argv[1:]))
+    if argv and argv[0] == "trace":
+        from glom_tpu.telemetry.tracectx import main as trace_main
+
+        sys.exit(trace_main(argv[1:]))
+    if argv and argv[0] == "aggregate":
+        from glom_tpu.telemetry.aggregate import aggregate_main
+
+        sys.exit(aggregate_main(argv[1:]))
+    if argv and argv[0] == "watch":
+        from glom_tpu.telemetry.aggregate import watch_main
+
+        sys.exit(watch_main(argv[1:]))
     from glom_tpu.telemetry.schema import main
 
     sys.exit(main(argv))

@@ -38,7 +38,19 @@ from glom_tpu.telemetry import schema
 # early-exit rows ("iters/request": column updates spent per request); the
 # rate check runs FIRST, so "column-iters/s/chip" still reads as a rate.
 _COST_UNIT_TOKENS = ("ms", "percent", "bytes", "second", "iters")
-_COST_METRIC_TOKENS = ("overhead", "time", "latency")
+# Failure-ish count names regress UP (more retries/failures/sheds is
+# worse); everything else counted (dispatches, rejoins, alive) is a
+# rate, where LOWER is the regression — a dead engine's dispatches
+# dropping to zero must gate, not vanish.
+_COST_METRIC_TOKENS = (
+    "overhead", "time", "latency", "retries", "failures", "gave_up",
+    "fast_failed", "shed", "evictions", "rejects", "expirations",
+    # Ladder churn regresses UP too: restores track degrades 1:1, so a
+    # run that never degraded improves on BOTH, and one that bounced
+    # more regresses on both — rate-classifying restores would gate the
+    # calm run for restoring less.
+    "degrades", "restores", "deaths", "failovers",
+)
 
 
 def lower_is_better(metric: str, unit: str) -> bool:
@@ -59,27 +71,82 @@ def _is_measured(rec: dict) -> bool:
     )
 
 
+def flatten_engine_metrics(rec: dict) -> List[dict]:
+    """Synthetic bench-shaped rows from one serve summary's per-engine
+    nest, so multi-engine rollups GATE instead of vanishing: the summary
+    nests dispatches / rejoins / ladder / retry counters under
+    `engines[name]` (flat on a single-engine summary — those fields ride
+    the record itself and were never per-engine), and the compare gate
+    only ingests `metric` rows. Numeric leaves (bools as 0/1 — an engine
+    going alive=1 -> 0 IS the regression kill-serve hunts) flatten to
+    `serve_engine.<name>.<dotted.path> (<config>)`, unit "count"; the
+    direction comes from _COST_METRIC_TOKENS (retries/failures regress
+    UP, dispatches/alive regress DOWN)."""
+    engines = rec.get("engines")
+    if not isinstance(engines, dict):
+        return []
+    cfg = rec.get("config")
+    suffix = f" ({cfg})" if isinstance(cfg, str) and cfg else ""
+    rows: List[dict] = []
+
+    def walk(prefix: str, obj: dict, out: Dict[str, float]) -> None:
+        for k, v in obj.items():
+            if isinstance(v, dict):
+                walk(f"{prefix}{k}.", v, out)
+            elif isinstance(v, (int, float)):
+                # bool is an int subclass: alive flattens as 0/1.
+                out[f"{prefix}{k}"] = float(v)
+
+    for name in sorted(engines):
+        st = engines[name]
+        if not isinstance(st, dict):
+            continue
+        flat: Dict[str, float] = {}
+        walk("", st, flat)
+        for key, value in sorted(flat.items()):
+            rows.append(
+                {
+                    "metric": f"serve_engine.{name}.{key}{suffix}",
+                    "value": value,
+                    "unit": "count",
+                    "kind": "bench",
+                }
+            )
+    return rows
+
+
 def load_bench_records(lines) -> Tuple[Dict[str, dict], Dict[str, dict]]:
     """(measured, unmeasured) bench rows keyed by metric label. Repeated
     measured rows keep EVERY value (collapsed to best at compare time);
     shell noise and non-bench kinds are skipped like the linter skips
     them. Legacy `value: 0.0` rows carrying an `error` field are the
-    round-5 dead zeros — classified unmeasured, never ingested."""
+    round-5 dead zeros — classified unmeasured, never ingested. Serve
+    SUMMARY records contribute their per-engine nest as synthetic
+    `serve_engine.*` rows (flatten_engine_metrics), so a fan-out
+    regression confined to one engine still gates."""
     measured: Dict[str, dict] = {}
     unmeasured: Dict[str, dict] = {}
-    for _, rec in schema.iter_json_lines(lines):
+
+    def ingest(rec: dict) -> None:
         metric = rec.get("metric")
         if not isinstance(metric, str):
-            continue
+            return
         kind = rec.get("kind", schema.infer_kind(rec))
         if kind not in ("bench", "error"):
-            continue
+            return
         dead_zero = rec.get("value") in (0, 0.0) and "error" in rec
         if _is_measured(rec) and not dead_zero:
             slot = measured.setdefault(metric, {"rec": rec, "values": []})
             slot["values"].append(float(rec["value"]))
         else:
             unmeasured[metric] = rec
+
+    for _, rec in schema.iter_json_lines(lines):
+        if rec.get("kind") == "serve" and rec.get("event") == "summary":
+            for row in flatten_engine_metrics(rec):
+                ingest(row)
+            continue
+        ingest(rec)
     return measured, unmeasured
 
 

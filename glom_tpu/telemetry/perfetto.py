@@ -42,27 +42,62 @@ from glom_tpu.telemetry import schema
 
 _PID = 1
 # Track (tid) layout: real spans nest by depth on low tids; one-off
-# instants and counters get stable named tracks via process_labels.
+# instants and counters get stable named tracks via process_labels;
+# barrier events (pod coordination, resilience/coordinator.py) get one
+# track PER HOST so a round's propose->commit->saved->complete chain
+# reads as flow arrows crossing the hosts instead of a pile of instants.
 _TID_SPANS = 1
 _TID_EVENTS = 90
 _TID_ROLLUPS = 91
+_TID_BARRIER_BASE = 100
 
 
-def _timestamp_s(rec: dict, fallback: float) -> float:
+CLOCK_KEYS = ("t_start", "wall_time_s", "wall_time", "t")
+# Above this, a clock value is an epoch (time.time()) reading; below, a
+# run-relative one. One definition — the pod aggregator
+# (telemetry/aggregate.py) reuses both constants for its cross-host
+# clock-family reconciliation.
+EPOCH_CUTOFF_S = 1e9
+
+
+def timestamp_s(rec: dict, fallback: float) -> float:
     """Best available clock for one record, in (heterogeneous) seconds.
     Epoch clocks dwarf run-relative ones; normalization happens per clock
     family in to_trace_events, so mixed streams still order sensibly."""
-    for key in ("t_start", "wall_time_s", "wall_time", "t"):
+    for key in CLOCK_KEYS:
         v = rec.get(key)
         if isinstance(v, (int, float)) and not isinstance(v, bool):
             return float(v)
     return fallback
 
 
+_timestamp_s = timestamp_s  # original private name, kept for callers
+
+
+# One vocabulary for "which traces does this record belong to": the flow
+# links must never diverge from the trees the trace CLI reconstructs.
+from glom_tpu.telemetry.tracectx import _trace_ids_of  # noqa: E402
+
+
 def to_trace_events(records: Iterable[dict]) -> List[dict]:
     """Chrome trace-event dicts (ts/dur in microseconds) from stamped
-    telemetry records, chronologically normalized to start at ~0."""
+    telemetry records, chronologically normalized to start at ~0.
+
+    Two flow-event families link related instants with arrows:
+
+      * request traces — serve records carrying v6 trace context chain
+        per trace_id (ph "s" at the first sighting, "t" per hop, "f" at
+        the resolve/response leaf), so selecting one dispatch in the UI
+        lights up the whole request across engines and hops;
+      * barrier rounds — "barrier" records land on per-host tracks
+        (thread_name metadata names them) and chain per round id, so a
+        pod save barrier's propose->commit->saved->complete reads as
+        arrows crossing the host tracks.
+    """
     raw: List[dict] = []
+    flow_seen: dict = {}  # barrier flow id -> "open"
+    trace_flows: dict = {}  # trace_id -> [(ts, is_leaf), ...]
+    barrier_tracks: dict = {}  # tid -> track label
     for i, rec in enumerate(records):
         kind = rec.get("kind", schema.infer_kind(rec))
         fallback = i * 1e-3  # 1ms spacing keeps clockless records ordered
@@ -119,6 +154,42 @@ def to_trace_events(records: Iterable[dict]) -> List[dict]:
                     "args": rec,
                 }
             )
+        elif kind == "barrier":
+            # One track per host: a pod round's phases land side by side
+            # instead of interleaved on the shared events track, and the
+            # per-round flow arrows below make the chain's ORDER visible.
+            host = rec.get("host")
+            if isinstance(host, int) and not isinstance(host, bool):
+                tid = _TID_BARRIER_BASE + host
+                barrier_tracks[tid] = f"barrier host {host}"
+            else:
+                tid = _TID_EVENTS
+            raw.append(
+                {
+                    "name": f"barrier:{rec.get('phase', '?')}",
+                    "ph": "i",
+                    "s": "t",
+                    "pid": _PID,
+                    "tid": tid,
+                    "ts": ts,
+                    "args": rec,
+                }
+            )
+            rnd = rec.get("round")
+            if isinstance(rnd, str):
+                fid = f"barrier:{rnd}"
+                raw.append(
+                    {
+                        "name": fid,
+                        "cat": "barrier",
+                        "ph": "s" if fid not in flow_seen else "t",
+                        "id": fid,
+                        "pid": _PID,
+                        "tid": tid,
+                        "ts": ts,
+                    }
+                )
+                flow_seen[fid] = "open"
         else:
             label = {
                 "train_step": f"step {rec.get('step', '?')}",
@@ -127,7 +198,6 @@ def to_trace_events(records: Iterable[dict]) -> List[dict]:
                 "error": f"error: {rec.get('error', '?')}",
                 "serve": f"serve:{rec.get('event', '?')}",
                 "recovery": f"recovery:{rec.get('action', '?')}",
-                "barrier": f"barrier:{rec.get('phase', '?')}",
             }.get(kind, kind)
             raw.append(
                 {
@@ -140,21 +210,78 @@ def to_trace_events(records: Iterable[dict]) -> List[dict]:
                     "args": rec,
                 }
             )
+            if kind in ("serve", "recovery", "span"):
+                # Collect this record into each request trace it belongs
+                # to (schema v6 trace context); phases are assigned after
+                # the walk, in TIMESTAMP order — the batcher emits a
+                # hop's resolve leaf BEFORE the hop's dispatch record, so
+                # assigning phases in stream order would start the flow
+                # at the leaf (never closing it) or close it early and
+                # drop the final hop.
+                leaf = rec.get("event") in ("resolve", "response")
+                for trace_id in _trace_ids_of(rec):
+                    trace_flows.setdefault(trace_id, []).append((ts, leaf))
+    # Flow-link each trace's records in CAUSAL order — hop records
+    # (dispatch/continuation/...) by timestamp, then the leaves
+    # (resolve/response): one "s" at the first hop, "t" per further hop,
+    # one "f" at the first leaf. Neither stream order nor pure ts order
+    # is causal here: the batcher stamps a hop's resolve leaf BEFORE the
+    # hop's own dispatch record (and the dispatch record's clock reads
+    # LATER), so either walk would start the flow at the leaf, or close
+    # it early and skip the final hop. Records after the finish are not
+    # flow-linked (a second leaf, e.g. the CLI response after the
+    # batcher's resolve, would close an already-terminated flow, which
+    # the importer drops); flow ts is clamped monotone so the closing
+    # arrow never points backward across the ms-scale stamp skew.
+    for trace_id, cands in trace_flows.items():
+        cands.sort(key=lambda c: (c[1], c[0]))
+        prev_ts = None
+        for i, (cts, leaf) in enumerate(cands):
+            ph = "s" if i == 0 else ("f" if leaf else "t")
+            if prev_ts is not None:
+                cts = max(cts, prev_ts)
+            prev_ts = cts
+            raw.append(
+                {
+                    "name": f"trace:{trace_id[:8]}",
+                    "cat": "trace",
+                    "ph": ph,
+                    **({"bp": "e"} if ph == "f" else {}),
+                    "id": f"trace:{trace_id}",
+                    "pid": _PID,
+                    "tid": _TID_EVENTS,
+                    "ts": cts,
+                }
+            )
+            if ph == "f":
+                break
     if not raw:
         return []
-    # Normalize per clock family: epoch-clock events (> ~1e9 s) and
-    # run-relative ones each shift to their own zero, so a stream mixing
-    # both still renders compactly instead of 50 years wide.
-    epochs = [e["ts"] for e in raw if e["ts"] > 1e9]
-    relatives = [e["ts"] for e in raw if e["ts"] <= 1e9]
+    # Normalize per clock family: epoch-clock events (> EPOCH_CUTOFF_S)
+    # and run-relative ones each shift to their own zero, so a stream
+    # mixing both still renders compactly instead of 50 years wide. Flow
+    # events copied their anchor instant's ts, so they stay in family.
+    epochs = [e["ts"] for e in raw if e["ts"] > EPOCH_CUTOFF_S]
+    relatives = [e["ts"] for e in raw if e["ts"] <= EPOCH_CUTOFF_S]
     e0 = min(epochs) if epochs else 0.0
     r0 = min(relatives) if relatives else 0.0
     for e in raw:
-        base = e0 if e["ts"] > 1e9 else r0
+        base = e0 if e["ts"] > EPOCH_CUTOFF_S else r0
         e["ts"] = round((e["ts"] - base) * 1e6, 3)
         if "dur" in e:
             e["dur"] = round(e["dur"], 3)
     raw.sort(key=lambda e: e["ts"])
+    # Name the per-host barrier tracks (metadata events; ts-less).
+    for tid, label in sorted(barrier_tracks.items()):
+        raw.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": _PID,
+                "tid": tid,
+                "args": {"name": label},
+            }
+        )
     return raw
 
 

@@ -37,7 +37,14 @@ from typing import Iterable, List, Optional, Tuple
 # propose/commit/saved/complete/abort, a gang-restart rendezvous — so a
 # multi-process chaos run can reconcile every host's view of the SAME
 # round from the per-host evidence streams alone).
-SCHEMA_VERSION = 5
+# v6 added request-scoped TRACE CONTEXT (telemetry/tracectx.py): serve
+# records of request-scoped events carry trace_id/span_id/parent_span
+# (batch-level records the parallel trace_ids/parent_spans lists), the
+# new "resolve" serve event is the per-request conservation leaf, and
+# the new "slo_breach" kind is one windowed SLO-rule violation from the
+# live monitor (`python -m glom_tpu.telemetry watch`,
+# telemetry/aggregate.py).
+SCHEMA_VERSION = 6
 
 _NUM = (int, float)
 _STR = (str,)
@@ -91,7 +98,29 @@ KINDS = {
     # masquerade as complete), "arrive" (gang-restart rendezvous).
     # `round` identifies the round; host/n_hosts/step ride per phase.
     "barrier": {"phase": _STR, "round": _STR},
+    # One windowed SLO-rule violation from the live monitor
+    # (telemetry/aggregate.py, `python -m glom_tpu.telemetry watch`):
+    # `rule` names the violated rule ("p99_ms", "shed_rate", ...);
+    # threshold/observed/window_s/n_samples ride per breach. The flight
+    # recorder counts these toward its anomaly-storm trigger.
+    "slo_breach": {"rule": _STR},
 }
+
+# Serve events that are REQUEST-scoped and must carry trace context on
+# schema-v6 records (telemetry/tracectx.py mints and reconstructs it; the
+# key may be null — an explicitly UNTRACED record, ServeConfig.
+# trace_requests=False — but it must be PRESENT, so an emit site that
+# forgot the threading is a lint failure, not a silent gap in the tree).
+TRACE_REQUIRED_EVENTS = (
+    "dispatch",
+    "continuation",
+    "shed",
+    "resolve",
+    "engine_failover",
+    "dispatch_error",
+    "response",
+)
+_TRACE_KEYS = ("trace_id", "trace_ids")
 
 WATCHDOG_STATES = ("unknown", "up", "down", "flapping")
 
@@ -164,6 +193,22 @@ def validate_record(rec: object) -> List[str]:
         errs.append(
             f"watchdog.backend_state {rec.get('backend_state')!r} not one "
             f"of {WATCHDOG_STATES}"
+        )
+    if (
+        kind == "serve"
+        and isinstance(v, int)
+        and v >= 6
+        and rec.get("event") in TRACE_REQUIRED_EVENTS
+        and not any(k in rec for k in _TRACE_KEYS)
+    ):
+        # v6's request-tracing contract: request-scoped serve events must
+        # carry trace context (null = explicitly untraced is fine; an
+        # ABSENT key means an emit site never threaded the context and
+        # this record can never join its request's tree).
+        errs.append(
+            f"serve.{rec.get('event')} record (v{v}) carries no trace "
+            f"context key ({'/'.join(_TRACE_KEYS)}) — see "
+            "telemetry/tracectx.py"
         )
     try:
         json.dumps(rec)

@@ -36,6 +36,16 @@ from typing import List, Optional
 from glom_tpu.telemetry import schema, watchdog
 
 
+def nearest_rank(sorted_samples: List[float], q: float) -> float:
+    """Nearest-rank quantile over pre-sorted samples — THE 'p99'
+    definition for the whole stack (per-host step histograms here, pod
+    rollups in telemetry/aggregate.py), so the two never drift apart."""
+    if not sorted_samples:
+        return 0.0
+    idx = min(len(sorted_samples) - 1, int(q * (len(sorted_samples) - 1) + 0.5))
+    return sorted_samples[idx]
+
+
 class StepTimeStats:
     """Streaming per-step wall-time stats with compile split out.
 
@@ -71,12 +81,7 @@ class StepTimeStats:
             self._max = max(self._max, 2 * len(self._samples))
             self._samples.append(dt_s)
 
-    @staticmethod
-    def _quantile(sorted_samples: List[float], q: float) -> float:
-        if not sorted_samples:
-            return 0.0
-        idx = min(len(sorted_samples) - 1, int(q * (len(sorted_samples) - 1) + 0.5))
-        return sorted_samples[idx]
+    _quantile = staticmethod(nearest_rank)
 
     def summary(self) -> dict:
         """The stamped histogram fields (milliseconds; compile in s)."""

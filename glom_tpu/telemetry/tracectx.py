@@ -1,0 +1,346 @@
+"""Request-scoped distributed tracing: every request is one causal tree.
+
+PRs 7-9 made a request's life distributed: admission -> bucket ->
+dispatch -> continuation hops -> failover requeue -> cache writeback,
+possibly across engines and (via failover) across dispatch records that
+never knew each other. Each hop already stamps a schema record, but no
+stamped event could be joined back to the REQUEST that caused it — a
+slow p99 was visible, its cause was not. This module is the Dapper-style
+fix: `DynamicBatcher.submit` mints a `trace_id` (the request) and a root
+`span_id` (the submit), every downstream record carries
+`trace_id`/`span_id`/`parent_span` (batch-level records carry the
+parallel `trace_ids`/`parent_spans` lists — one dispatch serves many
+traces), and this module reconstructs the tree:
+
+    python -m glom_tpu.telemetry trace FILE... --trace-id X
+
+prints the causal tree for one request and checks CONSERVATION — the
+paper's cost unit is per-request EXECUTED WORK, so the summed per-hop
+executed iterations and dispatch wall spans of the tree must exactly
+equal the totals the ticket resolved with (the stamped "resolve" leaf).
+A tree that doesn't conserve means a hop's evidence is missing or
+double-counted — exit 1, like the schema linter.
+
+Propagation inside the serving process is a thread-local DISPATCH SCOPE:
+the batcher worker opens `dispatch_scope(...)` around one dispatch, and
+every serve/recovery/span sink that emits from under it (retry events,
+cache evictions, lazy warmup compiles, host spans) inherits the trace
+fields without signature changes — `current_fields()` merges at the
+stamp sites (serve/events.stamp_serve, resilience/faults.emit_recovery,
+tracing/spans.span).
+
+Pure stdlib, like the rest of the telemetry surface: the trace CLI must
+run against a crashed run's dumps in a jax-broken environment.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Iterable, List, Optional
+
+# The trace-context vocabulary (schema v6). Per-request records carry the
+# singular keys; batch-level records (a dispatch serves many traces) carry
+# the parallel plural lists, row-aligned with the batch. The set of serve
+# events REQUIRED to carry them is schema.TRACE_REQUIRED_EVENTS — the
+# schema registry owns the contract, this module owns the mechanics.
+TRACE_FIELDS = ("trace_id", "span_id", "parent_span")
+TRACE_BATCH_FIELDS = ("trace_ids", "span_id", "parent_spans")
+
+
+def new_id(nbytes: int = 8) -> str:
+    """A fresh random hex id (16 hex chars by default — trace and span
+    ids share the format; collision across one deployment's traces is
+    negligible at 64 bits)."""
+    return os.urandom(nbytes).hex()
+
+
+new_trace_id = new_id
+new_span_id = new_id
+
+
+# -- thread-local dispatch scope --------------------------------------------
+
+_local = threading.local()
+
+
+class dispatch_scope:
+    """Context manager marking THIS thread as executing one dispatch.
+
+    Every record stamped from inside (retry recovery events, cache
+    evictions, a lazy mid-traffic warmup compile, host spans with a
+    writer) inherits the scope's trace fields via `current_fields()` —
+    the in-process analog of trace-context propagation, with no
+    signature changes through the engine/retry/cache layers."""
+
+    def __init__(self, span_id, trace_ids, parent_spans=None):
+        self._fields = {"span_id": span_id, "trace_ids": trace_ids}
+        if parent_spans is not None:
+            self._fields["parent_spans"] = parent_spans
+
+    def __enter__(self):
+        stack = getattr(_local, "scopes", None)
+        if stack is None:
+            stack = _local.scopes = []
+        stack.append(self._fields)
+        return self
+
+    def __exit__(self, *exc):
+        _local.scopes.pop()
+
+
+def current_fields() -> dict:
+    """The innermost open dispatch scope's trace fields on this thread
+    ({} outside any scope). Stamp sites merge these with setdefault, so
+    explicitly-carried fields always win."""
+    stack = getattr(_local, "scopes", None)
+    if not stack:
+        return {}
+    return dict(stack[-1])
+
+
+# -- tree reconstruction ----------------------------------------------------
+
+
+def _trace_ids_of(rec: dict) -> List[str]:
+    """Every trace id one record belongs to (singular or batch form)."""
+    out = []
+    t = rec.get("trace_id")
+    if isinstance(t, str):
+        out.append(t)
+    ts = rec.get("trace_ids")
+    if isinstance(ts, (list, tuple)):
+        out.extend(x for x in ts if isinstance(x, str))
+    return out
+
+
+def records_for(records: Iterable[dict], trace_id: str) -> List[dict]:
+    """The subset of `records` belonging to one trace, in stream order."""
+    return [r for r in records if trace_id in _trace_ids_of(r)]
+
+
+def _parent_for(rec: dict, trace_id: str) -> Optional[str]:
+    """This record's parent span AS SEEN BY one trace: the singular
+    `parent_span`, or the row-aligned entry of `parent_spans`."""
+    p = rec.get("parent_span")
+    if isinstance(p, str):
+        return p
+    parents = rec.get("parent_spans")
+    traces = rec.get("trace_ids")
+    if isinstance(parents, (list, tuple)) and isinstance(traces, (list, tuple)):
+        for t, pp in zip(traces, parents):
+            if t == trace_id and isinstance(pp, str):
+                return pp
+    return None
+
+
+def list_traces(records: Iterable[dict]) -> Dict[str, dict]:
+    """trace_id -> {n_records, n_hops, resolved, iters_total} for every
+    trace seen in the stream (the `trace` subcommand's no-id listing)."""
+    out: Dict[str, dict] = {}
+    for rec in records:
+        for t in _trace_ids_of(rec):
+            slot = out.setdefault(
+                t,
+                {"n_records": 0, "n_hops": 0, "resolved": False,
+                 "iters_total": None},
+            )
+            slot["n_records"] += 1
+            if rec.get("event") == "dispatch":
+                slot["n_hops"] += 1
+            if rec.get("event") == "resolve":
+                slot["resolved"] = True
+                slot["iters_total"] = rec.get("iters_total")
+    return out
+
+
+def build_tree(records: Iterable[dict], trace_id: str) -> dict:
+    """One trace's causal tree.
+
+    Nodes are SPANS: records sharing a span_id (a dispatch plus the retry
+    / cache / warmup events stamped under its scope) collapse into one
+    node carrying them all; edges follow each record's parent span as
+    seen by this trace. Parents that no record owns roll up to the
+    synthesized root (the submit span the batcher minted — submit itself
+    emits no record on the happy path). Returns
+    {"trace_id", "root": node} with node = {"span_id", "records",
+    "children": [node...]}."""
+    mine = records_for(records, trace_id)
+    nodes: Dict[str, dict] = {}
+    order: List[str] = []
+    parent_of: Dict[str, Optional[str]] = {}
+    for rec in mine:
+        span = rec.get("span_id")
+        if not isinstance(span, str):
+            # A trace-stamped record with no span of its own (e.g. a
+            # legacy sink): attach it to the root.
+            span = f"<anonymous:{len(nodes)}>"
+        node = nodes.get(span)
+        if node is None:
+            node = nodes[span] = {
+                "span_id": span, "records": [], "children": [],
+            }
+            order.append(span)
+        node["records"].append(rec)
+        if span not in parent_of:
+            parent_of[span] = _parent_for(rec, trace_id)
+    root = {"span_id": None, "records": [], "children": []}
+    for span in order:
+        parent = parent_of.get(span)
+        if parent is not None and parent in nodes:
+            nodes[parent]["children"].append(nodes[span])
+        else:
+            if root["span_id"] is None and parent is not None:
+                root["span_id"] = parent  # the minted submit span
+            root["children"].append(nodes[span])
+    return {"trace_id": trace_id, "root": root}
+
+
+def conservation(records: Iterable[dict], trace_id: str) -> dict:
+    """The trace-parity check: per-request executed work must CONSERVE
+    across hops. Sums `iters_run` and `latency_ms` over the trace's
+    dispatch hops and compares them against the stamped "resolve" leaf's
+    `iters_total` / `dispatch_ms_total` (what the ticket resolved with).
+    ok=True requires a resolve record and EXACT equality — a missing hop
+    or a double-counted one cannot conserve."""
+    mine = records_for(records, trace_id)
+    hops = [r for r in mine if r.get("event") == "dispatch"]
+    resolves = [r for r in mine if r.get("event") == "resolve"]
+    hop_iters = sum(
+        r["iters_run"] for r in hops
+        if isinstance(r.get("iters_run"), (int, float))
+    )
+    hop_ms = sum(
+        r["latency_ms"] for r in hops
+        if isinstance(r.get("latency_ms"), (int, float))
+    )
+    out = {
+        "trace_id": trace_id,
+        "n_hops": len(hops),
+        "hop_iters": hop_iters,
+        "hop_dispatch_ms": hop_ms,
+        "resolved": bool(resolves),
+        "ok": False,
+    }
+    if not resolves:
+        out["why"] = "no resolve record (request never resolved, or its leaf is missing from the stream)"
+        return out
+    leaf = resolves[-1]
+    out["iters_total"] = leaf.get("iters_total")
+    out["dispatch_ms_total"] = leaf.get("dispatch_ms_total")
+    iters_ok = leaf.get("iters_total") == hop_iters
+    # Wall spans: the resolve leaf accumulated the SAME rounded per-hop
+    # latency_ms values the dispatch records carry, in the same order —
+    # equality here is exact, not approximate.
+    ms_ok = leaf.get("dispatch_ms_total") == hop_ms
+    out["ok"] = iters_ok and ms_ok
+    if not iters_ok:
+        out["why"] = (
+            f"iters do not conserve: hops sum {hop_iters}, resolve leaf "
+            f"says {leaf.get('iters_total')}"
+        )
+    elif not ms_ok:
+        out["why"] = (
+            f"wall spans do not conserve: hops sum {hop_ms}, resolve "
+            f"leaf says {leaf.get('dispatch_ms_total')}"
+        )
+    return out
+
+
+def _node_label(node: dict) -> str:
+    recs = node["records"]
+    if not recs:
+        return "(submit)"
+    head = recs[0]
+    event = head.get("event") or head.get("kind") or "?"
+    bits = [str(event)]
+    if head.get("engine"):
+        bits.append(str(head["engine"]))
+    if isinstance(head.get("iters_run"), (int, float)):
+        bits.append(f"iters={head['iters_run']}")
+    if isinstance(head.get("iters_total"), (int, float)):
+        bits.append(f"iters_total={head['iters_total']}")
+    if isinstance(head.get("latency_ms"), (int, float)):
+        bits.append(f"{head['latency_ms']}ms")
+    if len(recs) > 1:
+        bits.append(f"+{len(recs) - 1} attached")
+    return " ".join(bits)
+
+
+def render_tree(tree: dict) -> List[str]:
+    """Human-readable indented lines for one trace tree."""
+    lines = [f"trace {tree['trace_id']}"]
+
+    def walk(node, depth):
+        lines.append("  " * depth + "- " + _node_label(node))
+        for child in node["children"]:
+            walk(child, depth + 1)
+
+    for child in tree["root"]["children"]:
+        walk(child, 1)
+    return lines
+
+
+# -- CLI --------------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import json
+    import sys
+
+    from glom_tpu.telemetry import schema
+
+    ap = argparse.ArgumentParser(
+        prog="python -m glom_tpu.telemetry trace",
+        description="Reconstruct one request's causal tree from stamped "
+        "JSONL and verify per-hop executed-work conservation "
+        "(docs/OBSERVABILITY.md, Request tracing)",
+    )
+    ap.add_argument("paths", nargs="+", help="JSONL logs / flight dumps")
+    ap.add_argument(
+        "--trace-id", default=None,
+        help="the trace to reconstruct; omit to list every trace seen",
+    )
+    args = ap.parse_args(argv)
+    records: List[dict] = []
+    for path in args.paths:
+        with open(path) as fh:
+            records.extend(rec for _, rec in schema.iter_json_lines(fh))
+    if args.trace_id is None:
+        traces = list_traces(records)
+        if not traces:
+            print("no trace-stamped records found", file=sys.stderr)
+            return 1
+        for t, info in sorted(traces.items()):
+            status = "resolved" if info["resolved"] else "OPEN"
+            print(
+                f"{t}  {info['n_hops']} hops  {info['n_records']} records"
+                f"  {status}"
+                + (
+                    f"  iters_total={info['iters_total']}"
+                    if info["iters_total"] is not None
+                    else ""
+                )
+            )
+        return 0
+    tree = build_tree(records, args.trace_id)
+    if not tree["root"]["children"]:
+        print(f"no records for trace {args.trace_id}", file=sys.stderr)
+        return 1
+    for line in render_tree(tree):
+        print(line)
+    check = conservation(records, args.trace_id)
+    print(json.dumps(schema.stamp(dict(check, summary=True), kind="summary")))
+    if not check["ok"]:
+        print(
+            f"CONSERVATION FAILED: {check.get('why', '?')}", file=sys.stderr
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -86,7 +86,11 @@ class FlightRecorder:
                 kind = rec.get("kind")
                 if kind == "watchdog" and rec.get("backend_state") == "down":
                     trigger = "backend-down"
-                elif kind == "anomaly":
+                elif kind in ("anomaly", "slo_breach"):
+                    # SLO breaches (telemetry/aggregate.SLOMonitor) count
+                    # toward the same storm trigger as NaN anomalies: a
+                    # burst of breaches is a serving incident, and the
+                    # ring should dump itself while the evidence is hot.
                     now = self._clock()
                     self._anomaly_times.append(now)
                     while (

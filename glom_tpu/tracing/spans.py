@@ -158,7 +158,7 @@ def span(
         if aggregator is not None:
             aggregator.observe(name, dur)
         if writer is not None:
-            from glom_tpu.telemetry import schema
+            from glom_tpu.telemetry import schema, tracectx
 
             rec = {
                 "name": name,
@@ -169,6 +169,11 @@ def span(
             if parent is not None:
                 rec["parent"] = parent
             rec.update(fields)
+            # A span closed under a serve dispatch scope carries that
+            # dispatch's trace context — host time joins the request's
+            # causal tree like every other stamped record.
+            if not any(k in rec for k in ("trace_id", "trace_ids")):
+                rec.update(tracectx.current_fields())
             writer.write(schema.stamp(rec, kind="span"))
 
 

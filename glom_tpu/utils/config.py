@@ -188,6 +188,15 @@ class ServeConfig:
     # pre-PR 8 contract). rejoin_interval_ms paces the probation probes.
     rejoin_threshold: int = 0
     rejoin_interval_ms: float = 200.0
+    # Request-scoped tracing (telemetry/tracectx.py, docs/OBSERVABILITY.md
+    # "Request tracing"): submit() mints a trace_id/span_id per request
+    # and every downstream serve record (dispatch, continuation, shed,
+    # failover, retry, cache, resolve) carries the context, so
+    # `python -m glom_tpu.telemetry trace` reconstructs the causal tree.
+    # Default ON — the measured overhead bar is <2% at full stamping
+    # (`bench_serve.py --trace-ab`). False stamps the context keys as
+    # null (explicitly untraced — the schema still lints).
+    trace_requests: bool = True
 
     def __post_init__(self):
         if not self.buckets:

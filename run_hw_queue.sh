@@ -151,6 +151,30 @@ step bench_serve_sharded 2400 python -u bench_serve.py --mesh-data 4
 #     here, unlike the CPU smoke). Baselined via step 11b.
 step bench_serve_temporal 2400 python -u bench_serve.py --temporal --streams 8 --frames 6
 
+# 9g. Request-tracing overhead gate + pod aggregation (this round's
+#     tentpole, docs/OBSERVABILITY.md): full trace stamping (ids minted
+#     per submit, per-dispatch scope, per-request resolve leaves) must
+#     cost < 2% end-to-end latency on real hardware — the A/B emits
+#     serve_trace_overhead in percent and the gate reads it back. Then
+#     the preempt-pod gate's per-host streams (step 9d--) must merge
+#     into ONE consistent pod timeline: clock families reconciled via
+#     the anchor records, barrier chains complete, --strict gating.
+step bench_serve_trace_ab 2400 python -u bench_serve.py --trace-ab
+step trace_overhead_gate 120 python - results/hw_queue/bench_serve_trace_ab.log <<'EOF'
+import sys
+from glom_tpu.telemetry import schema  # noise-tolerant line reader
+rows = [r for _, r in schema.iter_json_lines(open(sys.argv[1]))]
+ov = [r for r in rows if r.get("metric", "").startswith("serve_trace_overhead")]
+assert ov, "no serve_trace_overhead row in the trace A/B log"
+v = ov[-1]["value"]
+assert isinstance(v, (int, float)), f"trace overhead UNMEASURED: {ov[-1]}"
+assert v <= 2.0, f"trace overhead {v}% exceeds the 2% stamping budget"
+print(f"OK: trace stamping overhead {v}% within the 2% budget")
+EOF
+step pod_aggregate 300 python -m glom_tpu.telemetry aggregate \
+    results/hw_queue/chaos_pod/metrics_h0.jsonl \
+    results/hw_queue/chaos_pod/metrics_h1.jsonl --strict --timeline 20
+
 # 10. Schema lint: every JSON row this queue produced must validate
 #     against the versioned event schema (glom_tpu/telemetry/schema.py).
 #     Shell noise in the logs is skipped; --allow-unstamped because the

@@ -1,0 +1,57 @@
+"""schema-emit trace-context regression fixture: one serve emit site that
+forgot the v6 trace context, next to its correctly-stamped twins.
+
+tests/test_analysis.py runs glom-lint's schema-emit checker over this
+file and asserts the bare `dispatch` emit in `bad_dispatch_emit` is
+flagged (key "trace-context", file:line) while the three good shapes —
+an explicit trace_id (even null: explicitly untraced lints), the batch
+`trace_ids` form, and a `**fields` splat that may carry the context —
+stay clean. NOT importable production code: it exists to be linted.
+"""
+
+
+def emit_serve(writer, rec, kind="serve"):  # the emitter family's shape
+    return rec
+
+
+def bad_dispatch_emit(writer):
+    # BUG: a request-scoped serve event with no trace context key — the
+    # records this site writes can never join their request's tree, and
+    # the runtime linter rejects every one of them.
+    emit_serve(
+        writer,
+        {"event": "dispatch", "engine": "engine0", "latency_ms": 1.0},
+    )
+
+
+def good_singular_emit(writer, ticket):
+    emit_serve(
+        writer,
+        {
+            "event": "resolve",
+            "iters_total": 6,
+            "trace_id": ticket.trace_id,  # null when untraced — still fine
+        },
+    )
+
+
+def good_batch_emit(writer, batch):
+    emit_serve(
+        writer,
+        {
+            "event": "continuation",
+            "n_stragglers": len(batch),
+            "trace_ids": [it.trace_id for it in batch],
+        },
+    )
+
+
+def good_splat_emit(writer, fields):
+    # A **splat may carry the context (the batcher's tfields pattern);
+    # the static rule defers to the runtime linter here.
+    emit_serve(writer, {"event": "shed", "reason": "queue-full", **fields})
+
+
+def good_unscoped_emit(writer):
+    # Not a request-scoped event: no trace context required.
+    emit_serve(writer, {"event": "warmup", "bucket": 4})

@@ -1,0 +1,605 @@
+"""Pod-scale aggregation + SLO monitor (glom_tpu/telemetry/aggregate.py):
+clock-family reconciliation across hosts, rollups, barrier-chain checks,
+the windowed SLO rules, and both CLIs. Pure host-side, no jax."""
+
+import json
+
+import pytest
+
+from glom_tpu.telemetry import schema
+from glom_tpu.telemetry.aggregate import (
+    BARRIER_CHAIN,
+    SLOMonitor,
+    aggregate_main,
+    check_barrier_chains,
+    expand_paths,
+    load_host_records,
+    merge_timeline,
+    parse_slo,
+    percentile,
+    rollup,
+    watch_main,
+)
+
+EPOCH = 1.75e9  # a plausible time.time() reading
+
+
+def dispatch(engine="engine0", bucket=4, latency_ms=5.0, t=None, **extra):
+    rec = {"event": "dispatch", "engine": engine, "bucket": bucket,
+           "n_valid": 3, "latency_ms": latency_ms, "iters_run": 6,
+           "trace_ids": None, **extra}
+    if t is not None:
+        rec["wall_time_s"] = t
+    return schema.stamp(rec, kind="serve")
+
+
+def resolve(latency_ms=8.0, iters=6, trace_id=None, t=None, **extra):
+    rec = {"event": "resolve", "engine": "engine0", "iters_total": iters,
+           "dispatch_ms_total": 5.0, "latency_ms": latency_ms,
+           "trace_id": trace_id, **extra}
+    if t is not None:
+        rec["wall_time_s"] = t
+    return schema.stamp(rec, kind="serve")
+
+
+def barrier(phase, host, step=3, rnd="r1", t=None):
+    rec = {"phase": phase, "round": rnd, "host": host, "step": step}
+    if t is not None:
+        rec["wall_time_s"] = t
+    return schema.stamp(rec, kind="barrier")
+
+
+def train_step(step, wall_time, wall_time_s=None):
+    rec = {"step": step, "loss": 1.0, "wall_time": wall_time}
+    if wall_time_s is not None:
+        rec["wall_time_s"] = wall_time_s
+    return schema.stamp(rec, kind="train_step")
+
+
+def write_stream(path, recs):
+    with open(path, "w") as fh:
+        fh.write("shell noise line\n")
+        for r in recs:
+            fh.write(json.dumps(r) + "\n")
+    return path
+
+
+class TestPercentile:
+    def test_nearest_rank(self):
+        vals = [float(i) for i in range(1, 101)]
+        assert percentile(vals, 0.5) == 51.0
+        assert percentile(vals, 0.99) == 99.0
+        assert percentile([], 0.5) == 0.0
+
+
+class TestExpandPaths:
+    def test_dirs_expand_and_stems_label(self, tmp_path):
+        (tmp_path / "metrics_h0.jsonl").write_text("")
+        (tmp_path / "metrics_h1.jsonl").write_text("")
+        (tmp_path / "noise.log").write_text("")
+        hosts = expand_paths([str(tmp_path)])
+        assert list(hosts) == ["metrics_h0", "metrics_h1"]
+
+    def test_collisions_qualify_with_parent_dir(self, tmp_path):
+        a = tmp_path / "a"
+        b = tmp_path / "b"
+        a.mkdir()
+        b.mkdir()
+        (a / "metrics.jsonl").write_text("")
+        (b / "metrics.jsonl").write_text("")
+        hosts = expand_paths([str(a / "metrics.jsonl"),
+                              str(b / "metrics.jsonl")])
+        assert set(hosts) == {"metrics", "b/metrics"}
+
+    def test_triple_collision_never_drops_a_stream(self, tmp_path):
+        """Three runX/pod/metrics_h0.jsonl: the third's parent-qualified
+        label collides with the second's — it must qualify deeper (or
+        suffix), never silently overwrite a host's stream."""
+        files = []
+        for run in ("runA", "runB", "runC"):
+            d = tmp_path / run / "pod"
+            d.mkdir(parents=True)
+            f = d / "metrics_h0.jsonl"
+            f.write_text("")
+            files.append(str(f))
+        hosts = expand_paths(files)
+        assert len(hosts) == 3
+        assert sorted(hosts.values()) == sorted(files)
+
+
+class TestMergeTimeline:
+    def test_two_anchored_hosts_interleave_on_one_axis(self):
+        # Each host: run-relative train steps + ONE anchor record carrying
+        # both families (the MetricsWriter + barrier shape).
+        hosts = {
+            "h0": [train_step(0, 1.0, EPOCH + 1.0),
+                   train_step(1, 2.0)],
+            "h1": [train_step(0, 1.0, EPOCH + 1.5),
+                   train_step(1, 2.0)],
+        }
+        merged = merge_timeline(hosts)
+        assert merged["violations"] == []
+        order = [(e["host"], e["rec"].get("step")) for e in merged["events"]]
+        assert order == [("h0", 0), ("h1", 0), ("h0", 1), ("h1", 1)]
+        assert merged["events"][0]["t"] == 0.0
+
+    def test_barrier_chain_interleaves_with_per_host_steps(self):
+        """The preempt-pod acceptance shape: per-host steps on relative
+        clocks, the barrier chain on epoch clocks, one consistent merged
+        order with zero clock-family violations."""
+        hosts = {
+            "h0": [train_step(0, 1.0, EPOCH + 1.0),
+                   barrier("propose", 0, t=EPOCH + 2.0),
+                   barrier("commit", 0, t=EPOCH + 2.2),
+                   barrier("saved", 0, t=EPOCH + 2.4),
+                   barrier("complete", 0, t=EPOCH + 2.8)],
+            "h1": [train_step(0, 1.0, EPOCH + 1.1),
+                   train_step(1, 2.1),
+                   barrier("propose", 1, t=EPOCH + 2.1),
+                   barrier("commit", 1, t=EPOCH + 2.3),
+                   barrier("saved", 1, t=EPOCH + 2.5),
+                   barrier("complete", 1, t=EPOCH + 2.8)],
+        }
+        merged = merge_timeline(hosts)
+        assert merged["violations"] == []
+        labels = [
+            (e["host"], e["rec"].get("phase") or f"step{e['rec'].get('step')}")
+            for e in merged["events"]
+        ]
+        # h1's relative-clock step 1 (wall_time 2.1 -> epoch+2.2) lands
+        # INSIDE the barrier chain — the interleaving the merge exists for.
+        assert labels.index(("h1", "step1")) > labels.index(("h0", "propose"))
+        assert labels.index(("h1", "step1")) < labels.index(("h1", "complete"))
+        phases = [p for _, p in labels if p in BARRIER_CHAIN]
+        assert phases == sorted(phases, key=list(
+            ["propose", "commit", "saved", "complete"]).index)
+
+    def test_unanchorable_family_mix_is_a_violation(self):
+        hosts = {
+            "h0": [
+                schema.stamp({"note": "rel", "wall_time": 1.0}, kind="note"),
+                schema.stamp({"note": "epoch", "wall_time_s": EPOCH},
+                             kind="note"),
+            ],
+        }
+        merged = merge_timeline(hosts)
+        assert merged["violations"] and "no anchor" in merged["violations"][0]
+
+    def test_relative_only_host_beside_epoch_host_is_flagged(self):
+        hosts = {
+            "h0": [schema.stamp({"note": "x", "wall_time_s": EPOCH},
+                                kind="note")],
+            "h1": [schema.stamp({"note": "y", "wall_time": 1.0},
+                                kind="note")],
+        }
+        merged = merge_timeline(hosts)
+        assert any("no epoch anchor" in v for v in merged["violations"])
+
+    def test_clockless_records_keep_stream_order(self):
+        hosts = {"h0": [schema.stamp({"note": f"n{i}"}, kind="note")
+                        for i in range(3)]}
+        merged = merge_timeline(hosts)
+        assert merged["violations"] == []
+        assert [e["rec"]["note"] for e in merged["events"]] == [
+            "n0", "n1", "n2"
+        ]
+
+
+class TestRollup:
+    def hosts(self):
+        return {
+            "h0": [dispatch(latency_ms=4.0), dispatch(latency_ms=6.0),
+                   resolve(latency_ms=7.0, iters=4),
+                   resolve(latency_ms=9.0, iters=8),
+                   schema.stamp({"event": "shed", "reason": "queue-full",
+                                 "trace_id": None}, kind="serve"),
+                   schema.stamp({"event": "engine_failover",
+                                 "engine": "engine0", "trace_ids": None},
+                                kind="serve"),
+                   schema.stamp({"event": "summary",
+                                 "column_cache": {"n_hits": 3, "n_misses": 1,
+                                                  "n_writes": 4,
+                                                  "n_evictions": 0}},
+                                kind="serve")],
+            "h1": [dispatch(engine="engine1", bucket=2, latency_ms=10.0),
+                   resolve(latency_ms=11.0, iters=6)],
+        }
+
+    def test_pod_rollup_counts_and_percentiles(self):
+        roll = rollup(self.hosts())
+        assert roll["n_hosts"] == 2
+        assert roll["requests"]["n_resolved"] == 3
+        assert roll["requests"]["n_shed"] == 1
+        assert roll["requests"]["shed_rate"] == 0.25
+        assert roll["latency_ms"]["dispatch"]["n"] == 3
+        assert roll["latency_ms"]["request"]["p50"] == 9.0
+        assert roll["executed_iters"]["histogram"] == {"4": 1, "8": 1, "6": 1}
+        assert roll["executed_iters"]["mean"] == 6.0
+        assert roll["per_engine"]["engine0"]["n_failovers"] == 1
+        assert roll["per_engine"]["engine1"]["n_dispatches"] == 1
+        assert roll["per_bucket"]["2"]["n_dispatches"] == 1
+        assert roll["cache"]["hit_rate"] == 0.75
+        assert roll["per_host"]["h0"]["n_shed"] == 1
+
+    def test_rollup_without_cache_or_serve_records(self):
+        roll = rollup({"h0": [train_step(0, 1.0)]})
+        assert roll["cache"] is None
+        assert roll["requests"]["shed_rate"] is None
+
+    def test_dispatch_without_latency_still_counts(self):
+        """per_engine/per_bucket dispatch counts must not depend on the
+        record carrying a numeric latency_ms — only the latency
+        histograms do."""
+        rec = dispatch()
+        del rec["latency_ms"]
+        roll = rollup({"h0": [rec]})
+        assert roll["per_host"]["h0"]["n_dispatches"] == 1
+        assert roll["per_engine"]["engine0"]["n_dispatches"] == 1
+        assert roll["per_engine"]["engine0"]["n_valid"] == 3
+        assert roll["per_bucket"]["4"]["n_dispatches"] == 1
+        assert roll["per_engine"]["engine0"]["latency_ms"]["n"] == 0
+
+    def test_untraced_stream_rolls_up_from_responses(self):
+        """trace_requests=False streams carry NO resolve leaves — the
+        shed rate and request latency must fall back to the ok
+        responses (SLOMonitor's convention), not read one shed as
+        shed_rate 1.0 over an empty latency histogram."""
+        def response(ok=True, latency_ms=10.0):
+            return schema.stamp(
+                {"event": "response", "ok": ok, "latency_ms": latency_ms,
+                 "trace_id": None},
+                kind="serve",
+            )
+        recs = [response(latency_ms=ms) for ms in (8.0, 10.0, 12.0)]
+        recs.append(response(ok=False))
+        recs.append(schema.stamp(
+            {"event": "shed", "reason": "queue-full", "trace_id": None},
+            kind="serve",
+        ))
+        roll = rollup({"h0": recs})
+        assert roll["requests"]["n_resolved"] == 0
+        assert roll["requests"]["shed_rate"] == 0.25  # 1 / (3 ok + 1 shed)
+        assert roll["latency_ms"]["request"]["n"] == 3
+        assert roll["latency_ms"]["request"]["p50"] == 10.0
+
+    def test_traced_stream_does_not_double_count_responses(self):
+        """A traced stream carries BOTH leaves per request: successes
+        must come from the resolves (max, not sum) and the latency
+        histogram from the resolve leaves alone."""
+        recs = [resolve(latency_ms=8.0, trace_id="t1"),
+                schema.stamp(
+                    {"event": "response", "ok": True, "latency_ms": 9.0,
+                     "trace_id": "t1"},
+                    kind="serve",
+                )]
+        roll = rollup({"h0": recs})
+        assert roll["requests"]["shed_rate"] == 0.0
+        assert roll["latency_ms"]["request"]["n"] == 1
+        assert roll["latency_ms"]["request"]["p50"] == 8.0
+
+
+class TestBarrierChains:
+    def complete_round(self):
+        rounds = {}
+        for phase in BARRIER_CHAIN:
+            rounds.setdefault("r1", {}).setdefault(phase, []).extend(
+                {"host": h, "step": 3} for h in ("h0", "h1")
+            )
+        return rounds
+
+    def test_complete_chain_is_clean(self):
+        assert check_barrier_chains(self.complete_round()) == []
+
+    def test_missing_phase_on_one_host_is_flagged(self):
+        rounds = self.complete_round()
+        rounds["r1"]["saved"] = [{"host": "h0", "step": 3}]
+        problems = check_barrier_chains(rounds)
+        assert problems and "saved" in problems[0]
+
+    def test_diverging_commit_steps_are_flagged(self):
+        rounds = self.complete_round()
+        rounds["r1"]["commit"][1]["step"] = 4
+        problems = check_barrier_chains(rounds)
+        assert any("DIFFERENT steps" in p for p in problems)
+
+    def test_aborted_rounds_are_not_held_to_the_chain(self):
+        rounds = {"r1": {"propose": [{"host": "h0", "step": 3}],
+                         "abort": [{"host": "h0", "step": None}]}}
+        assert check_barrier_chains(rounds) == []
+
+    def test_committed_round_missing_complete_is_flagged(self):
+        """A host dying between commit and complete (no abort stamped)
+        is the partial pod checkpoint this check exists to catch — a
+        committed round must NOT be skipped just because 'complete'
+        never arrived."""
+        rounds = self.complete_round()
+        del rounds["r1"]["complete"]
+        del rounds["r1"]["saved"]
+        problems = check_barrier_chains(rounds)
+        assert any("saved" in p for p in problems)
+        assert any("complete" in p for p in problems)
+
+    def test_uncommitted_open_round_is_not_flagged(self):
+        rounds = {"r1": {"propose": [{"host": "h0", "step": 3},
+                                     {"host": "h1", "step": 3}]}}
+        assert check_barrier_chains(rounds) == []
+
+
+class TestParseSlo:
+    def test_parses_rule_and_threshold(self):
+        assert parse_slo("p99_ms=50") == ("p99_ms", 50.0)
+        assert parse_slo("shed_rate=0.1") == ("shed_rate", 0.1)
+
+    def test_unknown_rule_and_bad_value_fail_loudly(self):
+        with pytest.raises(ValueError, match="p99_ms"):
+            parse_slo("p99=50")
+        with pytest.raises(ValueError, match="not a number"):
+            parse_slo("p99_ms=fast")
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+class Sink:
+    def __init__(self):
+        self.records = []
+
+    def write(self, rec):
+        self.records.append(rec)
+
+
+class TestSLOMonitor:
+    def test_p99_breach_emits_stamped_record(self):
+        sink = Sink()
+        mon = SLOMonitor({"p99_ms": 50.0}, writer=sink, clock=FakeClock())
+        for ms in (10.0, 20.0, 80.0):
+            mon.observe(resolve(latency_ms=ms))
+        breaches = mon.evaluate()
+        assert len(breaches) == 1
+        b = breaches[0]
+        assert b["kind"] == "slo_breach" and b["rule"] == "p99_ms"
+        assert b["observed"] == 80.0 and b["threshold"] == 50.0
+        assert "backend_state" in b  # watchdog-attributable
+        assert schema.validate_record(b) == []
+        assert sink.records == breaches  # writer-delivered
+        assert mon.n_breaches == 1
+
+    def test_within_slo_emits_nothing(self):
+        mon = SLOMonitor({"p99_ms": 50.0, "shed_rate": 0.5},
+                         clock=FakeClock())
+        mon.observe(resolve(latency_ms=10.0))
+        assert mon.evaluate() == []
+
+    def test_shed_rate_rule(self):
+        mon = SLOMonitor({"shed_rate": 0.4}, clock=FakeClock())
+        mon.observe(resolve())
+        mon.observe(schema.stamp({"event": "shed", "reason": "queue-full",
+                                  "trace_id": None}, kind="serve"))
+        (b,) = mon.evaluate()
+        assert b["rule"] == "shed_rate" and b["observed"] == 0.5
+
+    def test_trace_id_dedups_resolve_and_response(self):
+        mon = SLOMonitor({"mean_ms": 1.0}, clock=FakeClock())
+        mon.observe(resolve(latency_ms=10.0, trace_id="t1"))
+        mon.observe(schema.stamp(
+            {"event": "response", "ok": True, "latency_ms": 12.0,
+             "trace_id": "t1"}, kind="serve"))
+        assert len(mon._latency) == 1  # counted once per trace
+
+    def test_min_samples_keeps_a_thin_window_silent(self):
+        mon = SLOMonitor({"p99_ms": 1.0}, min_samples=3, clock=FakeClock())
+        mon.observe(resolve(latency_ms=50.0))
+        assert mon.evaluate() == []
+
+    def test_window_prunes_old_samples(self):
+        clock = FakeClock()
+        mon = SLOMonitor({"p99_ms": 5.0}, window_s=10.0, clock=clock)
+        mon.observe(resolve(latency_ms=100.0))
+        clock.t += 60.0
+        mon.observe(resolve(latency_ms=1.0))
+        assert mon.evaluate() == []  # the spike aged out of the window
+
+    def test_idle_stream_stops_breaching_once_the_window_empties(self):
+        """evaluate() must prune on its own clock: a live watch over a
+        stream that went IDLE after a slow burst never calls observe()
+        again, and the stale burst must not keep firing breaches every
+        interval forever."""
+        clock = FakeClock()
+        mon = SLOMonitor({"p99_ms": 5.0}, window_s=10.0, clock=clock)
+        mon.observe(resolve(latency_ms=100.0))
+        assert len(mon.evaluate()) == 1  # breach while in-window
+        clock.t += 1000.0  # traffic stops; only evaluate() keeps running
+        assert mon.evaluate() == []
+        assert mon.n_breaches == 1
+
+    def test_breaches_feed_the_flight_recorder_storm_trigger(self, tmp_path):
+        from glom_tpu.tracing.flight import (
+            FlightRecorder,
+            set_global_flight_recorder,
+        )
+
+        fr = FlightRecorder(str(tmp_path), storm_threshold=2,
+                            storm_window_s=60.0)
+        set_global_flight_recorder(fr)
+        try:
+            mon = SLOMonitor({"p99_ms": 1.0}, clock=FakeClock())
+            mon.observe(resolve(latency_ms=50.0))
+            mon.evaluate()
+            mon.evaluate()  # second breach inside the storm window
+        finally:
+            set_global_flight_recorder(None)
+        assert fr.dumps, "an SLO-breach storm must dump the ring"
+        dumped = [json.loads(l) for l in open(fr.dumps[0])
+                  if l.strip().startswith("{")]
+        assert any(r.get("kind") == "slo_breach" for r in dumped)
+
+
+class TestWatchCli:
+    def breach_stream(self, tmp_path):
+        recs = [resolve(latency_ms=100.0 + i, trace_id=None)
+                for i in range(8)]
+        recs.append(schema.stamp({"event": "shed", "reason": "queue-full",
+                                  "trace_id": None}, kind="serve"))
+        return write_stream(tmp_path / "serve.jsonl", recs)
+
+    def test_once_mode_breach_exits_nonzero_and_stamps(self, tmp_path,
+                                                       capsys):
+        self.breach_stream(tmp_path)
+        rc = watch_main([str(tmp_path), "--slo", "p99_ms=50", "--once"])
+        assert rc == 1
+        out = capsys.readouterr()
+        stamped = [json.loads(l) for l in out.out.splitlines()
+                   if l.startswith("{")]
+        assert stamped and stamped[0]["kind"] == "slo_breach"
+        assert "SLO BREACH" in out.err
+
+    def test_once_mode_within_slo_exits_zero(self, tmp_path):
+        self.breach_stream(tmp_path)
+        assert watch_main(
+            [str(tmp_path), "--slo", "p99_ms=1000", "--once"]) == 0
+
+    def test_once_mode_with_no_records_exits_two(self, tmp_path):
+        (tmp_path / "empty.jsonl").write_text("no json\n")
+        assert watch_main(
+            [str(tmp_path), "--slo", "p99_ms=10", "--once"]) == 2
+
+    def test_bad_rule_exits_two(self, tmp_path):
+        self.breach_stream(tmp_path)
+        assert watch_main([str(tmp_path), "--slo", "bogus=1", "--once"]) == 2
+
+    def test_live_mode_tails_and_exits_on_deadline(self, tmp_path):
+        self.breach_stream(tmp_path)
+        rc = watch_main([
+            str(tmp_path), "--slo", "p99_ms=50", "--window", "60",
+            "--interval", "0.05", "--max-seconds", "0.2",
+        ])
+        assert rc == 1
+
+
+class TestAggregateCli:
+    def pod(self, tmp_path):
+        h0 = [train_step(0, 1.0, EPOCH + 1.0),
+              dispatch(latency_ms=3.0, t=EPOCH + 2.0),
+              resolve(latency_ms=5.0, t=EPOCH + 2.1),
+              barrier("propose", 0, t=EPOCH + 3.0),
+              barrier("commit", 0, t=EPOCH + 3.1),
+              barrier("saved", 0, t=EPOCH + 3.2),
+              barrier("complete", 0, t=EPOCH + 3.3)]
+        h1 = [train_step(0, 1.0, EPOCH + 1.1),
+              barrier("propose", 1, t=EPOCH + 3.05),
+              barrier("commit", 1, t=EPOCH + 3.15),
+              barrier("saved", 1, t=EPOCH + 3.25),
+              barrier("complete", 1, t=EPOCH + 3.3)]
+        write_stream(tmp_path / "metrics_h0.jsonl", h0)
+        write_stream(tmp_path / "metrics_h1.jsonl", h1)
+        return tmp_path
+
+    def test_pod_rollup_summary_line(self, tmp_path, capsys):
+        rc = aggregate_main([str(self.pod(tmp_path)), "--strict"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        summary = json.loads(out.strip().splitlines()[-1])
+        assert summary["kind"] == "summary"
+        assert summary["n_violations"] == 0
+        assert summary["pod_rollup"]["n_hosts"] == 2
+        assert set(
+            summary["pod_rollup"]["timelines"]["barrier"]["r1"]
+        ) == set(BARRIER_CHAIN)
+
+    def test_strict_fails_on_broken_barrier_chain(self, tmp_path, capsys):
+        self.pod(tmp_path)
+        # host 1's "saved" never happened: a torn pod round must gate.
+        lines = (tmp_path / "metrics_h1.jsonl").read_text().splitlines()
+        (tmp_path / "metrics_h1.jsonl").write_text(
+            "\n".join(l for l in lines if '"saved"' not in l) + "\n"
+        )
+        assert aggregate_main([str(tmp_path), "--strict"]) == 1
+        assert "saved" in capsys.readouterr().err
+
+    def test_out_writes_rollup_file(self, tmp_path, capsys):
+        out = tmp_path / "rollup.json"
+        assert aggregate_main(
+            [str(self.pod(tmp_path)), "--out", str(out)]) == 0
+        obj = json.loads(out.read_text())
+        assert obj["rollup"]["n_hosts"] == 2
+
+    def test_no_streams_exits_nonzero(self, tmp_path):
+        assert aggregate_main([str(tmp_path / "missing")]) == 1
+
+    def test_real_host_record_loader(self, tmp_path):
+        self.pod(tmp_path)
+        hosts = expand_paths([str(tmp_path)])
+        records = load_host_records(hosts)
+        assert set(records) == {"metrics_h0", "metrics_h1"}
+        assert all(records.values())
+
+
+class TestReviewRegressions:
+    """Pinned fixes from the PR 10 review pass."""
+
+    def test_shed_rate_on_an_untraced_response_only_stream(self):
+        """trace_requests=False streams carry responses but no resolve
+        leaves: one shed among many successes must NOT read as rate 1.0."""
+        mon = SLOMonitor({"shed_rate": 0.4}, clock=FakeClock())
+        for i in range(9):
+            mon.observe(schema.stamp(
+                {"event": "response", "ok": True, "latency_ms": 5.0,
+                 "trace_id": None}, kind="serve"))
+        mon.observe(schema.stamp({"event": "shed", "reason": "queue-full",
+                                  "trace_id": None}, kind="serve"))
+        assert mon.evaluate() == []
+        assert mon.observed()["shed_rate"] == 0.1
+
+    def test_shed_rate_not_halved_by_resolve_plus_response_pairs(self):
+        mon = SLOMonitor({"shed_rate": 0.0}, clock=FakeClock())
+        mon.observe(resolve(trace_id="t1"))
+        mon.observe(schema.stamp(
+            {"event": "response", "ok": True, "latency_ms": 5.0,
+             "trace_id": "t1"}, kind="serve"))
+        mon.observe(schema.stamp({"event": "shed", "reason": "queue-full",
+                                  "trace_id": None}, kind="serve"))
+        assert mon.observed()["shed_rate"] == 0.5  # 1 shed / (1 + 1)
+
+    def test_latency_trace_dedup_set_prunes_with_the_window(self):
+        clock = FakeClock()
+        mon = SLOMonitor({"p99_ms": 1e9}, window_s=10.0, clock=clock)
+        for i in range(5):
+            mon.observe(resolve(latency_ms=1.0, trace_id=f"t{i}"))
+        clock.t += 60.0
+        mon.observe(resolve(latency_ms=1.0, trace_id="fresh"))
+        assert mon._latency_traces == {"fresh"}
+
+    def test_clockless_record_after_epoch_anchor_stays_adjacent(self):
+        """A seq record trailing an epoch-clock one must ride the pod
+        axis through the re-zeroing, not strand ~50 years out."""
+        hosts = {
+            "h0": [
+                schema.stamp({"note": "anchor", "wall_time_s": EPOCH},
+                             kind="note"),
+                schema.stamp({"note": "clockless"}, kind="note"),
+                schema.stamp({"note": "later", "wall_time_s": EPOCH + 5.0},
+                             kind="note"),
+            ],
+        }
+        merged = merge_timeline(hosts)
+        order = [e["rec"]["note"] for e in merged["events"]]
+        assert order == ["anchor", "clockless", "later"]
+        assert merged["events"][1]["t"] == pytest.approx(1e-3)
+
+    def test_watch_live_tail_does_not_consume_a_torn_line(self, tmp_path):
+        """drain() must never advance past a half-flushed record: the
+        complete first line is observed, the torn tail is left for the
+        writer's next flush (not consumed as garbage)."""
+        p = tmp_path / "s.jsonl"
+        full = json.dumps(resolve(latency_ms=100.0))
+        p.write_text(full + "\n" + full[: len(full) // 2])
+        rc = watch_main([
+            str(p), "--slo", "p99_ms=50", "--max-seconds", "0.1",
+            "--interval", "0.02",
+        ])
+        assert rc == 1  # the complete line was seen and breached

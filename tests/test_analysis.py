@@ -419,9 +419,52 @@ class TestSchemaEmit:
         src = (
             "from glom_tpu.telemetry.sinks import emit\n"
             "emit({'metric': 'x', 'value': 1.0, 'unit': 'u'}, kind='bench')\n"
-            "emit({'event': 'dispatch'}, kind='serve')\n"
+            "emit({'event': 'dispatch', 'trace_ids': ids}, kind='serve')\n"
         )
         assert by_checker(lint(tmp_path, src), "schema-emit") == []
+
+    def test_request_scoped_event_without_trace_context_flagged(
+        self, tmp_path
+    ):
+        src = (
+            "from glom_tpu.serve.events import emit_serve\n"
+            "emit_serve(w, {'event': 'dispatch', 'engine': 'e0'})\n"
+        )
+        fs = by_checker(lint(tmp_path, src), "schema-emit")
+        assert len(fs) == 1 and fs[0].key == "trace-context"
+        assert "trace_id" in fs[0].message
+
+    def test_trace_context_rule_accepts_null_and_splat(self, tmp_path):
+        src = (
+            "from glom_tpu.serve.events import emit_serve\n"
+            "emit_serve(w, {'event': 'resolve', 'trace_id': None})\n"
+            "emit_serve(w, {'event': 'shed', **fields})\n"
+            "emit_serve(w, {'event': 'warmup', 'bucket': 4})\n"
+        )
+        assert by_checker(lint(tmp_path, src), "schema-emit") == []
+
+    def test_trace_context_rule_skips_non_serve_kinds(self, tmp_path):
+        # A "fault" record whose site context happens to name an event
+        # from the serve vocabulary is out of scope for the rule.
+        src = (
+            "from glom_tpu.telemetry.sinks import emit\n"
+            "emit({'fault': 'x', 'event': 'dispatch'}, kind='fault')\n"
+        )
+        assert by_checker(lint(tmp_path, src), "schema-emit") == []
+
+    def test_trace_emit_fixture_pair(self):
+        """The seeded acceptance pair (tests/fixtures/trace_emit.py): the
+        context-less dispatch emit flagged, the four good shapes clean."""
+        from glom_tpu.analysis import run
+
+        fs = by_checker(
+            run([str(FIXTURES / "trace_emit.py")]), "schema-emit"
+        )
+        assert len(fs) == 1, fs
+        assert fs[0].key == "trace-context"
+        assert fs[0].symbol == "bad_dispatch_emit"
+        src_lines = (FIXTURES / "trace_emit.py").read_text().splitlines()
+        assert "dispatch" in src_lines[fs[0].line - 1]
 
     def test_dead_zero_unmeasured_flagged(self, tmp_path):
         src = (

@@ -46,7 +46,7 @@ class TestSchemaV2Kinds:
             {"metric": "m", "value": None, "error": "backend-init-unavailable"},
             kind="error",
         )
-        assert span["schema_version"] == schema.SCHEMA_VERSION == 5
+        assert span["schema_version"] == schema.SCHEMA_VERSION == 6
         assert schema.validate_record(span) == []
         assert schema.validate_record(err) == []
         # missing required fields are rejected
@@ -274,3 +274,200 @@ class TestBenchArtifacts:
         ))
         lines = artifact_lines(str(p))
         assert len(lines) == 1 and json.loads(lines[0])["metric"] == "m"
+
+
+class TestEngineFlatten:
+    """PR 10 satellite: serve summaries' per-engine nests flatten into
+    synthetic serve_engine.* rows so fan-out regressions confined to one
+    engine GATE instead of vanishing (flatten_engine_metrics)."""
+
+    def summary(self, *, config="load=0.5x", dispatches=5, alive=True,
+                engines=("engine0", "engine1"), nest_ladder=False):
+        eng = {}
+        for name in engines:
+            st = {"alive": alive, "dispatches": dispatches,
+                  "consecutive_failures": 0, "rejoins": 0}
+            if nest_ladder:
+                st["ladder"] = {"ladder_degrades": 1, "ladder_restores": 1}
+                st["retry"] = {"retry_site": f"{name}-dispatch",
+                               "n_retries": 2, "n_gave_up": 0}
+            eng[name] = st
+        return schema.stamp(
+            {"event": "summary", "config": config, "n_served": 8,
+             "engines": eng},
+            kind="serve",
+        )
+
+    def lines(self, rec):
+        return [json.dumps(rec)]
+
+    def test_flattens_numeric_and_bool_leaves(self):
+        from glom_tpu.telemetry.compare import flatten_engine_metrics
+
+        rows = flatten_engine_metrics(self.summary(nest_ladder=True))
+        labels = {r["metric"] for r in rows}
+        assert "serve_engine.engine0.dispatches (load=0.5x)" in labels
+        assert "serve_engine.engine0.alive (load=0.5x)" in labels
+        assert "serve_engine.engine0.ladder.ladder_degrades (load=0.5x)" in labels
+        assert "serve_engine.engine1.retry.n_retries (load=0.5x)" in labels
+        # Strings (retry_site) never flatten; bools flatten as 0/1.
+        assert not any("retry_site" in m for m in labels)
+        alive = [r for r in rows if r["metric"].endswith(
+            "engine0.alive (load=0.5x)")][0]
+        assert alive["value"] == 1.0
+
+    def test_non_summary_and_nestless_records_flatten_to_nothing(self):
+        from glom_tpu.telemetry.compare import flatten_engine_metrics
+
+        assert flatten_engine_metrics({"event": "dispatch"}) == []
+        assert flatten_engine_metrics(
+            {"event": "summary", "n_served": 3}) == []
+
+    def test_dead_engine_regression_gates(self):
+        """The kill-serve shape: one engine's dispatches drop to zero and
+        alive flips 1 -> 0 — both must surface as regressions (counts are
+        rates: lower is the regression)."""
+        base = self.lines(self.summary(dispatches=5, alive=True))
+        new = self.lines(self.summary(dispatches=0, alive=False))
+        results = run(base, new)
+        by_metric = {r["metric"]: r for r in results}
+        assert by_metric[
+            "serve_engine.engine0.dispatches (load=0.5x)"
+        ]["status"] == "regression"
+        assert by_metric[
+            "serve_engine.engine0.alive (load=0.5x)"
+        ]["status"] == "regression"
+
+    def test_failure_counts_regress_up(self):
+        base = self.lines(self.summary(nest_ladder=True))
+        new_rec = self.summary(nest_ladder=True)
+        new_rec["engines"]["engine0"]["retry"]["n_retries"] = 20
+        results = run(base, self.lines(new_rec))
+        (row,) = [r for r in results if r["metric"] ==
+                  "serve_engine.engine0.retry.n_retries (load=0.5x)"]
+        assert row["lower_is_better"] is True
+        assert row["status"] == "regression"
+
+    def test_ladder_churn_regresses_up(self):
+        """ladder_degrades (and the restores that track it 1:1) are
+        failure-ish counts: a run degrading 20x more often must GATE,
+        and a calm run (both drop to 0) must read as an improvement,
+        not a vanished-rate regression."""
+        base = self.lines(self.summary(nest_ladder=True))
+        churny = self.summary(nest_ladder=True)
+        churny["engines"]["engine0"]["ladder"]["ladder_degrades"] = 20
+        churny["engines"]["engine0"]["ladder"]["ladder_restores"] = 20
+        by_metric = {r["metric"]: r for r in run(base, self.lines(churny))}
+        row = by_metric["serve_engine.engine0.ladder.ladder_degrades (load=0.5x)"]
+        assert row["lower_is_better"] is True
+        assert row["status"] == "regression"
+        calm = self.summary(nest_ladder=True)
+        calm["engines"]["engine0"]["ladder"]["ladder_degrades"] = 0
+        calm["engines"]["engine0"]["ladder"]["ladder_restores"] = 0
+        by_metric = {r["metric"]: r for r in run(base, self.lines(calm))}
+        for key in ("ladder_degrades", "ladder_restores"):
+            row = by_metric[f"serve_engine.engine0.ladder.{key} (load=0.5x)"]
+            assert row["status"] != "regression", row
+
+    def test_missing_engine_on_one_side(self):
+        """A replica absent from NEW (a vanished engine) is missing, not
+        silently dropped; a brand-new replica reports as new-metric."""
+        base = self.lines(self.summary(engines=("engine0", "engine1")))
+        new = self.lines(self.summary(engines=("engine0",)))
+        results = run(base, new)
+        statuses = {r["metric"]: r["status"] for r in results}
+        assert statuses[
+            "serve_engine.engine1.dispatches (load=0.5x)"
+        ] == "missing-in-new"
+        # And the mirror direction:
+        results = run(new, base)
+        statuses = {r["metric"]: r["status"] for r in results}
+        assert statuses[
+            "serve_engine.engine1.dispatches (load=0.5x)"
+        ] == "new-metric"
+
+    def test_nested_vs_flat_summary_shapes_both_ingest(self):
+        """A flat single-engine summary (PR 6 shape: ladder/retry fields
+        on the record itself) must not crash the adapter or fabricate
+        rows; the nested fan-out shape produces them."""
+        flat = schema.stamp(
+            {"event": "summary", "n_served": 3, "ladder_rung": "full",
+             "n_retries": 1,
+             "engines": {"engine0": {"alive": True, "dispatches": 3}}},
+            kind="serve",
+        )
+        measured, unmeasured = load_bench_records(self.lines(flat))
+        assert set(measured) == {
+            "serve_engine.engine0.alive",
+            "serve_engine.engine0.dispatches",
+        }
+        assert unmeasured == {}
+
+
+class TestBenchArtifactEdgeCases:
+    """PR 10 satellite: `telemetry compare --bench-artifact` edge cases —
+    missing engines, all-UNMEASURED artifacts, and summary shapes riding
+    the driver's BENCH_r0x container."""
+
+    def artifact(self, tmp_path, name, rows):
+        p = tmp_path / name
+        p.write_text(json.dumps(
+            {"tail": "\n".join(json.dumps(r) for r in rows)}
+        ))
+        return str(p)
+
+    def bench_row(self, metric="m", value=1.0):
+        return schema.stamp(
+            {"metric": metric, "value": value, "unit": "x"}, kind="bench"
+        )
+
+    def unmeasured_row(self, metric="m"):
+        return schema.stamp(
+            {"metric": metric, "value": None, "unit": "x",
+             "error": "backend-init-unavailable"},
+            kind="error",
+        )
+
+    def test_all_unmeasured_artifact_warns_not_regresses(self, tmp_path):
+        base = self.artifact(tmp_path, "b.json",
+                             [self.bench_row("m1"), self.bench_row("m2")])
+        new = self.artifact(tmp_path, "n.json",
+                            [self.unmeasured_row("m1"),
+                             self.unmeasured_row("m2")])
+        assert compare_main([base, new, "--bench-artifact"]) == 0
+        assert compare_main(
+            [base, new, "--bench-artifact", "--fail-on-missing"]) == 0
+        results = compare_files(base, new, artifacts=True)
+        assert {r["status"] for r in results} == {"unmeasured-in-new"}
+
+    def test_unmeasured_on_both_sides(self, tmp_path):
+        base = self.artifact(tmp_path, "b.json", [self.unmeasured_row()])
+        new = self.artifact(tmp_path, "n.json", [self.unmeasured_row()])
+        results = compare_files(base, new, artifacts=True)
+        assert [r["status"] for r in results] == ["unmeasured-both"]
+
+    def test_engine_nest_rides_the_artifact_container(self, tmp_path):
+        mk = TestEngineFlatten()
+        base = self.artifact(
+            tmp_path, "b.json", [mk.summary(dispatches=4)])
+        new_rec = mk.summary(dispatches=4)
+        new_rec["engines"]["engine1"]["dispatches"] = 0
+        new = self.artifact(tmp_path, "n.json", [new_rec])
+        assert compare_main([base, new, "--bench-artifact"]) == 1
+        results = compare_files(base, new, artifacts=True)
+        regressed = [r["metric"] for r in results
+                     if r["status"] == "regression"]
+        assert regressed == [
+            "serve_engine.engine1.dispatches (load=0.5x)"
+        ]
+
+    def test_missing_engine_in_artifact_gates_with_fail_on_missing(
+        self, tmp_path
+    ):
+        mk = TestEngineFlatten()
+        base = self.artifact(tmp_path, "b.json", [mk.summary()])
+        new = self.artifact(
+            tmp_path, "n.json", [mk.summary(engines=("engine0",))])
+        assert compare_main([base, new, "--bench-artifact"]) == 0
+        assert compare_main(
+            [base, new, "--bench-artifact", "--fail-on-missing"]) == 1

@@ -533,7 +533,12 @@ class TestBatcherLadder:
         batcher.submit(np.zeros((3, 8, 8), np.float32))  # fills depth-1
         with pytest.raises(QueueFullError) as ei:
             batcher.submit(np.zeros((3, 8, 8), np.float32))
-        assert ei.value.detail == {
+        detail = dict(ei.value.detail)
+        # Since schema v6 the shed detail also carries the request's
+        # minted trace_id (telemetry/tracectx.py) so callers can join
+        # their own failure records to the shed leaf.
+        assert isinstance(detail.pop("trace_id", None), str)
+        assert detail == {
             "queue_depth": 1,
             "queue_capacity": 1,
             "continuations_queued": 0,
@@ -541,6 +546,7 @@ class TestBatcherLadder:
         shed = [r for r in w.records if r.get("event") == "shed"]
         assert shed[0]["queue_depth"] == 1
         assert shed[0]["reason"] == "queue-full"
+        assert shed[0]["trace_id"] == ei.value.detail["trace_id"]
         batcher.stop(drain=False)
 
 

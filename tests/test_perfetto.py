@@ -143,3 +143,166 @@ class TestConvertAndCli:
         assert main([str(a), str(b), "-o", str(out)]) == 0
         trace = json.loads(out.read_text())
         assert len(trace["traceEvents"]) == 2
+
+
+class TestBarrierTracksAndFlows:
+    """PR 10: barrier records render as per-host tracks with per-round
+    flow arrows (propose -> commit -> saved -> complete), and v6
+    trace-context serve records chain into per-request flows."""
+
+    def barrier(self, phase, host, i, rnd="r1"):
+        return schema.stamp(
+            {"phase": phase, "round": rnd, "host": host, "step": 3,
+             "wall_time_s": 1.7e9 + i},
+            kind="barrier",
+        )
+
+    def test_barrier_records_land_on_per_host_tracks(self):
+        recs = [
+            self.barrier(p, h, i + h * 0.1)
+            for i, p in enumerate(("propose", "commit", "saved", "complete"))
+            for h in (0, 1)
+        ]
+        evs = to_trace_events(recs)
+        instants = [e for e in evs if e["ph"] == "i"]
+        assert {e["tid"] for e in instants} == {100, 101}
+        meta = [e for e in evs if e["ph"] == "M"]
+        assert {e["args"]["name"] for e in meta} == {
+            "barrier host 0", "barrier host 1"
+        }
+
+    def test_barrier_round_chains_as_flow_arrows(self):
+        recs = [
+            self.barrier(p, 0, i)
+            for i, p in enumerate(("propose", "commit", "saved", "complete"))
+        ]
+        evs = to_trace_events(recs)
+        flows = [e for e in evs if e.get("cat") == "barrier"]
+        assert [e["ph"] for e in flows] == ["s", "t", "t", "t"]
+        assert {e["id"] for e in flows} == {"barrier:r1"}
+        # Arrows follow time: the flow steps are ts-ordered.
+        ts = [e["ts"] for e in flows]
+        assert ts == sorted(ts)
+
+    def test_hostless_barrier_falls_back_to_the_events_track(self):
+        rec = schema.stamp(
+            {"phase": "arrive", "round": "g0", "wall_time_s": 1.7e9},
+            kind="barrier",
+        )
+        (ev,) = [e for e in to_trace_events([rec]) if e["ph"] == "i"]
+        assert ev["tid"] == 90  # _TID_EVENTS
+
+    def test_trace_context_serve_records_flow_link(self):
+        recs = [
+            schema.stamp(
+                {"event": "dispatch", "engine": "e0", "latency_ms": 1.0,
+                 "trace_ids": ["abc12345ff", "zzz"], "span_id": "d1",
+                 "wall_time_s": 1.7e9 + 1},
+                kind="serve",
+            ),
+            schema.stamp(
+                {"event": "resolve", "iters_total": 6,
+                 "trace_id": "abc12345ff", "wall_time_s": 1.7e9 + 2},
+                kind="serve",
+            ),
+        ]
+        evs = to_trace_events(recs)
+        abc = [e for e in evs if e.get("id") == "trace:abc12345ff"]
+        assert [e["ph"] for e in abc] == ["s", "f"]  # start -> finish
+        assert abc[0]["name"] == "trace:abc12345"
+        zzz = [e for e in evs if e.get("id") == "trace:zzz"]
+        assert [e["ph"] for e in zzz] == ["s"]
+
+    def test_untraced_serve_records_emit_no_flows(self):
+        rec = schema.stamp(
+            {"event": "dispatch", "engine": "e0", "trace_ids": None,
+             "wall_time_s": 1.7e9},
+            kind="serve",
+        )
+        assert not [e for e in to_trace_events([rec]) if "id" in e]
+
+    def test_whole_trace_object_stays_serializable(self):
+        recs = [
+            self.barrier("propose", 0, 0),
+            schema.stamp(
+                {"event": "resolve", "trace_id": "t1", "iters_total": 4,
+                 "wall_time_s": 1.7e9 + 5},
+                kind="serve",
+            ),
+        ]
+        json.dumps({"traceEvents": to_trace_events(recs)})
+
+    def test_flow_finishes_exactly_once_across_resolve_and_response(self):
+        """A traced CLI stream carries BOTH leaves per request (the
+        batcher's resolve, then the CLI's response): the flow must emit
+        one "s" and ONE "f" — a second finish on a terminated id is
+        dropped by the importer."""
+        mk = lambda ev, t: schema.stamp(
+            {"event": ev, "trace_id": "abc", "latency_ms": 1.0,
+             "wall_time_s": 1.7e9 + t},
+            kind="serve",
+        )
+        evs = to_trace_events([
+            schema.stamp(
+                {"event": "dispatch", "trace_ids": ["abc"],
+                 "latency_ms": 1.0, "wall_time_s": 1.7e9},
+                kind="serve",
+            ),
+            mk("resolve", 1), mk("response", 2),
+        ])
+        flows = [e for e in evs if e.get("id") == "trace:abc"]
+        assert [e["ph"] for e in flows] == ["s", "t", "f"] or \
+            [e["ph"] for e in flows] == ["s", "f"], flows
+        assert [e["ph"] for e in flows].count("f") == 1
+
+    def test_flows_are_causal_under_the_batcher_emit_order(self):
+        """The batcher stamps a hop's resolve leaf BEFORE the hop's own
+        dispatch record, and the dispatch record's clock reads LATER —
+        both stream order and raw ts order would start the flow at the
+        leaf (never closing it) or close it early and drop the final
+        hop. The flow must still read hop(s) -> leaf: "s" on the
+        dispatch, "f" on the resolve, ts monotone."""
+        resolve = schema.stamp(
+            {"event": "resolve", "trace_id": "abc", "iters_total": 6,
+             "latency_ms": 4.0, "wall_time": 5.995},
+            kind="serve",
+        )
+        response = schema.stamp(
+            {"event": "response", "trace_id": "abc", "ok": True,
+             "latency_ms": 4.0, "wall_time": 5.995},
+            kind="serve",
+        )
+        dispatch = schema.stamp(
+            {"event": "dispatch", "trace_ids": ["abc"], "latency_ms": 4.0,
+             "wall_time": 5.999},
+            kind="serve",
+        )
+        # The real stream order: resolve, response, then the dispatch.
+        evs = to_trace_events([resolve, response, dispatch])
+        flows = [e for e in evs if e.get("id") == "trace:abc"]
+        assert [e["ph"] for e in flows] == ["s", "f"], flows
+        ts = [e["ts"] for e in flows]
+        assert ts == sorted(ts), flows
+        # The "s" sits on the dispatch hop's instant, not the leaf's.
+        (disp,) = [e for e in evs if e.get("name") == "serve:dispatch"]
+        assert flows[0]["ts"] == disp["ts"]
+
+    def test_multi_hop_flow_keeps_every_hop_before_the_leaf(self):
+        """A straggler's final hop is stamped after the resolve in
+        stream order; it must still flow-link as a hop, not be dropped
+        by an already-closed flow."""
+        hop = lambda t: schema.stamp(
+            {"event": "dispatch", "trace_ids": ["abc"], "latency_ms": 1.0,
+             "wall_time": t},
+            kind="serve",
+        )
+        resolve = schema.stamp(
+            {"event": "resolve", "trace_id": "abc", "iters_total": 9,
+             "latency_ms": 3.0, "wall_time": 7.0},
+            kind="serve",
+        )
+        evs = to_trace_events([hop(1.0), hop(4.0), resolve, hop(7.1)])
+        flows = [e for e in evs if e.get("id") == "trace:abc"]
+        assert [e["ph"] for e in flows] == ["s", "t", "t", "f"], flows
+        ts = [e["ts"] for e in flows]
+        assert ts == sorted(ts), flows

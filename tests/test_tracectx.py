@@ -1,0 +1,270 @@
+"""Request-scoped tracing (glom_tpu/telemetry/tracectx.py): id minting,
+the thread-local dispatch scope, causal-tree reconstruction, the exact
+executed-work conservation check, the schema-v6 trace-context contract,
+and the `python -m glom_tpu.telemetry trace` CLI. Pure host-side, no jax
+— the CLI must run against a crashed run's dumps."""
+
+import json
+import threading
+
+import pytest
+
+from glom_tpu.telemetry import schema, tracectx
+
+
+def serve(event, **fields):
+    return schema.stamp({"event": event, **fields}, kind="serve")
+
+
+def make_trace(trace_id="t1", *, iters=(4, 8), submit_span="root"):
+    """A two-hop straggler trace: dispatch -> continuation -> dispatch ->
+    resolve, with exact per-hop accounting."""
+    d1, d2 = "d1", "d2"
+    recs = [
+        serve("dispatch", engine="e0", iters_run=iters[0], latency_ms=1.5,
+              span_id=d1, trace_ids=[trace_id], parent_spans=[submit_span]),
+        serve("continuation", engine="e0", n_stragglers=1,
+              span_id="c1", trace_ids=[trace_id], parent_spans=[d1]),
+        serve("dispatch", engine="e1", iters_run=iters[1], latency_ms=2.25,
+              span_id=d2, trace_ids=[trace_id], parent_spans=[d1]),
+        serve("resolve", request_id=1, engine="e1",
+              iters_total=sum(iters), dispatch_ms_total=1.5 + 2.25,
+              latency_ms=9.0, trace_id=trace_id, span_id="r1",
+              parent_span=d2),
+    ]
+    return recs
+
+
+class TestIds:
+    def test_ids_are_hex_and_distinct(self):
+        ids = {tracectx.new_id() for _ in range(64)}
+        assert len(ids) == 64
+        for i in ids:
+            assert len(i) == 16
+            int(i, 16)  # hex
+
+    def test_trace_and_span_share_the_format(self):
+        assert len(tracectx.new_trace_id()) == len(tracectx.new_span_id())
+
+
+class TestDispatchScope:
+    def test_scope_fields_visible_inside_only(self):
+        assert tracectx.current_fields() == {}
+        with tracectx.dispatch_scope("s1", ["t1", "t2"], ["p1", "p2"]):
+            got = tracectx.current_fields()
+            assert got == {
+                "span_id": "s1",
+                "trace_ids": ["t1", "t2"],
+                "parent_spans": ["p1", "p2"],
+            }
+        assert tracectx.current_fields() == {}
+
+    def test_scopes_nest_innermost_wins(self):
+        with tracectx.dispatch_scope("outer", ["t"]):
+            with tracectx.dispatch_scope("inner", ["t"]):
+                assert tracectx.current_fields()["span_id"] == "inner"
+            assert tracectx.current_fields()["span_id"] == "outer"
+
+    def test_scope_is_thread_local(self):
+        seen = {}
+
+        def worker():
+            seen["inner"] = tracectx.current_fields()
+
+        with tracectx.dispatch_scope("s1", ["t1"]):
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        assert seen["inner"] == {}  # another thread's scope never leaks
+
+    def test_stamp_serve_merges_scope_fields(self):
+        from glom_tpu.serve.events import stamp_serve
+
+        with tracectx.dispatch_scope("s1", ["t1"]):
+            rec = stamp_serve({"event": "cache_evict", "bytes": 8})
+            assert rec["span_id"] == "s1" and rec["trace_ids"] == ["t1"]
+            # A record carrying its OWN identity is never widened.
+            own = stamp_serve({"event": "resolve", "trace_id": "mine"})
+            assert own["trace_id"] == "mine" and "trace_ids" not in own
+
+
+class TestTreeReconstruction:
+    def test_records_for_singular_and_batch_forms(self):
+        recs = [
+            serve("resolve", trace_id="a"),
+            serve("dispatch", trace_ids=["a", "b"]),
+            serve("dispatch", trace_ids=["b"]),
+            serve("shed", trace_id=None),  # explicitly untraced
+        ]
+        assert len(tracectx.records_for(recs, "a")) == 2
+        assert len(tracectx.records_for(recs, "b")) == 2
+
+    def test_list_traces_counts_hops_and_resolution(self):
+        recs = make_trace("t1") + [serve("dispatch", trace_ids=["open"],
+                                         span_id="dx", parent_spans=["rx"],
+                                         iters_run=2, latency_ms=1.0)]
+        traces = tracectx.list_traces(recs)
+        assert traces["t1"]["n_hops"] == 2
+        assert traces["t1"]["resolved"] is True
+        assert traces["t1"]["iters_total"] == 12
+        assert traces["open"]["resolved"] is False
+
+    def test_build_tree_parent_chain(self):
+        tree = tracectx.build_tree(make_trace("t1"), "t1")
+        root = tree["root"]
+        assert root["span_id"] == "root"  # the synthesized submit span
+        assert [n["span_id"] for n in root["children"]] == ["d1"]
+        d1 = root["children"][0]
+        assert sorted(n["span_id"] for n in d1["children"]) == ["c1", "d2"]
+        d2 = [n for n in d1["children"] if n["span_id"] == "d2"][0]
+        assert [n["span_id"] for n in d2["children"]] == ["r1"]
+
+    def test_records_sharing_a_span_collapse_into_one_node(self):
+        recs = [
+            serve("dispatch", span_id="d1", trace_ids=["t"],
+                  parent_spans=["root"], iters_run=3, latency_ms=1.0),
+            schema.stamp({"action": "dispatch-retry", "span_id": "d1",
+                          "trace_ids": ["t"]}, kind="recovery"),
+        ]
+        tree = tracectx.build_tree(recs, "t")
+        (node,) = tree["root"]["children"]
+        assert len(node["records"]) == 2  # the retry rides the dispatch node
+
+    def test_render_tree_is_printable(self):
+        lines = tracectx.render_tree(tracectx.build_tree(make_trace(), "t1"))
+        assert lines[0].startswith("trace t1")
+        assert any("resolve" in ln for ln in lines)
+
+
+class TestConservation:
+    def test_exact_conservation_passes(self):
+        check = tracectx.conservation(make_trace("t1"), "t1")
+        assert check["ok"] is True
+        assert check["n_hops"] == 2
+        assert check["hop_iters"] == 12
+        assert check["hop_dispatch_ms"] == 3.75
+
+    def test_missing_hop_fails(self):
+        recs = make_trace("t1")[1:]  # drop the first dispatch
+        check = tracectx.conservation(recs, "t1")
+        assert check["ok"] is False and "conserve" in check["why"]
+
+    def test_wall_span_mismatch_fails(self):
+        recs = make_trace("t1")
+        recs[-1] = dict(recs[-1], dispatch_ms_total=99.0)
+        check = tracectx.conservation(recs, "t1")
+        assert check["ok"] is False and "wall spans" in check["why"]
+
+    def test_unresolved_trace_fails_with_why(self):
+        recs = make_trace("t1")[:-1]
+        check = tracectx.conservation(recs, "t1")
+        assert check["ok"] is False and check["resolved"] is False
+
+
+class TestSchemaV6Contract:
+    def test_request_scoped_serve_event_requires_a_trace_key(self):
+        rec = serve("dispatch", engine="e0", latency_ms=1.0)
+        errs = schema.validate_record(rec)
+        assert errs and "trace" in errs[0]
+
+    def test_null_trace_key_is_explicitly_untraced_and_valid(self):
+        assert schema.validate_record(serve("shed", trace_id=None)) == []
+        assert schema.validate_record(
+            serve("dispatch", trace_ids=None)) == []
+
+    def test_pre_v6_records_are_grandfathered(self):
+        rec = dict(serve("dispatch", engine="e0"), schema_version=5)
+        assert schema.validate_record(rec) == []
+
+    def test_non_request_scoped_events_are_exempt(self):
+        assert schema.validate_record(serve("warmup", bucket=4)) == []
+
+    def test_slo_breach_kind_validates(self):
+        rec = schema.stamp(
+            {"rule": "p99_ms", "threshold": 50.0, "observed": 80.0},
+            kind="slo_breach",
+        )
+        assert schema.validate_record(rec) == []
+        assert schema.validate_record(
+            schema.stamp({"threshold": 1.0}, kind="slo_breach")) != []
+
+
+class TestCli:
+    def write(self, tmp_path, recs, name="trace.jsonl"):
+        p = tmp_path / name
+        with open(p, "w") as fh:
+            fh.write("shell noise to be skipped\n")
+            for r in recs:
+                fh.write(json.dumps(r) + "\n")
+        return p
+
+    def test_list_mode(self, tmp_path, capsys):
+        p = self.write(tmp_path, make_trace("aaa"))
+        assert tracectx.main([str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "aaa" in out and "resolved" in out
+
+    def test_tree_mode_conserving_trace_exits_zero(self, tmp_path, capsys):
+        p = self.write(tmp_path, make_trace("aaa"))
+        assert tracectx.main([str(p), "--trace-id", "aaa"]) == 0
+        out = capsys.readouterr().out
+        assert "trace aaa" in out
+        summary = json.loads(out.strip().splitlines()[-1])
+        assert summary["ok"] is True and summary["kind"] == "summary"
+
+    def test_broken_conservation_exits_nonzero(self, tmp_path, capsys):
+        recs = make_trace("aaa")[1:]  # a hop's evidence is missing
+        p = self.write(tmp_path, recs)
+        assert tracectx.main([str(p), "--trace-id", "aaa"]) == 1
+        assert "CONSERVATION FAILED" in capsys.readouterr().err
+
+    def test_unknown_trace_exits_nonzero(self, tmp_path, capsys):
+        p = self.write(tmp_path, make_trace("aaa"))
+        assert tracectx.main([str(p), "--trace-id", "zzz"]) == 1
+
+    def test_no_traces_listing_exits_nonzero(self, tmp_path):
+        p = self.write(
+            tmp_path, [schema.stamp({"note": "hi"}, kind="note")]
+        )
+        assert tracectx.main([str(p)]) == 1
+
+    def test_multiple_inputs_merge(self, tmp_path, capsys):
+        recs = make_trace("aaa")
+        p1 = self.write(tmp_path, recs[:2], "a.jsonl")
+        p2 = self.write(tmp_path, recs[2:], "b.jsonl")
+        assert tracectx.main([str(p1), str(p2), "--trace-id", "aaa"]) == 0
+
+
+class TestUntracedMode:
+    def test_batcher_with_tracing_off_stamps_null_context(self):
+        import sys
+
+        sys.path.insert(0, "tests")
+        import numpy as np
+
+        from glom_tpu.serve.batcher import DynamicBatcher
+
+        class Sink:
+            def __init__(self):
+                self.records = []
+
+            def write(self, rec):
+                self.records.append(rec)
+
+        from test_serve import FakeEngine  # type: ignore
+
+        eng = FakeEngine()
+        sink = Sink()
+        with DynamicBatcher(eng, max_batch=2, max_delay_ms=10.0,
+                            writer=sink, trace=False) as b:
+            for t in [b.submit(IMG := np.zeros((3, 8, 8), np.float32))
+                      for _ in range(2)]:
+                t.result(timeout=10.0)
+        dispatches = [r for r in sink.records if r.get("event") == "dispatch"]
+        assert dispatches and all(
+            r["trace_ids"] is None for r in dispatches
+        )
+        # No resolve leaves when untraced — they exist for the tree.
+        assert not [r for r in sink.records if r.get("event") == "resolve"]
+        for r in sink.records:
+            assert schema.validate_record(r) == [], r
