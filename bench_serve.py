@@ -491,6 +491,212 @@ def run_temporal(cfg, scfg, label: str, *, n_streams: int, n_frames: int,
     return means
 
 
+def run_ragged(cfg, scfg, label: str, *, n_streams: int, n_frames: int,
+               perturb: float) -> dict:
+    """Mixed-resolution sweep: the ragged paged route vs the bucket
+    ladder (docs/SERVING.md, "Paged column memory" / "Ragged admission").
+
+    S streams at CYCLING resolutions (full, 3/4, 1/2 of the canvas — the
+    new workload class: mixed resolutions/aspect ratios), F frames each,
+    hard 100x-scale bases plus a small per-frame perturbation. The same
+    traffic is served twice:
+
+      * bucket-ladder — every image PADDED host-side to the full canvas
+        and row-padded to a bucket shape; warm frames ride the PR 8
+        host-array cache (levels0 re-uploaded per warm dispatch);
+      * ragged-paged — native resolutions packed page-aligned onto the
+        ragged page ladder; warm frames take pool pages IN-GRAPH (zero
+        levels0 upload).
+
+    The measured numbers: `serve_pad_waste` per arm (true useful tokens
+    over dispatched token slots — the bucket arm's canvas padding counts
+    as waste, because the MXU multiplies it), warm/cold dispatch latency
+    per arm, and `serve_levels0_h2d_bytes` per arm (the ragged arm's
+    MUST be zero — the CI gate asserts both claims). Returns
+    {arm: pad_waste_pct}."""
+    import dataclasses
+
+    import numpy as np
+
+    from glom_tpu.serve.batcher import DynamicBatcher, ShedError
+    from glom_tpu.serve.column_cache import column_state_bytes
+    from glom_tpu.serve.paged_columns import (
+        pages_for_tokens,
+        resolve_page_tokens,
+    )
+    from glom_tpu.telemetry.sinks import emit
+
+    if scfg.iters != "auto":
+        emit(
+            {"note": "ragged sweep skipped: the configured route is not "
+             "iters='auto' (warm frames save nothing on a fixed budget)"},
+            kind="note",
+        )
+        return {}
+    rng = np.random.default_rng(13)
+    p = cfg.patch_size
+    side = cfg.image_size
+    # Cycling resolutions: full, ~3/4, ~1/2 of the canvas, rounded to
+    # patch multiples (all >= one patch).
+    sizes = sorted(
+        {max(p, (side * f // (4 * p)) * p) for f in (4, 3, 2)}, reverse=True
+    )
+    stream_size = [sizes[s % len(sizes)] for s in range(n_streams)]
+    bases = [
+        (100.0 * rng.normal(size=(cfg.channels, hw, hw))).astype(np.float32)
+        for hw in stream_size
+    ]
+    frames = [
+        [
+            (bases[s] + perturb * rng.normal(size=bases[s].shape)).astype(
+                np.float32
+            )
+            for _ in range(n_frames)
+        ]
+        for s in range(n_streams)
+    ]
+    n_tokens = [(hw // p) ** 2 for hw in stream_size]
+    useful = sum(n_tokens) * n_frames
+
+    pt = resolve_page_tokens(cfg, scfg)
+    ppr = pages_for_tokens(cfg.num_patches, pt)
+    cache_bytes = (n_streams + 1) * column_state_bytes(cfg, scfg)
+    pool_pages = (n_streams + 2) * ppr
+    arms = (
+        ("bucket-ladder", dataclasses.replace(
+            scfg, ragged=False, page_pool_pages=0, max_continuations=0,
+            column_cache_bytes=cache_bytes)),
+        ("ragged-paged", dataclasses.replace(
+            scfg, ragged=True, page_pool_pages=pool_pages, page_tokens=pt,
+            max_continuations=0, column_cache_bytes=cache_bytes)),
+    )
+    waste: dict = {}
+    for arm, arm_scfg in arms:
+        engines = _make_engines(cfg, arm_scfg, 1)
+        engine = engines[0]
+        if arm == "ragged-paged":
+            engine.warmup_ragged()
+        else:
+            engine.warmup()
+        served = 0
+        with DynamicBatcher(engines=engines) as batcher:
+            for f in range(n_frames):
+                tickets = []
+                for s in range(n_streams):
+                    img = frames[s][f]
+                    if arm == "bucket-ladder":
+                        # The pad tax, literally: embed the small image
+                        # into the full canvas (zeros elsewhere) so the
+                        # fixed-shape engine can serve it at all.
+                        canvas = np.zeros(
+                            (cfg.channels, side, side), np.float32
+                        )
+                        canvas[:, : img.shape[1], : img.shape[2]] = img
+                        img = canvas
+                    try:
+                        tickets.append(
+                            batcher.submit(img, session_id=f"s{s}")
+                        )
+                    except ShedError:
+                        continue
+                for t in tickets:
+                    try:
+                        t.result(timeout=600.0)
+                        served += 1
+                    except Exception:
+                        continue
+            summary = batcher.summary_record()
+            dispatches = list(batcher.dispatches)
+        # True token-slot accounting per arm: the bucket arm's slots are
+        # bucket x full-resolution patches (canvas padding included);
+        # the ragged arm's are its page-aligned totals.
+        if arm == "ragged-paged":
+            slots = sum(
+                d["n_pages"] * pt for d in dispatches if d.get("ragged")
+            )
+        else:
+            slots = sum(d["bucket"] * cfg.num_patches for d in dispatches)
+        pct = round(100.0 * (1.0 - useful / slots), 2) if slots else None
+        warm_lat = [
+            d["latency_ms"] for d in dispatches
+            if d.get("n_cache_warm", 0) or d.get("n_page_warm", 0)
+        ]
+        cold_lat = [
+            d["latency_ms"] for d in dispatches
+            if not (d.get("n_cache_warm", 0) or d.get("n_page_warm", 0))
+        ]
+        emit(dict(summary, config=f"{arm}, {label}"), kind="serve")
+        if pct is None:
+            emit(
+                {
+                    "metric": f"serve_pad_waste ({arm}, {label})",
+                    "value": None,
+                    "unit": "percent",
+                    "error": "no-requests-served",
+                    "note": f"UNMEASURED: ragged sweep {arm} served nothing",
+                },
+                kind="error",
+            )
+            continue
+        waste[arm] = pct
+        emit(
+            {
+                "metric": f"serve_pad_waste ({arm}, {label})",
+                "value": pct,
+                "unit": "percent",
+                "useful_tokens": useful,
+                "slot_tokens": slots,
+                "served": served,
+            }
+        )
+        emit(
+            {
+                "metric": f"serve_levels0_h2d_bytes ({arm}, {label})",
+                "value": summary["levels0_h2d_bytes"],
+                "unit": "bytes",
+                "n_page_warm": summary["n_page_warm"],
+            }
+        )
+        for name, lat in (("warm", warm_lat), ("cold", cold_lat)):
+            if lat:
+                emit(
+                    {
+                        "metric": (
+                            f"serve_{name}_dispatch_ms ({arm}, {label})"
+                        ),
+                        "value": round(sum(lat) / len(lat), 3),
+                        "unit": "ms",
+                        "n_dispatches": len(lat),
+                    }
+                )
+        mean = summary.get("mean_executed_iters")
+        if mean is not None:
+            emit(
+                {
+                    "metric": f"serve_ragged_mean_iters ({arm}, {label})",
+                    "value": mean,
+                    "unit": "iters/request",
+                }
+            )
+    if "bucket-ladder" in waste and "ragged-paged" in waste:
+        # Informational (kind "note", not a gated bench row: a LARGER
+        # saving is better, which the cost-unit heuristics would read
+        # backwards — the per-arm serve_pad_waste rows are what gate).
+        emit(
+            {
+                "note": "ragged pad-waste saving",
+                "config": label,
+                "saved_pct_points": round(
+                    waste["bucket-ladder"] - waste["ragged-paged"], 2
+                ),
+                "bucket_ladder_pct": waste["bucket-ladder"],
+                "ragged_paged_pct": waste["ragged-paged"],
+            },
+            kind="note",
+        )
+    return waste
+
+
 def run_trace_ab(cfg, scfg, label: str, *, n_requests: int,
                  n_engines: int = 1, repeats: int = 3) -> dict:
     """Request-tracing overhead A/B (docs/OBSERVABILITY.md, Request
@@ -606,6 +812,13 @@ def main(argv=None) -> int:
     ap.add_argument("--hetero", type=float, default=0.5, metavar="FRAC",
                     help="fraction of HARD (slow-converging) requests in "
                     "the two-tier A/B's synthetic traffic (default 0.5)")
+    ap.add_argument("--ragged", action="store_true",
+                    help="run the mixed-resolution ragged-vs-bucket sweep "
+                    "INSTEAD of the load sweep: the same streamed traffic "
+                    "served padded through the bucket ladder vs packed "
+                    "through the ragged page ladder, measuring pad-waste "
+                    "fraction, warm/cold dispatch latency, and warm-path "
+                    "levels0 upload bytes per arm (docs/SERVING.md)")
     ap.add_argument("--temporal", action="store_true",
                     help="run the streaming warm-vs-cold A/B INSTEAD of "
                     "the load sweep: frame-sequence traffic per stream "
@@ -699,6 +912,14 @@ def main(argv=None) -> int:
             cfg, scfg, label,
             n_requests=n_requests,
             n_engines=args.engines,
+        )
+        return 0
+    if args.ragged:
+        run_ragged(
+            cfg, scfg, label,
+            n_streams=args.streams,
+            n_frames=args.frames,
+            perturb=args.perturb,
         )
         return 0
     if args.temporal:

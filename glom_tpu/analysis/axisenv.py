@@ -1,0 +1,326 @@
+"""axis-environment: a collective's axis name must exist in the enclosing
+shard_map's mesh.
+
+The collective-coverage checker (analysis/collectives.py) validates axis
+names against the GLOBAL vocabulary — every `*_AXIS` constant in the
+scanned tree. That misses a subtler bug: a psum over 'model' inside a
+shard_map whose mesh only declares ('data', 'seq') uses a perfectly
+vocabulary-legal axis that DOES NOT EXIST in its own environment, and
+fails only at runtime, only when that exact mesh shape traces. The paged
+serve gathers (parallel/serve_mesh.py) are exactly where this bites: the
+serve mesh is ('data', 'seq') while the training mesh also carries
+'model', so a copy-pasted training collective is one axis name away from
+a trace-time explosion the lint should catch on CPU.
+
+Environment resolution (static, conservative — unresolvable skips, never
+guesses). The flagging environment must be ATTESTED by a MeshConfig
+construction, because PartitionSpec literals alone are a lower bound (an
+axis can exist in the mesh without sharding any input):
+
+  * a `mesh=` argument whose value (directly or via one local/module
+    assignment) contains a literal `MeshConfig(data=..., seq=...)` call
+    — the keyword names ARE the axis names (MeshConfig.axis_names); or,
+    failing that,
+  * the MODULE-WIDE union of every MeshConfig axis keyword in the file
+    (a module that only ever builds (data, seq) meshes — the serve mesh
+    — never legally runs a 'model' collective);
+  * PartitionSpec axes from in_specs/out_specs (following one level of
+    local-variable indirection, `batch_spec = P(DATA_AXIS)`) UNION into
+    the environment but never attest it on their own.
+
+A shard_map with no attested environment (an opaque mesh parameter in a
+module that builds no meshes — the training shard bodies, whose mesh
+shapes arrive from config) is SKIPPED — precision stance: this checker
+only fires when it can prove the axis absent. Collectives are checked
+through the body's intra-module call graph, both direct lax.* sites and
+axis names threaded through `*axis*`-named parameters of local helpers
+(the `_psum_wire(x, SEQ_AXIS, k)` idiom).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from glom_tpu.analysis.astutil import (
+    call_name,
+    const_str,
+    enclosing_function,
+    imported_collective_aliases,
+)
+from glom_tpu.analysis.collectives import AXIS_ARG, _collective_of
+from glom_tpu.analysis.core import Checker, Context, Finding, SourceModule
+
+# MeshConfig keyword names that declare axes (num_slices is a layout
+# knob, not an axis — parallel/mesh.py).
+_MESH_AXIS_KW = {"data", "seq", "model"}
+
+
+def _local_assignments(fn_node: Optional[ast.AST], tree: ast.Module):
+    """name -> assigned expression, function-local first then module
+    level (one level of indirection is all the spec idiom uses)."""
+    out: Dict[str, ast.AST] = {}
+    scopes = []
+    if fn_node is not None:
+        scopes.append(ast.iter_child_nodes(fn_node))
+    scopes.append(iter(tree.body))
+    for body in scopes:
+        for node in body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id not in out:
+                        out[t.id] = node.value
+    return out
+
+
+def _spec_axes(
+    node: ast.AST,
+    consts: Dict[str, str],
+    assigns,
+    _seen: Optional[Set[str]] = None,
+) -> Set[str]:
+    """Axis names in a PartitionSpec expression subtree, following Name
+    references (spec variables like `lv_spec = P(DATA_AXIS, SEQ_AXIS)`)
+    through the assignment map (cycle-guarded)."""
+    seen = _seen if _seen is not None else set()
+    axes: Set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            name = call_name(sub)
+            if name and name.split(".")[-1] in ("P", "PartitionSpec"):
+                for arg in sub.args:
+                    for leaf in ast.walk(arg):
+                        s = const_str(leaf)
+                        if s is not None:
+                            axes.add(s)
+                        elif (
+                            isinstance(leaf, ast.Name)
+                            and leaf.id in consts
+                        ):
+                            axes.add(consts[leaf.id])
+        elif isinstance(sub, ast.Name) and sub.id not in seen:
+            seen.add(sub.id)
+            target = assigns.get(sub.id)
+            if target is not None:
+                axes |= _spec_axes(target, consts, assigns, seen)
+    return axes
+
+
+def _mesh_axes(node: Optional[ast.AST], assigns) -> Set[str]:
+    """Axis names provable from a mesh= argument: a MeshConfig(...) call
+    in the argument's (or its assignment's) subtree declares its keyword
+    names as axes."""
+    if node is None:
+        return set()
+    if isinstance(node, ast.Name):
+        node = assigns.get(node.id)
+        if node is None:
+            return set()
+    axes: Set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            name = call_name(sub)
+            if name and name.split(".")[-1] == "MeshConfig":
+                for kw in sub.keywords:
+                    if kw.arg in _MESH_AXIS_KW:
+                        axes.add(kw.arg)
+    return axes
+
+
+class AxisEnvironment(Checker):
+    name = "axis-environment"
+    description = (
+        "collectives inside a shard_map use axis names that exist in "
+        "THAT shard_map's mesh (not just the global vocabulary)"
+    )
+
+    def check(self, module: SourceModule, ctx: Context) -> List[Finding]:
+        aliases = imported_collective_aliases(module.tree)
+        consts: Dict[str, str] = {}
+        for node in module.tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    s = const_str(node.value)
+                    if isinstance(t, ast.Name) and s is not None:
+                        consts[t.id] = s
+        # Module-wide attestation: every MeshConfig axis keyword in the
+        # file (the fallback environment when a site's mesh= argument is
+        # an opaque parameter).
+        module_mesh_axes: Set[str] = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                if name and name.split(".")[-1] == "MeshConfig":
+                    for kw in node.keywords:
+                        if kw.arg in _MESH_AXIS_KW:
+                            module_mesh_axes.add(kw.arg)
+        findings: List[Finding] = []
+        seen = set()
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if not name or name.split(".")[-1] != "shard_map":
+                continue
+            for f in self._check_shard_map(
+                module, node, aliases, consts, module_mesh_axes
+            ):
+                # A helper reached from several shard_map sites yields
+                # one finding per site — identical claims dedup.
+                fp = (f.line, f.col, f.key, f.message)
+                if fp not in seen:
+                    seen.add(fp)
+                    findings.append(f)
+        return findings
+
+    # -- one shard_map site -------------------------------------------------
+
+    def _check_shard_map(
+        self,
+        module: SourceModule,
+        call: ast.Call,
+        aliases: dict,
+        consts: Dict[str, str],
+        module_mesh_axes: Set[str],
+    ) -> List[Finding]:
+        enclosing = enclosing_function(module.parents, call)
+        assigns = _local_assignments(enclosing, module.tree)
+        spec_env: Set[str] = set()
+        mesh_arg = None
+        for kw in call.keywords:
+            if kw.arg in ("in_specs", "out_specs"):
+                spec_env |= _spec_axes(kw.value, consts, assigns)
+            elif kw.arg == "mesh":
+                mesh_arg = kw.value
+        attested = _mesh_axes(mesh_arg, assigns) or module_mesh_axes
+        if not attested:
+            return []  # opaque environment: skip, never guess
+        env = attested | spec_env
+        body = call.args[0] if call.args else None
+        funcs = self._reachable(module, enclosing, body)
+        findings: List[Finding] = []
+        for info in funcs:
+            for sub in info.body_nodes():
+                if not isinstance(sub, ast.Call):
+                    continue
+                findings.extend(
+                    self._check_call(
+                        module, sub, aliases, consts, env, info
+                    )
+                )
+        return findings
+
+    def _reachable(self, module: SourceModule, enclosing, body) -> List:
+        """The body function plus every intra-module function its call
+        graph reaches (names resolved through the scope chain)."""
+        start = None
+        if isinstance(body, ast.Name):
+            scope_info = (
+                module.index.info_for(enclosing) if enclosing else None
+            )
+            scope = (
+                scope_info.scope if scope_info else module.index.module_scope
+            )
+            start = scope.resolve(body.id)
+        elif isinstance(body, (ast.Lambda, ast.FunctionDef)):
+            start = module.index.info_for(body)
+        if start is None:
+            return []
+        seen = {id(start.node)}
+        work, out = [start], [start]
+        while work:
+            info = work.pop()
+            for sub in info.body_nodes():
+                if not isinstance(sub, ast.Call):
+                    continue
+                name = call_name(sub)
+                if not name or "." in name:
+                    continue
+                callee = info.scope.resolve(name)
+                if callee is not None and id(callee.node) not in seen:
+                    seen.add(id(callee.node))
+                    work.append(callee)
+                    out.append(callee)
+        return out
+
+    def _resolve_axis(
+        self, node: ast.AST, consts: Dict[str, str]
+    ) -> Optional[str]:
+        s = const_str(node)
+        if s is not None:
+            return s
+        if isinstance(node, ast.Name) and node.id in consts:
+            return consts[node.id]
+        return None
+
+    def _check_call(
+        self,
+        module: SourceModule,
+        call: ast.Call,
+        aliases: dict,
+        consts: Dict[str, str],
+        env: Set[str],
+        info,
+    ) -> List[Finding]:
+        out: List[Finding] = []
+
+        def flag(axis: str, what: str) -> None:
+            out.append(
+                Finding(
+                    checker=self.name,
+                    path=module.relpath,
+                    line=call.lineno,
+                    col=call.col_offset,
+                    message=(
+                        f"{what} uses axis {axis!r}, which is not in the "
+                        f"enclosing shard_map's mesh axes {sorted(env)} — "
+                        "this traces only at runtime, on that exact mesh"
+                    ),
+                    symbol=info.qualname,
+                    key=f"axis-env-{axis}",
+                )
+            )
+
+        coll = _collective_of(call, aliases)
+        if coll is not None:
+            axis_node = None
+            for kw in call.keywords:
+                if kw.arg == "axis_name":
+                    axis_node = kw.value
+            if axis_node is None:
+                idx = AXIS_ARG[coll]
+                if len(call.args) > idx:
+                    axis_node = call.args[idx]
+            axes = []
+            if axis_node is not None:
+                if isinstance(axis_node, (ast.Tuple, ast.List)):
+                    axes = [
+                        self._resolve_axis(e, consts)
+                        for e in axis_node.elts
+                    ]
+                else:
+                    axes = [self._resolve_axis(axis_node, consts)]
+            for axis in axes:
+                if axis is not None and axis not in env:
+                    flag(axis, f"lax.{coll}")
+            return out
+        # Axis threaded through a local helper's *axis*-named parameter
+        # (the registered-wrapper idiom: _psum_wire(x, SEQ_AXIS, k)).
+        name = call_name(call)
+        if not name or "." in name:
+            return out
+        callee = info.scope.resolve(name)
+        if callee is None:
+            return out
+        params = callee.params
+        for i, arg in enumerate(call.args):
+            if i < len(params) and "axis" in params[i]:
+                axis = self._resolve_axis(arg, consts)
+                if axis is not None and axis not in env:
+                    flag(axis, f"{name}({params[i]}=...)")
+        for kw in call.keywords:
+            if kw.arg and "axis" in kw.arg:
+                axis = self._resolve_axis(kw.value, consts)
+                if axis is not None and axis not in env:
+                    flag(axis, f"{name}({kw.arg}=...)")
+        return out

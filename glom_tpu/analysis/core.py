@@ -212,6 +212,7 @@ def _collect_axis_vocab(modules: List[SourceModule], ctx: Context) -> None:
 
 
 def default_checkers() -> List[Checker]:
+    from glom_tpu.analysis.axisenv import AxisEnvironment
     from glom_tpu.analysis.collectives import CollectiveCoverage
     from glom_tpu.analysis.donation import DonationSafety
     from glom_tpu.analysis.lockset import LockOrder, Lockset
@@ -221,6 +222,7 @@ def default_checkers() -> List[Checker]:
 
     return [
         CollectiveCoverage(),
+        AxisEnvironment(),
         TracePurity(),
         DonationSafety(),
         SchemaEmit(),

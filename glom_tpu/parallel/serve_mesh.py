@@ -71,19 +71,28 @@ def make_serve_mesh(scfg: ServeConfig, devices: Optional[list] = None):
     )
 
 
-def serve_shardings(mesh, params, *, warm: bool = False):
+def serve_shardings(mesh, params, *, warm: bool = False, paged: bool = False):
     """(in_shardings, out_shardings) for one sharded bucket signature:
     params replicated, the image batch and validity mask sharded over
-    'data', a warm levels carry over ('data', 'seq'); outputs mirror the
-    forward's (levels, iters_run, row_converged, row_iters) contract.
-    Spec resolution lives HERE (one place) so the engine's AOT compile and
-    its per-attempt device_put can never disagree about layout."""
+    'data', a warm levels carry over ('data', 'seq') — or, on the PAGED
+    route, the pool buffer sharded on its PAGE axis over 'data' plus the
+    replicated page-index map; outputs mirror the forward's (levels,
+    iters_run, row_converged, row_iters) contract. Spec resolution lives
+    HERE (one place) so the engine's AOT compile and its per-attempt
+    device_put can never disagree about layout."""
+    if warm and paged:
+        raise ValueError("warm (host levels0) and paged are exclusive")
     rep = NamedSharding(mesh, P())
     batch = NamedSharding(mesh, P(DATA_AXIS))
     rows = NamedSharding(mesh, P(DATA_AXIS))
     lv = NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
+    pool_sh = NamedSharding(mesh, P(DATA_AXIS))
     param_sh = jax.tree_util.tree_map(lambda _: rep, params)
-    in_sh = (param_sh, batch, rows) + ((lv,) if warm else ())
+    in_sh = (param_sh, batch, rows)
+    if warm:
+        in_sh = in_sh + (lv,)
+    elif paged:
+        in_sh = in_sh + (pool_sh, rep)
     out_sh = (lv, rep, rows, rows)
     return in_sh, out_sh
 
@@ -96,6 +105,19 @@ def _psum_wire(x, axis_name: str, k: int):
         "reduce", tele_counters.ring_allreduce_bytes(x, k)
     )
     return lax.psum(x, axis_name)
+
+
+def _gather_pages_wire(pool_loc, k: int):
+    """The SHARDED PAGE GATHER (docs/SERVING.md, "Paged column memory"):
+    the pool buffer shards its page axis over 'data', and a paged warm
+    dispatch materializes the full pool per shard with one registered
+    all_gather before the page-index take. Wire is priced at the whole
+    pool shard ((k-1) x local bytes — the provisioning bound; a
+    needed-pages-only exchange is the documented follow-on)."""
+    tele_counters.record_collective(
+        "gather", tele_counters.ring_all_gather_bytes(pool_loc, k)
+    )
+    return lax.all_gather(pool_loc, DATA_AXIS, axis=0, tiled=True)
 
 
 def _sharded_row_agreement(levels, n: int, seq: int) -> jnp.ndarray:
@@ -127,6 +149,7 @@ def make_serve_forward(
     use_pallas: bool = False,
     sp_strategy: str = "auto",
     warm: bool = False,
+    page_tokens: Optional[int] = None,
 ):
     """Build the sharded bucket forward for one engine signature.
 
@@ -139,6 +162,14 @@ def make_serve_forward(
     identically. The per-shard loop body is the reference-layout
     `update_step` (the SAME contract as serve/early_exit), with consensus
     swapped for the per-shard ring/ulysses/halo body when seq > 1.
+
+    page_tokens selects the PAGED warm variant instead: the signature
+    takes (pool [n_pages, page_tokens, L, d] sharded on its page axis
+    over 'data', page_idx [b, pages_per_row] replicated int32, -1 =
+    cold row) and each shard assembles its rows' levels0 in-graph — one
+    registered all_gather of the pool over 'data' (the sharded page
+    gather), a page-index take, then the seq band slice. Warm column
+    state never crosses the host boundary on this route.
     """
     from glom_tpu.serve.early_exit import (
         _validate_auto_args,
@@ -300,6 +331,52 @@ def make_serve_forward(
     lv_spec = P(DATA_AXIS, SEQ_AXIS)
     out_specs = (lv_spec, P(), P(DATA_AXIS), P(DATA_AXIS))
 
+    if warm and page_tokens is not None:
+        raise ValueError("warm (host levels0) and page_tokens are exclusive")
+    if page_tokens is not None:
+        if n % page_tokens != 0:
+            raise ValueError(
+                f"page_tokens {page_tokens} does not divide patches {n}"
+            )
+        pt = page_tokens
+
+        def paged_body(glom_params, img, mask, pool_loc, page_idx):
+            # The sharded page gather: pool pages live 1/dp per shard;
+            # one registered all_gather over 'data' materializes the
+            # full pool for this dispatch's take (wire priced at the
+            # provisioning bound — see _gather_pages_wire).
+            with jax.named_scope("page_gather"):
+                pool_full = _gather_pages_wire(pool_loc, dp)
+            b_loc = img.shape[0]
+            didx = lax.axis_index(DATA_AXIS)
+            my_idx = lax.dynamic_slice_in_dim(
+                page_idx, didx * b_loc, b_loc, axis=0
+            )  # [b_loc, pages_per_row]
+            with jax.named_scope("page_take"):
+                pages = pool_full[
+                    jnp.clip(my_idx, 0, pool_full.shape[0] - 1)
+                ]
+                init = jnp.broadcast_to(
+                    glom_params.init_levels[None],
+                    (pt, cfg.levels, cfg.dim),
+                ).astype(pool_full.dtype)
+                pages = jnp.where(
+                    (my_idx >= 0)[..., None, None, None], pages, init
+                )
+                lv_full = pages.reshape(b_loc, n, cfg.levels, cfg.dim)
+            seq_idx = lax.axis_index(SEQ_AXIS)
+            lv_loc = lax.dynamic_slice_in_dim(
+                lv_full, seq_idx * n_loc, n_loc, axis=1
+            )
+            return body_fn(glom_params, img, mask, lv_loc)
+
+        return shard_map(
+            paged_body,
+            mesh=mesh,
+            in_specs=(P(), batch_spec, batch_spec, P(DATA_AXIS), P()),
+            out_specs=out_specs,
+            check_vma=False,
+        )
     if warm:
         return shard_map(
             body_fn,

@@ -17,7 +17,12 @@ between that checkpoint and traffic (docs/SERVING.md). Layers:
     column_cache — ColumnCache: session-keyed warm-start column state
                  (streaming: frame t+1 dispatches from frame t's
                  converged columns), LRU under an HBM-priced byte
-                 budget, TTL, invalidation on engine failure
+                 budget, TTL, invalidation on engine failure; PAGES
+                 mode makes entries page-table references into the pool
+    paged_columns — PagedColumnPool: the device-resident HBM page pool
+                 (one preallocated [pages, page_tokens, L, d] buffer per
+                 engine + host page table) behind the zero-transfer warm
+                 path and ragged admission
     early_exit — glom_forward_auto / glom_forward_tiered: lax.while_loop
                  over column updates with the consensus-agreement delta
                  as the stopping witness (iters="auto"; the tiered form
@@ -32,6 +37,7 @@ the jax import, and engine/early_exit pull jax only when actually used.
 
 _EXPORTS = {
     "InferenceEngine": "engine",
+    "RaggedServeResult": "engine",
     "ServeResult": "engine",
     "BackendDownError": "batcher",
     "DynamicBatcher": "batcher",
@@ -40,18 +46,27 @@ _EXPORTS = {
     "ShedError": "batcher",
     "Ticket": "batcher",
     "ColumnCache": "column_cache",
+    "PageHit": "column_cache",
     "column_state_bytes": "column_cache",
     "resolve_column_cache": "column_cache",
+    "PagedColumnPool": "paged_columns",
+    "page_state_bytes": "paged_columns",
+    "pages_for_tokens": "paged_columns",
+    "resolve_page_pool": "paged_columns",
+    "resolve_page_tokens": "paged_columns",
+    "RaggedResult": "early_exit",
     "TieredAutoResult": "early_exit",
     "batch_agreement": "early_exit",
     "glom_forward_auto": "early_exit",
+    "glom_forward_ragged": "early_exit",
     "glom_forward_tiered": "early_exit",
     "masked_level_agreement": "early_exit",
+    "ragged_row_layout": "early_exit",
     "emit_serve": "events",
     "stamp_serve": "events",
 }
 _SUBMODULES = ("batcher", "cli", "column_cache", "early_exit", "engine",
-               "events")
+               "events", "paged_columns")
 
 __all__ = sorted([*_EXPORTS, *_SUBMODULES])
 

@@ -208,9 +208,13 @@ class _Item:
     __slots__ = (
         "img", "ticket", "session", "levels", "executed", "hops",
         "redispatches", "warm_src", "parent_span", "dispatch_ms",
+        "n_patches", "pages",
     )
 
-    def __init__(self, img: np.ndarray, ticket: Ticket, session=None):
+    def __init__(
+        self, img: np.ndarray, ticket: Ticket, session=None,
+        n_patches: Optional[int] = None,
+    ):
         self.img = img
         self.ticket = ticket
         self.session = session
@@ -218,15 +222,33 @@ class _Item:
         self.executed = 0  # column iterations run so far
         self.hops = 0      # continuation dispatches so far
         self.redispatches = 0
-        self.warm_src: Optional[str] = None  # None | "cache" | "cont"
+        # None | "cache" (host array) | "cont" (straggler) | "pages"
+        # (device-resident pool pages — serve/paged_columns.py)
+        self.warm_src: Optional[str] = None
         self.parent_span = ticket.span_id
         self.dispatch_ms = 0.0
+        self.n_patches = n_patches  # ragged: this row's patch count
+        self.pages = None           # pages-warm: the pinned PageHit
 
 
 def _backend_down() -> bool:
     from glom_tpu.telemetry.watchdog import backend_record
 
     return backend_record().get("backend_state") == "down"
+
+
+def _patchify_host(img: np.ndarray, patch_size: int) -> np.ndarray:
+    """[c, H, W] -> [n, p*p*c], bit-identical to ops/patch.patchify's
+    einops order ('b c (h p1) (w p2) -> b (h w) (p1 p2 c)') — a pure
+    reshape/transpose with no float ops, so the ragged route's in-graph
+    embed sees exactly the values the dense route's patchify produces
+    (the bitwise parity anchor; tests/test_paged_columns.py)."""
+    c, height, width = img.shape
+    p = patch_size
+    h, w = height // p, width // p
+    x = img.reshape(c, h, p, w, p)
+    x = x.transpose(1, 3, 2, 4, 0)  # [h, w, p1, p2, c]
+    return np.ascontiguousarray(x.reshape(h * w, p * p * c))
 
 
 class DynamicBatcher:
@@ -301,6 +323,18 @@ class DynamicBatcher:
             trace if trace is not None
             else bool(getattr(scfg, "trace_requests", True)) if scfg else True
         )
+        # Page pools (serve/paged_columns.py): engines carrying a device
+        # page pool switch the session cache to PAGES mode — entries are
+        # page-table references, warm dispatches take pages in-graph,
+        # and session AFFINITY routes a stream to the engine holding its
+        # pages. Ragged admission (scfg.ragged) packs mixed-resolution
+        # requests onto the page axis (docs/SERVING.md).
+        self._pools = {
+            self._ename(eng, i): eng.pool
+            for i, eng in enumerate(self.engines)
+            if getattr(eng, "pool", None) is not None
+        }
+        self._ragged = bool(getattr(scfg, "ragged", False)) if scfg else False
         # Streaming warm-start column cache (serve/column_cache.py):
         # None RESOLVES from the lead engine's ServeConfig
         # (column_cache_bytes > 0 builds one) — the ladder pattern. Pass
@@ -308,8 +342,31 @@ class DynamicBatcher:
         if column_cache is None:
             from glom_tpu.serve.column_cache import resolve_column_cache
 
-            column_cache = resolve_column_cache(scfg, writer=writer)
+            column_cache = resolve_column_cache(
+                scfg, writer=writer, pools=self._pools or None
+            )
         self.cache = column_cache
+        if (
+            self.cache is not None
+            and getattr(self.cache, "pools", None) is not None
+        ):
+            # Pages mode must cover the WHOLE fleet: a pool-less engine
+            # would receive PageHits its host-path dispatch cannot use
+            # (and its write-backs have no pool to land in) — a config
+            # error, caught loudly here rather than as a mid-traffic
+            # worker crash.
+            missing = [
+                self._ename(eng, i)
+                for i, eng in enumerate(self.engines)
+                if self._ename(eng, i) not in self.cache.pools
+            ]
+            if missing:
+                raise ValueError(
+                    f"pages-mode column cache but engines {missing} carry "
+                    "no page pool — mixed pool/pool-less fleets are "
+                    "unsupported (give every engine page_pool_pages, or "
+                    "none)"
+                )
         # Engine REJOIN after recovery: a dead engine's worker hands off
         # to a PROBATION thread that health-dispatches until
         # rejoin_threshold consecutive successes re-admit the engine
@@ -363,6 +420,16 @@ class DynamicBatcher:
         self.ladder = self._ladders[self._ename(self.engines[0], 0)]
         self._clock = clock
         self._q: queue.Queue = queue.Queue(maxsize=depth)
+        # SESSION-AFFINITY queues (pages mode): one per engine. A stream
+        # whose pages live in engine E's pool routes to E's queue — its
+        # worker drains it ahead of the shared queue, so the warm path
+        # actually finds its pages on the engine that holds them. A full
+        # affinity queue (or a dead target) falls back to the shared
+        # queue: affinity is a fast path, never a trap.
+        self._aff_q = {
+            self._ename(eng, i): queue.Queue(maxsize=depth)
+            for i, eng in enumerate(self.engines)
+        }
         # Continuation queue: one GROUP (list of warm _Item sharing a
         # source dispatch) per entry. Unbounded:
         # its population is bounded by admitted-but-unresolved requests,
@@ -400,6 +467,18 @@ class DynamicBatcher:
         self.n_redispatched = 0  # engine-failover hand-offs
         self.n_folded = 0     # fresh rows folded into warm-group dispatches
         self.n_rejoined = 0   # engines re-admitted after probation
+        self.n_affinity = 0   # requests routed by session affinity
+        self.n_page_warm = 0  # rows warm-started from pool pages
+        # Pad-tax rollup (ISSUE 11 satellite): per-dispatch pad_fraction
+        # was stamped since PR 4 but never aggregated — the summary now
+        # carries the mean plus the BYTES the padding wasted (pad token
+        # positions x per-token column bytes), so `telemetry compare`
+        # can gate pad-waste regressions. levels0 upload bytes aggregate
+        # alongside (zero on the paged warm path — the acceptance
+        # counter).
+        self._pad_fraction_sum = 0.0
+        self._pad_bytes_wasted = 0
+        self._levels0_h2d_bytes = 0
         # The most recent request's [c, H, W] shape — what the probation
         # health probe dispatches (engine-agnostic: the batcher never
         # assumes a model config). Guarded by _counter_lock: submit()
@@ -496,7 +575,14 @@ class DynamicBatcher:
                 try:
                     got = self._cont_q.get_nowait()  # a continuation group
                 except queue.Empty:
-                    return
+                    for aq in self._aff_q.values():
+                        try:
+                            got = [aq.get_nowait()]
+                            break
+                        except queue.Empty:
+                            continue
+                    if got is None:
+                        return
             # Counted as FAILED: these tickets were admitted (n_submitted
             # incremented) and can no longer resolve — without the count,
             # summary_record()'s conservation (n_served + n_shed +
@@ -589,6 +675,23 @@ class DynamicBatcher:
                         **detail,
                     )
             img = np.asarray(img, np.float32)
+            n_patches = None
+            if self._ragged:
+                n_patches = self._ragged_patch_count(img)
+            # SESSION AFFINITY (pages mode): a stream whose pages live
+            # in a LIVE engine's pool routes to that engine's queue —
+            # the warm path must reach the pool that holds the state.
+            # Cold streams (and dead/unknown targets) ride the shared
+            # queue's least-depth dispatch as always.
+            target = None
+            if (
+                session_id is not None
+                and self.cache is not None
+                and self._pools
+            ):
+                t = self.cache.engine_of(session_id)
+                if t is not None and t in alive and t in self._aff_q:
+                    target = t
             # Count the admission BEFORE the put (rolled back on a full
             # queue): the instant the request is enqueued a worker may
             # serve it, and n_served must never exceed n_submitted even
@@ -597,18 +700,41 @@ class DynamicBatcher:
             with self._counter_lock:
                 self.n_submitted += 1
                 self._probe_shape = img.shape
-            try:
-                self._q.put_nowait(_Item(img, ticket, session_id))
-            except queue.Full:
-                with self._counter_lock:
-                    self.n_submitted -= 1
-                detail = dict(self._pressure(), trace_id=ticket.trace_id)
-                self._shed(ticket, "queue-full", **detail)
-                raise QueueFullError(
-                    f"request queue at capacity ({self._q.maxsize}); "
-                    "backpressure — retry later",
-                    **detail,
-                ) from None
+            item = _Item(img, ticket, session_id, n_patches=n_patches)
+            placed = False
+            if target is not None:
+                try:
+                    self._aff_q[target].put_nowait(item)
+                    placed = True
+                    with self._counter_lock:
+                        self.n_affinity += 1
+                except queue.Full:
+                    pass  # fall back to the shared queue
+                if placed:
+                    # Race with a concurrent death: the failure handler
+                    # sets alive=False BEFORE draining the affinity
+                    # queue, so either its drain saw this put, or we see
+                    # the flag here and drain ourselves — the ticket can
+                    # never strand in a queue no worker reads.
+                    with self._engine_lock:
+                        still_alive = self._engine_state[target]["alive"]
+                    if not still_alive:
+                        self._drain_affinity(target)
+            if not placed:
+                try:
+                    self._q.put_nowait(item)
+                except queue.Full:
+                    with self._counter_lock:
+                        self.n_submitted -= 1
+                    detail = dict(
+                        self._pressure(), trace_id=ticket.trace_id
+                    )
+                    self._shed(ticket, "queue-full", **detail)
+                    raise QueueFullError(
+                        f"request queue at capacity ({self._q.maxsize}); "
+                        "backpressure — retry later",
+                        **detail,
+                    ) from None
             with self._counter_lock:
                 threads = list(self._threads)
             if self._stop.is_set() and not any(
@@ -621,6 +747,31 @@ class DynamicBatcher:
                 self._fail_queued()
                 raise ShedError("batcher stopped")
         return ticket
+
+    def _ragged_patch_count(self, img: np.ndarray) -> int:
+        """Validate a ragged-mode request's shape and return its patch
+        count: [c, H, W] with H and W multiples of the patch size and at
+        most the full-resolution patch count (the pos table bounds a
+        row's length)."""
+        cfg = getattr(self.engine, "cfg", None)
+        if cfg is None or img.ndim != 3:
+            raise ValueError(
+                f"ragged submit needs a [c, H, W] image; got {img.shape}"
+            )
+        p = cfg.patch_size
+        c, h, w = img.shape
+        if c != cfg.channels or h % p or w % p or h < p or w < p:
+            raise ValueError(
+                f"ragged image {img.shape}: channels must be "
+                f"{cfg.channels} and H, W multiples of patch_size {p}"
+            )
+        n = (h // p) * (w // p)
+        if n > cfg.num_patches:
+            raise ValueError(
+                f"{n} patches exceed the model's {cfg.num_patches} (the "
+                "pos table bounds the row length)"
+            )
+        return n
 
     def _pressure(self, engine_name: Optional[str] = None) -> dict:
         """The machine-readable WHY of a shed/ladder decision: queue depth
@@ -732,17 +883,33 @@ class DynamicBatcher:
         first get is fairness-rotated (see __init__): last winner defers
         a handicap on an idle queue, timeouts carry per-engine jitter."""
         max_batch = self._effective_max_batch(engine_name)
-        if self._defer_pickup(engine_name):
-            time.sleep(self._pickup_handicap_s)
+        aq = self._aff_q[engine_name]
+        first = None
         try:
-            first = self._q.get(timeout=self._first_get_timeout(engine_name))
+            # Affinity first: streams routed HERE hold pages in this
+            # engine's pool — serving them elsewhere would cold-start.
+            first = aq.get_nowait()
         except queue.Empty:
-            return []
-        with self._counter_lock:
-            self._last_pickup = engine_name
+            pass
+        if first is None:
+            if self._defer_pickup(engine_name):
+                time.sleep(self._pickup_handicap_s)
+            try:
+                first = self._q.get(
+                    timeout=self._first_get_timeout(engine_name)
+                )
+            except queue.Empty:
+                return []
+            with self._counter_lock:
+                self._last_pickup = engine_name
         batch = [first]
         deadline = self._clock() + self.max_delay_s
         while len(batch) < max_batch:
+            try:
+                batch.append(aq.get_nowait())
+                continue
+            except queue.Empty:
+                pass
             remaining = deadline - self._clock()
             if remaining <= 0:
                 break
@@ -790,6 +957,7 @@ class DynamicBatcher:
             self._stop.is_set()
             and self._q.empty()
             and self._cont_q.empty()
+            and self._aff_q[engine_name].empty()
         ):
             with self._engine_lock:
                 if not self._engine_state[engine_name]["alive"]:
@@ -862,6 +1030,12 @@ class DynamicBatcher:
         while not self._stop.wait(self._rejoin_interval_s):
             with self._counter_lock:
                 shape = self._probe_shape
+            cfg = getattr(engine, "cfg", None)
+            if cfg is not None:
+                # A config-carrying engine probes at its own full
+                # resolution — ragged traffic's last-seen shape may be a
+                # smaller canvas than the bucket signatures compile for.
+                shape = (cfg.channels, cfg.image_size, cfg.image_size)
             if shape is None:
                 continue  # no traffic seen yet: nothing to probe with
             try:
@@ -919,6 +1093,19 @@ class DynamicBatcher:
 
     # -- dispatch ----------------------------------------------------------
 
+    @staticmethod
+    def _token_state_bytes(engine) -> Optional[int]:
+        """Per-token column bytes (L x d x itemsize) — the pad-waste
+        pricing unit. None for config-less fake engines."""
+        cfg = getattr(engine, "cfg", None)
+        scfg = getattr(engine, "scfg", None)
+        if cfg is None or scfg is None:
+            return None
+        itemsize = (
+            2 if getattr(scfg, "compute_dtype", "") == "bfloat16" else 4
+        )
+        return cfg.levels * cfg.dim * itemsize
+
     def _note_dispatch(self, engine_name: str, rec: dict, resolved: List[dict],
                        n_served: int, n_degraded: int, n_continued: int) -> None:
         """Per-engine + global bookkeeping for one successful dispatch,
@@ -933,6 +1120,12 @@ class DynamicBatcher:
                 self.n_served += n_served
                 self.n_degraded += n_degraded
                 self.n_continued += n_continued
+                self.n_page_warm += rec.get("n_page_warm") or 0
+                self._pad_fraction_sum += rec.get("pad_fraction") or 0.0
+                self._pad_bytes_wasted += rec.get("pad_bytes") or 0
+                self._levels0_h2d_bytes += (
+                    rec.get("levels0_h2d_bytes") or 0
+                )
                 self.dispatches.append(rec)
                 for r in resolved:
                     key = str(r["iters"])
@@ -986,8 +1179,13 @@ class DynamicBatcher:
                     )
                 )
                 continue
-            if item.warm_src == "cache":
+            if item.warm_src in ("cache", "pages"):
+                # Cache/page warmth drops to COLD on requeue: the
+                # failing engine's entries (and pool pages) are being
+                # invalidated right now — a re-dispatch must re-decide
+                # against the post-invalidation cache.
                 item.levels = None
+                item.pages = None
                 item.warm_src = None
             if item.levels is not None:
                 warm_survivors.append(item)
@@ -1008,6 +1206,56 @@ class DynamicBatcher:
             self.n_redispatched += requeued
         return requeued
 
+    def _drain_affinity(self, engine_name: str) -> None:
+        """A dead engine's affinity queue drains back to the SHARED
+        queue (its streams cold-start on a sibling — the pages died with
+        the pool). Tickets that no longer fit anywhere fail fast."""
+        aq = self._aff_q.get(engine_name)
+        if aq is None:
+            return
+        while True:
+            try:
+                item = aq.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                self._q.put_nowait(item)
+            except queue.Full:
+                with self._counter_lock:
+                    self.n_failed += 1
+                item.ticket._fail(
+                    QueueFullError("affinity drain after engine death: full")
+                )
+
+    def _ragged_chunks(self, engine, batch) -> List[list]:
+        """Split a gathered ragged batch so each chunk's total pages fit
+        the largest ragged signature (one chunk in the common case — the
+        default ladder tops at max_batch x pages-per-full-row)."""
+        from glom_tpu.serve.paged_columns import (
+            pages_for_tokens,
+            resolve_page_tokens,
+        )
+
+        pool = getattr(engine, "pool", None)
+        pt = (
+            pool.page_tokens if pool is not None
+            else resolve_page_tokens(engine.cfg, engine.scfg)
+        )
+        top = max(engine.ragged_page_buckets)
+        chunks: List[list] = []
+        cur: List = []
+        pages = 0
+        for it in batch:
+            need = pages_for_tokens(it.n_patches, pt)
+            if cur and pages + need > top:
+                chunks.append(cur)
+                cur, pages = [], 0
+            cur.append(it)
+            pages += need
+        if cur:
+            chunks.append(cur)
+        return chunks
+
     def _dispatch(self, engine, engine_name: str, batch) -> None:
         if self.shed_when_down and _backend_down():
             # Gathered but undispatchable: fail every ticket fast with the
@@ -1018,6 +1266,13 @@ class DynamicBatcher:
                     req.ticket, "backend-down", **self._pressure(engine_name)
                 )
             return
+        if self._ragged and hasattr(engine, "infer_ragged"):
+            for chunk in self._ragged_chunks(engine, batch):
+                self._dispatch_traced(engine, engine_name, chunk)
+            return
+        self._dispatch_traced(engine, engine_name, batch)
+
+    def _dispatch_traced(self, engine, engine_name: str, batch) -> None:
         if self._trace:
             # One span per dispatch ATTEMPT: the batch-level records of
             # this dispatch (dispatch/continuation/failover) share it, and
@@ -1035,14 +1290,102 @@ class DynamicBatcher:
             with tracectx.dispatch_scope(
                 dspan, tfields["trace_ids"], tfields["parent_spans"]
             ):
-                self._dispatch_batch(engine, engine_name, batch, dspan, tfields)
+                self._dispatch_one(engine, engine_name, batch, dspan, tfields)
         else:
             # Untraced: the context keys still stamp — as null, so the
             # schema's presence contract holds (an explicitly untraced
             # record lints; an absent key would not).
-            self._dispatch_batch(
+            self._dispatch_one(
                 engine, engine_name, batch, None, {"trace_ids": None}
             )
+
+    def _dispatch_one(
+        self, engine, engine_name: str, batch, dspan, tfields
+    ) -> None:
+        if self._ragged and hasattr(engine, "infer_ragged"):
+            self._dispatch_ragged_batch(
+                engine, engine_name, batch, dspan, tfields
+            )
+        else:
+            self._dispatch_batch(engine, engine_name, batch, dspan, tfields)
+
+    def _handle_dispatch_failure(
+        self, engine_name: str, batch, e, dspan, tfields, n: int, warm: bool
+    ) -> None:
+        """One dispatch failure's full consequence chain, shared by the
+        bucket and ragged routes: engine-state transition, cache (and
+        page) invalidation BEFORE any requeue, affinity fallback,
+        sibling failover or per-ticket failure, and the stamped
+        evidence."""
+        state = self._note_failure(engine_name)
+        if self.cache is not None:
+            # A failing engine's cache entries are suspect the moment
+            # the failure is observed: drop them BEFORE any requeue
+            # re-decides warmth, so stale or dead-engine state can
+            # never warm-start a request (docs/SERVING.md). In pages
+            # mode this FREES the engine's pool pages (the cache's
+            # _drop returns them) — exactly as cache invalidation
+            # does, before any failover requeue.
+            self.cache.invalidate_engine(engine_name)
+        if not state["alive"]:
+            # Streams routed here by session affinity fall back to
+            # the shared queue (their pages just died with the pool).
+            self._drain_affinity(engine_name)
+        if state["siblings"]:
+            # FAILOVER: hand this batch to the siblings instead of
+            # failing it — the multi-engine contract a dead engine's
+            # chaos scenario validates (docs/RESILIENCE.md). The
+            # failover record takes this attempt's span (the failed
+            # dispatch emitted no record of its own), and the items
+            # re-parent to it, so the redispatch hop is a CHILD of
+            # the failover in each request's causal tree.
+            if dspan is not None:
+                for item in batch:
+                    item.parent_span = dspan
+            n_req = self._requeue(batch)
+            self._emit(
+                {
+                    "event": "engine_failover",
+                    "engine": engine_name,
+                    "engine_alive": state["alive"],
+                    "n_requeued": n_req,
+                    "n_valid": n,
+                    "warm_state": warm,
+                    "exception": f"{type(e).__name__}: {e}"[:300],
+                    **tfields,
+                }
+            )
+            if not state["alive"]:
+                self._emit(
+                    {"event": "engine_dead", "engine": engine_name}
+                )
+            if not self._alive_engines():
+                # The sibling snapshot raced a concurrent death: the
+                # requeued batch landed in queues no live worker will
+                # drain — fail it (and everything else queued) now
+                # rather than strand tickets until stop().
+                self._fail_queued()
+            return
+        with self._counter_lock:
+            self.n_failed += len(batch)
+        for req in batch:
+            req.ticket._fail(e)
+        self._emit(
+            {
+                "event": "dispatch_error",
+                "engine": engine_name,
+                "n_valid": n,
+                "exception": f"{type(e).__name__}: {e}"[:300],
+                **tfields,
+            }
+        )
+        if not state["alive"]:
+            self._emit({"event": "engine_dead", "engine": engine_name})
+            if not self._alive_engines():
+                # The LAST engine just died: nothing will ever drain
+                # the queues — fail what is waiting rather than
+                # strand it until stop() (tickets stay terminal).
+                self._fail_queued()
 
     def _dispatch_batch(
         self, engine, engine_name: str, batch, dspan, tfields
@@ -1071,11 +1414,49 @@ class DynamicBatcher:
         # stream's cached columns when one is resident (full budget — a
         # new frame, not a continuation). Decided HERE, at dispatch, so
         # the state is the freshest write-back and a cache invalidated
-        # since submit can never warm-start the row.
+        # since submit can never warm-start the row. PAGES mode: the hit
+        # is a pinned PageHit — the row carries page INDICES into the
+        # engine's paged signature and the columns never leave HBM; a
+        # hit in a SIBLING's pool (affinity raced a failover) reads as a
+        # miss here. Continuation groups skip lookups in pages mode (the
+        # paged signature and the host levels0 carry are different
+        # programs — folded fresh rows go cold, stamped as misses).
+        pages_mode = (
+            engine_name in self._pools
+            and self.cache is not None
+            and self.cache.pools is not None
+        )
+        has_cont = any(it.warm_src == "cont" for it in batch)
         n_cache_warm = n_cache_miss = 0
+        pinned: List[str] = []
         if self.cache is not None:
             for it in batch:
-                if it.levels is None and it.session is not None:
+                if (
+                    it.levels is not None
+                    or it.pages is not None
+                    or it.session is None
+                ):
+                    continue
+                if pages_mode:
+                    if has_cont:
+                        n_cache_miss += 1
+                        continue
+                    hit = self.cache.lookup(it.session, pin=True)
+                    full_n = engine.cfg.num_patches
+                    if (
+                        hit is not None
+                        and getattr(hit, "engine", None) == engine_name
+                        and getattr(hit, "n_tokens", None) == full_n
+                    ):
+                        it.pages = hit
+                        it.warm_src = "pages"
+                        pinned.append(it.session)
+                        n_cache_warm += 1
+                    else:
+                        if hit is not None:
+                            self.cache.unpin(it.session)
+                        n_cache_miss += 1
+                else:
                     hit = self.cache.lookup(it.session)
                     if hit is not None:
                         it.levels = hit
@@ -1083,6 +1464,7 @@ class DynamicBatcher:
                         n_cache_warm += 1
                     else:
                         n_cache_miss += 1
+        warm_pages = any(it.pages is not None for it in batch)
         warm = any(it.levels is not None for it in batch)
         # The remaining per-request budget caps the auto route at the
         # TIGHTEST row (min over rows of budget - executed; cold and
@@ -1126,73 +1508,30 @@ class DynamicBatcher:
                     and remaining < budget
                 ):
                     kw["auto_budget"] = remaining
+            elif warm_pages:
+                # The PAGED warm path: rows carry page indices, cold
+                # rows -1 — the compiled program takes the pool pages
+                # in-graph (zero levels0 upload; serve/paged_columns.py).
+                pool = self._pools[engine_name]
+                ppr = engine.cfg.num_patches // pool.page_tokens
+                prow = np.full((bucket, ppr), -1, np.int32)
+                for i, it in enumerate(batch):
+                    if it.pages is not None:
+                        prow[i] = it.pages.pages
+                kw["page_rows"] = prow
             with span("serve_dispatch", aggregator=self.spans):
                 result = engine.infer(imgs, n_valid=n, **kw)
+            for sid in pinned:
+                self.cache.unpin(sid)
+            pinned = []
             with span("serve_fetch", aggregator=self.spans):
                 levels = np.asarray(result.levels[:n])
         except BaseException as e:  # noqa: BLE001 — relayed per ticket
-            state = self._note_failure(engine_name)
-            if self.cache is not None:
-                # A failing engine's cache entries are suspect the moment
-                # the failure is observed: drop them BEFORE any requeue
-                # re-decides warmth, so stale or dead-engine state can
-                # never warm-start a request (docs/SERVING.md).
-                self.cache.invalidate_engine(engine_name)
-            if state["siblings"]:
-                # FAILOVER: hand this batch to the siblings instead of
-                # failing it — the multi-engine contract a dead engine's
-                # chaos scenario validates (docs/RESILIENCE.md). The
-                # failover record takes this attempt's span (the failed
-                # dispatch emitted no record of its own), and the items
-                # re-parent to it, so the redispatch hop is a CHILD of
-                # the failover in each request's causal tree.
-                if dspan is not None:
-                    for item in batch:
-                        item.parent_span = dspan
-                n_req = self._requeue(batch)
-                self._emit(
-                    {
-                        "event": "engine_failover",
-                        "engine": engine_name,
-                        "engine_alive": state["alive"],
-                        "n_requeued": n_req,
-                        "n_valid": n,
-                        "warm_state": warm,
-                        "exception": f"{type(e).__name__}: {e}"[:300],
-                        **tfields,
-                    }
-                )
-                if not state["alive"]:
-                    self._emit(
-                        {"event": "engine_dead", "engine": engine_name}
-                    )
-                if not self._alive_engines():
-                    # The sibling snapshot raced a concurrent death: the
-                    # requeued batch landed in queues no live worker will
-                    # drain — fail it (and everything else queued) now
-                    # rather than strand tickets until stop().
-                    self._fail_queued()
-                return
-            with self._counter_lock:
-                self.n_failed += len(batch)
-            for req in batch:
-                req.ticket._fail(e)
-            self._emit(
-                {
-                    "event": "dispatch_error",
-                    "engine": engine_name,
-                    "n_valid": n,
-                    "exception": f"{type(e).__name__}: {e}"[:300],
-                    **tfields,
-                }
+            for sid in pinned:
+                self.cache.unpin(sid)
+            self._handle_dispatch_failure(
+                engine_name, batch, e, dspan, tfields, n, warm or warm_pages
             )
-            if not state["alive"]:
-                self._emit({"event": "engine_dead", "engine": engine_name})
-                if not self._alive_engines():
-                    # The LAST engine just died: nothing will ever drain
-                    # the queues — fail what is waiting rather than
-                    # strand it until stop() (tickets stay terminal).
-                    self._fail_queued()
             return
 
         # Resolve vs re-bucket, row by row. Stragglers (valid, unconverged,
@@ -1236,11 +1575,22 @@ class DynamicBatcher:
             else:
                 # Write-back BEFORE resolve: the moment the caller sees
                 # frame t's response it may submit frame t+1, and that
-                # frame must find the cache already warm.
+                # frame must find the cache already warm. Pages mode
+                # hands the DEVICE row slice straight to the pool
+                # (device-to-device — the converged columns never visit
+                # the host on the way in).
                 if self.cache is not None and it.session is not None:
-                    self.cache.store(
-                        it.session, np.array(levels[i]), engine=engine_name
-                    )
+                    if pages_mode:
+                        self.cache.store(
+                            it.session, result.levels[i],
+                            engine=engine_name,
+                            n_tokens=engine.cfg.num_patches,
+                        )
+                    else:
+                        self.cache.store(
+                            it.session, np.array(levels[i]),
+                            engine=engine_name,
+                        )
                 to_resolve.append((it, i, executed_i))
                 resolved.append({"iters": executed_i, "tier": it.hops})
                 n_resolved += 1
@@ -1263,12 +1613,18 @@ class DynamicBatcher:
                 cont["span_id"] = tracectx.new_span_id()
                 cont["parent_spans"] = [dspan] * len(stragglers)
             self._emit(cont)
+        n_page_warm = sum(1 for it in batch if it.warm_src == "pages")
+        tok_bytes = self._token_state_bytes(engine)
+        pad_tokens = None
+        if getattr(engine, "cfg", None) is not None:
+            pad_tokens = (result.bucket - n) * engine.cfg.num_patches
         rec = {
             "event": "dispatch",
             "engine": engine_name,
             "bucket": result.bucket,
             "n_valid": n,
-            "warm_state": warm,
+            "warm_state": warm or warm_pages,
+            "paged": warm_pages,
             "tier": entry_tier,
             "pad_fraction": round(1.0 - n / result.bucket, 4),
             "latency_ms": latency_ms,
@@ -1276,9 +1632,15 @@ class DynamicBatcher:
             "n_stragglers": len(stragglers),
             "n_cache_warm": n_cache_warm,
             "n_cache_miss": n_cache_miss,
+            "n_page_warm": n_page_warm,
+            "levels0_h2d_bytes": getattr(result, "levels0_h2d_bytes", 0),
             "compiled": result.compiled,
             **tfields,
         }
+        if pad_tokens is not None:
+            rec["pad_tokens"] = pad_tokens
+            if tok_bytes is not None:
+                rec["pad_bytes"] = pad_tokens * tok_bytes
         if rung_name is not None:
             rec["rung"] = rung_name
         if iters_override is not None:
@@ -1316,6 +1678,192 @@ class DynamicBatcher:
                         "iters_total": executed_i,
                         "dispatch_ms_total": it.dispatch_ms,
                         "hops": it.hops,
+                        "redispatches": it.redispatches,
+                        "latency_ms": round(1e3 * it.ticket._latency_s, 3),
+                        "trace_id": it.ticket.trace_id,
+                        "span_id": tracectx.new_span_id(),
+                        "parent_span": dspan,
+                    }
+                )
+        self._emit(rec)
+        self._ladder_observe(engine_name)
+
+    def _dispatch_ragged_batch(
+        self, engine, engine_name: str, batch, dspan, tfields
+    ) -> None:
+        """One RAGGED dispatch (docs/SERVING.md, "Ragged admission"):
+        rows of differing patch counts pack page-aligned onto a flat
+        token axis sized by a ragged-ladder page count — no worst-row
+        bucket shape, no pad rows. Warm state rides the page pool ONLY
+        (session hits pin their pages and the dispatch carries indices);
+        every row resolves on this hop (the ragged route has no
+        continuation queue — ServeConfig forbids the combination)."""
+        from glom_tpu.serve.paged_columns import (
+            pages_for_tokens,
+            resolve_page_tokens,
+        )
+
+        n = len(batch)
+        iters_override = None
+        rung_name = None
+        ladder = self._ladders.get(engine_name)
+        if ladder is not None:
+            from glom_tpu.resilience.ladder import CAPPED_ITERS, RUNGS
+
+            rung = ladder.rung()
+            rung_name = RUNGS[rung]
+            if rung >= CAPPED_ITERS:
+                iters_override = ladder.degraded_iters
+        pool = self._pools.get(engine_name)
+        pages_mode = (
+            pool is not None
+            and self.cache is not None
+            and self.cache.pools is not None
+        )
+        n_cache_warm = n_cache_miss = 0
+        pinned: List[str] = []
+        if self.cache is not None:
+            for it in batch:
+                if it.session is None:
+                    continue
+                if not pages_mode:
+                    # A host-array cache cannot warm a ragged dispatch
+                    # (the route has no levels0 input by design — that
+                    # is the transfer being killed): stamped as a miss.
+                    n_cache_miss += 1
+                    continue
+                hit = self.cache.lookup(it.session, pin=True)
+                if (
+                    hit is not None
+                    and getattr(hit, "engine", None) == engine_name
+                    and getattr(hit, "n_tokens", None) == it.n_patches
+                ):
+                    it.pages = hit
+                    it.warm_src = "pages"
+                    pinned.append(it.session)
+                    n_cache_warm += 1
+                else:
+                    if hit is not None:
+                        self.cache.unpin(it.session)
+                    n_cache_miss += 1
+        pt = (
+            pool.page_tokens if pool is not None
+            else resolve_page_tokens(engine.cfg, engine.scfg)
+        )
+        counts = [it.n_patches for it in batch]
+        row_pages = [pages_for_tokens(c, pt) for c in counts]
+        try:
+            pages_sig = engine.pick_pages(sum(row_pages))
+            T = pages_sig * pt
+            flat = np.zeros((T, engine.cfg.patch_dim), np.float32)
+            pidx = (
+                np.full((pages_sig,), -1, np.int32)
+                if pool is not None else None
+            )
+            starts = []
+            off = 0
+            for it, k in zip(batch, row_pages):
+                start = off * pt
+                starts.append(start)
+                flat[start:start + it.n_patches] = _patchify_host(
+                    it.img, engine.cfg.patch_size
+                )
+                if it.pages is not None:
+                    pidx[off:off + k] = it.pages.pages
+                off += k
+            kw = {}
+            if iters_override is not None:
+                kw["iters_override"] = iters_override
+            with span("serve_dispatch", aggregator=self.spans):
+                result = engine.infer_ragged(
+                    flat, counts, page_idx=pidx, **kw
+                )
+            for sid in pinned:
+                self.cache.unpin(sid)
+            pinned = []
+            with span("serve_fetch", aggregator=self.spans):
+                levels_flat = np.asarray(result.levels)
+        except BaseException as e:  # noqa: BLE001 — relayed per ticket
+            for sid in pinned:
+                self.cache.unpin(sid)
+            self._handle_dispatch_failure(
+                engine_name, batch, e, dspan, tfields, n, n_cache_warm > 0
+            )
+            return
+
+        latency_ms = round(1e3 * result.latency_s, 3)
+        resolved: List[dict] = []
+        to_resolve: List[tuple] = []
+        for i, it in enumerate(batch):
+            it.dispatch_ms += latency_ms
+            if dspan is not None:
+                it.parent_span = dspan
+            # Write-back BEFORE resolve, device-to-device: the row's
+            # converged columns go straight from the dispatch output
+            # into owned pool pages (the next frame's warm state never
+            # visits the host).
+            if pages_mode and it.session is not None:
+                self.cache.store(
+                    it.session,
+                    result.levels[starts[i]:starts[i] + it.n_patches],
+                    engine=engine_name,
+                    n_tokens=it.n_patches,
+                )
+            row_levels = levels_flat[starts[i]:starts[i] + it.n_patches]
+            to_resolve.append((it, row_levels, result.iters_run))
+            resolved.append({"iters": result.iters_run, "tier": 0})
+        pad_tokens = T - sum(counts)
+        tok_bytes = self._token_state_bytes(engine)
+        rec = {
+            "event": "dispatch",
+            "engine": engine_name,
+            "bucket": f"ragged{pages_sig}",
+            "ragged": True,
+            "n_valid": n,
+            "n_pages": pages_sig,
+            "n_tokens": sum(counts),
+            "warm_state": n_cache_warm > 0,
+            "paged": n_cache_warm > 0,
+            "tier": 0,
+            # Token-based pad accounting: the ragged pad tax is the page
+            # tails plus the ladder round-up — row axis padding is GONE.
+            "pad_fraction": round(pad_tokens / T, 4),
+            "pad_tokens": pad_tokens,
+            "latency_ms": latency_ms,
+            "iters_run": result.iters_run,
+            "n_stragglers": 0,
+            "n_cache_warm": n_cache_warm,
+            "n_cache_miss": n_cache_miss,
+            "n_page_warm": n_cache_warm,
+            "levels0_h2d_bytes": 0,
+            "compiled": result.compiled,
+            **tfields,
+        }
+        if tok_bytes is not None:
+            rec["pad_bytes"] = pad_tokens * tok_bytes
+        if rung_name is not None:
+            rec["rung"] = rung_name
+        if iters_override is not None:
+            rec["iters_override"] = iters_override
+        self._note_dispatch(
+            engine_name, rec, resolved,
+            n_served=len(batch),
+            n_degraded=len(batch) if iters_override is not None else 0,
+            n_continued=0,
+        )
+        for it, row_levels, iters in to_resolve:
+            it.ticket._resolve(
+                row_levels, iters, hops=0, dispatch_ms=it.dispatch_ms,
+            )
+            if self._trace:
+                self._emit(
+                    {
+                        "event": "resolve",
+                        "request_id": it.ticket.request_id,
+                        "engine": engine_name,
+                        "iters_total": iters,
+                        "dispatch_ms_total": it.dispatch_ms,
+                        "hops": 0,
                         "redispatches": it.redispatches,
                         "latency_ms": round(1e3 * it.ticket._latency_s, 3),
                         "trace_id": it.ticket.trace_id,
@@ -1368,6 +1916,11 @@ class DynamicBatcher:
                 n_redispatched = self.n_redispatched
                 n_folded = self.n_folded
                 n_rejoined = self.n_rejoined
+                n_affinity = self.n_affinity
+                n_page_warm = self.n_page_warm
+                pad_fraction_sum = self._pad_fraction_sum
+                pad_bytes_wasted = self._pad_bytes_wasted
+                levels0_h2d_bytes = self._levels0_h2d_bytes
         rec = {
             "event": "summary",
             "n_requests": n_requests,
@@ -1380,7 +1933,19 @@ class DynamicBatcher:
             "n_redispatched": n_redispatched,
             "n_folded": n_folded,
             "n_rejoined": n_rejoined,
+            "n_affinity": n_affinity,
+            "n_page_warm": n_page_warm,
             "n_dispatches": len(dispatches),
+            # Pad-tax rollup (mean dispatch pad fraction + the bytes the
+            # padding wasted) and the warm-path upload total — the pair
+            # the ragged bench's CI gate and `telemetry compare` read
+            # (pad regresses UP as a cost; levels0_h2d_bytes must be 0
+            # on the paged warm path).
+            "pad_fraction_mean": round(
+                pad_fraction_sum / len(dispatches), 4
+            ) if dispatches else 0.0,
+            "pad_bytes_wasted": pad_bytes_wasted,
+            "levels0_h2d_bytes": levels0_h2d_bytes,
             # Mean GATHERED batch size: valid rows per dispatch (a warm
             # continuation hop is a dispatch too) — n_served would skew
             # it, since a straggler's rows resolve on a LATER dispatch
@@ -1400,6 +1965,13 @@ class DynamicBatcher:
             # bytes vs budget) — the temporal bench and its CI gate read
             # this nest (docs/OBSERVABILITY.md, cache metrics).
             rec["column_cache"] = self.cache.record()
+        if self._pools:
+            # The page pools' rollup (capacity/churn in live-bytes form;
+            # pages_used + pages_free == pages_total is the conservation
+            # pair the churn test reads).
+            rec["page_pools"] = {
+                name: pool.record() for name, pool in self._pools.items()
+            }
         # Ladder/retry rollups: flat on a single-engine summary (the PR 6
         # record shape, pinned by tests), NESTED per engine under
         # `engines` on fan-out — a flat merge would let the last engine's

@@ -45,9 +45,22 @@ from __future__ import annotations
 import threading
 import time
 from collections import OrderedDict
-from typing import List, Optional
+from typing import List, NamedTuple, Optional
 
 import numpy as np
+
+
+class PageHit(NamedTuple):
+    """A PAGES-mode cache hit (serve/paged_columns.py): the warm state is
+    device-resident — the dispatch carries these page indices into the
+    engine's paged signature instead of a host array. `engine` names the
+    pool (and the session-affinity routing target); the hit arrives
+    PINNED when looked up with pin=True — the caller unpins after the
+    dispatch snapshot (ColumnCache.unpin)."""
+
+    engine: str
+    pages: List[int]
+    n_tokens: int
 
 
 def column_state_bytes(cfg, scfg) -> int:
@@ -62,13 +75,24 @@ def column_state_bytes(cfg, scfg) -> int:
 
 
 class _Entry:
-    __slots__ = ("levels", "nbytes", "engine", "t_write")
+    __slots__ = ("levels", "nbytes", "engine", "t_write", "n_tokens")
 
-    def __init__(self, levels: np.ndarray, engine: str, t_write: float):
-        self.levels = levels
-        self.nbytes = int(levels.nbytes)
+    def __init__(
+        self,
+        levels: Optional[np.ndarray],
+        engine: str,
+        t_write: float,
+        *,
+        nbytes: Optional[int] = None,
+        n_tokens: int = 0,
+    ):
+        self.levels = levels  # host array, or None in PAGES mode
+        self.nbytes = int(
+            nbytes if nbytes is not None else levels.nbytes
+        )
         self.engine = engine
         self.t_write = t_write
+        self.n_tokens = n_tokens
 
 
 class ColumnCache:
@@ -76,7 +100,19 @@ class ColumnCache:
 
     `budget_bytes` is the hard residency ceiling (HBM-priced via
     column_state_bytes); `ttl_s=None` disables expiry. The clock is
-    injectable so TTL tests never sleep."""
+    injectable so TTL tests never sleep.
+
+    PAGES MODE (`pools={engine_name: PagedColumnPool}`): entries become
+    PAGE-TABLE REFERENCES — store() writes the converged columns
+    device-to-device into the named engine's pool and lookup() returns a
+    `PageHit` (engine + page indices) instead of a host array; eviction,
+    TTL expiry, and invalidation FREE PAGES instead of dropping host
+    arrays. The residency policy (LRU under the byte budget, TTL, engine
+    invalidation) is unchanged — each entry is priced at its allocated
+    pages x page_state_bytes, and pool exhaustion reads as eviction
+    pressure exactly like the byte budget does. LOCK ORDER: the cache
+    lock is taken BEFORE any pool lock, never the reverse (pools never
+    call back into the cache)."""
 
     def __init__(
         self,
@@ -85,6 +121,7 @@ class ColumnCache:
         ttl_s: Optional[float] = None,
         writer=None,
         clock=time.monotonic,
+        pools=None,
     ):
         if budget_bytes < 1:
             raise ValueError(f"budget_bytes {budget_bytes} must be >= 1")
@@ -96,6 +133,7 @@ class ColumnCache:
         self._clock = clock
         self._lock = threading.Lock()
         self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
+        self.pools = dict(pools) if pools else None
         self._bytes = 0
         self._peak_bytes = 0
         self.n_hits = 0
@@ -108,11 +146,25 @@ class ColumnCache:
 
     # -- the request path --------------------------------------------------
 
-    def lookup(self, session_id: str) -> Optional[np.ndarray]:
+    def engine_of(self, session_id: str) -> Optional[str]:
+        """Which engine's pool holds the session's pages (None when
+        absent or the cache is in host mode) — the SESSION-AFFINITY
+        routing read (serve/batcher.py routes a stream to the engine
+        holding its pages). A peek: no LRU touch, no counters."""
+        if self.pools is None:
+            return None
+        with self._lock:
+            entry = self._entries.get(session_id)
+            return entry.engine if entry is not None else None
+
+    def lookup(self, session_id: str, *, pin: bool = False):
         """The session's cached column state (freshest-first LRU touch),
-        or None on miss. An entry past its TTL is dropped HERE — an
-        expired stream must never warm-start a request — and counts as
-        one expiration plus the miss."""
+        or None on miss: the host [n, L, d] array, or a `PageHit` in
+        pages mode. An entry past its TTL is dropped HERE — an expired
+        stream must never warm-start a request — and counts as one
+        expiration plus the miss. pin=True (pages mode) read-pins the
+        block so eviction cannot re-issue its pages while the dispatch
+        reads them — callers unpin() after the dispatch."""
         events: List[dict] = []
         with self._lock:
             entry = self._entries.get(session_id)
@@ -134,23 +186,215 @@ class ColumnCache:
                         "age_s": round(self._clock() - entry.t_write, 3),
                     }
                 )
-                levels = None
+                out = None
             else:
                 self._entries.move_to_end(session_id)
                 self.n_hits += 1
-                levels = entry.levels
+                if self.pools is not None:
+                    got = self.pools[entry.engine].lookup(
+                        session_id, pin=pin
+                    )
+                    if got is None:  # pool lost the block (force-free)
+                        self._drop(session_id, entry)
+                        self.n_hits -= 1
+                        self.n_misses += 1
+                        out = None
+                    else:
+                        out = PageHit(entry.engine, got[0], got[1])
+                else:
+                    out = entry.levels
         self._flush(events)
-        return levels
+        return out
 
-    def store(self, session_id: str, levels, *, engine: str) -> bool:
+    def unpin(self, session_id: str) -> None:
+        """Release a pin taken by lookup(pin=True) (pages mode no-op
+        otherwise)."""
+        if self.pools is None:
+            return
+        with self._lock:
+            entry = self._entries.get(session_id)
+            engine = entry.engine if entry is not None else None
+        if engine is not None:
+            self.pools[engine].unpin(session_id)
+
+    def _sweep_expired_locked(self, events: List[dict]) -> int:
+        """Drop EVERY expired entry (caller holds the lock) — the
+        eviction-pressure sweep: TTL otherwise fires only at lookup, so
+        a dead session's bytes (pages) stay pinned until someone touches
+        the key. Under pressure the sweep reclaims them FIRST, before
+        any live LRU victim pays (stamped cache_expire like the lookup
+        path — one leak, one event vocabulary)."""
+        if self.ttl_s is None:
+            return 0
+        now = self._clock()
+        expired = [
+            (sid, e)
+            for sid, e in self._entries.items()
+            if now - e.t_write > self.ttl_s
+        ]
+        for sid, entry in expired:
+            self._drop(sid, entry)
+            self.n_expirations += 1
+            events.append(
+                {
+                    "event": "cache_expire",
+                    "session": sid,
+                    "bytes": entry.nbytes,
+                    "age_s": round(now - entry.t_write, 3),
+                    "swept": True,
+                }
+            )
+        return len(expired)
+
+    def _evict_lru_locked(self, events: List[dict], *, skip=()) -> bool:
+        """Evict the least-recently-used UNPINNED entry (caller holds
+        the lock). False when nothing evictable remains."""
+        for victim_id, victim in self._entries.items():
+            if victim_id in skip:
+                continue
+            if (
+                self.pools is not None
+                and self.pools[victim.engine].is_pinned(victim_id)
+            ):
+                continue  # an in-flight dispatch is reading these pages
+            self._drop(victim_id, victim)
+            self.n_evictions += 1
+            events.append(
+                {
+                    "event": "cache_evict",
+                    "session": victim_id,
+                    "bytes": victim.nbytes,
+                    "bytes_in_use": self._bytes,
+                    "budget_bytes": self.budget_bytes,
+                }
+            )
+            return True
+        return False
+
+    def store(
+        self,
+        session_id: str,
+        levels,
+        *,
+        engine: str,
+        n_tokens: Optional[int] = None,
+    ) -> bool:
         """Write one resolved request's converged columns back under its
         session key (the warm init for the stream's NEXT frame), evicting
         LRU entries until the byte budget holds. Returns False when the
         entry alone exceeds the whole budget (rejected, stamped — the
-        budget is a ceiling, never overcommitted)."""
-        levels = np.asarray(levels)
+        budget is a ceiling, never overcommitted).
+
+        PAGES mode: `levels` is the DEVICE row slice and `n_tokens` its
+        patch count — the columns go device-to-device into the engine's
+        pool (never the host). Eviction pressure (byte budget OR pool
+        exhaustion) first SWEEPS expired entries, then evicts live LRU
+        victims; pinned blocks (in-flight readers) are skipped."""
         now = self._clock()
         events: List[dict] = []
+        if self.pools is not None:
+            if n_tokens is None:
+                raise ValueError("pages mode store() needs n_tokens")
+            pool = self.pools[engine]
+            from glom_tpu.serve.paged_columns import pages_for_tokens
+
+            need_pages = pages_for_tokens(n_tokens, pool.page_tokens)
+            nbytes = need_pages * pool.page_bytes
+            with self._lock:
+                if (
+                    nbytes > self.budget_bytes
+                    or need_pages > pool.n_pages
+                ):
+                    self.n_rejects += 1
+                    events.append(
+                        {
+                            "event": "cache_reject",
+                            "session": session_id,
+                            "bytes": nbytes,
+                            "budget_bytes": min(
+                                self.budget_bytes,
+                                pool.n_pages * pool.page_bytes,
+                            ),
+                        }
+                    )
+                    self._flush(events)
+                    return False
+                old = self._entries.pop(session_id, None)
+                if old is not None:
+                    self._bytes -= old.nbytes
+                    if old.engine != engine:
+                        # The stream moved engines (failover): its old
+                        # pages live in the OLD pool — free them there.
+                        self.pools[old.engine].free(
+                            session_id, reason="moved"
+                        )
+                # Byte-budget pressure: sweep expired first, then LRU.
+                swept = False
+                while self._bytes + nbytes > self.budget_bytes:
+                    if not swept:
+                        swept = True
+                        if self._sweep_expired_locked(events):
+                            continue
+                    if not self._evict_lru_locked(
+                        events, skip=(session_id,)
+                    ):
+                        break
+                # Pool pressure: the write-back allocates; exhaustion is
+                # eviction pressure too (same sweep-then-LRU order; only
+                # victims in THIS pool free the pages we need).
+                stored = pool.write_back(session_id, levels, n_tokens)
+                while not stored:
+                    if not swept:
+                        swept = True
+                        if self._sweep_expired_locked(events):
+                            stored = pool.write_back(
+                                session_id, levels, n_tokens
+                            )
+                            continue
+                    evicted = False
+                    for vid, victim in list(self._entries.items()):
+                        if vid == session_id or victim.engine != engine:
+                            continue
+                        if pool.is_pinned(vid):
+                            continue
+                        self._drop(vid, victim)
+                        self.n_evictions += 1
+                        events.append(
+                            {
+                                "event": "cache_evict",
+                                "session": vid,
+                                "bytes": victim.nbytes,
+                                "bytes_in_use": self._bytes,
+                                "budget_bytes": self.budget_bytes,
+                            }
+                        )
+                        evicted = True
+                        break
+                    if not evicted:
+                        break
+                    stored = pool.write_back(session_id, levels, n_tokens)
+                if not stored:
+                    self.n_rejects += 1
+                    events.append(
+                        {
+                            "event": "cache_reject",
+                            "session": session_id,
+                            "bytes": nbytes,
+                            "budget_bytes": self.budget_bytes,
+                            "reason": "pool-exhausted",
+                        }
+                    )
+                else:
+                    entry = _Entry(
+                        None, engine, now, nbytes=nbytes, n_tokens=n_tokens
+                    )
+                    self._entries[session_id] = entry
+                    self._bytes += entry.nbytes
+                    self.n_writes += 1
+                    self._peak_bytes = max(self._peak_bytes, self._bytes)
+            self._flush(events)
+            return stored
+        levels = np.asarray(levels)
         with self._lock:
             if int(levels.nbytes) > self.budget_bytes:
                 self.n_rejects += 1
@@ -171,19 +415,20 @@ class ColumnCache:
                 self._entries[session_id] = entry
                 self._bytes += entry.nbytes
                 self.n_writes += 1
+                swept = False
                 while self._bytes > self.budget_bytes:
-                    victim_id, victim = next(iter(self._entries.items()))
-                    self._drop(victim_id, victim)
-                    self.n_evictions += 1
-                    events.append(
-                        {
-                            "event": "cache_evict",
-                            "session": victim_id,
-                            "bytes": victim.nbytes,
-                            "bytes_in_use": self._bytes,
-                            "budget_bytes": self.budget_bytes,
-                        }
-                    )
+                    # Eviction pressure: reclaim EXPIRED entries first
+                    # (the TTL-at-lookup-only leak — a dead session's
+                    # bytes stay pinned until someone touches the key),
+                    # then live LRU victims.
+                    if not swept:
+                        swept = True
+                        if self._sweep_expired_locked(events):
+                            continue
+                    if not self._evict_lru_locked(
+                        events, skip=(session_id,)
+                    ):
+                        break
                 self._peak_bytes = max(self._peak_bytes, self._bytes)
                 stored = True
         self._flush(events)
@@ -241,9 +486,13 @@ class ColumnCache:
     # -- internals ---------------------------------------------------------
 
     def _drop(self, session_id: str, entry: _Entry) -> None:
-        # Caller holds the lock.
+        # Caller holds the lock. In pages mode the entry's pages return
+        # to its pool's free list (cache lock -> pool lock, the
+        # documented order; the pool stamps its own page_free).
         self._entries.pop(session_id, None)
         self._bytes -= entry.nbytes
+        if self.pools is not None:
+            self.pools[entry.engine].free(session_id)
 
     def _flush(self, events: List[dict]) -> None:
         from glom_tpu.serve.events import emit_serve
@@ -283,15 +532,19 @@ class ColumnCache:
             }
 
 
-def resolve_column_cache(scfg, *, writer=None) -> Optional[ColumnCache]:
+def resolve_column_cache(scfg, *, writer=None, pools=None) -> Optional[ColumnCache]:
     """The one config -> cache resolution: `column_cache_bytes > 0`
     builds the cache with the configured TTL, 0 disables streaming
     warm-start entirely (every request cold-starts — the pre-PR 8
-    contract)."""
+    contract). `pools` (engine name -> PagedColumnPool, resolved by the
+    batcher from the engines' page pools) switches the cache to PAGES
+    mode: entries are page-table references and the warm path is
+    device-resident (docs/SERVING.md, "Paged column memory")."""
     if getattr(scfg, "column_cache_bytes", 0) <= 0:
         return None
     return ColumnCache(
         scfg.column_cache_bytes,
         ttl_s=scfg.column_cache_ttl_s,
         writer=writer,
+        pools=pools or None,
     )

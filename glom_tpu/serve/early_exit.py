@@ -45,7 +45,12 @@ from glom_tpu.models.core import contribution_divisor, update_step
 from glom_tpu.ops.consensus import build_local_mask, consensus_attention
 from glom_tpu.ops.patch import image_to_tokens
 from glom_tpu.utils.config import GlomConfig
-from glom_tpu.utils.helpers import exists
+from glom_tpu.utils.helpers import (
+    TOKEN_ATTEND_SELF_VALUE,
+    exists,
+    l2norm,
+    max_neg_value,
+)
 
 
 def batch_agreement(levels: jnp.ndarray) -> jnp.ndarray:
@@ -295,3 +300,318 @@ def glom_forward_tiered(
     row_iters = jnp.where(conv, row_iters, iters_run)
     agreement = masked_level_agreement(final, valid_mask)
     return TieredAutoResult(final, iters_run, agreement, conv, row_iters)
+
+
+# -- ragged paged dispatch (docs/SERVING.md, "Paged column memory") --------
+#
+# The ragged forward serves requests with DIFFERING patch counts (mixed
+# resolutions/aspect ratios) in ONE dispatch: rows pack onto a flat,
+# page-aligned token axis of T = n_pages x page_tokens positions instead
+# of each padding to the worst row's [bucket, n_max] shape. Per-row
+# structure is recovered in-graph from `n_patches` alone (page-aligned
+# row starts by cumulative sum), and consensus attention becomes a
+# row-WINDOWED gather: every token attends over its own row's window of
+# W = pages(num_patches) x page_tokens positions, padded past the row's
+# real length with hard-masked slots. W is the SAME static width in every
+# ragged signature, so a row's attention layout — gather order, softmax
+# axis length, masked tail — is identical whether the row dispatches
+# alone or packed with others: the threshold-0 ragged dispatch is BITWISE
+# the per-row lone dispatches it replaced (locked by
+# tests/test_paged_columns.py; cross-route vs the dense engine the
+# contract is the PR 4 scoping — same update ops, kernel-parity
+# tolerance). Short rows are masked out of the witness per POSITION, not
+# just per row: a pad slot never votes a bucket out of (or into) the
+# early-exit loop.
+
+
+class RaggedResult(NamedTuple):
+    """One ragged dispatch's outcome (device arrays). `levels` is the
+    FLAT [T, L, d] page-aligned state — callers slice row r's columns at
+    [row_start[r], row_start[r] + n_patches[r]). Rows with n_patches 0
+    are unused slots (masked everywhere, stamped converged)."""
+
+    levels: jnp.ndarray         # [T, L, d]
+    iters_run: jnp.ndarray      # int32 scalar
+    row_converged: jnp.ndarray  # [R] bool
+    row_iters: jnp.ndarray      # [R] int32
+
+
+def ragged_row_layout(n_patches, page_tokens: int):
+    """The in-graph row layout: page-aligned token starts from the patch
+    counts alone. Returns (starts [R+1] int32 — starts[r] is row r's
+    first flat token, starts[R] the used-token total; row_id [T] needs T,
+    so callers derive it). The HOST packer (serve/batcher.py) computes
+    the same layout with numpy — both sides derive from n_patches, so
+    they can never disagree."""
+    pages = (n_patches + page_tokens - 1) // page_tokens
+    return jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(pages).astype(jnp.int32)]
+    ) * page_tokens
+
+
+def _ragged_structure(n_patches, page_tokens: int, T: int):
+    """(row_id [T], tok_off [T], tok_valid [T]) from the page-aligned
+    layout. Tokens past the last used page clamp to the final row and
+    read invalid (their offset lands past its patch count)."""
+    R = n_patches.shape[0]
+    starts = ragged_row_layout(n_patches, page_tokens)  # [R+1]
+    t = jnp.arange(T, dtype=jnp.int32)
+    row_id = jnp.sum(
+        (t[:, None] >= starts[None, 1:]).astype(jnp.int32), axis=1
+    )
+    row_id = jnp.minimum(row_id, R - 1)
+    tok_off = t - starts[row_id]
+    tok_valid = tok_off < n_patches[row_id]
+    return row_id, tok_off, tok_valid, starts
+
+
+def ragged_consensus_attention(
+    levels: jnp.ndarray,
+    *,
+    row_start: jnp.ndarray,
+    row_len: jnp.ndarray,
+    window: int,
+    attend_self: bool = False,
+) -> jnp.ndarray:
+    """Row-windowed consensus attention over a flat [T, L, d] state:
+    token t attends over the `window` positions starting at its row's
+    flat offset, with slots past the row's real length hard-masked
+    (max_neg — exactly zero attention after softmax) and the self slot
+    soft-masked as the dense op does. row_start/row_len are PER TOKEN
+    ([T] int32). Same q/k/v convention as ops/consensus.consensus_attention
+    (raw q and v, L2-normalized k, d^-1/2 scale)."""
+    T = levels.shape[0]
+    d = levels.shape[-1]
+    q = levels
+    k = l2norm(levels, axis=-1)
+    v = levels
+    w = jnp.arange(window, dtype=jnp.int32)
+    widx = row_start[:, None] + w[None, :]           # [T, W]
+    wvalid = w[None, :] < row_len[:, None]           # [T, W]
+    widx_c = jnp.clip(widx, 0, T - 1)
+    kw = k[widx_c]                                   # [T, W, L, d]
+    vw = v[widx_c]
+    scale = d ** -0.5
+    sim = jnp.einsum(
+        "tld,twld->tlw", q, kw, preferred_element_type=jnp.float32
+    )
+    sim = sim * scale
+    if not attend_self:
+        self_slot = widx == jnp.arange(T, dtype=jnp.int32)[:, None]
+        sim = jnp.where(self_slot[:, None, :], TOKEN_ATTEND_SELF_VALUE, sim)
+    sim = jnp.where(wvalid[:, None, :], sim, max_neg_value(sim.dtype))
+    attn = jax.nn.softmax(sim, axis=-1).astype(levels.dtype)
+    out = jnp.einsum(
+        "tlw,twld->tld", attn, vw, preferred_element_type=jnp.float32
+    )
+    return out.astype(levels.dtype)
+
+
+def ragged_row_agreement(
+    levels: jnp.ndarray, row_weight: jnp.ndarray, row_id: jnp.ndarray,
+    n_patches: jnp.ndarray,
+) -> jnp.ndarray:
+    """Per-row [R, L] consensus agreement from a flat [T, L, d] state —
+    batch_agreement's reduction with the row mean taken by a masked
+    segment sum, so a short row's PAD SLOTS never contribute to its mean
+    direction (the per-position masking the ragged witness requires).
+    row_weight is the [T, R] float one-hot of (row_id, tok_valid)."""
+    x = levels.astype(jnp.float32)
+    eps = 1e-8
+    xhat = x / (jnp.linalg.norm(x, axis=-1, keepdims=True) + eps)
+    denom = jnp.maximum(n_patches.astype(jnp.float32), 1.0)
+    mean = (
+        jnp.einsum("tr,tld->rld", row_weight, xhat)
+        / denom[:, None, None]
+    )
+    mhat = mean / (jnp.linalg.norm(mean, axis=-1, keepdims=True) + eps)
+    cos = jnp.sum(xhat * mhat[row_id], axis=-1)  # [T, L]
+    return jnp.einsum("tr,tl->rl", row_weight, cos) / denom[:, None]
+
+
+def glom_forward_ragged(
+    params,
+    patches: jnp.ndarray,
+    cfg: GlomConfig,
+    *,
+    n_patches: jnp.ndarray,
+    page_tokens: int,
+    route,
+    max_iters: Optional[int] = None,
+    threshold: float = 1e-3,
+    min_iters: int = 1,
+    quorum: float = 1.0,
+    levels0: Optional[jnp.ndarray] = None,
+    pool: Optional[jnp.ndarray] = None,
+    page_idx: Optional[jnp.ndarray] = None,
+    compute_dtype=None,
+    use_pallas: bool = False,
+) -> RaggedResult:
+    """The ragged paged GLOM forward: one dispatch over a flat
+    page-aligned token axis.
+
+    patches: [T, patch_dim] host-patchified rows packed page-aligned in
+    row order (T = total pages x page_tokens; the embed matmul runs
+    in-graph so token values are bitwise the dense path's). n_patches:
+    [R] per-row patch counts, 0 marking unused row slots. route: "auto"
+    (tiered quorum exit, budget max_iters) or an int (fixed count).
+
+    Warm state arrives ONE of two ways: `levels0` [T, L, d] flat (the
+    host-carry form — continuation stragglers), or `pool` [N, page_tokens,
+    L, d] + `page_idx` [T/page_tokens] int32 — the device-resident page
+    pool with -1 marking cold pages, assembled in-graph by a page-index
+    take so warm columns never cross the host boundary
+    (serve/paged_columns.py). threshold=0.0 keeps the bitwise contract:
+    no row ever converges, exactly max_iters updates run, and each row's
+    state equals its lone ragged dispatch bit-for-bit.
+    """
+    if cfg.local_consensus_radius > 0:
+        raise ValueError(
+            "ragged dispatch requires local_consensus_radius == 0 (the "
+            "row window has no per-resolution 2D grid to build a radius "
+            "mask from)"
+        )
+    if pool is not None and levels0 is not None:
+        raise ValueError("pass levels0 OR pool+page_idx, not both")
+    auto = route == "auto"
+    if auto:
+        T_budget = max_iters if max_iters is not None else cfg.default_iters
+        _validate_auto_args(T_budget, min_iters, threshold)
+    else:
+        T_budget = int(route)
+        if T_budget < 1:
+            raise ValueError(f"route={route!r}: an int >= 1 or 'auto'")
+
+    if use_pallas:
+        from glom_tpu.kernels import fused_grouped_ffw
+
+        ffw_fn = fused_grouped_ffw
+    else:
+        from glom_tpu.ops.ffw import grouped_ffw
+
+        ffw_fn = grouped_ffw
+
+    T = patches.shape[0]
+    R = n_patches.shape[0]
+    n_patches = n_patches.astype(jnp.int32)
+    # The row window: full-resolution pages x page_tokens, the SAME
+    # static width in every ragged signature (the bitwise anchor — see
+    # the section comment above).
+    window = min(
+        T, ((cfg.num_patches + page_tokens - 1) // page_tokens) * page_tokens
+    )
+
+    # Identical cast discipline to _build_update_step: once, outside the
+    # loop.
+    if compute_dtype is not None:
+        params = jax.tree_util.tree_map(
+            lambda t: t.astype(compute_dtype), params
+        )
+        patches = patches.astype(compute_dtype)
+        if exists(levels0):
+            levels0 = levels0.astype(compute_dtype)
+
+    row_id, tok_off, tok_valid, starts = _ragged_structure(
+        n_patches, page_tokens, T
+    )
+    row_start_tok = starts[row_id]               # [T]
+    row_len_tok = n_patches[row_id]              # [T]
+
+    with jax.named_scope("patches_to_tokens"):
+        tokens = patches @ params.token_embed.w + params.token_embed.b
+    d = tokens.shape[-1]
+    pos_flat = params.pos_emb[
+        jnp.clip(tok_off, 0, params.pos_emb.shape[0] - 1)
+    ]
+    pos = pos_flat[None, :, None, :]             # [1, T, 1, d]
+    bottom = tokens[None, :, None, :]            # [1, T, 1, d]
+
+    init_flat = jnp.broadcast_to(
+        params.init_levels[None], (T, cfg.levels, d)
+    ).astype(tokens.dtype)
+    if pool is not None:
+        with jax.named_scope("page_take"):
+            pages = pool[jnp.clip(page_idx, 0, pool.shape[0] - 1)]
+            pages = jnp.where(
+                (page_idx >= 0)[:, None, None, None],
+                pages.astype(tokens.dtype),
+                init_flat.reshape(
+                    T // page_tokens, page_tokens, cfg.levels, d
+                ),
+            )
+            levels = pages.reshape(T, cfg.levels, d)[None]
+    elif exists(levels0):
+        levels = levels0[None].astype(tokens.dtype)
+    else:
+        levels = init_flat[None]
+    divisor = contribution_divisor(cfg.levels, jnp.float32)
+
+    def consensus_fn(lv):
+        return ragged_consensus_attention(
+            lv[0],
+            row_start=row_start_tok,
+            row_len=row_len_tok,
+            window=window,
+            attend_self=cfg.consensus_self,
+        )[None]
+
+    def step(lv):
+        return update_step(
+            params, lv, bottom, pos, divisor,
+            consensus_fn=consensus_fn, ffw_fn=ffw_fn,
+        )
+
+    valid = n_patches > 0                        # [R]
+    row_weight = (
+        jnp.logical_and(
+            row_id[:, None] == jnp.arange(R, dtype=jnp.int32)[None, :],
+            tok_valid[:, None],
+        )
+    ).astype(jnp.float32)                        # [T, R]
+
+    if not auto:
+        final, _ = jax.lax.scan(
+            lambda lv, _: (step(lv), None), levels, None, length=T_budget
+        )
+        return RaggedResult(
+            final[0],
+            jnp.int32(T_budget),
+            jnp.ones((R,), bool),
+            jnp.full((R,), T_budget, jnp.int32),
+        )
+
+    def row_agreement(lv):
+        return ragged_row_agreement(lv[0], row_weight, row_id, n_patches)
+
+    need = quorum_need(quorum, jnp.sum(valid.astype(jnp.float32)))
+    thr = jnp.float32(threshold)
+
+    def cond(carry):
+        lv, prev_rows, i, conv, row_iters = carry
+        n_conv = jnp.sum(jnp.logical_and(conv, valid).astype(jnp.int32))
+        return jnp.logical_and(i < T_budget, n_conv < need)
+
+    def body(carry):
+        lv, prev_rows, i, conv, row_iters = carry
+        new = step(lv)
+        agree_rows = row_agreement(new)          # [R, L]
+        delta = row_agreement_delta(agree_rows, prev_rows)
+        newly = jnp.logical_and(i + 1 >= min_iters, delta < thr)
+        first = jnp.logical_and(newly, jnp.logical_not(conv))
+        row_iters = jnp.where(first, i + 1, row_iters)
+        return new, agree_rows, i + 1, jnp.logical_or(conv, newly), row_iters
+
+    init_rows = row_agreement(levels)
+    final, _, iters_run, conv, row_iters = jax.lax.while_loop(
+        cond,
+        body,
+        (
+            levels,
+            init_rows,
+            jnp.int32(0),
+            jnp.zeros((R,), bool),
+            jnp.full((R,), T_budget, jnp.int32),
+        ),
+    )
+    row_iters = jnp.where(conv, row_iters, iters_run)
+    return RaggedResult(final[0], iters_run, conv, row_iters)

@@ -57,7 +57,10 @@ class ServeResult(NamedTuple):
     budget); `latency_s` is dispatch-to-fetch wall time for the batch.
     `row_converged`/`row_iters` are the PER-ROW tiered-exit outcome
     ([bucket] host arrays; fixed-route dispatches mark every row
-    converged — there are no stragglers without a witness)."""
+    converged — there are no stragglers without a witness).
+    `levels0_h2d_bytes` is what the dispatch UPLOADED of warm column
+    state (host levels0 x attempts; 0 on the cold and PAGED routes — the
+    zero the ragged bench gate asserts)."""
 
     levels: jax.Array
     iters_run: int
@@ -66,6 +69,23 @@ class ServeResult(NamedTuple):
     compiled: bool  # True when this call paid the signature's compile
     row_converged: Optional[np.ndarray] = None
     row_iters: Optional[np.ndarray] = None
+    levels0_h2d_bytes: int = 0
+
+
+class RaggedServeResult(NamedTuple):
+    """One RAGGED dispatch's outcome. `levels` is the FLAT page-aligned
+    [T, L, d] device state (row r's columns at [start_r, start_r +
+    n_patches[r]) — serve/early_exit.ragged_row_layout); `pages` is the
+    compiled page-count signature this dispatch rode."""
+
+    levels: jax.Array
+    iters_run: int
+    latency_s: float
+    pages: int
+    compiled: bool
+    row_converged: np.ndarray
+    row_iters: np.ndarray
+    levels0_h2d_bytes: int = 0
 
 
 def _resolve_donate(donate: Optional[bool]) -> bool:
@@ -127,7 +147,65 @@ class InferenceEngine:
         self._cold_levels: Optional[np.ndarray] = None
         self._stats: Dict[Tuple, StepTimeStats] = {}
         self._comm: Dict[Tuple, dict] = {}  # sharded route: counted wire bytes
-        self._shardings: Dict[bool, Tuple] = {}  # warm -> (in_sh, out_sh)
+        self._shardings: Dict = {}  # warm mode -> (in_sh, out_sh)
+        # Paged column memory (serve/paged_columns.py): page_pool_pages
+        # > 0 preallocates THIS engine's device page pool — warm column
+        # state lives in HBM pages, assembled in-graph by a page-index
+        # take (zero host->device levels0 bytes on the paged warm path).
+        # On the sharded route the pool buffer shards its PAGE axis over
+        # 'data' and the forward gathers it with a registered all_gather
+        # (parallel/serve_mesh.py).
+        from glom_tpu.serve.paged_columns import resolve_page_pool
+
+        pool_sharding = None
+        if mesh is not None and getattr(scfg, "page_pool_pages", 0) > 0:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            if scfg.page_pool_pages % scfg.mesh_data != 0:
+                raise ValueError(
+                    f"page_pool_pages {scfg.page_pool_pages} not divisible "
+                    f"by mesh_data={scfg.mesh_data} (the pool's page axis "
+                    "shards over 'data')"
+                )
+            pool_sharding = NamedSharding(mesh, P("data"))
+        self.pool = resolve_page_pool(
+            cfg, scfg, writer=writer, name=name, pool_sharding=pool_sharding
+        )
+        if getattr(scfg, "ragged", False):
+            if mesh is not None:
+                raise ValueError(
+                    "ragged admission rides the single-device route only "
+                    "(the sharded ragged gather is a follow-on; "
+                    "docs/SERVING.md)"
+                )
+            if cfg.local_consensus_radius > 0:
+                raise ValueError(
+                    "ragged admission requires local_consensus_radius == 0"
+                )
+            from glom_tpu.serve.paged_columns import (
+                pages_for_tokens,
+                resolve_page_tokens,
+            )
+
+            ppr = pages_for_tokens(
+                cfg.num_patches, resolve_page_tokens(cfg, scfg)
+            )
+            if scfg.ragged_pages and max(scfg.ragged_pages) < ppr:
+                # Admission allows any row up to num_patches tokens —
+                # a ladder that cannot hold one full-resolution row
+                # would turn every such request into a dispatch-time
+                # failure that reads as an ENGINE fault.
+                raise ValueError(
+                    f"ragged_pages top {max(scfg.ragged_pages)} is below "
+                    f"one full-resolution row's {ppr} pages — every "
+                    "full-size request would fail at dispatch"
+                )
+        # Host levels0 upload accounting (the PR 8 warm path's PCIe tax;
+        # the paged route's reason to exist): total bytes of warm column
+        # state this engine transferred host->device. The ragged bench
+        # gate asserts this stays ZERO on the paged warm path.
+        self.levels0_h2d_bytes_total = 0
         # Transient-dispatch retry (glom_tpu/resilience/retry.py): None
         # resolves from the config (scfg.dispatch_retries; 0 disables).
         # The policy is watchdog-aware — a FLAPPING backend retries (the
@@ -209,13 +287,55 @@ class InferenceEngine:
             f"n={n} exceeds the largest bucket {max(self.scfg.buckets)}"
         )
 
+    @property
+    def ragged_rows(self) -> int:
+        """Static row capacity of every ragged signature (row slots past
+        the gathered count mask out with n_patches 0)."""
+        return self.scfg.max_batch
+
+    @property
+    def ragged_page_buckets(self) -> Tuple[int, ...]:
+        """The ascending page-count ladder the ragged signatures
+        precompile — `ServeConfig.ragged_pages` when set, else
+        full-row-page strides from one full-resolution row up to
+        max_batch rows (at most ~8 signatures). DENSER than buckets x
+        pages-per-row on purpose: the ladder rounds a dispatch UP to its
+        page count, and a coarse ladder hands the round-up right back to
+        the pad tax the ragged route exists to kill."""
+        if self.scfg.ragged_pages:
+            return tuple(self.scfg.ragged_pages)
+        from glom_tpu.serve.paged_columns import (
+            pages_for_tokens,
+            resolve_page_tokens,
+        )
+
+        ppr = pages_for_tokens(
+            self.cfg.num_patches, resolve_page_tokens(self.cfg, self.scfg)
+        )
+        top = self.scfg.max_batch * ppr
+        stride = ppr * max(1, -(-self.scfg.max_batch // 8))
+        return tuple(range(stride, top + 1, stride))
+
+    def pick_pages(self, n_pages: int) -> int:
+        """Smallest ragged ladder entry admitting n_pages total pages
+        (the page-axis pick_bucket)."""
+        if n_pages < 1:
+            raise ValueError(f"n_pages={n_pages} must be >= 1")
+        for p in self.ragged_page_buckets:
+            if n_pages <= p:
+                return p
+        raise ValueError(
+            f"n_pages={n_pages} exceeds the largest ragged signature "
+            f"{max(self.ragged_page_buckets)}"
+        )
+
     def signature(
         self,
-        bucket: int,
+        bucket,
         iters_override: Optional[int] = None,
         *,
         auto_budget: Optional[int] = None,
-        warm: bool = False,
+        warm=False,
     ) -> Tuple:
         if iters_override is not None:
             route = iters_override
@@ -271,7 +391,10 @@ class InferenceEngine:
                 quorum=scfg.exit_quorum,
                 compute_dtype=compute_dtype,
                 use_pallas=scfg.use_pallas,
-                warm=warm,
+                warm=warm is True,
+                page_tokens=(
+                    self.pool.page_tokens if warm == "paged" else None
+                ),
             )
 
         if auto:
@@ -309,9 +432,103 @@ class InferenceEngine:
                     jnp.full((b,), iters, jnp.int32),
                 )
 
+        if warm == "paged":
+            # The PAGED warm variant: levels0 never crosses the host
+            # boundary — the dispatch carries tiny int32 page indices and
+            # the compiled program assembles the warm state by a
+            # page-index take from the device-resident pool
+            # (serve/paged_columns.py). page_idx rows of -1 are COLD:
+            # they take the forward's own init broadcast, bitwise the
+            # cold_levels() contract.
+            pt = self.pool.page_tokens
+
+            def paged_fn(params, img, mask, pool, page_idx):
+                b = img.shape[0]
+                with jax.named_scope("page_take"):
+                    pages = pool[jnp.clip(page_idx, 0, pool.shape[0] - 1)]
+                    init = jnp.broadcast_to(
+                        params.init_levels[None],
+                        (pt, cfg.levels, cfg.dim),
+                    ).astype(pool.dtype)
+                    pages = jnp.where(
+                        (page_idx >= 0)[..., None, None, None], pages, init
+                    )
+                    levels0 = pages.reshape(
+                        b, cfg.num_patches, cfg.levels, cfg.dim
+                    )
+                return fn(params, img, mask, levels0)
+
+            return paged_fn
         if warm:
             return fn
         return lambda params, img, mask: fn(params, img, mask)
+
+    def _build_ragged_fn(
+        self,
+        iters_override: Optional[int] = None,
+        *,
+        auto_budget: Optional[int] = None,
+    ):
+        """The ragged signature's pure forward
+        (serve/early_exit.glom_forward_ragged): (params, patches
+        [T, patch_dim], n_patches [R][, pool, page_idx [P]]) -> (levels
+        [T, L, d], iters_run, row_converged [R], row_iters [R]). The
+        pool args exist exactly when the engine owns a page pool — one
+        program serves cold and page-warm ragged dispatches (cold pages
+        are index -1)."""
+        from glom_tpu.serve.early_exit import glom_forward_ragged
+
+        cfg, scfg = self.cfg, self.scfg
+        compute_dtype = self._compute_dtype
+        auto = iters_override is None and self.iters_key == "auto"
+        if auto:
+            max_iters = (
+                auto_budget if auto_budget is not None else self.auto_budget
+            )
+            route = "auto"
+        else:
+            route = max_iters = (
+                iters_override if iters_override is not None else self.iters_key
+            )
+        pt = self.pool.page_tokens if self.pool is not None else None
+        if pt is None:
+            from glom_tpu.serve.paged_columns import resolve_page_tokens
+
+            pt = resolve_page_tokens(cfg, scfg)
+        kw = dict(
+            page_tokens=pt,
+            route=route,
+            max_iters=max_iters if auto else None,
+            threshold=scfg.exit_threshold,
+            min_iters=min(scfg.min_iters, max_iters),
+            quorum=scfg.exit_quorum,
+            compute_dtype=compute_dtype,
+            use_pallas=scfg.use_pallas,
+        )
+        if self.pool is not None:
+
+            def fn(params, patches, n_patches, pool, page_idx):
+                res = glom_forward_ragged(
+                    params, patches, cfg, n_patches=n_patches,
+                    pool=pool, page_idx=page_idx, **kw,
+                )
+                return (
+                    res.levels, res.iters_run,
+                    res.row_converged, res.row_iters,
+                )
+
+        else:
+
+            def fn(params, patches, n_patches):
+                res = glom_forward_ragged(
+                    params, patches, cfg, n_patches=n_patches, **kw,
+                )
+                return (
+                    res.levels, res.iters_run,
+                    res.row_converged, res.row_iters,
+                )
+
+        return fn
 
     def _compile(
         self,
@@ -344,12 +561,29 @@ class InferenceEngine:
             self._compute_dtype if self._compute_dtype is not None
             else jnp.float32
         )
-        lv_abs = jax.ShapeDtypeStruct(
-            (bucket, cfg.num_patches, cfg.levels, cfg.dim), lv_dtype
+        if warm == "paged":
+            pool = self.pool
+            pool_abs = jax.ShapeDtypeStruct(
+                (pool.n_pages, pool.page_tokens, cfg.levels, cfg.dim),
+                pool.buffer().dtype,
+            )
+            pidx_abs = jax.ShapeDtypeStruct(
+                (bucket, cfg.num_patches // pool.page_tokens), jnp.int32
+            )
+            abstract = (params_abs, img_abs, mask_abs, pool_abs, pidx_abs)
+        else:
+            lv_abs = jax.ShapeDtypeStruct(
+                (bucket, cfg.num_patches, cfg.levels, cfg.dim), lv_dtype
+            )
+            abstract = (params_abs, img_abs, mask_abs) + (
+                (lv_abs,) if warm else ()
+            )
+        # Donate the image batch, and the warm levels carry with it. The
+        # POOL is never donated: it is the persistent page store every
+        # later dispatch reads (write-backs swap it copy-on-write).
+        donate = (
+            ((1, 3) if warm is True else (1,)) if self._donate else ()
         )
-        abstract = (params_abs, img_abs, mask_abs) + ((lv_abs,) if warm else ())
-        # Donate the image batch, and the warm levels carry with it.
-        donate = ((1, 3) if warm else (1,)) if self._donate else ()
         fn = self._build_fn(
             bucket, iters_override, auto_budget=auto_budget, warm=warm
         )
@@ -388,6 +622,69 @@ class InferenceEngine:
         )
         return compiled
 
+    def _compile_ragged(
+        self,
+        pages: int,
+        iters_override: Optional[int] = None,
+        *,
+        auto_budget: Optional[int] = None,
+    ):
+        """AOT-compile one RAGGED page-count signature (flat token axis
+        of pages x page_tokens; the pool args exactly when the engine
+        owns one). Same warmup-event discipline as the bucket route."""
+        sig = self.signature(
+            f"ragged{pages}", iters_override,
+            auto_budget=auto_budget,
+            warm="pool" if self.pool is not None else "ragged",
+        )
+        if sig in self._compiled:
+            return self._compiled[sig]
+        from glom_tpu.serve.paged_columns import resolve_page_tokens
+
+        cfg = self.cfg
+        pt = (
+            self.pool.page_tokens if self.pool is not None
+            else resolve_page_tokens(cfg, self.scfg)
+        )
+        T = pages * pt
+        patches_abs = jax.ShapeDtypeStruct((T, cfg.patch_dim), jnp.float32)
+        n_abs = jax.ShapeDtypeStruct((self.ragged_rows,), jnp.int32)
+        params_abs = jax.tree_util.tree_map(
+            lambda t: jax.ShapeDtypeStruct(t.shape, t.dtype), self.params
+        )
+        abstract = (params_abs, patches_abs, n_abs)
+        if self.pool is not None:
+            pool_abs = jax.ShapeDtypeStruct(
+                (self.pool.n_pages, pt, cfg.levels, cfg.dim),
+                self.pool.buffer().dtype,
+            )
+            pidx_abs = jax.ShapeDtypeStruct((pages,), jnp.int32)
+            abstract = abstract + (pool_abs, pidx_abs)
+        donate = (1,) if self._donate else ()
+        fn = self._build_ragged_fn(iters_override, auto_budget=auto_budget)
+        t0 = time.perf_counter()
+        compiled = jax.jit(fn, donate_argnums=donate).lower(
+            *abstract
+        ).compile()
+        dt = time.perf_counter() - t0
+        self._compiled[sig] = compiled
+        self._stats.setdefault(sig, StepTimeStats()).observe(
+            dt, is_compile=True
+        )
+        self._emit(
+            {
+                "event": "warmup",
+                "bucket": sig[0],
+                "iters": sig[1],
+                "warm_state": sig[3],
+                "degraded": iters_override is not None,
+                "sharded": False,
+                "use_pallas": self.scfg.use_pallas,
+                "compile_time_s": round(dt, 4),
+            }
+        )
+        return compiled
+
     def warmup(
         self,
         buckets: Optional[Tuple[int, ...]] = None,
@@ -411,17 +708,38 @@ class InferenceEngine:
             out[b] = 0.0 if already else time.perf_counter() - t0
         return out
 
+    def warmup_ragged(
+        self, pages: Optional[Tuple[int, ...]] = None
+    ) -> dict:
+        """Precompile the RAGGED page-count ladder (and, with a pool,
+        the bucket route's PAGED warm signatures ride warmup(warm=...)
+        as usual). Returns {page_count: compile_seconds}."""
+        out = {}
+        for p in pages if pages is not None else self.ragged_page_buckets:
+            sig = self.signature(
+                f"ragged{p}",
+                warm="pool" if self.pool is not None else "ragged",
+            )
+            already = sig in self._compiled
+            t0 = time.perf_counter()
+            self._compile_ragged(p)
+            out[p] = 0.0 if already else time.perf_counter() - t0
+        return out
+
     # -- dispatch ----------------------------------------------------------
 
-    def _serve_shardings(self, warm: bool) -> Tuple:
+    def _serve_shardings(self, warm) -> Tuple:
         """Memoized (in_shardings, out_shardings) for the sharded route —
-        resolved once per (engine, warm) rather than per dispatch (the
-        param tree_map is pure overhead in the request hot path)."""
+        resolved once per (engine, warm mode) rather than per dispatch
+        (the param tree_map is pure overhead in the request hot path).
+        warm is False | True (host levels0 carry) | "paged" (pool +
+        page-index take)."""
         if warm not in self._shardings:
             from glom_tpu.parallel.serve_mesh import serve_shardings
 
             self._shardings[warm] = serve_shardings(
-                self.mesh, self.params, warm=warm
+                self.mesh, self.params,
+                warm=warm is True, paged=warm == "paged",
             )
         return self._shardings[warm]
 
@@ -442,6 +760,7 @@ class InferenceEngine:
         iters_override: Optional[int] = None,
         levels0=None,
         auto_budget: Optional[int] = None,
+        page_rows=None,
     ) -> ServeResult:
         """Run one padded batch. `imgs` is [b, c, H, W] (numpy or jax) with
         b equal to a bucket size — callers that batch themselves pass an
@@ -452,7 +771,11 @@ class InferenceEngine:
         (the degradation ladder's capped_iters rung); None runs the
         configured route. levels0 [b, n, L, d] carries warm column state
         in (the continuation path), and auto_budget caps the auto route's
-        max_iters to the stragglers' remaining budget. Transient dispatch
+        max_iters to the stragglers' remaining budget. page_rows
+        [b, pages_per_row] int32 selects the PAGED warm signature
+        instead: each row's levels0 assembles in-graph from the engine's
+        pool pages (-1 rows take the cold init) — zero levels0 bytes
+        cross the host boundary (serve/paged_columns.py). Transient dispatch
         failures retry per the engine's RetryPolicy — a failed attempt
         against an up-or-flapping backend backs off and re-dispatches from
         FRESH input buffers (donation invalidates the old ones), while a
@@ -482,8 +805,25 @@ class InferenceEngine:
         n_valid = b if n_valid is None else n_valid
         if not 1 <= n_valid <= b:
             raise ValueError(f"n_valid={n_valid} outside 1..{b}")
-        warm = levels0 is not None
-        if warm and np.shape(levels0)[0] != b:
+        if page_rows is not None:
+            if self.pool is None:
+                raise ValueError(
+                    "page_rows needs a page pool "
+                    "(ServeConfig.page_pool_pages > 0)"
+                )
+            if levels0 is not None:
+                raise ValueError("pass levels0 OR page_rows, not both")
+            page_rows = np.asarray(page_rows, np.int32)
+            ppr = self.cfg.num_patches // self.pool.page_tokens
+            if page_rows.shape != (b, ppr):
+                raise ValueError(
+                    f"page_rows shape {page_rows.shape} != ({b}, {ppr})"
+                )
+        warm = (
+            "paged" if page_rows is not None
+            else levels0 is not None
+        )
+        if warm is True and np.shape(levels0)[0] != b:
             raise ValueError(
                 f"levels0 batch {np.shape(levels0)[0]} != bucket {b}"
             )
@@ -491,11 +831,13 @@ class InferenceEngine:
             self._compute_dtype if self._compute_dtype is not None
             else np.float32
         )
-        img_sh = mask_sh = lv_sh = None
+        img_sh = mask_sh = lv_sh = pidx_sh = None
         if self.mesh is not None:
             in_sh, _ = self._serve_shardings(warm)
             img_sh, mask_sh = in_sh[1], in_sh[2]
-            lv_sh = in_sh[3] if warm else None
+            lv_sh = in_sh[3] if warm is True else None
+            pidx_sh = in_sh[4] if warm == "paged" else None
+        levels0_h2d = [0]
         if self._donate:
             # Every ATTEMPT needs fresh device buffers: the compiled call
             # donates its inputs, so a retry after a failed dispatch must
@@ -505,18 +847,22 @@ class InferenceEngine:
             # re-transfer per attempt.
             src = np.asarray(imgs, np.float32)
             make_input = lambda: self._device_input(src, img_sh)
-            lv_src = None if not warm else np.asarray(levels0, lv_dtype)
-            make_levels = (
-                None if not warm
-                else (lambda: self._device_input(lv_src, lv_sh))
-            )
+            if warm is True:
+                lv_src = np.asarray(levels0, lv_dtype)
+
+                def make_levels():
+                    levels0_h2d[0] += lv_src.nbytes
+                    return self._device_input(lv_src, lv_sh)
+
+            else:
+                make_levels = None
         else:
             dev = self._device_input(np.asarray(imgs, np.float32), img_sh)
             make_input = lambda: dev
-            if warm:
-                lv_dev = self._device_input(
-                    np.asarray(levels0, lv_dtype), lv_sh
-                )
+            if warm is True:
+                lv_src = np.asarray(levels0, lv_dtype)
+                levels0_h2d[0] += lv_src.nbytes
+                lv_dev = self._device_input(lv_src, lv_sh)
                 make_levels = lambda: lv_dev
             else:
                 make_levels = None
@@ -526,6 +872,14 @@ class InferenceEngine:
             if mask_sh is not None
             else jnp.asarray(mask_host)
         )
+        if warm == "paged":
+            # The whole point: the warm state stays device-resident —
+            # only the tiny int32 page map crosses the host boundary.
+            pidx_dev = (
+                jax.device_put(page_rows, pidx_sh)
+                if pidx_sh is not None
+                else jnp.asarray(page_rows)
+            )
         sig = self.signature(
             b, iters_override, auto_budget=auto_budget, warm=warm
         )
@@ -543,7 +897,12 @@ class InferenceEngine:
                     {"bucket": b, "n_valid": n_valid, "attempt": attempts[0]}
                 )
             args = (self.params, make_input(), mask)
-            if warm:
+            if warm == "paged":
+                # Snapshot per attempt: the freshest write-backs (the
+                # pool swaps copy-on-write, never donated — safe to read
+                # from any number of in-flight dispatches).
+                args = args + (self.pool.buffer(), pidx_dev)
+            elif warm:
                 args = args + (make_levels(),)
             levels, iters_run, conv, row_iters = fn(*args)
             iters_host = int(jax.device_get(iters_run))  # syncs: serving
@@ -565,6 +924,7 @@ class InferenceEngine:
         levels, iters_host, conv, row_iters = out
         dt = time.perf_counter() - t0
         stats.observe(dt, is_compile=False)
+        self.levels0_h2d_bytes_total += levels0_h2d[0]
         return ServeResult(
             levels=levels,
             iters_run=iters_host,
@@ -573,6 +933,151 @@ class InferenceEngine:
             compiled=not compiled_before,
             row_converged=conv,
             row_iters=row_iters,
+            levels0_h2d_bytes=levels0_h2d[0],
+        )
+
+    def infer_ragged(
+        self,
+        patches,
+        n_patches,
+        *,
+        page_idx=None,
+        auto_budget: Optional[int] = None,
+        iters_override: Optional[int] = None,
+    ) -> RaggedServeResult:
+        """Run one RAGGED dispatch: rows of DIFFERING patch counts packed
+        page-aligned on a flat token axis (docs/SERVING.md, "Ragged
+        admission").
+
+        patches: [T, patch_dim] host-patchified rows in row order, page
+        padded (T = P x page_tokens with P a ragged-ladder entry — the
+        batcher packs with the same `ragged_row_layout` the compiled
+        program derives in-graph). n_patches: per-row patch counts (at
+        most `ragged_rows`; padded with 0 internally). page_idx: [P]
+        int32 pool pages per dispatch-page slot, -1 = cold (requires the
+        engine's pool; None = all cold). Warm state rides the POOL ONLY
+        — there is no host levels0 on this route, which is exactly what
+        `levels0_h2d_bytes == 0` asserts."""
+        if self.mesh is not None:
+            raise ValueError("ragged dispatch: single-device route only")
+        if iters_override is not None and (
+            not isinstance(iters_override, int) or iters_override < 1
+        ):
+            raise ValueError(
+                f"iters_override={iters_override!r}: an int >= 1 or None"
+            )
+        if auto_budget is not None:
+            if not isinstance(auto_budget, int) or auto_budget < 1:
+                raise ValueError(
+                    f"auto_budget={auto_budget!r}: an int >= 1 or None"
+                )
+            if iters_override is not None:
+                raise ValueError(
+                    "auto_budget composes with the auto route only"
+                )
+        from glom_tpu.serve.paged_columns import (
+            pages_for_tokens,
+            resolve_page_tokens,
+        )
+
+        pt = (
+            self.pool.page_tokens if self.pool is not None
+            else resolve_page_tokens(self.cfg, self.scfg)
+        )
+        patches = np.asarray(patches, np.float32)
+        T = patches.shape[0]
+        if T % pt != 0:
+            raise ValueError(f"T={T} is not a multiple of page_tokens {pt}")
+        P = T // pt
+        if P not in self.ragged_page_buckets:
+            raise ValueError(
+                f"{P} pages is not a ragged signature "
+                f"{self.ragged_page_buckets}; pack to a ladder entry "
+                "(DynamicBatcher does)"
+            )
+        n_list = [int(n) for n in np.asarray(n_patches).reshape(-1)]
+        R = self.ragged_rows
+        if len(n_list) > R:
+            raise ValueError(f"{len(n_list)} rows exceed ragged_rows {R}")
+        if any(n < 0 or n > self.cfg.num_patches for n in n_list):
+            raise ValueError(
+                f"n_patches {n_list}: each row needs 0..{self.cfg.num_patches}"
+                " patches (the pos table bounds the row length)"
+            )
+        need = sum(pages_for_tokens(n, pt) for n in n_list if n > 0)
+        if need > P:
+            raise ValueError(f"rows need {need} pages > dispatch size {P}")
+        n_host = np.zeros((R,), np.int32)
+        n_host[: len(n_list)] = n_list
+        if page_idx is not None and self.pool is None:
+            raise ValueError(
+                "page_idx needs a page pool (ServeConfig.page_pool_pages)"
+            )
+        if self.pool is not None:
+            pidx_host = (
+                np.full((P,), -1, np.int32) if page_idx is None
+                else np.asarray(page_idx, np.int32)
+            )
+            if pidx_host.shape != (P,):
+                raise ValueError(
+                    f"page_idx shape {pidx_host.shape} != ({P},)"
+                )
+        sig = self.signature(
+            f"ragged{P}", iters_override,
+            auto_budget=auto_budget,
+            warm="pool" if self.pool is not None else "ragged",
+        )
+        compiled_before = sig in self._compiled
+        fn = self._compile_ragged(
+            P, iters_override, auto_budget=auto_budget
+        )
+        stats = self._stats.setdefault(sig, StepTimeStats())
+        n_dev = jnp.asarray(n_host)
+        attempts = [0]
+
+        def attempt():
+            attempts[0] += 1
+            if self._fault_hook is not None:
+                self._fault_hook(
+                    {
+                        "bucket": f"ragged{P}",
+                        "n_valid": sum(1 for n in n_list if n > 0),
+                        "attempt": attempts[0],
+                    }
+                )
+            args = (self.params, jnp.asarray(patches), n_dev)
+            if self.pool is not None:
+                args = args + (self.pool.buffer(), jnp.asarray(pidx_host))
+            levels, iters_run, conv, row_iters = fn(*args)
+            iters_host = int(jax.device_get(iters_run))
+            levels.block_until_ready()
+            return (
+                levels,
+                iters_host,
+                np.asarray(jax.device_get(conv)),
+                np.asarray(jax.device_get(row_iters)),
+            )
+
+        t0 = time.perf_counter()
+        if self.retry is not None:
+            out = self.retry.run(
+                attempt, bucket=f"ragged{P}",
+                n_valid=sum(1 for n in n_list if n > 0),
+            )
+        else:
+            out = attempt()
+        levels, iters_host, conv, row_iters = out
+        dt = time.perf_counter() - t0
+        stats.observe(dt, is_compile=False)
+        return RaggedServeResult(
+            levels=levels,
+            iters_run=iters_host,
+            latency_s=dt,
+            pages=P,
+            compiled=not compiled_before,
+            row_converged=conv,
+            row_iters=row_iters,
+            levels0_h2d_bytes=0,
         )
 
     # -- telemetry ---------------------------------------------------------

@@ -1,0 +1,479 @@
+"""Device-resident paged column memory: the HBM page pool behind the warm
+serving path and ragged admission.
+
+PR 8's ColumnCache killed repeated convergence but kept the cached
+`[n, L, d]` columns HOST-side: every warm frame re-uploaded its columns
+over PCIe before the forward even started. Following *Ragged Paged
+Attention* (PAPERS.md) — pages as the residency unit, a page table as the
+indirection — this module keeps warm column state WHERE IT IS USED:
+
+  * ONE preallocated device buffer of `[n_pages, page_tokens, L, d]` per
+    engine (the pool), sized by `ServeConfig.page_pool_pages` and priced
+    in the same analytic live-bytes form as `column_state_bytes`;
+  * a host-side PAGE TABLE mapping `(session_id, block ordinal)` to page
+    indices — allocation hands out free pages (no contiguity needed: the
+    dispatch gathers by index), free returns them, and `defrag()`
+    compacts allocated pages toward low indices (a device-to-device
+    gather/scatter, stamped `page_defrag`) so long-lived pools keep
+    gather locality;
+  * write-back on resolve copies converged columns DEVICE-TO-DEVICE into
+    the session's owned pages (`write_back`: a memoized jitted scatter —
+    the columns never visit the host), and the warm dispatch assembles
+    `levels0` IN-GRAPH via a page-index take (engine.py's paged
+    signatures) — zero host<->device levels0 transfer on the warm path,
+    the number `bench_serve.py --ragged` asserts via the engine's
+    transfer counters;
+  * pages are PINNED while a dispatch reads them (`pin`/`unpin`): the
+    cache's eviction policy skips pinned blocks, so an in-flight gather
+    can never read pages a concurrent eviction re-issued. Engine death
+    force-frees (the dispatch that observed the death demotes its rows
+    to cold on requeue — serve/batcher.py).
+
+The pool buffer is updated copy-on-write (a write-back builds the next
+buffer functionally and swaps the reference under the lock): in-flight
+dispatches keep reading the buffer they snapshotted, so the scatter is
+never donated — true in-place aliasing would require serializing every
+dispatch against every write-back. XLA reuses the dropped buffer's HBM;
+the transient double-residency window is one write-back wide.
+
+Accounting: every alloc/free/defrag is a stamped "serve" event
+(`page_alloc`/`page_free`/`page_defrag`, docs/OBSERVABILITY.md) and
+`record()` rolls pages/bytes/churn into the batcher summary in the same
+live-bytes vocabulary the column cache uses.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+def resolve_page_tokens(cfg, scfg) -> int:
+    """The page granularity in patch tokens. An explicit
+    `ServeConfig.page_tokens` must tile the full-resolution row (the
+    bucket route maps `[bucket, num_patches]` onto whole pages); 0
+    resolves to the largest divisor of `num_patches` that is at most
+    min(64, num_patches // 4) — at least FOUR pages per full-resolution
+    row (coarser and a half-resolution row pays a whole-row page, which
+    is the pad tax back again), capped at 64 tokens so the page-index
+    take stays coarse-grained on big models (flagship 256 patches ->
+    64-token pages)."""
+    n = cfg.num_patches
+    if scfg.page_tokens > 0:
+        if n % scfg.page_tokens != 0:
+            raise ValueError(
+                f"page_tokens {scfg.page_tokens} does not divide "
+                f"num_patches {n} (pages must tile the full-resolution row)"
+            )
+        return scfg.page_tokens
+    for cand in range(max(1, min(64, n // 4)), 0, -1):
+        if n % cand == 0:
+            return cand
+    return n  # pragma: no cover — cand=1 always divides
+
+
+def pages_for_tokens(n_tokens: int, page_tokens: int) -> int:
+    """ceil(n_tokens / page_tokens): pages one row's columns occupy."""
+    if n_tokens < 1:
+        raise ValueError(f"n_tokens {n_tokens} must be >= 1")
+    return -(-n_tokens // page_tokens)
+
+
+def page_state_bytes(cfg, scfg, page_tokens: Optional[int] = None) -> int:
+    """The live-bytes price of ONE pool page — `page_tokens x levels x
+    dim` in the serving dtype, the per-page unit `column_state_bytes`
+    decomposes into (docs/SERVING.md, "Paged column memory")."""
+    pt = page_tokens if page_tokens is not None else resolve_page_tokens(cfg, scfg)
+    itemsize = 2 if scfg.compute_dtype == "bfloat16" else 4
+    return pt * cfg.levels * cfg.dim * itemsize
+
+
+class _Block:
+    """One session's page-table entry: the ordered page indices holding
+    its column state (block ordinal k covers tokens [k*pt, (k+1)*pt))."""
+
+    __slots__ = ("pages", "n_tokens", "pins")
+
+    def __init__(self, pages: List[int], n_tokens: int):
+        self.pages = pages
+        self.n_tokens = n_tokens
+        self.pins = 0
+
+
+class PagedColumnPool:
+    """Fixed-size device page pool + host page table for one engine.
+
+    `mesh`/`pool_sharding` route the buffer through a NamedSharding on
+    the page axis (the sharded engines' pool — parallel/serve_mesh.py
+    gathers it with a registered all_gather); None keeps the
+    single-device buffer. The injectable `writer` delivers the stamped
+    page events through the usual writer-else-flight path."""
+
+    def __init__(
+        self,
+        cfg,
+        scfg,
+        *,
+        writer=None,
+        name: str = "engine0",
+        pool_sharding=None,
+    ):
+        import jax.numpy as jnp
+
+        if scfg.page_pool_pages < 1:
+            raise ValueError(
+                f"page_pool_pages {scfg.page_pool_pages} must be >= 1 to "
+                "build a pool (0 disables paged columns — resolve first)"
+            )
+        self.cfg = cfg
+        self.scfg = scfg
+        self.name = name
+        self.writer = writer
+        self.page_tokens = resolve_page_tokens(cfg, scfg)
+        self.n_pages = int(scfg.page_pool_pages)
+        self.page_bytes = page_state_bytes(cfg, scfg, self.page_tokens)
+        self.pool_bytes = self.n_pages * self.page_bytes
+        self._dtype = (
+            jnp.bfloat16 if scfg.compute_dtype == "bfloat16" else jnp.float32
+        )
+        self._lock = threading.Lock()
+        self._table: Dict[str, _Block] = {}
+        self._free: List[int] = list(range(self.n_pages - 1, -1, -1))
+        self._scatter_fns: Dict = {}
+        self._gather_fns: Dict = {}
+        self.n_allocs = 0
+        self.n_frees = 0
+        self.n_alloc_fails = 0
+        self.n_writebacks = 0
+        self.n_defrag_moves = 0
+        self._pages_peak = 0
+        # THE preallocated buffer: pages x page_tokens x L x d, zeros.
+        # One allocation up front — warm traffic never grows it.
+        buf = jnp.zeros(
+            (self.n_pages, self.page_tokens, cfg.levels, cfg.dim),
+            self._dtype,
+        )
+        if pool_sharding is not None:
+            import jax
+
+            buf = jax.device_put(buf, pool_sharding)
+        self._buffer = buf
+        self._pool_sharding = pool_sharding
+
+    # -- the page table ----------------------------------------------------
+
+    def buffer(self):
+        """The current pool buffer (snapshot for one dispatch). The
+        reference swaps copy-on-write under the lock; pinned pages stay
+        valid in every later buffer, so a dispatch built from (buffer,
+        pinned indices) reads a consistent state."""
+        with self._lock:
+            return self._buffer
+
+    def pages_used(self) -> int:
+        with self._lock:
+            return self.n_pages - len(self._free)
+
+    def bytes_in_use(self) -> int:
+        return self.pages_used() * self.page_bytes
+
+    def holds(self, session_id: str) -> bool:
+        with self._lock:
+            return session_id in self._table
+
+    def lookup(self, session_id: str, *, pin: bool = False):
+        """(pages, n_tokens) for the session, or None. pin=True takes a
+        read pin (the dispatch path): the block survives eviction until
+        the matching unpin — cache eviction skips pinned blocks."""
+        with self._lock:
+            blk = self._table.get(session_id)
+            if blk is None:
+                return None
+            if pin:
+                blk.pins += 1
+            return list(blk.pages), blk.n_tokens
+
+    def unpin(self, session_id: str) -> None:
+        with self._lock:
+            blk = self._table.get(session_id)
+            if blk is not None and blk.pins > 0:
+                blk.pins -= 1
+
+    def is_pinned(self, session_id: str) -> bool:
+        with self._lock:
+            blk = self._table.get(session_id)
+            return blk is not None and blk.pins > 0
+
+    def alloc(self, session_id: str, n_tokens: int) -> Optional[List[int]]:
+        """Own `ceil(n_tokens / page_tokens)` pages under the session
+        key. An existing block of the right size is reused (the steady
+        warm path — a stream's frames share a resolution); a resized
+        session frees and re-allocates. None when the pool lacks free
+        pages (the CALLER evicts — residency policy lives in the cache,
+        mechanism here)."""
+        need = pages_for_tokens(n_tokens, self.page_tokens)
+        events = []
+        with self._lock:
+            blk = self._table.get(session_id)
+            if blk is not None:
+                if len(blk.pages) == need:
+                    blk.n_tokens = n_tokens
+                    return list(blk.pages)
+                events.append(self._free_locked(session_id, blk, "resize"))
+            if len(self._free) < need:
+                self.n_alloc_fails += 1
+                self._flush(events)
+                return None
+            pages = [self._free.pop() for _ in range(need)]
+            self._table[session_id] = _Block(pages, n_tokens)
+            self.n_allocs += 1
+            used = self.n_pages - len(self._free)
+            self._pages_peak = max(self._pages_peak, used)
+            events.append(
+                {
+                    "event": "page_alloc",
+                    "session": session_id,
+                    "n_pages": need,
+                    "n_tokens": n_tokens,
+                    "pages_used": used,
+                    "pages_total": self.n_pages,
+                    "bytes_in_use": used * self.page_bytes,
+                }
+            )
+        self._flush(events)
+        return list(pages)
+
+    def free(self, session_id: str, *, reason: str = "evict") -> int:
+        """Return the session's pages to the free list (eviction, TTL
+        expiry, engine-death invalidation). Returns pages freed (0 when
+        absent). Force-frees pinned blocks too — the only force callers
+        are death/invalidation paths whose in-flight readers demote to
+        cold on requeue."""
+        with self._lock:
+            blk = self._table.get(session_id)
+            if blk is None:
+                return 0
+            ev = self._free_locked(session_id, blk, reason)
+            n = ev["n_pages"]
+        self._flush([ev])
+        return n
+
+    def free_all(self, *, reason: str = "engine-death") -> int:
+        """Drop EVERY block — the engine just died; its pool state is
+        unreachable warmth. One stamped page_free with the totals."""
+        with self._lock:
+            n = self.n_pages - len(self._free)
+            sessions = len(self._table)
+            if not sessions:
+                return 0
+            self._table.clear()
+            self._free = list(range(self.n_pages - 1, -1, -1))
+            self.n_frees += sessions
+            ev = {
+                "event": "page_free",
+                "reason": reason,
+                "n_sessions": sessions,
+                "n_pages": n,
+                "pages_used": 0,
+                "bytes_in_use": 0,
+            }
+        self._flush([ev])
+        return n
+
+    def _free_locked(self, session_id: str, blk: _Block, reason: str) -> dict:
+        # Caller holds the lock.
+        self._table.pop(session_id, None)
+        self._free.extend(reversed(blk.pages))
+        self.n_frees += 1
+        used = self.n_pages - len(self._free)
+        return {
+            "event": "page_free",
+            "session": session_id,
+            "reason": reason,
+            "n_pages": len(blk.pages),
+            "pages_used": used,
+            "bytes_in_use": used * self.page_bytes,
+        }
+
+    # -- device-side data movement ----------------------------------------
+
+    def _writeback_fn(self, k: int, n: int):
+        """Memoized jitted scatter for a (pages, tokens) shape class:
+        pad the row's [n, L, d] columns to whole pages and set them at
+        the block's indices. Functional update — the result is the NEXT
+        pool buffer (copy-on-write; see module docstring)."""
+        key = (k, n)
+        if key not in self._scatter_fns:
+            import jax
+            import jax.numpy as jnp
+
+            pt = self.page_tokens
+            L, d = self.cfg.levels, self.cfg.dim
+            dtype = self._dtype
+
+            def fn(pool, idx, row):
+                flat = jnp.pad(
+                    row.astype(dtype), ((0, k * pt - n), (0, 0), (0, 0))
+                )
+                return pool.at[idx].set(flat.reshape(k, pt, L, d))
+
+            self._scatter_fns[key] = jax.jit(fn)
+        return self._scatter_fns[key]
+
+    def write_back(self, session_id: str, levels_row, n_tokens: int) -> bool:
+        """Copy one resolved row's converged columns device-to-device
+        into the session's pages (allocating on first write). levels_row
+        is the DEVICE [n_tokens, L, d] slice of the dispatch output — it
+        never visits the host. False when allocation failed (pool full:
+        the cache's eviction pressure path frees and retries)."""
+        pages = self.alloc(session_id, n_tokens)
+        if pages is None:
+            return False
+        import jax.numpy as jnp
+
+        k = len(pages)
+        fn = self._writeback_fn(k, n_tokens)
+        idx = jnp.asarray(np.asarray(pages, np.int32))
+        with self._lock:
+            # The scatter runs under the lock: buffer swaps serialize
+            # (two concurrent write-backs must not both extend the same
+            # parent buffer and drop one update on the swap).
+            self._buffer = fn(self._buffer, idx, levels_row)
+            self.n_writebacks += 1
+        return True
+
+    def read_block(self, session_id: str) -> Optional[np.ndarray]:
+        """HOST copy of one session's [n_tokens, L, d] columns — the
+        tests' parity window and the cold-path fallback, NOT the warm
+        dispatch path (which takes pages in-graph)."""
+        got = self.lookup(session_id)
+        if got is None:
+            return None
+        pages, n_tokens = got
+        key = len(pages)
+        if key not in self._gather_fns:
+            import jax
+
+            pt = self.page_tokens
+            L, d = self.cfg.levels, self.cfg.dim
+
+            def fn(pool, idx):
+                return pool[idx].reshape(key * pt, L, d)
+
+            self._gather_fns[key] = jax.jit(fn)
+        import jax.numpy as jnp
+
+        with self._lock:
+            buf = self._buffer
+        flat = self._gather_fns[key](
+            buf, jnp.asarray(np.asarray(pages, np.int32))
+        )
+        return np.asarray(flat)[:n_tokens]
+
+    def defrag(self) -> int:
+        """Compact allocated, UNPINNED pages toward low indices (one
+        device gather/scatter from the pre-move buffer, so overlapping
+        src/dst ranges read original values). Returns pages moved;
+        stamps page_defrag. Allocation never NEEDS this (the take is
+        index-addressed) — it is a locality/accounting pass for
+        long-lived pools."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            blocks = sorted(
+                (
+                    (sid, blk)
+                    for sid, blk in self._table.items()
+                    if blk.pins == 0
+                ),
+                key=lambda kv: min(kv[1].pages),
+            )
+            pinned_pages = {
+                p
+                for blk in self._table.values()
+                if blk.pins > 0
+                for p in blk.pages
+            }
+            # Targets: lowest indices not owned by pinned blocks.
+            targets = iter(
+                i for i in range(self.n_pages) if i not in pinned_pages
+            )
+            src: List[int] = []
+            dst: List[int] = []
+            for sid, blk in blocks:
+                new_pages = []
+                for p in blk.pages:
+                    t = next(targets)
+                    new_pages.append(t)
+                    if t != p:
+                        src.append(p)
+                        dst.append(t)
+                blk.pages = new_pages
+            if not src:
+                return 0
+            used_pages = {
+                p for blk in self._table.values() for p in blk.pages
+            }
+            self._free = sorted(
+                (i for i in range(self.n_pages) if i not in used_pages),
+                reverse=True,
+            )
+            self._buffer = self._buffer.at[
+                jnp.asarray(np.asarray(dst, np.int32))
+            ].set(self._buffer[jnp.asarray(np.asarray(src, np.int32))])
+            self.n_defrag_moves += len(src)
+            ev = {
+                "event": "page_defrag",
+                "n_moved": len(src),
+                "pages_used": self.n_pages - len(self._free),
+                "pages_total": self.n_pages,
+            }
+        self._flush([ev])
+        return len(src)
+
+    # -- observability -----------------------------------------------------
+
+    def _flush(self, events) -> None:
+        from glom_tpu.serve.events import emit_serve
+
+        for rec in events:
+            if rec:
+                emit_serve(self.writer, dict(rec, engine=self.name))
+
+    def record(self) -> dict:
+        """The pool rollup the batcher nests under its summary: capacity
+        and churn in the live-bytes form (pages x page_state_bytes), the
+        conservation pair the churn test reads (pages_used + pages_free
+        == pages_total always)."""
+        with self._lock:
+            used = self.n_pages - len(self._free)
+            return {
+                "page_tokens": self.page_tokens,
+                "page_bytes": self.page_bytes,
+                "pages_total": self.n_pages,
+                "pages_used": used,
+                "pages_free": len(self._free),
+                "pages_peak": self._pages_peak,
+                "pool_bytes": self.pool_bytes,
+                "bytes_in_use": used * self.page_bytes,
+                "n_sessions": len(self._table),
+                "n_allocs": self.n_allocs,
+                "n_frees": self.n_frees,
+                "n_alloc_fails": self.n_alloc_fails,
+                "n_writebacks": self.n_writebacks,
+                "n_defrag_moves": self.n_defrag_moves,
+            }
+
+
+def resolve_page_pool(
+    cfg, scfg, *, writer=None, name: str = "engine0", pool_sharding=None
+) -> Optional[PagedColumnPool]:
+    """The one config -> pool resolution: `page_pool_pages > 0` builds
+    the device pool, 0 keeps the PR 8 host-array column cache."""
+    if getattr(scfg, "page_pool_pages", 0) <= 0:
+        return None
+    return PagedColumnPool(
+        cfg, scfg, writer=writer, name=name, pool_sharding=pool_sharding
+    )

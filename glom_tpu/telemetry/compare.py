@@ -50,6 +50,10 @@ _COST_METRIC_TOKENS = (
     # more regresses on both — rate-classifying restores would gate the
     # calm run for restoring less.
     "degrades", "restores", "deaths", "failovers",
+    # Pad waste is a COST (ISSUE 11): a serve change that pads more —
+    # higher pad_fraction_mean, more pad bytes, or warm levels0 bytes
+    # creeping back onto the host->device path — regresses UP.
+    "pad", "h2d",
 )
 
 
@@ -109,6 +113,26 @@ def flatten_engine_metrics(rec: dict) -> List[dict]:
                     "metric": f"serve_engine.{name}.{key}{suffix}",
                     "value": value,
                     "unit": "count",
+                    "kind": "bench",
+                }
+            )
+    # Pad-tax rollup rows (ISSUE 11): the summary's aggregated pad waste
+    # and warm-path upload bytes gate as COSTS — a serving change that
+    # re-grows the pad fraction or puts levels0 back on the PCIe path
+    # regresses, whatever it did to latency. Units make the direction
+    # ("fraction"/"bytes" carry the pad/h2d cost tokens in the metric).
+    for key, unit in (
+        ("pad_fraction_mean", "fraction"),
+        ("pad_bytes_wasted", "bytes"),
+        ("levels0_h2d_bytes", "bytes"),
+    ):
+        v = rec.get(key)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            rows.append(
+                {
+                    "metric": f"serve_pad.{key}{suffix}",
+                    "value": float(v),
+                    "unit": unit,
                     "kind": "bench",
                 }
             )

@@ -181,6 +181,31 @@ class ServeConfig:
     # engine fails, so stale or dead-engine state never warm-starts.
     column_cache_bytes: int = 0
     column_cache_ttl_s: Optional[float] = None
+    # Paged column memory (glom_tpu/serve/paged_columns.py, docs/SERVING.md
+    # "Paged column memory"): page_pool_pages > 0 preallocates ONE
+    # device-resident HBM buffer of [page_pool_pages, page_tokens, L, d]
+    # per engine — the column-state page pool. Cached session columns then
+    # live in pool pages instead of host arrays: warm dispatches assemble
+    # levels0 IN-GRAPH via a page-index take (zero host<->device levels0
+    # transfer on the warm path) and write-back on resolve copies the
+    # converged columns device-to-device into owned pages. 0 keeps the
+    # PR 8 host-array cache (every warm dispatch re-uploads its columns).
+    # page_tokens is the page granularity in patch tokens; 0 resolves to
+    # the largest divisor of num_patches <= 64 (resolve_page_tokens — a
+    # page must tile the full-resolution row so the bucket route's
+    # [bucket, n] layout maps onto whole pages).
+    page_pool_pages: int = 0
+    page_tokens: int = 0
+    # Ragged admission (docs/SERVING.md "Ragged admission"): requests with
+    # DIFFERING patch counts (mixed resolutions/aspect ratios) share one
+    # dispatch sized by total PAGES instead of padding every row to the
+    # worst-row bucket shape. ragged_pages is the ascending page-count
+    # ladder the ragged signatures precompile (the page-axis analog of
+    # `buckets`); empty resolves to buckets x pages-per-full-row. Requires
+    # local_consensus_radius == 0 (the ragged window has no 2D coordinate
+    # grid to build a radius mask from — the engine validates loudly).
+    ragged: bool = False
+    ragged_pages: Tuple[int, ...] = ()
     # Engine REJOIN after recovery (docs/RESILIENCE.md): a fan-out engine
     # marked dead re-enters service only after rejoin_threshold
     # CONSECUTIVE successful probation health dispatches (stamped
@@ -278,6 +303,33 @@ class ServeConfig:
                 f"column_cache_ttl_s {self.column_cache_ttl_s} must be > 0 "
                 "or None"
             )
+        if self.page_pool_pages < 0:
+            raise ValueError(
+                f"page_pool_pages {self.page_pool_pages} must be >= 0 "
+                "(0 disables the device-resident column page pool)"
+            )
+        if self.page_tokens < 0:
+            raise ValueError(
+                f"page_tokens {self.page_tokens} must be >= 0 (0 resolves "
+                "from the model's patch count)"
+            )
+        if self.ragged and self.max_continuations > 0:
+            raise ValueError(
+                "ragged admission and the continuation queue are "
+                "exclusive: a ragged dispatch has no host levels0 carry "
+                "for straggler re-buckets (rows resolve with their state "
+                "at quorum exit — the pre-two-tier contract)"
+            )
+        if self.ragged_pages:
+            if list(self.ragged_pages) != sorted(set(self.ragged_pages)):
+                raise ValueError(
+                    f"ragged_pages {self.ragged_pages} must be strictly "
+                    "ascending"
+                )
+            if any(p < 1 for p in self.ragged_pages):
+                raise ValueError(
+                    f"ragged_pages {self.ragged_pages} must be >= 1"
+                )
         if self.rejoin_threshold < 0:
             raise ValueError(
                 f"rejoin_threshold {self.rejoin_threshold} must be >= 0 "

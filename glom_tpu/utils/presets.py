@@ -176,6 +176,15 @@ _register(
             column_cache_bytes=1 << 30,
             column_cache_ttl_s=60.0,
             rejoin_threshold=3,
+            # Paged column memory (docs/SERVING.md): the 1 GiB cache
+            # budget lives in a device page pool — 2728 pages x 64
+            # tokens x 6 levels x 512 dim x bf16 = 384 KiB/page (~682
+            # full-resolution streams at 4 pages each), warm frames
+            # assembled in-graph with ZERO host->device levels0 bytes.
+            # Ragged admission stays a workload opt-in (bench_serve.py
+            # --ragged; it is exclusive with the continuation queue).
+            page_pool_pages=2728,
+            page_tokens=64,
         ),
     )
 )
@@ -234,6 +243,14 @@ _register(
             column_cache_bytes=2 << 30,
             column_cache_ttl_s=60.0,
             rejoin_threshold=3,
+            # Paged pool per 8-chip replica: the page axis shards over
+            # 'data' (pages % mesh_data == 0 — 341 pages/chip), and the
+            # paged warm signature gathers it with one registered
+            # all_gather (parallel/serve_mesh.py). 1364 pages x 64
+            # tokens x 12 levels x 1024 dim x bf16 = 1.5 MiB/page ->
+            # ~341 full-res streams resident per replica.
+            page_pool_pages=1364,
+            page_tokens=64,
         ),
     )
 )

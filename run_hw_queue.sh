@@ -151,6 +151,37 @@ step bench_serve_sharded 2400 python -u bench_serve.py --mesh-data 4
 #     here, unlike the CPU smoke). Baselined via step 11b.
 step bench_serve_temporal 2400 python -u bench_serve.py --temporal --streams 8 --frames 6
 
+# 9h. Ragged paged sweep + paged warm-path A/B (this round's tentpole,
+#     docs/SERVING.md "Paged column memory"/"Ragged admission"): the
+#     same mixed-resolution streamed traffic served padded through the
+#     bucket ladder vs packed through the ragged page ladder. On real
+#     hardware this measures what the CPU smoke cannot: the actual
+#     PCIe-vs-HBM warm-path dispatch latency delta (the paged arm's
+#     levels0_h2d_bytes is 0 — its warm state never leaves HBM) and the
+#     MXU time the pad tokens stop burning. The serve_pad_waste pair,
+#     both arms' warm/cold dispatch-latency rows, and the per-arm
+#     levels0_h2d_bytes feed the step 11b serve compare baseline (pad
+#     and h2d rows gate as COSTS — telemetry/compare.py).
+step bench_serve_ragged 2400 python -u bench_serve.py --ragged --streams 8 --frames 6
+step ragged_gate 120 python - results/hw_queue/bench_serve_ragged.log <<'EOF'
+import sys
+from glom_tpu.telemetry import schema
+rows = [r for _, r in schema.iter_json_lines(open(sys.argv[1]))]
+waste, h2d = {}, {}
+for r in rows:
+    m = r.get("metric", "")
+    if m.startswith("serve_pad_waste ("):
+        waste[m.split("(")[1].split(",")[0]] = r["value"]
+    if m.startswith("serve_levels0_h2d_bytes ("):
+        h2d[m.split("(")[1].split(",")[0]] = (r["value"], r.get("n_page_warm", 0))
+assert set(waste) == {"bucket-ladder", "ragged-paged"}, f"arms missing: {waste}"
+assert waste["ragged-paged"] < waste["bucket-ladder"], f"pad waste not reduced: {waste}"
+b, w = h2d.get("ragged-paged", (None, 0))
+assert b == 0 and w > 0, f"paged warm path not zero-transfer: {h2d}"
+print(f"OK: pad waste {waste['bucket-ladder']}% -> {waste['ragged-paged']}%; "
+      f"0 warm levels0 bytes over {w} page-warm rows")
+EOF
+
 # 9g. Request-tracing overhead gate + pod aggregation (this round's
 #     tentpole, docs/OBSERVABILITY.md): full trace stamping (ids minted
 #     per submit, per-dispatch scope, per-request resolve leaves) must
@@ -204,6 +235,7 @@ grep -ah '^{' results/hw_queue/bench_serve.log \
     results/hw_queue/bench_serve_two_tier.log \
     results/hw_queue/bench_serve_sharded.log \
     results/hw_queue/bench_serve_temporal.log \
+    results/hw_queue/bench_serve_ragged.log \
     > results/hw_queue/serve_candidate.jsonl 2>/dev/null || true
 if [ -f results/serve_baseline.jsonl ]; then
     step serve_compare 300 python -m glom_tpu.telemetry compare \

@@ -1,0 +1,88 @@
+"""Seeded fixture pair for the axis-environment checker
+(glom_tpu/analysis/axisenv.py).
+
+`leaky_serve_body` psums over MODEL_AXIS inside a shard_map whose mesh is
+('data', 'seq') — a vocabulary-LEGAL axis (the training mesh declares it)
+that does not exist in this shard_map's environment: the copy-pasted-
+from-training bug the checker exists to catch on CPU. `clean_serve_body`
+is the twin with every collective on a declared axis, including one
+threaded through the registered-wrapper idiom.
+
+This file is a LINT FIXTURE: it is parsed, never imported (the fake
+shard_map below keeps it import-safe anyway).
+"""
+
+from glom_tpu.telemetry import counters as tele_counters
+from glom_tpu.utils.config import MeshConfig
+
+DATA_AXIS = "data"
+SEQ_AXIS = "seq"
+MODEL_AXIS = "model"
+
+
+def shard_map(fn, mesh=None, in_specs=None, out_specs=None):  # noqa: ARG001
+    return fn
+
+
+def P(*axes):  # noqa: ARG001 — spec stand-in, parsed not executed
+    return axes
+
+
+def make_mesh(cfg):
+    return cfg
+
+
+def lax_psum(x, axis):  # pragma: no cover — never called
+    del axis
+    return x
+
+
+def _psum_wire(x, axis_name, k):
+    """The registered-wrapper idiom the real serve mesh uses."""
+    from jax import lax
+
+    tele_counters.record_collective("reduce", 0 * k)
+    return lax.psum(x, axis_name)
+
+
+def build_leaky():
+    from jax import lax
+
+    mesh = make_mesh(MeshConfig(data=4, seq=2))
+    batch_spec = P(DATA_AXIS)
+
+    def leaky_serve_body(x, y):
+        tele_counters.record_collective("reduce", 0)
+        part = lax.psum(x, SEQ_AXIS)  # fine: 'seq' is in the mesh
+        # BUG: 'model' is a declared axis SOMEWHERE (the training mesh),
+        # but not in THIS shard_map's ('data', 'seq') environment.
+        tele_counters.record_collective("reduce", 0)
+        bad = lax.psum(part, MODEL_AXIS)
+        return _psum_wire(bad + y, MODEL_AXIS, 2)  # threaded: also bad
+
+    return shard_map(
+        leaky_serve_body,
+        mesh=mesh,
+        in_specs=(batch_spec, P(DATA_AXIS, SEQ_AXIS)),
+        out_specs=P(DATA_AXIS),
+    )
+
+
+def build_clean():
+    from jax import lax
+
+    mesh = make_mesh(MeshConfig(data=4, seq=2))
+    batch_spec = P(DATA_AXIS)
+
+    def clean_serve_body(x, y):
+        tele_counters.record_collective("reduce", 0)
+        part = lax.psum(x, SEQ_AXIS)
+        total = _psum_wire(part + y, DATA_AXIS, 4)  # threaded: declared
+        return total
+
+    return shard_map(
+        clean_serve_body,
+        mesh=mesh,
+        in_specs=(batch_spec, P(DATA_AXIS, SEQ_AXIS)),
+        out_specs=P(DATA_AXIS),
+    )

@@ -1027,3 +1027,110 @@ class TestSignalSafety:
             run([flight_mod.__file__, coord_mod.__file__]), "signal-safety"
         )
         assert fs == [], fs
+
+
+class TestAxisEnvironment:
+    def test_seeded_fixture_pair(self):
+        """The seeded acceptance pair (tests/fixtures/axis_env.py): the
+        leaky body's psum over MODEL_AXIS — vocabulary-legal but absent
+        from ITS shard_map's ('data','seq') MeshConfig — is flagged both
+        at the direct lax.psum site and through the _psum_wire threaded
+        axis; the clean twin (every collective on a declared axis) scans
+        clean."""
+        fs = by_checker(
+            run([str(FIXTURES / "axis_env.py")]), "axis-environment"
+        )
+        assert len(fs) == 2, fs
+        assert all("'model'" in f.message for f in fs)
+        src_lines = (FIXTURES / "axis_env.py").read_text().splitlines()
+        for f in fs:
+            assert "leaky" in f.symbol or "MODEL" in src_lines[f.line - 1]
+
+    def test_mesh_attested_env_flags_foreign_axis(self, tmp_path):
+        src = (
+            "from jax import lax\n"
+            "from glom_tpu.utils.config import MeshConfig\n"
+            "from glom_tpu.utils.compat import shard_map\n"
+            "DATA_AXIS = 'data'\n"
+            "MODEL_AXIS = 'model'\n"
+            "def build(make_mesh, P):\n"
+            "    mesh = make_mesh(MeshConfig(data=8))\n"
+            "    def body(x):\n"
+            "        return lax.psum(x, MODEL_AXIS)\n"
+            "    return shard_map(body, mesh=mesh,\n"
+            "                     in_specs=(P(DATA_AXIS),), out_specs=P())\n"
+        )
+        fs = by_checker(lint(tmp_path, src), "axis-environment")
+        assert len(fs) == 1
+        assert "'model'" in fs[0].message
+
+    def test_opaque_mesh_skips(self, tmp_path):
+        """No MeshConfig anywhere (the training shard bodies' shape:
+        mesh arrives from config) -> the environment is unattested and
+        the checker never guesses."""
+        src = (
+            "from jax import lax\n"
+            "from glom_tpu.utils.compat import shard_map\n"
+            "DATA_AXIS = 'data'\n"
+            "MODEL_AXIS = 'model'\n"
+            "def build(mesh, P):\n"
+            "    def body(x):\n"
+            "        return lax.psum(x, MODEL_AXIS)\n"
+            "    return shard_map(body, mesh=mesh,\n"
+            "                     in_specs=(P(DATA_AXIS),), out_specs=P())\n"
+        )
+        assert by_checker(lint(tmp_path, src), "axis-environment") == []
+
+    def test_module_wide_meshconfig_attests(self, tmp_path):
+        """A module that builds meshes SOMEWHERE attests its axis set
+        even when a given site's mesh is a parameter — the serve-mesh
+        shape (make_serve_mesh builds (data, seq); every shard_map in
+        the file inherits that environment)."""
+        src = (
+            "from jax import lax\n"
+            "from glom_tpu.utils.config import MeshConfig\n"
+            "from glom_tpu.utils.compat import shard_map\n"
+            "DATA_AXIS = 'data'\n"
+            "SEQ_AXIS = 'seq'\n"
+            "MODEL_AXIS = 'model'\n"
+            "def make_my_mesh(make_mesh, scfg):\n"
+            "    return make_mesh(MeshConfig(data=scfg.d, seq=scfg.s))\n"
+            "def build(mesh, P):\n"
+            "    def body(x):\n"
+            "        y = lax.psum(x, SEQ_AXIS)\n"
+            "        return lax.psum(y, MODEL_AXIS)\n"
+            "    return shard_map(body, mesh=mesh,\n"
+            "                     in_specs=(P(DATA_AXIS),), out_specs=P())\n"
+        )
+        fs = by_checker(lint(tmp_path, src), "axis-environment")
+        assert len(fs) == 1
+        assert "'model'" in fs[0].message
+
+    def test_spec_axes_union_into_env(self, tmp_path):
+        """An axis visible only in the specs (via a local spec variable,
+        one level of indirection) is part of the environment — spec axes
+        never false-positive even when the MeshConfig kwargs are
+        narrower than the specs."""
+        src = (
+            "from jax import lax\n"
+            "from glom_tpu.utils.config import MeshConfig\n"
+            "from glom_tpu.utils.compat import shard_map\n"
+            "DATA_AXIS = 'data'\n"
+            "SEQ_AXIS = 'seq'\n"
+            "def build(make_mesh, P):\n"
+            "    mesh = make_mesh(MeshConfig(data=4))\n"
+            "    lv_spec = P(DATA_AXIS, SEQ_AXIS)\n"
+            "    def body(x):\n"
+            "        return lax.psum(x, SEQ_AXIS)\n"
+            "    return shard_map(body, mesh=mesh,\n"
+            "                     in_specs=(lv_spec,), out_specs=lv_spec)\n"
+        )
+        assert by_checker(lint(tmp_path, src), "axis-environment") == []
+
+    def test_serve_mesh_paged_gather_is_clean(self):
+        """The site the ISSUE names: parallel/serve_mesh.py's paged
+        gather collectives (all_gather over 'data', witness psums over
+        'seq'/'data') all live inside the (data, seq) environment."""
+        import glom_tpu.parallel.serve_mesh as sm
+
+        assert by_checker(run([sm.__file__]), "axis-environment") == []
