@@ -21,6 +21,15 @@ axis can exist in the mesh without sharding any input):
     assignment) contains a literal `MeshConfig(data=..., seq=...)` call
     — the keyword names ARE the axis names (MeshConfig.axis_names); or,
     failing that,
+  * a mesh= argument that is an OPAQUE PARAMETER of the enclosing
+    function, followed back through the intra-module call graph: when
+    every intra-module caller's argument (directly, via one local
+    assignment, or via the caller's OWN parameter one more hop up)
+    attests a MeshConfig, the UNION of those callers' axes is the
+    environment — more specific than the module union, which is what
+    catches a serve-shaped helper in a file that also builds a 'model'-
+    carrying training mesh; one unresolvable caller skips (never
+    guess); or, failing that,
   * the MODULE-WIDE union of every MeshConfig axis keyword in the file
     (a module that only ever builds (data, seq) meshes — the serve mesh
     — never legally runs a 'model' collective);
@@ -192,7 +201,16 @@ class AxisEnvironment(Checker):
                 spec_env |= _spec_axes(kw.value, consts, assigns)
             elif kw.arg == "mesh":
                 mesh_arg = kw.value
-        attested = _mesh_axes(mesh_arg, assigns) or module_mesh_axes
+        attested = _mesh_axes(mesh_arg, assigns)
+        if not attested:
+            # Opaque parameter: follow the INTRA-MODULE callers' mesh
+            # argument back to their MeshConfig — caller-specific axes
+            # beat the module union (a file can build both a (data, seq)
+            # serve mesh and a 'model'-carrying training mesh; the union
+            # would attest the wrong environment for both).
+            attested = self._caller_attested(module, enclosing, mesh_arg)
+        if not attested:
+            attested = module_mesh_axes
         if not attested:
             return []  # opaque environment: skip, never guess
         env = attested | spec_env
@@ -209,6 +227,70 @@ class AxisEnvironment(Checker):
                     )
                 )
         return findings
+
+    def _caller_attested(
+        self,
+        module: SourceModule,
+        enclosing: Optional[ast.AST],
+        mesh_arg: Optional[ast.AST],
+        depth: int = 3,
+    ) -> Set[str]:
+        """Axes provable by following an opaque mesh PARAMETER back to
+        the intra-module callers that bind it. Attests only when at
+        least one caller is found AND every found caller's argument
+        resolves to a MeshConfig (directly, through one local
+        assignment, or through the caller's own parameter — bounded
+        recursion); any unresolvable caller returns the empty set, the
+        precision stance everywhere in this checker."""
+        if (
+            depth <= 0
+            or enclosing is None
+            or not isinstance(mesh_arg, ast.Name)
+        ):
+            return set()
+        info = module.index.info_for(enclosing)
+        if info is None or mesh_arg.id not in info.params:
+            return set()
+        param = mesh_arg.id
+        a = enclosing.args
+        pos_names = [p.arg for p in a.posonlyargs + a.args]
+        axes: Set[str] = set()
+        found = False
+        for caller in module.index.functions.values():
+            if caller.node is enclosing:
+                continue  # self-recursion never adds evidence
+            for sub in caller.body_nodes():
+                if not isinstance(sub, ast.Call):
+                    continue
+                name = call_name(sub)
+                if not name or "." in name:
+                    continue
+                callee = caller.scope.resolve(name)
+                if callee is None or callee.node is not enclosing:
+                    continue
+                arg_expr = None
+                for kw in sub.keywords:
+                    if kw.arg == param:
+                        arg_expr = kw.value
+                if arg_expr is None and param in pos_names:
+                    idx = pos_names.index(param)
+                    if idx < len(sub.args):
+                        arg_expr = sub.args[idx]
+                if arg_expr is None:
+                    return set()  # splat / default binding: never guess
+                caller_assigns = _local_assignments(
+                    caller.node, module.tree
+                )
+                got = _mesh_axes(arg_expr, caller_assigns)
+                if not got and isinstance(arg_expr, ast.Name):
+                    got = self._caller_attested(
+                        module, caller.node, arg_expr, depth - 1
+                    )
+                if not got:
+                    return set()  # one unattested caller poisons all
+                found = True
+                axes |= got
+        return axes if found else set()
 
     def _reachable(self, module: SourceModule, enclosing, body) -> List:
         """The body function plus every intra-module function its call

@@ -108,16 +108,62 @@ def _psum_wire(x, axis_name: str, k: int):
 
 
 def _gather_pages_wire(pool_loc, k: int):
-    """The SHARDED PAGE GATHER (docs/SERVING.md, "Paged column memory"):
-    the pool buffer shards its page axis over 'data', and a paged warm
-    dispatch materializes the full pool per shard with one registered
-    all_gather before the page-index take. Wire is priced at the whole
-    pool shard ((k-1) x local bytes — the provisioning bound; a
-    needed-pages-only exchange is the documented follow-on)."""
+    """The WHOLE-POOL page gather (docs/SERVING.md, "Paged column
+    memory"): the pool buffer shards its page axis over 'data', and a
+    paged warm dispatch materializes the full pool per shard with one
+    registered all_gather before the page-index take. Wire is priced at
+    the whole pool shard ((k-1) x local bytes — the provisioning bound;
+    ServeConfig.page_gather picks this or the needed-pages exchange)."""
     tele_counters.record_collective(
         "gather", tele_counters.ring_all_gather_bytes(pool_loc, k)
     )
     return lax.all_gather(pool_loc, DATA_AXIS, axis=0, tiled=True)
+
+
+def _scatter_needed_pages_wire(pool_loc, page_idx, k: int, b_loc: int):
+    """The NEEDED-PAGES-ONLY exchange (the PR 11 follow-on): instead of
+    all_gathering the whole pool, every shard contributes the pages it
+    OWNS of every destination shard's referenced list, and one registered
+    psum_scatter delivers shard d exactly its own rows' pages — wire is
+    k x rows x pages-per-row page payloads, independent of pool size.
+
+    The payload moves as BITCAST integers: exactly one shard owns any
+    referenced page (the rest contribute zero words), so the integer sum
+    reproduces the owner's bit pattern EXACTLY — float summation would
+    turn a stored -0.0 into +0.0 and break the threshold-0 bitwise
+    parity contract. Unowned slots (page index -1) deliver zeros; the
+    caller's cold-init select replaces them.
+
+    page_idx: [k*b_loc, pages_per_row] replicated int32. Returns
+    [b_loc, pages_per_row, page_tokens, L, d] — this shard's rows' pages.
+    """
+    import jax
+
+    pps = pool_loc.shape[0]  # pages per shard
+    ppr = page_idx.shape[1]
+    int_t = jnp.int16 if pool_loc.dtype == jnp.bfloat16 else jnp.int32
+    flat = page_idx.reshape(k, b_loc * ppr)  # destination-major needs
+    didx = lax.axis_index(DATA_AXIS)
+    owner = jnp.where(flat >= 0, flat // pps, -1)
+    local = jnp.clip(flat - didx * pps, 0, pps - 1)
+    mine = owner == didx
+    pool_bits = jax.lax.bitcast_convert_type(pool_loc, int_t)
+    contrib = jnp.where(
+        mine[..., None, None, None],
+        pool_bits[local],
+        jnp.zeros((), int_t),
+    )  # [k, b_loc*ppr, pt, L, d] as integers
+    tele_counters.record_collective(
+        "reduce_scatter",
+        tele_counters.ring_reduce_scatter_bytes(contrib, k),
+    )
+    got = lax.psum_scatter(
+        contrib, DATA_AXIS, scatter_dimension=0, tiled=True
+    )
+    pages = jax.lax.bitcast_convert_type(
+        got.reshape(b_loc, ppr, *pool_loc.shape[1:]), pool_loc.dtype
+    )
+    return pages
 
 
 def _sharded_row_agreement(levels, n: int, seq: int) -> jnp.ndarray:
@@ -150,6 +196,7 @@ def make_serve_forward(
     sp_strategy: str = "auto",
     warm: bool = False,
     page_tokens: Optional[int] = None,
+    page_gather: str = "auto",
 ):
     """Build the sharded bucket forward for one engine signature.
 
@@ -340,30 +387,56 @@ def make_serve_forward(
             )
         pt = page_tokens
 
+        if page_gather not in ("auto", "pool", "needed"):
+            raise ValueError(
+                f"page_gather {page_gather!r}: 'auto', 'pool', or 'needed'"
+            )
+
         def paged_body(glom_params, img, mask, pool_loc, page_idx):
-            # The sharded page gather: pool pages live 1/dp per shard;
-            # one registered all_gather over 'data' materializes the
-            # full pool for this dispatch's take (wire priced at the
-            # provisioning bound — see _gather_pages_wire).
-            with jax.named_scope("page_gather"):
-                pool_full = _gather_pages_wire(pool_loc, dp)
+            # The sharded page materialization: pool pages live 1/dp per
+            # shard. Two registered routes (ServeConfig.page_gather):
+            # "pool" all_gathers the WHOLE pool (the provisioning bound),
+            # "needed" psum_scatters ONLY the referenced pages; "auto"
+            # picks whichever moves fewer bytes at this signature's
+            # STATIC shapes — decided at trace time, and the compile
+            # trace's counted bytes record the choice.
             b_loc = img.shape[0]
             didx = lax.axis_index(DATA_AXIS)
-            my_idx = lax.dynamic_slice_in_dim(
-                page_idx, didx * b_loc, b_loc, axis=0
-            )  # [b_loc, pages_per_row]
-            with jax.named_scope("page_take"):
-                pages = pool_full[
-                    jnp.clip(my_idx, 0, pool_full.shape[0] - 1)
-                ]
-                init = jnp.broadcast_to(
-                    glom_params.init_levels[None],
-                    (pt, cfg.levels, cfg.dim),
-                ).astype(pool_full.dtype)
-                pages = jnp.where(
-                    (my_idx >= 0)[..., None, None, None], pages, init
+            mode = page_gather
+            if mode == "auto":
+                elt = pool_loc.dtype.itemsize
+                page_elts = pt * cfg.levels * cfg.dim
+                whole = (dp - 1) * pool_loc.shape[0] * page_elts * elt
+                needed = (
+                    (dp - 1) * b_loc * page_idx.shape[1] * page_elts * elt
                 )
-                lv_full = pages.reshape(b_loc, n, cfg.levels, cfg.dim)
+                mode = "needed" if needed < whole else "pool"
+            if mode == "needed":
+                with jax.named_scope("page_scatter_needed"):
+                    pages = _scatter_needed_pages_wire(
+                        pool_loc, page_idx, dp, b_loc
+                    )
+                my_idx = lax.dynamic_slice_in_dim(
+                    page_idx, didx * b_loc, b_loc, axis=0
+                )
+            else:
+                with jax.named_scope("page_gather"):
+                    pool_full = _gather_pages_wire(pool_loc, dp)
+                my_idx = lax.dynamic_slice_in_dim(
+                    page_idx, didx * b_loc, b_loc, axis=0
+                )  # [b_loc, pages_per_row]
+                with jax.named_scope("page_take"):
+                    pages = pool_full[
+                        jnp.clip(my_idx, 0, pool_full.shape[0] - 1)
+                    ]
+            init = jnp.broadcast_to(
+                glom_params.init_levels[None],
+                (pt, cfg.levels, cfg.dim),
+            ).astype(pool_loc.dtype)
+            pages = jnp.where(
+                (my_idx >= 0)[..., None, None, None], pages, init
+            )
+            lv_full = pages.reshape(b_loc, n, cfg.levels, cfg.dim)
             seq_idx = lax.axis_index(SEQ_AXIS)
             lv_loc = lax.dynamic_slice_in_dim(
                 lv_full, seq_idx * n_loc, n_loc, axis=1

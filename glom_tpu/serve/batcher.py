@@ -208,7 +208,7 @@ class _Item:
     __slots__ = (
         "img", "ticket", "session", "levels", "executed", "hops",
         "redispatches", "warm_src", "parent_span", "dispatch_ms",
-        "n_patches", "pages",
+        "n_patches", "pages", "patches",
     )
 
     def __init__(
@@ -229,6 +229,7 @@ class _Item:
         self.dispatch_ms = 0.0
         self.n_patches = n_patches  # ragged: this row's patch count
         self.pages = None           # pages-warm: the pinned PageHit
+        self.patches = None         # delta mode: host-patchified input
 
 
 def _backend_down() -> bool:
@@ -469,6 +470,7 @@ class DynamicBatcher:
         self.n_rejoined = 0   # engines re-admitted after probation
         self.n_affinity = 0   # requests routed by session affinity
         self.n_page_warm = 0  # rows warm-started from pool pages
+        self.n_incremental = 0  # rows served on the incremental route
         # Pad-tax rollup (ISSUE 11 satellite): per-dispatch pad_fraction
         # was stamped since PR 4 but never aggregated — the summary now
         # carries the mean plus the BYTES the padding wasted (pad token
@@ -1121,6 +1123,7 @@ class DynamicBatcher:
                 self.n_degraded += n_degraded
                 self.n_continued += n_continued
                 self.n_page_warm += rec.get("n_page_warm") or 0
+                self.n_incremental += rec.get("n_incremental") or 0
                 self._pad_fraction_sum += rec.get("pad_fraction") or 0.0
                 self._pad_bytes_wasted += rec.get("pad_bytes") or 0
                 self._levels0_h2d_bytes += (
@@ -1426,8 +1429,28 @@ class DynamicBatcher:
             and self.cache is not None
             and self.cache.pools is not None
         )
+        # DELTA STREAMING (docs/SERVING.md, "Delta streaming"): a
+        # delta-config pool stores base+Σdeltas chains; warm session rows
+        # additionally compute their INPUT delta's page support (bitwise
+        # vs the previous frame's host patches) and ride the engine's
+        # incremental signature, where empty-support rows start
+        # pre-converged. Threshold 0 disables the seeding (bitwise
+        # contract) and the dispatch is the plain paged route.
+        pool = self._pools.get(engine_name)
+        delta_mode = (
+            pages_mode and pool is not None and getattr(pool, "delta", False)
+        )
+        use_inc = (
+            delta_mode
+            and getattr(scfg, "delta_incremental", True)
+            and getattr(engine, "iters_key", None) == "auto"
+            and getattr(scfg, "exit_threshold", 0.0) > 0.0
+            and iters_override is None
+            and getattr(engine, "mesh", None) is None
+        )
         has_cont = any(it.warm_src == "cont" for it in batch)
         n_cache_warm = n_cache_miss = 0
+        hold_rows = None  # delta mode: rows whose input did not change
         pinned: List[str] = []
         if self.cache is not None:
             for it in batch:
@@ -1441,6 +1464,13 @@ class DynamicBatcher:
                     if has_cont:
                         n_cache_miss += 1
                         continue
+                    if delta_mode and it.patches is None:
+                        # Once per row: the support comparison AND the
+                        # next write-back's prev-input reference read
+                        # these same host patches.
+                        it.patches = _patchify_host(
+                            it.img, engine.cfg.patch_size
+                        )
                     hit = self.cache.lookup(it.session, pin=True)
                     full_n = engine.cfg.num_patches
                     if (
@@ -1512,13 +1542,40 @@ class DynamicBatcher:
                 # The PAGED warm path: rows carry page indices, cold
                 # rows -1 — the compiled program takes the pool pages
                 # in-graph (zero levels0 upload; serve/paged_columns.py).
-                pool = self._pools[engine_name]
+                # In delta mode the indices are each session's EFFECTIVE
+                # base+Σdeltas map — reconstruction is this same take.
                 ppr = engine.cfg.num_patches // pool.page_tokens
                 prow = np.full((bucket, ppr), -1, np.int32)
                 for i, it in enumerate(batch):
                     if it.pages is not None:
                         prow[i] = it.pages.pages
                 kw["page_rows"] = prow
+                if use_inc:
+                    # The incremental route's seed: warm rows carry
+                    # their input delta's page support, cold/miss rows
+                    # full support (they behave like plain tiered exit).
+                    srow = np.zeros((bucket, ppr), bool)
+                    for i, it in enumerate(batch):
+                        if it.pages is not None and it.patches is not None:
+                            srow[i] = self.cache.input_support(
+                                it.session, it.patches, pool.page_tokens
+                            )
+                        else:
+                            srow[i] = True
+                    srow[n:] = False  # pad rows: masked out anyway
+                    kw["support_rows"] = srow
+                    # A HOLD frame (empty input support) also skips its
+                    # write-back below: an unchanged input adds nothing
+                    # worth storing, and one floor-iteration of drift
+                    # written back every frame would churn delta pages
+                    # (and force compactions that privatize shared
+                    # bases) for state the next frame reconverges to
+                    # anyway. The cache stays warm with the previous
+                    # entry; prev_input is unchanged by construction.
+                    hold_rows = [
+                        bool(it.pages is not None and not srow[i].any())
+                        for i, it in enumerate(batch)
+                    ]
             with span("serve_dispatch", aggregator=self.spans):
                 result = engine.infer(imgs, n_valid=n, **kw)
             for sid in pinned:
@@ -1579,12 +1636,39 @@ class DynamicBatcher:
                 # hands the DEVICE row slice straight to the pool
                 # (device-to-device — the converged columns never visit
                 # the host on the way in).
-                if self.cache is not None and it.session is not None:
+                skip_store = bool(
+                    hold_rows is not None and i < len(hold_rows)
+                    and hold_rows[i]
+                )
+                if (
+                    self.cache is not None
+                    and it.session is not None
+                    and not skip_store
+                ):
                     if pages_mode:
+                        ch = None
+                        if delta_mode and not pool.holds(it.session):
+                            # Content hash over the EXACT row bytes the
+                            # pool will store: identical converged bases
+                            # (two cameras, one scene) alias refcounted
+                            # pool pages. Hashed from the host copy the
+                            # resolve path already fetched — no extra
+                            # transfer, and only on BASE creation (a
+                            # session already holding a block appends
+                            # deltas; the pool consumes no hash there, so
+                            # hashing every frame would be pure resolve-
+                            # path overhead).
+                            import hashlib
+
+                            ch = hashlib.sha256(
+                                np.ascontiguousarray(levels[i]).tobytes()
+                            ).hexdigest()
                         self.cache.store(
                             it.session, result.levels[i],
                             engine=engine_name,
                             n_tokens=engine.cfg.num_patches,
+                            patches=it.patches if delta_mode else None,
+                            content_hash=ch,
                         )
                     else:
                         self.cache.store(
@@ -1637,6 +1721,13 @@ class DynamicBatcher:
             "compiled": result.compiled,
             **tfields,
         }
+        if use_inc and warm_pages:
+            # The incremental dispatch stamps its route and its explicit
+            # tolerance (the compare gate reads delta_page_atol — 0.0
+            # would be the bitwise mode, which never reaches this route).
+            rec["incremental"] = True
+            rec["n_incremental"] = n
+            rec["delta_page_atol"] = pool.delta_page_atol
         if pad_tokens is not None:
             rec["pad_tokens"] = pad_tokens
             if tok_bytes is not None:
@@ -1918,6 +2009,7 @@ class DynamicBatcher:
                 n_rejoined = self.n_rejoined
                 n_affinity = self.n_affinity
                 n_page_warm = self.n_page_warm
+                n_incremental = self.n_incremental
                 pad_fraction_sum = self._pad_fraction_sum
                 pad_bytes_wasted = self._pad_bytes_wasted
                 levels0_h2d_bytes = self._levels0_h2d_bytes
@@ -1935,6 +2027,7 @@ class DynamicBatcher:
             "n_rejoined": n_rejoined,
             "n_affinity": n_affinity,
             "n_page_warm": n_page_warm,
+            "n_incremental": n_incremental,
             "n_dispatches": len(dispatches),
             # Pad-tax rollup (mean dispatch pad fraction + the bytes the
             # padding wasted) and the warm-path upload total — the pair

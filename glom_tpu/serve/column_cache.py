@@ -75,7 +75,9 @@ def column_state_bytes(cfg, scfg) -> int:
 
 
 class _Entry:
-    __slots__ = ("levels", "nbytes", "engine", "t_write", "n_tokens")
+    __slots__ = (
+        "levels", "nbytes", "engine", "t_write", "n_tokens", "prev_input",
+    )
 
     def __init__(
         self,
@@ -93,6 +95,11 @@ class _Entry:
         self.engine = engine
         self.t_write = t_write
         self.n_tokens = n_tokens
+        # DELTA mode: the previous frame's host-patchified input
+        # [n, patch_dim] — the reference the next frame's INPUT delta
+        # support is computed against (input_support; host RAM, never
+        # HBM, and only retained when delta streaming is on).
+        self.prev_input: Optional[np.ndarray] = None
 
 
 class ColumnCache:
@@ -134,6 +141,15 @@ class ColumnCache:
         self._lock = threading.Lock()
         self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
         self.pools = dict(pools) if pools else None
+        # DELTA mode (docs/SERVING.md, "Delta streaming"): pools built
+        # from a delta_streaming config store base+delta chains instead
+        # of whole-row blocks; the cache's byte accounting then prices
+        # ACTUAL pool pages (shared bases counted once, chains at their
+        # real sparse size) — the "several-fold more live streams in the
+        # same budget" claim is this recount, not an estimate.
+        self.delta = bool(self.pools) and any(
+            getattr(p, "delta", False) for p in self.pools.values()
+        )
         self._bytes = 0
         self._peak_bytes = 0
         self.n_hits = 0
@@ -278,6 +294,8 @@ class ColumnCache:
         *,
         engine: str,
         n_tokens: Optional[int] = None,
+        patches: Optional[np.ndarray] = None,
+        content_hash: Optional[str] = None,
     ) -> bool:
         """Write one resolved request's converged columns back under its
         session key (the warm init for the stream's NEXT frame), evicting
@@ -296,6 +314,11 @@ class ColumnCache:
             if n_tokens is None:
                 raise ValueError("pages mode store() needs n_tokens")
             pool = self.pools[engine]
+            if self.delta and getattr(pool, "delta", False):
+                return self._store_delta(
+                    session_id, levels, engine, n_tokens, pool, now,
+                    patches=patches, content_hash=content_hash,
+                )
             from glom_tpu.serve.paged_columns import pages_for_tokens
 
             need_pages = pages_for_tokens(n_tokens, pool.page_tokens)
@@ -434,6 +457,172 @@ class ColumnCache:
         self._flush(events)
         return stored
 
+    def _store_delta(
+        self,
+        session_id: str,
+        levels,
+        engine: str,
+        n_tokens: int,
+        pool,
+        now: float,
+        *,
+        patches: Optional[np.ndarray] = None,
+        content_hash: Optional[str] = None,
+    ) -> bool:
+        """The DELTA-mode store: the pool lays down a base / appends a
+        sparse delta / folds the chain (write_back_stream); the cache
+        keeps residency policy — sweep-then-LRU under pool exhaustion AND
+        under the byte budget, both priced on the pools' ACTUAL pages.
+        Every outcome is a stamped event: cache_delta (base or sparse
+        append, with the explicit atol the compare gate reads),
+        cache_compact (chain folded), cache_share (base aliased)."""
+        events: List[dict] = []
+        with self._lock:
+            old = self._entries.pop(session_id, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+                if old.engine != engine:
+                    self.pools[old.engine].free(session_id, reason="moved")
+            swept = False
+            info = pool.write_back_stream(
+                session_id, levels, n_tokens, content_hash=content_hash
+            )
+            while info is None:
+                if not swept:
+                    swept = True
+                    if self._sweep_expired_locked(events):
+                        info = pool.write_back_stream(
+                            session_id, levels, n_tokens,
+                            content_hash=content_hash,
+                        )
+                        continue
+                evicted = False
+                for vid, victim in list(self._entries.items()):
+                    if vid == session_id or victim.engine != engine:
+                        continue
+                    if pool.is_pinned(vid):
+                        continue
+                    self._drop(vid, victim)
+                    self.n_evictions += 1
+                    events.append(
+                        {
+                            "event": "cache_evict",
+                            "session": vid,
+                            "bytes": victim.nbytes,
+                            "bytes_in_use": self._bytes,
+                            "budget_bytes": self.budget_bytes,
+                        }
+                    )
+                    evicted = True
+                    break
+                if not evicted:
+                    break
+                info = pool.write_back_stream(
+                    session_id, levels, n_tokens, content_hash=content_hash
+                )
+            if info is None:
+                from glom_tpu.serve.paged_columns import pages_for_tokens
+
+                self.n_rejects += 1
+                events.append(
+                    {
+                        "event": "cache_reject",
+                        "session": session_id,
+                        "bytes": pages_for_tokens(n_tokens, pool.page_tokens)
+                        * pool.page_bytes,
+                        "budget_bytes": self.budget_bytes,
+                        "reason": "pool-exhausted",
+                    }
+                )
+                if old is not None and old.engine == engine:
+                    # The failed append rolled nothing forward — the pool
+                    # still holds the session's PREVIOUS state. Reinstate
+                    # the entry so that block stays reachable (lookups
+                    # serve the old frame's warmth) and EVICTABLE —
+                    # popping it while the pool kept the pages would
+                    # strand them outside every eviction walk.
+                    self._entries[session_id] = old
+                self._recount_locked()
+                self._flush(events)
+                return False
+            nbytes = info["session_pages"] * pool.page_bytes
+            entry = _Entry(
+                None, engine, now, nbytes=nbytes, n_tokens=n_tokens
+            )
+            if patches is not None:
+                entry.prev_input = np.ascontiguousarray(
+                    np.asarray(patches, np.float32)
+                )
+            self._entries[session_id] = entry
+            self.n_writes += 1
+            self._recount_locked()
+            # Budget pressure on ACTUAL bytes (shared bases counted once,
+            # chains at their sparse size): sweep expired first, then LRU.
+            while self._bytes > self.budget_bytes:
+                if not swept:
+                    swept = True
+                    if self._sweep_expired_locked(events):
+                        continue
+                if not self._evict_lru_locked(events, skip=(session_id,)):
+                    break
+            event = {
+                "base": "cache_delta",
+                "delta": "cache_delta",
+                "share": "cache_share",
+                "compact": "cache_compact",
+            }[info["kind"]]
+            events.append(
+                {
+                    "event": event,
+                    "session": session_id,
+                    "kind": info["kind"],
+                    "pages_written": info["pages_written"],
+                    "chain_len": info["chain_len"],
+                    "base_refs": info.get("base_refs"),
+                    "bytes": nbytes,
+                    "bytes_in_use": self._bytes,
+                    "delta_page_atol": pool.delta_page_atol,
+                    **(
+                        {"empty": True} if info.get("empty") else {}
+                    ),
+                    **(
+                        {"compact_deferred": True}
+                        if info.get("compact_deferred")
+                        else {}
+                    ),
+                }
+            )
+        self._flush(events)
+        return True
+
+    def input_support(
+        self, session_id: str, patches: np.ndarray, page_tokens: int
+    ) -> np.ndarray:
+        """[n_pages] bool — which INPUT pages of this frame changed vs
+        the session's previous frame (bitwise: a hold frame is empty
+        support, a moving region is exactly its pages). No previous
+        frame, or a resolution change, marks every page changed — the
+        conservative seed (the row behaves like plain tiered exit). This
+        is the support `glom_forward_incremental` seeds the witness
+        from; pre-converged rows still pay the min_iters floor."""
+        with self._lock:
+            entry = self._entries.get(session_id)
+            prev = entry.prev_input if entry is not None else None
+        patches = np.asarray(patches, np.float32)
+        n = patches.shape[0]
+        n_pages = -(-n // page_tokens)
+        if prev is None or prev.shape != patches.shape:
+            return np.ones((n_pages,), bool)
+        same = (
+            patches.view(np.int32) == prev.view(np.int32)
+        )  # bitcast compare: -0.0 vs 0.0 is a CHANGE
+        out = np.zeros((n_pages,), bool)
+        for k in range(n_pages):
+            out[k] = not bool(
+                same[k * page_tokens:(k + 1) * page_tokens].all()
+            )
+        return out
+
     # -- invalidation ------------------------------------------------------
 
     def invalidate(self, session_id: str, *, reason: str = "explicit") -> bool:
@@ -493,6 +682,19 @@ class ColumnCache:
         self._bytes -= entry.nbytes
         if self.pools is not None:
             self.pools[entry.engine].free(session_id)
+        if self.delta:
+            # A dropped session may have been the charged owner of a
+            # still-shared base, or an un-charged aliaser of one — the
+            # per-entry nbytes cannot know which at drop time. Recount
+            # from the pools' ACTUAL page occupancy instead.
+            self._recount_locked()
+
+    def _recount_locked(self) -> None:
+        """DELTA mode: _bytes mirrors the pools' actual page occupancy
+        (caller holds the cache lock; pool locks nest inside — the
+        documented order)."""
+        self._bytes = sum(p.bytes_in_use() for p in self.pools.values())
+        self._peak_bytes = max(self._peak_bytes, self._bytes)
 
     def _flush(self, events: List[dict]) -> None:
         from glom_tpu.serve.events import emit_serve
@@ -516,7 +718,7 @@ class ColumnCache:
         the temporal bench's acceptance reads (`bytes_peak` must never
         exceed `budget_bytes`)."""
         with self._lock:
-            return {
+            rec = {
                 "n_sessions": len(self._entries),
                 "bytes_in_use": self._bytes,
                 "bytes_peak": self._peak_bytes,
@@ -530,6 +732,41 @@ class ColumnCache:
                 "n_invalidations": self.n_invalidations,
                 "n_rejects": self.n_rejects,
             }
+            if self.delta:
+                # The cache-delta nest (docs/OBSERVABILITY.md): bytes and
+                # chain length are COSTS the compare gate flattens as
+                # serve_cache_delta.* rows; the atol is the explicit
+                # tolerance stamp (0.0 = bitwise reconstruction).
+                n_sessions = len(self._entries)
+                agg: dict = {
+                    "bytes_per_stream": (
+                        round(self._bytes / n_sessions, 1)
+                        if n_sessions
+                        else None
+                    ),
+                }
+                for p in self.pools.values():
+                    sub = p.record().get("delta")
+                    if not sub:
+                        continue
+                    agg.setdefault(
+                        "delta_page_atol", sub["delta_page_atol"]
+                    )
+                    agg.setdefault(
+                        "delta_chain_cap", sub["delta_chain_cap"]
+                    )
+                    agg["delta_chain_len_max"] = max(
+                        agg.get("delta_chain_len_max", 0),
+                        sub["delta_chain_len_max"],
+                    )
+                    for k in (
+                        "n_delta_writes", "n_delta_pages", "n_delta_empty",
+                        "n_compactions", "n_compact_deferred",
+                        "n_base_shares",
+                    ):
+                        agg[k] = agg.get(k, 0) + sub[k]
+                rec["delta"] = agg
+            return rec
 
 
 def resolve_column_cache(scfg, *, writer=None, pools=None) -> Optional[ColumnCache]:

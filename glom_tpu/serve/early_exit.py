@@ -302,6 +302,126 @@ def glom_forward_tiered(
     return TieredAutoResult(final, iters_run, agreement, conv, row_iters)
 
 
+def support_agreement(
+    levels: jnp.ndarray, support: jnp.ndarray
+) -> jnp.ndarray:
+    """Per-row [b, L] consensus agreement restricted to the SUPPORT token
+    positions ([b, n] bool — the input delta's page support expanded to
+    tokens): batch_agreement's reduction with both the mean direction and
+    the cosine average taken over support tokens only, so the witness
+    watches exactly the columns the frame perturbed. Rows with EMPTY
+    support read 0.0 at every level (constant across iterations — their
+    delta is 0, which is what "pre-converged" means to the exit test)."""
+    x = levels.astype(jnp.float32)
+    eps = 1e-8
+    xhat = x / (jnp.linalg.norm(x, axis=-1, keepdims=True) + eps)
+    w = support.astype(jnp.float32)[:, :, None, None]  # [b, n, 1, 1]
+    cnt = jnp.maximum(jnp.sum(w, axis=(1, 2, 3)), 1.0)  # [b]
+    mean = jnp.sum(xhat * w, axis=1, keepdims=True) / cnt[:, None, None, None]
+    mhat = mean / (jnp.linalg.norm(mean, axis=-1, keepdims=True) + eps)
+    cos = jnp.sum(xhat * mhat, axis=-1)  # [b, n, L]
+    return (
+        jnp.sum(cos * support.astype(jnp.float32)[:, :, None], axis=1)
+        / cnt[:, None]
+    )
+
+
+def glom_forward_incremental(
+    params,
+    img: jnp.ndarray,
+    cfg: GlomConfig,
+    *,
+    max_iters: Optional[int] = None,
+    threshold: float = 1e-3,
+    min_iters: int = 1,
+    quorum: float = 1.0,
+    levels: Optional[jnp.ndarray] = None,
+    support_mask: Optional[jnp.ndarray] = None,
+    valid_mask: Optional[jnp.ndarray] = None,
+    compute_dtype=None,
+    use_pallas: bool = False,
+) -> TieredAutoResult:
+    """The SPARSE INCREMENTAL warm forward (docs/SERVING.md, "Delta
+    streaming"): glom_forward_tiered seeded from the input delta's page
+    support. `support_mask` [b, n] marks the token positions whose INPUT
+    changed since the frame that produced `levels`:
+
+      * rows with EMPTY support (a hold frame — bitwise-identical input)
+        start PRE-CONVERGED: they count toward the quorum from iteration
+        zero and pay exactly the `min_iters` floor when the whole bucket
+        is clean;
+      * rows WITH support iterate under a witness computed ON the support
+        (support_agreement) — the perturbed region's re-settling is what
+        gates the exit, so a small perturbation converges in ~1-2 iters
+        instead of re-running the full warm width whose global witness
+        keeps moving while the change propagates.
+
+    threshold == 0.0 is the BITWISE contract: the support seeding is
+    disabled entirely (a Python-level branch, decided at trace time) and
+    the call is glom_forward_tiered — no row ever converges, exactly
+    max_iters updates run, bit-for-bit the full warm path. Any
+    threshold > 0 mode is approximate BY THE STAMPED TOLERANCE: the
+    un-supported columns' drift is bounded by the same exit threshold the
+    auto route already accepts."""
+    if threshold == 0.0 or support_mask is None:
+        return glom_forward_tiered(
+            params, img, cfg,
+            max_iters=max_iters, threshold=threshold, min_iters=min_iters,
+            quorum=quorum, levels=levels, valid_mask=valid_mask,
+            compute_dtype=compute_dtype, use_pallas=use_pallas,
+        )
+    T = max_iters if max_iters is not None else cfg.default_iters
+    _validate_auto_args(T, min_iters, threshold)
+    step, levels = _build_update_step(
+        params, img, cfg, levels, compute_dtype, use_pallas
+    )
+    b = levels.shape[0]
+    valid = (
+        jnp.ones((b,), bool) if valid_mask is None else valid_mask.astype(bool)
+    )
+    support = support_mask.astype(bool)
+    row_dirty = jnp.any(support, axis=1)  # [b]
+    need = quorum_need(quorum, jnp.sum(valid.astype(jnp.float32)))
+    thr = jnp.float32(threshold)
+
+    def cond(carry):
+        lv, prev_rows, i, conv, row_iters = carry
+        n_conv = jnp.sum(jnp.logical_and(conv, valid).astype(jnp.int32))
+        # The min_iters FLOOR must live in the loop condition here: an
+        # all-clean bucket is pre-converged before the first update, and
+        # an empty-delta frame still owes its floor iterations (the
+        # satellite contract tests/test_delta_cache.py pins).
+        return jnp.logical_and(
+            i < T, jnp.logical_or(i < min_iters, n_conv < need)
+        )
+
+    def body(carry):
+        lv, prev_rows, i, conv, row_iters = carry
+        new = step(lv)
+        agree_rows = support_agreement(new, support)  # [b, L]
+        delta = row_agreement_delta(agree_rows, prev_rows)
+        newly = jnp.logical_and(i + 1 >= min_iters, delta < thr)
+        first = jnp.logical_and(newly, jnp.logical_not(conv))
+        row_iters = jnp.where(first, i + 1, row_iters)
+        return new, agree_rows, i + 1, jnp.logical_or(conv, newly), row_iters
+
+    init_rows = support_agreement(levels, support)
+    final, agree_rows, iters_run, conv, row_iters = jax.lax.while_loop(
+        cond,
+        body,
+        (
+            levels,
+            init_rows,
+            jnp.int32(0),
+            jnp.logical_not(row_dirty),  # empty support = pre-converged
+            jnp.where(row_dirty, T, 0).astype(jnp.int32),
+        ),
+    )
+    row_iters = jnp.where(conv, row_iters, iters_run)
+    agreement = masked_level_agreement(final, valid_mask)
+    return TieredAutoResult(final, iters_run, agreement, conv, row_iters)
+
+
 # -- ragged paged dispatch (docs/SERVING.md, "Paged column memory") --------
 #
 # The ragged forward serves requests with DIFFERING patch counts (mixed

@@ -380,6 +380,12 @@ class InferenceEngine:
             )
 
         if self.mesh is not None:
+            if warm == "paged-inc":
+                raise ValueError(
+                    "the incremental route rides the single-device paged "
+                    "path only (sharded incremental is a documented "
+                    "follow-on; docs/SERVING.md)"
+                )
             from glom_tpu.parallel.serve_mesh import make_serve_forward
 
             return make_serve_forward(
@@ -395,6 +401,7 @@ class InferenceEngine:
                 page_tokens=(
                     self.pool.page_tokens if warm == "paged" else None
                 ),
+                page_gather=getattr(scfg, "page_gather", "auto"),
             )
 
         if auto:
@@ -432,18 +439,19 @@ class InferenceEngine:
                     jnp.full((b,), iters, jnp.int32),
                 )
 
-        if warm == "paged":
+        if warm in ("paged", "paged-inc"):
             # The PAGED warm variant: levels0 never crosses the host
             # boundary — the dispatch carries tiny int32 page indices and
             # the compiled program assembles the warm state by a
             # page-index take from the device-resident pool
             # (serve/paged_columns.py). page_idx rows of -1 are COLD:
             # they take the forward's own init broadcast, bitwise the
-            # cold_levels() contract.
+            # cold_levels() contract. With a delta-chain page table the
+            # indices are the session's EFFECTIVE base+Σdeltas map — the
+            # reconstruction IS this same take.
             pt = self.pool.page_tokens
 
-            def paged_fn(params, img, mask, pool, page_idx):
-                b = img.shape[0]
+            def take_pages(params, pool, page_idx, b):
                 with jax.named_scope("page_take"):
                     pages = pool[jnp.clip(page_idx, 0, pool.shape[0] - 1)]
                     init = jnp.broadcast_to(
@@ -453,9 +461,51 @@ class InferenceEngine:
                     pages = jnp.where(
                         (page_idx >= 0)[..., None, None, None], pages, init
                     )
-                    levels0 = pages.reshape(
+                    return pages.reshape(
                         b, cfg.num_patches, cfg.levels, cfg.dim
                     )
+
+            if warm == "paged-inc":
+                # The INCREMENTAL route (docs/SERVING.md, "Delta
+                # streaming"): the dispatch additionally carries the
+                # input delta's [b, pages_per_row] page support — rows
+                # whose frame did not change start pre-converged, changed
+                # rows exit on the support-masked witness. auto-route
+                # only (a fixed budget has no exit to seed).
+                if not auto:
+                    raise ValueError(
+                        "the incremental route needs iters='auto' (a "
+                        "fixed budget has no early exit to seed)"
+                    )
+                from glom_tpu.serve.early_exit import (
+                    glom_forward_incremental,
+                )
+
+                def paged_inc_fn(params, img, mask, pool, page_idx, support):
+                    b = img.shape[0]
+                    levels0 = take_pages(params, pool, page_idx, b)
+                    support_tok = jnp.repeat(support, pt, axis=1)  # [b, n]
+                    res = glom_forward_incremental(
+                        params, img, cfg,
+                        max_iters=max_iters,
+                        threshold=scfg.exit_threshold,
+                        min_iters=min(scfg.min_iters, max_iters),
+                        quorum=scfg.exit_quorum,
+                        levels=levels0,
+                        support_mask=support_tok,
+                        valid_mask=mask,
+                        compute_dtype=compute_dtype,
+                        use_pallas=scfg.use_pallas,
+                    )
+                    return (
+                        res.levels, res.iters_run,
+                        res.row_converged, res.row_iters,
+                    )
+
+                return paged_inc_fn
+
+            def paged_fn(params, img, mask, pool, page_idx):
+                levels0 = take_pages(params, pool, page_idx, img.shape[0])
                 return fn(params, img, mask, levels0)
 
             return paged_fn
@@ -561,7 +611,7 @@ class InferenceEngine:
             self._compute_dtype if self._compute_dtype is not None
             else jnp.float32
         )
-        if warm == "paged":
+        if warm in ("paged", "paged-inc"):
             pool = self.pool
             pool_abs = jax.ShapeDtypeStruct(
                 (pool.n_pages, pool.page_tokens, cfg.levels, cfg.dim),
@@ -571,6 +621,11 @@ class InferenceEngine:
                 (bucket, cfg.num_patches // pool.page_tokens), jnp.int32
             )
             abstract = (params_abs, img_abs, mask_abs, pool_abs, pidx_abs)
+            if warm == "paged-inc":
+                supp_abs = jax.ShapeDtypeStruct(
+                    (bucket, cfg.num_patches // pool.page_tokens), jnp.bool_
+                )
+                abstract = abstract + (supp_abs,)
         else:
             lv_abs = jax.ShapeDtypeStruct(
                 (bucket, cfg.num_patches, cfg.levels, cfg.dim), lv_dtype
@@ -761,6 +816,7 @@ class InferenceEngine:
         levels0=None,
         auto_budget: Optional[int] = None,
         page_rows=None,
+        support_rows=None,
     ) -> ServeResult:
         """Run one padded batch. `imgs` is [b, c, H, W] (numpy or jax) with
         b equal to a bucket size — callers that batch themselves pass an
@@ -819,10 +875,34 @@ class InferenceEngine:
                 raise ValueError(
                     f"page_rows shape {page_rows.shape} != ({b}, {ppr})"
                 )
-        warm = (
-            "paged" if page_rows is not None
-            else levels0 is not None
-        )
+        if support_rows is not None:
+            # The INCREMENTAL route: a paged dispatch carrying the input
+            # delta's page support (docs/SERVING.md, "Delta streaming").
+            if page_rows is None:
+                raise ValueError(
+                    "support_rows rides page_rows (the incremental route "
+                    "is a paged dispatch)"
+                )
+            if self.iters_key != "auto" or iters_override is not None:
+                raise ValueError(
+                    "support_rows needs the iters='auto' route (a fixed "
+                    "budget has no early exit to seed)"
+                )
+            if self.mesh is not None:
+                raise ValueError(
+                    "the incremental route rides the single-device paged "
+                    "path only (sharded incremental is a follow-on)"
+                )
+            support_rows = np.asarray(support_rows, bool)
+            if support_rows.shape != page_rows.shape:
+                raise ValueError(
+                    f"support_rows shape {support_rows.shape} != "
+                    f"{page_rows.shape}"
+                )
+        if page_rows is not None:
+            warm = "paged-inc" if support_rows is not None else "paged"
+        else:
+            warm = levels0 is not None
         if warm is True and np.shape(levels0)[0] != b:
             raise ValueError(
                 f"levels0 batch {np.shape(levels0)[0]} != bucket {b}"
@@ -872,13 +952,17 @@ class InferenceEngine:
             if mask_sh is not None
             else jnp.asarray(mask_host)
         )
-        if warm == "paged":
+        if warm in ("paged", "paged-inc"):
             # The whole point: the warm state stays device-resident —
-            # only the tiny int32 page map crosses the host boundary.
+            # only the tiny int32 page map (plus, on the incremental
+            # route, the bool support map) crosses the host boundary.
             pidx_dev = (
                 jax.device_put(page_rows, pidx_sh)
                 if pidx_sh is not None
                 else jnp.asarray(page_rows)
+            )
+            supp_dev = (
+                jnp.asarray(support_rows) if warm == "paged-inc" else None
             )
         sig = self.signature(
             b, iters_override, auto_budget=auto_budget, warm=warm
@@ -897,11 +981,13 @@ class InferenceEngine:
                     {"bucket": b, "n_valid": n_valid, "attempt": attempts[0]}
                 )
             args = (self.params, make_input(), mask)
-            if warm == "paged":
+            if warm in ("paged", "paged-inc"):
                 # Snapshot per attempt: the freshest write-backs (the
                 # pool swaps copy-on-write, never donated — safe to read
                 # from any number of in-flight dispatches).
                 args = args + (self.pool.buffer(), pidx_dev)
+                if warm == "paged-inc":
+                    args = args + (supp_dev,)
             elif warm:
                 args = args + (make_levels(),)
             levels, iters_run, conv, row_iters = fn(*args)

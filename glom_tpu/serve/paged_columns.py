@@ -102,6 +102,48 @@ class _Block:
         self.pins = 0
 
 
+class _BaseBlock:
+    """A DELTA-mode base: whole-row page set, REFCOUNTED so sessions with
+    content-identical bases (hash-matched at write-back) alias the same
+    read-only pool pages — two cameras on one scene pay for one base.
+    Pages free only when the last referencing session drops."""
+
+    __slots__ = ("pages", "n_tokens", "refs", "hkey")
+
+    def __init__(self, pages: List[int], n_tokens: int, hkey=None):
+        self.pages = pages
+        self.n_tokens = n_tokens
+        self.refs = 1
+        self.hkey = hkey
+
+
+class _DeltaBlock:
+    """A DELTA-mode session entry: a (possibly shared) base plus a chain
+    of frame-to-frame deltas, each a {block ordinal -> page index} map of
+    ONLY the pages whose column residual exceeded `delta_page_atol`. The
+    effective page map is base overridden by the chain newest-last —
+    base+Σdeltas resolved to plain page indices, so reconstruction rides
+    the SAME in-graph page-index take every paged dispatch already uses."""
+
+    __slots__ = ("base", "deltas", "n_tokens", "pins")
+
+    def __init__(self, base: _BaseBlock, n_tokens: int):
+        self.base = base
+        self.deltas: List[Dict[int, int]] = []
+        self.n_tokens = n_tokens
+        self.pins = 0
+
+    def effective(self) -> List[int]:
+        pages = list(self.base.pages)
+        for d in self.deltas:
+            for ordinal, page in d.items():
+                pages[ordinal] = page
+        return pages
+
+    def delta_pages(self) -> List[int]:
+        return [p for d in self.deltas for p in d.values()]
+
+
 class PagedColumnPool:
     """Fixed-size device page pool + host page table for one engine.
 
@@ -149,6 +191,28 @@ class PagedColumnPool:
         self.n_writebacks = 0
         self.n_defrag_moves = 0
         self._pages_peak = 0
+        # Delta streaming (ServeConfig.delta_streaming, docs/SERVING.md
+        # "Delta streaming"): sessions written through write_back_stream
+        # hold a refcounted BASE plus a chain of sparse deltas instead of
+        # a whole-row block. The atol decides what counts as a changed
+        # page (0.0 = any changed BIT — bitcast-compared, so -0.0 vs 0.0
+        # still stores); the chain compacts at the cap; content-identical
+        # bases alias via the hash index.
+        self.delta = bool(getattr(scfg, "delta_streaming", False))
+        self.delta_page_atol = float(getattr(scfg, "delta_page_atol", 0.0))
+        self.delta_chain_cap = int(getattr(scfg, "delta_chain_cap", 4))
+        self._share = bool(getattr(scfg, "delta_base_share", True))
+        self._hash_index: Dict[str, _BaseBlock] = {}
+        self._residual_fns: Dict = {}
+        self._delta_scatter_fns: Dict = {}
+        self._compact_fns: Dict = {}
+        self.n_delta_writes = 0
+        self.n_delta_pages = 0
+        self.n_delta_empty = 0
+        self.n_compactions = 0
+        self.n_compact_deferred = 0
+        self.n_base_shares = 0
+        self.n_superseded = 0
         # THE preallocated buffer: pages x page_tokens x L x d, zeros.
         # One allocation up front — warm traffic never grows it.
         buf = jnp.zeros(
@@ -193,6 +257,12 @@ class PagedColumnPool:
                 return None
             if pin:
                 blk.pins += 1
+            if isinstance(blk, _DeltaBlock):
+                # The EFFECTIVE map: base overridden by the delta chain
+                # newest-last — base+Σdeltas as plain page indices, ready
+                # for the same in-graph page-index take as any warm
+                # dispatch (zero levels0 H2D, the PR 11 contract).
+                return blk.effective(), blk.n_tokens
             return list(blk.pages), blk.n_tokens
 
     def unpin(self, session_id: str) -> None:
@@ -217,6 +287,12 @@ class PagedColumnPool:
         events = []
         with self._lock:
             blk = self._table.get(session_id)
+            if isinstance(blk, _DeltaBlock):
+                raise ValueError(
+                    f"session {session_id!r} holds a delta-chain block; "
+                    "whole-state alloc() does not compose with "
+                    "write_back_stream on one key"
+                )
             if blk is not None:
                 if len(blk.pages) == need:
                     blk.n_tokens = n_tokens
@@ -269,6 +345,7 @@ class PagedColumnPool:
             if not sessions:
                 return 0
             self._table.clear()
+            self._hash_index.clear()
             self._free = list(range(self.n_pages - 1, -1, -1))
             self.n_frees += sessions
             ev = {
@@ -282,17 +359,32 @@ class PagedColumnPool:
         self._flush([ev])
         return n
 
-    def _free_locked(self, session_id: str, blk: _Block, reason: str) -> dict:
-        # Caller holds the lock.
+    def _free_locked(self, session_id: str, blk, reason: str) -> dict:
+        # Caller holds the lock. A delta block frees its chain pages and
+        # DECREFS its base — the base's pages return to the free list
+        # only when the last aliasing session drops (refcount 0), which
+        # is exactly what "two cameras pay for one base" requires on the
+        # way OUT too.
         self._table.pop(session_id, None)
-        self._free.extend(reversed(blk.pages))
+        if isinstance(blk, _DeltaBlock):
+            freed = blk.delta_pages()
+            blk.base.refs -= 1
+            if blk.base.refs == 0:
+                freed = freed + blk.base.pages
+                if blk.base.hkey is not None:
+                    stored = self._hash_index.get(blk.base.hkey)
+                    if stored is blk.base:
+                        del self._hash_index[blk.base.hkey]
+        else:
+            freed = blk.pages
+        self._free.extend(reversed(freed))
         self.n_frees += 1
         used = self.n_pages - len(self._free)
         return {
             "event": "page_free",
             "session": session_id,
             "reason": reason,
-            "n_pages": len(blk.pages),
+            "n_pages": len(freed),
             "pages_used": used,
             "bytes_in_use": used * self.page_bytes,
         }
@@ -344,6 +436,350 @@ class PagedColumnPool:
             self.n_writebacks += 1
         return True
 
+    # -- delta streaming (docs/SERVING.md, "Delta streaming") --------------
+
+    def _alloc_raw_locked(self, need: int) -> Optional[List[int]]:
+        """Pop `need` free pages (caller holds the lock), or None."""
+        if len(self._free) < need:
+            self.n_alloc_fails += 1
+            return None
+        return [self._free.pop() for _ in range(need)]
+
+    def _idx(self, pages) -> "object":
+        import jax.numpy as jnp
+
+        return jnp.asarray(np.asarray(pages, np.int32))
+
+    def _residual_fn(self, k: int, n: int):
+        """Memoized per-page residual probe for a (pages, tokens) shape
+        class: compare one row's new [n, L, d] columns against its
+        current effective pages and return ([k] any-bit-differs bool,
+        [k] max-abs f32) — the host picks by atol (0.0 reads the BITCAST
+        channel, so the stored/skipped decision is literally bitwise)."""
+        key = (k, n)
+        if key not in self._residual_fns:
+            import jax
+            import jax.numpy as jnp
+
+            pt = self.page_tokens
+            dtype = self._dtype
+            int_t = jnp.int16 if dtype == jnp.bfloat16 else jnp.int32
+
+            def fn(pool, eff_idx, row):
+                flat = jnp.pad(
+                    row.astype(dtype), ((0, k * pt - n), (0, 0), (0, 0))
+                ).reshape(k, pt, *row.shape[1:])
+                cur = pool[eff_idx]
+                bits = jnp.any(
+                    jax.lax.bitcast_convert_type(cur, int_t)
+                    != jax.lax.bitcast_convert_type(flat, int_t),
+                    axis=(1, 2, 3),
+                )
+                diff = jnp.max(
+                    jnp.abs(
+                        cur.astype(jnp.float32) - flat.astype(jnp.float32)
+                    ),
+                    axis=(1, 2, 3),
+                )
+                return bits, diff
+
+            self._residual_fns[key] = jax.jit(fn)
+        return self._residual_fns[key]
+
+    def _delta_scatter_fn(self, kc: int, k: int, n: int):
+        """Memoized scatter of `kc` CHANGED pages out of a row's `k`:
+        (pool, dst_idx [kc], row [n, L, d], ordinals [kc]) -> next pool
+        buffer (functional, copy-on-write like every write path)."""
+        key = (kc, k, n)
+        if key not in self._delta_scatter_fns:
+            import jax
+            import jax.numpy as jnp
+
+            pt = self.page_tokens
+            dtype = self._dtype
+
+            def fn(pool, dst_idx, row, ordinals):
+                flat = jnp.pad(
+                    row.astype(dtype), ((0, k * pt - n), (0, 0), (0, 0))
+                ).reshape(k, pt, *row.shape[1:])
+                return pool.at[dst_idx].set(flat[ordinals])
+
+            self._delta_scatter_fns[key] = jax.jit(fn)
+        return self._delta_scatter_fns[key]
+
+    def _copy_pages_fn(self, k: int):
+        """Memoized device-to-device page copy: (pool, src_idx [k],
+        dst_idx [k]) -> next buffer with dst pages holding src content
+        (reads the PRE-move buffer, so src/dst never alias mid-copy)."""
+        if k not in self._compact_fns:
+            import jax
+
+            def fn(pool, src_idx, dst_idx):
+                return pool.at[dst_idx].set(pool[src_idx])
+
+            self._compact_fns[k] = jax.jit(fn)
+        return self._compact_fns[k]
+
+    def delta_chain_len(self, session_id: str) -> Optional[int]:
+        with self._lock:
+            blk = self._table.get(session_id)
+            if not isinstance(blk, _DeltaBlock):
+                return None
+            return len(blk.deltas)
+
+    def base_refs(self, session_id: str) -> Optional[int]:
+        with self._lock:
+            blk = self._table.get(session_id)
+            if not isinstance(blk, _DeltaBlock):
+                return None
+            return blk.base.refs
+
+    def _compact_locked(self, session_id: str, blk: _DeltaBlock, events) -> bool:
+        """Fold base+Σdeltas into ONE base, device-to-device, under the
+        pool's pin/conservation rules: a PINNED session defers (an
+        in-flight dispatch snapshotted its chain's page indices — freeing
+        them would let a re-allocation rewrite what that snapshot's NEXT
+        buffer read resolves to); a sole-owner base compacts IN PLACE
+        (only overridden ordinals copy); a SHARED base copies on write
+        into fresh pages so the aliasing sessions keep theirs bit-for-bit.
+        Returns True when the chain actually folded."""
+        if blk.pins > 0:
+            self.n_compact_deferred += 1
+            return False
+        overridden = sorted({o for d in blk.deltas for o in d.keys()})
+        eff = blk.effective()
+        chain_pages = blk.delta_pages()
+        if blk.base.refs == 1:
+            # In place: copy each overridden ordinal's newest page into
+            # the base's page; unchanged ordinals already hold the base.
+            if overridden:
+                src = [eff[o] for o in overridden]
+                dst = [blk.base.pages[o] for o in overridden]
+                fn = self._copy_pages_fn(len(overridden))
+                self._buffer = fn(
+                    self._buffer, self._idx(src), self._idx(dst)
+                )
+            if blk.base.hkey is not None:
+                # Content changed: the registered hash no longer names
+                # these pages — de-index so no future session aliases a
+                # stale fingerprint.
+                stored = self._hash_index.get(blk.base.hkey)
+                if stored is blk.base:
+                    del self._hash_index[blk.base.hkey]
+                blk.base.hkey = None
+        else:
+            fresh = self._alloc_raw_locked(len(blk.base.pages))
+            if fresh is None:
+                # Pool too tight to copy-on-write a shared base: keep the
+                # over-cap chain (correct, just unfolded) and let
+                # eviction pressure free room first.
+                self.n_compact_deferred += 1
+                return False
+            fn = self._copy_pages_fn(len(eff))
+            self._buffer = fn(self._buffer, self._idx(eff), self._idx(fresh))
+            blk.base.refs -= 1
+            blk.base = _BaseBlock(fresh, blk.n_tokens, hkey=None)
+        blk.deltas = []
+        if chain_pages:
+            self._free.extend(reversed(chain_pages))
+            used = self.n_pages - len(self._free)
+            events.append(
+                {
+                    "event": "page_free",
+                    "session": session_id,
+                    "reason": "compact",
+                    "n_pages": len(chain_pages),
+                    "pages_used": used,
+                    "bytes_in_use": used * self.page_bytes,
+                }
+            )
+        self.n_compactions += 1
+        return True
+
+    def write_back_stream(
+        self,
+        session_id: str,
+        levels_row,
+        n_tokens: int,
+        *,
+        content_hash: Optional[str] = None,
+    ) -> Optional[dict]:
+        """The DELTA-mode write-back: first store lays down (or aliases)
+        a BASE; every later store probes the row's per-page residual
+        against the session's effective state and appends a delta holding
+        ONLY the pages past `delta_page_atol` (atol 0.0 = any changed
+        bit). The chain folds base <- base+Σdeltas at `delta_chain_cap`.
+        `content_hash` (the batcher's hash of the exact row bytes) keys
+        cross-stream base sharing. Returns an info dict for the cache's
+        stamped cache_delta/cache_compact/cache_share events, or None
+        when the pool lacks pages (the cache evicts and retries)."""
+        need = pages_for_tokens(n_tokens, self.page_tokens)
+        events: List[dict] = []
+        info: Optional[dict] = None
+        with self._lock:
+            blk = self._table.get(session_id)
+            if blk is not None and not isinstance(blk, _DeltaBlock):
+                events.append(
+                    self._free_locked(session_id, blk, "delta-convert")
+                )
+                blk = None
+            if blk is not None and blk.n_tokens != n_tokens:
+                events.append(self._free_locked(session_id, blk, "resize"))
+                blk = None
+            if blk is None:
+                shared = None
+                if content_hash is not None and self._share:
+                    cand = self._hash_index.get(content_hash)
+                    if cand is not None and cand.n_tokens == n_tokens:
+                        shared = cand
+                if shared is not None:
+                    shared.refs += 1
+                    self._table[session_id] = _DeltaBlock(shared, n_tokens)
+                    self.n_base_shares += 1
+                    info = {
+                        "kind": "share",
+                        "pages_written": 0,
+                        "chain_len": 0,
+                        "base_refs": shared.refs,
+                    }
+                else:
+                    pages = self._alloc_raw_locked(need)
+                    if pages is None:
+                        self._flush(events)
+                        return None
+                    fn = self._writeback_fn(need, n_tokens)
+                    self._buffer = fn(
+                        self._buffer, self._idx(pages), levels_row
+                    )
+                    self.n_writebacks += 1
+                    base = _BaseBlock(pages, n_tokens, hkey=content_hash)
+                    if content_hash is not None and self._share:
+                        self._hash_index[content_hash] = base
+                    self._table[session_id] = _DeltaBlock(base, n_tokens)
+                    self.n_allocs += 1
+                    used = self.n_pages - len(self._free)
+                    self._pages_peak = max(self._pages_peak, used)
+                    events.append(
+                        {
+                            "event": "page_alloc",
+                            "session": session_id,
+                            "n_pages": need,
+                            "n_tokens": n_tokens,
+                            "delta_base": True,
+                            "pages_used": used,
+                            "pages_total": self.n_pages,
+                            "bytes_in_use": used * self.page_bytes,
+                        }
+                    )
+                    info = {
+                        "kind": "base",
+                        "pages_written": need,
+                        "chain_len": 0,
+                        "base_refs": 1,
+                    }
+            else:
+                eff = blk.effective()
+                probe = self._residual_fn(need, n_tokens)
+                bits, diff = probe(self._buffer, self._idx(eff), levels_row)
+                if self.delta_page_atol <= 0.0:
+                    changed_mask = np.asarray(bits)
+                else:
+                    changed_mask = np.asarray(diff) > self.delta_page_atol
+                ordinals = [int(o) for o in np.nonzero(changed_mask)[0]]
+                if not ordinals:
+                    self.n_delta_empty += 1
+                    info = {
+                        "kind": "delta",
+                        "pages_written": 0,
+                        "chain_len": len(blk.deltas),
+                        "empty": True,
+                    }
+                else:
+                    pages = self._alloc_raw_locked(len(ordinals))
+                    if pages is None:
+                        self._flush(events)
+                        return None
+                    fn = self._delta_scatter_fn(len(ordinals), need, n_tokens)
+                    self._buffer = fn(
+                        self._buffer,
+                        self._idx(pages),
+                        levels_row,
+                        self._idx(ordinals),
+                    )
+                    blk.deltas.append(dict(zip(ordinals, pages)))
+                    self.n_delta_writes += 1
+                    self.n_delta_pages += len(ordinals)
+                    self.n_writebacks += 1
+                    # Prune SUPERSEDED chain pages (unpinned blocks
+                    # only): an ordinal overridden by a newer delta is
+                    # never read again — the effective map takes the
+                    # newest — so its page returns to the pool NOW
+                    # instead of waiting for the cap compaction. This is
+                    # what keeps a stream that keeps perturbing the same
+                    # region at ~constant pages. Pinned blocks defer: an
+                    # in-flight dispatch snapshotted those indices.
+                    if blk.pins == 0 and len(blk.deltas) > 1:
+                        covered = set(blk.deltas[-1].keys())
+                        kept = [blk.deltas[-1]]
+                        superseded: List[int] = []
+                        for d in reversed(blk.deltas[:-1]):
+                            for o in [o for o in d if o in covered]:
+                                superseded.append(d.pop(o))
+                            if d:
+                                covered |= set(d.keys())
+                                kept.append(d)
+                        kept.reverse()
+                        blk.deltas = kept
+                        if superseded:
+                            self._free.extend(reversed(superseded))
+                            self.n_superseded += len(superseded)
+                            used = self.n_pages - len(self._free)
+                            events.append(
+                                {
+                                    "event": "page_free",
+                                    "session": session_id,
+                                    "reason": "superseded",
+                                    "n_pages": len(superseded),
+                                    "pages_used": used,
+                                    "bytes_in_use": used * self.page_bytes,
+                                }
+                            )
+                    used = self.n_pages - len(self._free)
+                    self._pages_peak = max(self._pages_peak, used)
+                    events.append(
+                        {
+                            "event": "page_alloc",
+                            "session": session_id,
+                            "n_pages": len(ordinals),
+                            "n_tokens": n_tokens,
+                            "delta": True,
+                            "chain_len": len(blk.deltas),
+                            "pages_used": used,
+                            "pages_total": self.n_pages,
+                            "bytes_in_use": used * self.page_bytes,
+                        }
+                    )
+                    info = {
+                        "kind": "delta",
+                        "pages_written": len(ordinals),
+                        "chain_len": len(blk.deltas),
+                    }
+                    if len(blk.deltas) >= self.delta_chain_cap:
+                        if self._compact_locked(session_id, blk, events):
+                            info["kind"] = "compact"
+                            info["chain_len"] = 0
+                        else:
+                            info["compact_deferred"] = True
+            if info is not None:
+                blk = self._table[session_id]
+                info["session_pages"] = len(blk.delta_pages()) + (
+                    len(blk.base.pages) if blk.base.refs == 1 else 0
+                )
+                info["base_pages"] = len(blk.base.pages)
+                info["base_refs"] = blk.base.refs
+        self._flush(events)
+        return info
+
     def read_block(self, session_id: str) -> Optional[np.ndarray]:
         """HOST copy of one session's [n_tokens, L, d] columns — the
         tests' parity window and the cold-path fallback, NOT the warm
@@ -381,6 +817,12 @@ class PagedColumnPool:
         long-lived pools."""
         import jax.numpy as jnp
 
+        if self.delta:
+            # Delta blocks interleave shared bases and chain pages; the
+            # take is index-addressed, so locality compaction buys
+            # nothing a chain compaction doesn't — skip rather than move
+            # pages a sibling session aliases.
+            return 0
         with self._lock:
             blocks = sorted(
                 (
@@ -449,7 +891,7 @@ class PagedColumnPool:
         == pages_total always)."""
         with self._lock:
             used = self.n_pages - len(self._free)
-            return {
+            rec = {
                 "page_tokens": self.page_tokens,
                 "page_bytes": self.page_bytes,
                 "pages_total": self.n_pages,
@@ -465,6 +907,39 @@ class PagedColumnPool:
                 "n_writebacks": self.n_writebacks,
                 "n_defrag_moves": self.n_defrag_moves,
             }
+            if self.delta:
+                # The delta rollup the acceptance reads: bytes_per_stream
+                # is ACTUAL pool pages over live sessions (shared bases
+                # and sparse chains both shrink it — the several-fold
+                # drop the delta cache exists for), chain stats price the
+                # reconstruction depth, and the atol is the explicit
+                # tolerance stamp the compare gate reads (0.0 = bitwise).
+                chains = [
+                    len(b.deltas)
+                    for b in self._table.values()
+                    if isinstance(b, _DeltaBlock)
+                ]
+                rec["delta"] = {
+                    "delta_page_atol": self.delta_page_atol,
+                    "delta_chain_cap": self.delta_chain_cap,
+                    "bytes_per_stream": (
+                        round(used * self.page_bytes / len(self._table), 1)
+                        if self._table
+                        else None
+                    ),
+                    "delta_chain_len_mean": (
+                        round(sum(chains) / len(chains), 3) if chains else 0.0
+                    ),
+                    "delta_chain_len_max": max(chains) if chains else 0,
+                    "n_delta_writes": self.n_delta_writes,
+                    "n_delta_pages": self.n_delta_pages,
+                    "n_delta_empty": self.n_delta_empty,
+                    "n_compactions": self.n_compactions,
+                    "n_compact_deferred": self.n_compact_deferred,
+                    "n_base_shares": self.n_base_shares,
+                    "n_superseded": self.n_superseded,
+                }
+            return rec
 
 
 def resolve_page_pool(

@@ -54,6 +54,11 @@ _COST_METRIC_TOKENS = (
     # higher pad_fraction_mean, more pad bytes, or warm levels0 bytes
     # creeping back onto the host->device path — regresses UP.
     "pad", "h2d",
+    # Delta-cache depth is a COST (ISSUE 12): longer chains mean more
+    # pages per stream and deeper reconstruction; compactions deferred
+    # under pins are pressure evidence. bytes_per_stream rides the
+    # "bytes" unit token.
+    "chain", "compact_deferred",
 )
 
 
@@ -131,6 +136,27 @@ def flatten_engine_metrics(rec: dict) -> List[dict]:
             rows.append(
                 {
                     "metric": f"serve_pad.{key}{suffix}",
+                    "value": float(v),
+                    "unit": unit,
+                    "kind": "bench",
+                }
+            )
+    # The cache-delta nest (ISSUE 12): bytes_per_stream and chain length
+    # gate as COSTS — a storage change that re-grows per-stream pages or
+    # deepens chains regresses even when latency holds. Counters
+    # (n_delta_writes, n_base_shares, ...) flatten too; direction comes
+    # from _COST_METRIC_TOKENS ("chain"/"compact_deferred" up, shares as
+    # a rate down).
+    delta = (rec.get("column_cache") or {}).get("delta")
+    if isinstance(delta, dict):
+        for key in sorted(delta):
+            v = delta[key]
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                continue
+            unit = "bytes" if "bytes" in key else "count"
+            rows.append(
+                {
+                    "metric": f"serve_cache_delta.{key}{suffix}",
                     "value": float(v),
                     "unit": unit,
                     "kind": "bench",

@@ -206,6 +206,40 @@ class ServeConfig:
     # grid to build a radius mask from — the engine validates loudly).
     ragged: bool = False
     ragged_pages: Tuple[int, ...] = ()
+    # Delta streaming (glom_tpu/serve/paged_columns.py, docs/SERVING.md
+    # "Delta streaming"): instead of rewriting a session's whole [n, L, d]
+    # column state every frame, each session keeps a paged BASE plus a
+    # chain of frame-to-frame DELTAS — only pages whose column residual
+    # exceeds delta_page_atol are stored (0.0 = exact: a page is "changed"
+    # when any BIT differs). Reconstruction is base+Σdeltas resolved to an
+    # effective page map and assembled in-graph by the same page-index
+    # take the paged warm path already uses (zero levels0 H2D). The chain
+    # compacts base <- base+Σdeltas device-to-device at delta_chain_cap.
+    # delta_base_share aliases content-identical bases across sessions
+    # (hash at write-back, refcounted pool pages — two cameras on one
+    # scene pay for one base). delta_incremental routes warm frames
+    # through glom_forward_incremental: the early-exit witness is seeded
+    # from the INPUT delta's page support, so rows whose frame did not
+    # change start pre-converged (min_iters floor still applies) and a
+    # small perturbation converges in ~1-2 iters. Requires a page pool;
+    # exclusive with ragged admission (bucket route only for now). Any
+    # delta_page_atol > 0 mode stamps the tolerance on every record the
+    # compare gate reads — threshold 0 stays BITWISE.
+    delta_streaming: bool = False
+    delta_page_atol: float = 0.0
+    delta_chain_cap: int = 4
+    delta_base_share: bool = True
+    delta_incremental: bool = True
+    # Sharded paged route (parallel/serve_mesh.py): how a paged warm
+    # dispatch materializes pool pages across the 'data' shards.
+    #   "pool"   — all_gather the WHOLE pool per dispatch (the PR 11
+    #              provisioning bound);
+    #   "needed" — exchange ONLY the pages the dispatch references via a
+    #              registered psum_scatter (dp x rows x pages-per-row
+    #              page payloads — the pad-free wire);
+    #   "auto"   — pick whichever moves fewer bytes at the signature's
+    #              static shapes (the compile trace records the choice).
+    page_gather: str = "auto"
     # Engine REJOIN after recovery (docs/RESILIENCE.md): a fan-out engine
     # marked dead re-enters service only after rejoin_threshold
     # CONSECUTIVE successful probation health dispatches (stamped
@@ -330,6 +364,31 @@ class ServeConfig:
                 raise ValueError(
                     f"ragged_pages {self.ragged_pages} must be >= 1"
                 )
+        if self.delta_streaming:
+            if self.page_pool_pages <= 0:
+                raise ValueError(
+                    "delta_streaming needs a device page pool "
+                    "(page_pool_pages > 0): delta entries are pool pages"
+                )
+            if self.ragged:
+                raise ValueError(
+                    "delta_streaming rides the bucket route only (ragged "
+                    "delta chains are a documented follow-on)"
+                )
+        if self.delta_page_atol < 0:
+            raise ValueError(
+                f"delta_page_atol {self.delta_page_atol} must be >= 0 "
+                "(0.0 = exact: any changed bit stores the page)"
+            )
+        if self.delta_chain_cap < 1:
+            raise ValueError(
+                f"delta_chain_cap {self.delta_chain_cap} must be >= 1"
+            )
+        if self.page_gather not in ("auto", "pool", "needed"):
+            raise ValueError(
+                f"page_gather {self.page_gather!r}: 'auto', 'pool', or "
+                "'needed'"
+            )
         if self.rejoin_threshold < 0:
             raise ValueError(
                 f"rejoin_threshold {self.rejoin_threshold} must be >= 0 "

@@ -182,6 +182,40 @@ print(f"OK: pad waste {waste['bucket-ladder']}% -> {waste['ragged-paged']}%; "
       f"0 warm levels0 bytes over {w} page-warm rows")
 EOF
 
+# 9i. Delta streaming A/B gate (ISSUE 12, docs/SERVING.md "Delta
+#     streaming"): whole-state paged warm vs delta-chain storage + the
+#     sparse incremental route over O(1)-shaped frame traffic (shared
+#     scene bases, bitwise holds, a one-patch moving region). On real
+#     hardware this prices what the CPU smoke cannot: the residual
+#     probe + sparse scatter on the device write-back path, and the HBM
+#     actually freed per live stream. The gate requires the delta arm
+#     STRICTLY below whole-state on BOTH mean executed iters/frame
+#     (and < 2) and bytes_per_stream (>= 3x), with the threshold-0
+#     reconstruction parity probe BITWISE — rows feed the step 11b
+#     serve baseline (bytes/chain rows gate as costs).
+step bench_serve_delta 2400 python -u bench_serve.py --temporal --delta --streams 8 --frames 16
+step delta_gate 120 python - results/hw_queue/bench_serve_delta.log <<'EOF'
+import sys
+from glom_tpu.telemetry import schema
+rows = [r for _, r in schema.iter_json_lines(open(sys.argv[1]))]
+iters, bps, parity = {}, {}, None
+for r in rows:
+    m = r.get("metric", "")
+    if m.startswith("serve_delta_mean_iters ("):
+        iters[m.split("(")[1].split(",")[0]] = r["value"]
+    if m.startswith("serve_delta_bytes_per_stream ("):
+        bps[m.split("(")[1].split(",")[0]] = r["value"]
+    if m.startswith("serve_delta_parity ("):
+        parity = r["value"]
+assert set(iters) == {"whole-state", "delta"}, f"arms missing: {iters}"
+assert iters["delta"] < 2.0 and iters["delta"] < iters["whole-state"], (
+    f"incremental path did not beat the bar: {iters}")
+assert bps["delta"] * 3 <= bps["whole-state"], f"bytes not >=3x down: {bps}"
+assert parity == 1.0, "threshold-0 delta reconstruction is NOT bitwise"
+print(f"OK: iters {iters['whole-state']} -> {iters['delta']}, bytes/stream "
+      f"{bps['whole-state']} -> {bps['delta']}, parity bitwise")
+EOF
+
 # 9g. Request-tracing overhead gate + pod aggregation (this round's
 #     tentpole, docs/OBSERVABILITY.md): full trace stamping (ids minted
 #     per submit, per-dispatch scope, per-request resolve leaves) must
@@ -236,6 +270,7 @@ grep -ah '^{' results/hw_queue/bench_serve.log \
     results/hw_queue/bench_serve_sharded.log \
     results/hw_queue/bench_serve_temporal.log \
     results/hw_queue/bench_serve_ragged.log \
+    results/hw_queue/bench_serve_delta.log \
     > results/hw_queue/serve_candidate.jsonl 2>/dev/null || true
 if [ -f results/serve_baseline.jsonl ]; then
     step serve_compare 300 python -m glom_tpu.telemetry compare \

@@ -1,0 +1,128 @@
+"""Seeded fixture pair for the axis-environment checker's OPAQUE-MESH
+caller attestation (glom_tpu/analysis/axisenv.py).
+
+The blind spot this pair pins: `_serve_shard_leaky` takes its mesh as an
+opaque PARAMETER, and this module ALSO builds a 'model'-carrying
+training mesh — so the module-wide MeshConfig union ({data, seq, model})
+would attest the wrong environment and miss the bug. The checker must
+instead follow the intra-module CALLER (`build_serve_leaky`) to its
+`MeshConfig(data=..., seq=...)` and flag the psum over MODEL_AXIS, both
+at the direct lax site and through the registered-wrapper threaded axis.
+`_serve_shard_clean` is the twin with every collective on a
+caller-attested axis. `_opaque_shard` has NO intra-module caller at all
+— with the module union in play it attests {data, seq, model} and stays
+clean (the fallback contract, unchanged).
+
+This file is a LINT FIXTURE: it is parsed, never imported (the fake
+shard_map below keeps it import-safe anyway).
+"""
+
+from glom_tpu.telemetry import counters as tele_counters
+from glom_tpu.utils.config import MeshConfig
+
+DATA_AXIS = "data"
+SEQ_AXIS = "seq"
+MODEL_AXIS = "model"
+
+
+def shard_map(fn, mesh=None, in_specs=None, out_specs=None):  # noqa: ARG001
+    return fn
+
+
+def P(*axes):  # noqa: ARG001 — spec stand-in, parsed not executed
+    return axes
+
+
+def make_mesh(cfg):
+    return cfg
+
+
+def build_train_mesh():
+    """The 'model'-carrying training mesh that poisons the module-wide
+    union — the reason caller attestation must win over the fallback."""
+    return make_mesh(MeshConfig(data=2, seq=2, model=2))
+
+
+def _psum_wire(x, axis_name, k):
+    """The registered-wrapper idiom the real serve mesh uses."""
+    from jax import lax
+
+    tele_counters.record_collective("reduce", 0 * k)
+    return lax.psum(x, axis_name)
+
+
+def _serve_shard_leaky(mesh):
+    from jax import lax
+
+    def body(x, y):
+        part = _psum_wire(x, SEQ_AXIS, 2)  # fine: caller mesh has 'seq'
+        # BUG: the module builds a 'model' mesh SOMEWHERE (build_train_
+        # mesh), but THIS shard_map's callers only ever pass (data, seq).
+        tele_counters.record_collective("reduce", 0)
+        bad = lax.psum(part, MODEL_AXIS)
+        return _psum_wire(bad + y, MODEL_AXIS, 2)  # threaded: also bad
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS, SEQ_AXIS)),
+        out_specs=P(DATA_AXIS),
+    )
+
+
+def build_serve_leaky():
+    mesh = make_mesh(MeshConfig(data=4, seq=2))
+    return _serve_shard_leaky(mesh)
+
+
+def _serve_shard_clean(mesh):
+    def body(x, y):
+        part = _psum_wire(x, SEQ_AXIS, 2)
+        return _psum_wire(part + y, DATA_AXIS, 4)
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS, SEQ_AXIS)),
+        out_specs=P(DATA_AXIS),
+    )
+
+
+def build_serve_clean():
+    mesh = make_mesh(MeshConfig(data=4, seq=2))
+    return _serve_shard_clean(mesh)
+
+
+def _hop_leaky(mesh):
+    """Leaky THROUGH a forwarding hop: the only path to a MeshConfig is
+    caller -> caller (bounded parameter-to-parameter recursion). Flagged
+    only when the checker actually follows the second hop."""
+    from jax import lax
+
+    def body(x):
+        tele_counters.record_collective("reduce", 0)
+        return lax.psum(x, MODEL_AXIS)
+
+    return shard_map(body, mesh=mesh, in_specs=(P(DATA_AXIS),), out_specs=P())
+
+
+def _forward_mesh(mesh):
+    return _hop_leaky(mesh)
+
+
+def build_serve_forwarded():
+    mesh = make_mesh(MeshConfig(data=4, seq=2))
+    return _forward_mesh(mesh)
+
+
+def _opaque_shard(mesh):
+    """No intra-module caller: falls back to the module union (which
+    includes 'model' via build_train_mesh) — stays clean, the unchanged
+    fallback contract."""
+    from jax import lax
+
+    def body(x):
+        tele_counters.record_collective("reduce", 0)
+        return lax.psum(x, MODEL_AXIS)
+
+    return shard_map(body, mesh=mesh, in_specs=(P(DATA_AXIS),), out_specs=P())

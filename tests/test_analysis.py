@@ -1064,6 +1064,74 @@ class TestAxisEnvironment:
         assert len(fs) == 1
         assert "'model'" in fs[0].message
 
+    def test_opaque_param_caller_attestation_pair(self):
+        """The seeded pair for the opaque-mesh blind spot
+        (tests/fixtures/axis_env_param.py): the module ALSO builds a
+        'model'-carrying training mesh, so the module-wide union would
+        attest the wrong environment — the checker must follow the
+        intra-module CALLER's MeshConfig(data, seq) instead and flag
+        the psum over 'model' (direct site + threaded wrapper), plus
+        the hop-forwarded leaky body whose MeshConfig is one more
+        caller up. The clean twins and the caller-less opaque helper
+        (module-union fallback) scan clean."""
+        fs = by_checker(
+            run([str(FIXTURES / "axis_env_param.py")]), "axis-environment"
+        )
+        assert len(fs) == 3, fs
+        assert all("'model'" in f.message for f in fs)
+        assert sum("_serve_shard_leaky" in f.symbol for f in fs) == 2
+        assert sum("_hop_leaky" in f.symbol for f in fs) == 1
+
+    def test_caller_attestation_beats_module_union(self, tmp_path):
+        """A file that builds BOTH a (data, seq) serve mesh (passed to
+        the opaque-param helper) and a model-carrying training mesh:
+        the union alone would hide the bug."""
+        src = (
+            "from jax import lax\n"
+            "from glom_tpu.utils.config import MeshConfig\n"
+            "from glom_tpu.utils.compat import shard_map\n"
+            "DATA_AXIS = 'data'\n"
+            "MODEL_AXIS = 'model'\n"
+            "def train_mesh(make_mesh):\n"
+            "    return make_mesh(MeshConfig(data=2, model=2))\n"
+            "def helper(mesh, P):\n"
+            "    def body(x):\n"
+            "        return lax.psum(x, MODEL_AXIS)\n"
+            "    return shard_map(body, mesh=mesh,\n"
+            "                     in_specs=(P(DATA_AXIS),), out_specs=P())\n"
+            "def build(make_mesh, P):\n"
+            "    mesh = make_mesh(MeshConfig(data=8))\n"
+            "    return helper(mesh, P)\n"
+        )
+        fs = by_checker(lint(tmp_path, src), "axis-environment")
+        assert len(fs) == 1
+        assert "'model'" in fs[0].message
+
+    def test_one_unattested_caller_poisons_attestation(self, tmp_path):
+        """Two callers, one of which binds the mesh param opaquely: the
+        checker must not guess — it falls back to the module union
+        (which carries 'model' here), so nothing flags."""
+        src = (
+            "from jax import lax\n"
+            "from glom_tpu.utils.config import MeshConfig\n"
+            "from glom_tpu.utils.compat import shard_map\n"
+            "DATA_AXIS = 'data'\n"
+            "MODEL_AXIS = 'model'\n"
+            "def train_mesh(make_mesh):\n"
+            "    return make_mesh(MeshConfig(data=2, model=2))\n"
+            "def helper(mesh, P):\n"
+            "    def body(x):\n"
+            "        return lax.psum(x, MODEL_AXIS)\n"
+            "    return shard_map(body, mesh=mesh,\n"
+            "                     in_specs=(P(DATA_AXIS),), out_specs=P())\n"
+            "def build(make_mesh, P):\n"
+            "    mesh = make_mesh(MeshConfig(data=8))\n"
+            "    return helper(mesh, P)\n"
+            "def build_opaque(mesh, P):\n"
+            "    return helper(mesh, P)\n"
+        )
+        assert by_checker(lint(tmp_path, src), "axis-environment") == []
+
     def test_opaque_mesh_skips(self, tmp_path):
         """No MeshConfig anywhere (the training shard bodies' shape:
         mesh arrives from config) -> the environment is unattested and

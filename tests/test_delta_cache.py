@@ -1,0 +1,449 @@
+"""Delta-encoded streaming cache + sparse incremental convergence
+(ISSUE 12, docs/SERVING.md "Delta streaming").
+
+The contracts the delta route ships under:
+
+  * base+Σdeltas reconstruction is BITWISE the whole-state block at
+    threshold 0 / atol 0 — the effective page map feeds the SAME paged
+    warm signature, so a chain-reconstructed dispatch equals the
+    whole-state warm dispatch bit for bit;
+  * compaction conserves pages (pages_used + pages_free == pages_total
+    through base folds, copy-on-write of shared bases, superseded-page
+    reclamation) and DEFERS under concurrent pins — an in-flight
+    dispatch's snapshotted indices are never freed under it;
+  * an empty-delta frame (bitwise-identical input) short-circuits to the
+    min_iters floor on the incremental route;
+  * a shared base's pages free only at refcount 0;
+  * the chain cap triggers exactly AT the cap.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from glom_tpu.models.core import init_glom
+from glom_tpu.serve.batcher import DynamicBatcher
+from glom_tpu.serve.column_cache import ColumnCache
+from glom_tpu.serve.early_exit import (
+    glom_forward_incremental,
+    glom_forward_tiered,
+)
+from glom_tpu.serve.engine import InferenceEngine
+from glom_tpu.serve.paged_columns import PagedColumnPool
+from glom_tpu.utils.config import GlomConfig, ServeConfig
+
+CFG = GlomConfig(dim=32, levels=3, image_size=16, patch_size=4)  # n=16
+DSCFG = ServeConfig(
+    buckets=(1, 2, 4), max_batch=4, max_delay_ms=2.0,
+    iters="auto", max_auto_iters=8, exit_threshold=1e-3,
+    page_pool_pages=64, page_tokens=4,
+    delta_streaming=True, delta_page_atol=0.05, delta_chain_cap=3,
+    column_cache_bytes=1 << 20, dispatch_retries=0,
+)
+
+
+def _row(rng, scale=100.0):
+    return (scale * rng.normal(size=(16, 3, 32))).astype(np.float32)
+
+
+def _conserved(pool):
+    rec = pool.record()
+    assert rec["pages_used"] + rec["pages_free"] == rec["pages_total"], rec
+    return rec
+
+
+def _bump_page(row, ordinal, pt=4):
+    out = row.copy()
+    out[ordinal * pt] += 1.0
+    return out
+
+
+class TestDeltaChain:
+    def _pool(self, **over):
+        scfg = dataclasses.replace(DSCFG, **over) if over else DSCFG
+        return PagedColumnPool(CFG, scfg, name="t")
+
+    def test_chain_cap_triggers_exactly_at_cap(self):
+        """cap=3: deltas at DISJOINT ordinals grow the chain 1, 2 — and
+        the 3rd (== cap) folds base <- base+Σdeltas, never earlier."""
+        pool = self._pool()
+        rng = np.random.default_rng(0)
+        row = _row(rng)
+        assert pool.write_back_stream("s", row, 16)["kind"] == "base"
+        for step, ordinal in enumerate((0, 1)):
+            row = _bump_page(row, ordinal)
+            info = pool.write_back_stream("s", row, 16)
+            assert info["kind"] == "delta", info
+            assert info["chain_len"] == step + 1
+        row = _bump_page(row, 2)
+        info = pool.write_back_stream("s", row, 16)
+        assert info["kind"] == "compact" and info["chain_len"] == 0, info
+        assert pool.n_compactions == 1
+        assert np.array_equal(pool.read_block("s"), row)
+        _conserved(pool)
+
+    def test_superseded_pages_reclaimed(self):
+        """A stream that keeps perturbing the SAME region stays at
+        ~constant pages: the older chain entry's page frees the moment a
+        newer delta overrides its ordinal."""
+        pool = self._pool(delta_chain_cap=16)
+        rng = np.random.default_rng(1)
+        row = _row(rng)
+        pool.write_back_stream("s", row, 16)
+        for _ in range(5):
+            row = _bump_page(row, 2)
+            info = pool.write_back_stream("s", row, 16)
+            assert info["kind"] == "delta" and info["chain_len"] == 1, info
+        assert pool.n_superseded == 4
+        rec = _conserved(pool)
+        assert rec["pages_used"] == 4 + 1  # base + ONE live delta page
+        assert np.array_equal(pool.read_block("s"), row)
+
+    def test_compaction_conservation_under_concurrent_pins(self):
+        """A PINNED session defers compaction (and superseded pruning) —
+        its in-flight snapshot's page indices survive — and the deferred
+        fold lands on the next unpinned write, pages conserved
+        throughout."""
+        pool = self._pool()
+        rng = np.random.default_rng(2)
+        row = _row(rng)
+        pool.write_back_stream("s", row, 16)
+        assert pool.lookup("s", pin=True) is not None
+        for ordinal in (0, 1, 2, 3):
+            row = _bump_page(row, ordinal)
+            info = pool.write_back_stream("s", row, 16)
+            assert info["kind"] == "delta", info
+            _conserved(pool)
+        # Chain is past the cap, but the pin held every fold back.
+        assert info["chain_len"] == 4 and info.get("compact_deferred")
+        assert pool.n_compactions == 0 and pool.n_compact_deferred >= 2
+        pool.unpin("s")
+        row = _bump_page(row, 0)
+        info = pool.write_back_stream("s", row, 16)
+        assert info["kind"] == "compact", info
+        assert np.array_equal(pool.read_block("s"), row)
+        _conserved(pool)
+
+    def test_shared_base_frees_only_at_refcount_zero(self):
+        pool = self._pool()
+        rng = np.random.default_rng(3)
+        row = _row(rng)
+        assert pool.write_back_stream("a", row, 16, content_hash="h")[
+            "kind"
+        ] == "base"
+        info = pool.write_back_stream("b", row, 16, content_hash="h")
+        assert info["kind"] == "share" and info["base_refs"] == 2
+        assert pool.base_refs("a") == 2
+        used_shared = _conserved(pool)["pages_used"]
+        assert used_shared == 4  # ONE base, two sessions
+        # Owner evicts first: the base must survive for the aliaser.
+        assert pool.free("a") == 0  # no delta pages, base still ref'd
+        assert np.array_equal(pool.read_block("b"), row)
+        assert _conserved(pool)["pages_used"] == 4
+        assert pool.free("b") == 4  # refcount 0: base pages free NOW
+        assert _conserved(pool)["pages_used"] == 0
+
+    def test_shared_base_copy_on_write_compaction(self):
+        """A sharer that compacts must NOT rewrite the shared pages: it
+        copies on write into a fresh private base; the other session's
+        content stays bit-for-bit."""
+        pool = self._pool()
+        rng = np.random.default_rng(4)
+        row = _row(rng)
+        pool.write_back_stream("a", row, 16, content_hash="h")
+        pool.write_back_stream("b", row, 16, content_hash="h")
+        mut = row
+        for ordinal in (0, 1, 2):
+            mut = _bump_page(mut, ordinal)
+            info = pool.write_back_stream("a", mut, 16)
+        assert info["kind"] == "compact" and info["base_refs"] == 1
+        assert pool.base_refs("b") == 1  # the old base is b's alone now
+        assert np.array_equal(pool.read_block("a"), mut)
+        assert np.array_equal(pool.read_block("b"), row)
+        _conserved(pool)
+
+    def test_atol_zero_is_bitwise(self):
+        """atol 0.0 stores a page when any BIT differs — including a
+        -0.0 vs 0.0 flip float comparison would miss — and an identical
+        frame is an EMPTY delta."""
+        pool = self._pool(delta_page_atol=0.0)
+        rng = np.random.default_rng(5)
+        row = _row(rng)
+        pool.write_back_stream("s", row, 16)
+        info = pool.write_back_stream("s", row.copy(), 16)
+        assert info["pages_written"] == 0 and info.get("empty"), info
+        flip = row.copy()
+        flip[0, 0, 0] = -0.0 if flip[0, 0, 0] == 0.0 else -flip[0, 0, 0]
+        info = pool.write_back_stream("s", flip, 16)
+        assert info["pages_written"] == 1, info
+
+    def test_whole_state_alloc_rejected_on_delta_session(self):
+        pool = self._pool()
+        rng = np.random.default_rng(6)
+        pool.write_back_stream("s", _row(rng), 16)
+        with pytest.raises(ValueError, match="delta-chain"):
+            pool.alloc("s", 16)
+
+
+class TestDeltaCacheResidency:
+    def _cache_pool(self, **over):
+        scfg = dataclasses.replace(DSCFG, **over) if over else DSCFG
+        pool = PagedColumnPool(CFG, scfg, name="e0")
+        cache = ColumnCache(
+            scfg.column_cache_bytes, pools={"e0": pool}
+        )
+        assert cache.delta
+        return cache, pool
+
+    def test_store_lookup_roundtrip_and_actual_pricing(self):
+        cache, pool = self._cache_pool()
+        rng = np.random.default_rng(0)
+        row = _row(rng)
+        assert cache.store("a", row, engine="e0", n_tokens=16,
+                           content_hash="h")
+        assert cache.store("b", row, engine="e0", n_tokens=16,
+                           content_hash="h")
+        # Priced on ACTUAL pages: one shared base = 4 pages total, not 8.
+        assert cache.bytes_in_use() == 4 * pool.page_bytes
+        hit = cache.lookup("a")
+        assert hit is not None and hit.n_tokens == 16
+        rec = cache.record()
+        assert rec["delta"]["n_base_shares"] == 1
+        assert rec["delta"]["bytes_per_stream"] == 2 * pool.page_bytes
+
+    def test_eviction_frees_chain_and_refcounted_base(self):
+        cache, pool = self._cache_pool()
+        rng = np.random.default_rng(1)
+        row = _row(rng)
+        cache.store("a", row, engine="e0", n_tokens=16, content_hash="h")
+        cache.store("b", row, engine="e0", n_tokens=16, content_hash="h")
+        cache.store("a", _bump_page(row, 1), engine="e0", n_tokens=16)
+        assert cache.invalidate("a")
+        # a's delta page freed, the shared base survives for b.
+        assert pool.record()["pages_used"] == 4
+        assert np.array_equal(pool.read_block("b"), row)
+        assert cache.invalidate("b")
+        assert pool.record()["pages_used"] == 0
+        assert cache.bytes_in_use() == 0
+
+    def test_pool_exhaustion_evicts_lru(self):
+        # 12 pages = 3 whole bases; a 4th DISTINCT stream must evict.
+        cache, pool = self._cache_pool(page_pool_pages=12)
+        rng = np.random.default_rng(2)
+        for s in range(4):
+            assert cache.store(
+                f"s{s}", _row(rng), engine="e0", n_tokens=16
+            )
+        assert cache.n_evictions >= 1
+        assert cache.lookup("s0") is None  # the LRU victim
+        _conserved(pool)
+
+    def test_reject_keeps_previous_state_reachable(self):
+        """A delta append that fails on a bone-dry pool (nothing
+        evictable) must NOT strand the session's existing block: the
+        store returns False, but the PREVIOUS frame's state stays
+        reachable through the cache — and evictable, so the pages are
+        never orphaned outside every eviction walk."""
+        cache, pool = self._cache_pool(page_pool_pages=4)  # exactly 1 base
+        rng = np.random.default_rng(5)
+        row = _row(rng)
+        assert cache.store("s", row, engine="e0", n_tokens=16)
+        assert not cache.store(
+            "s", _bump_page(row, 1), engine="e0", n_tokens=16
+        )
+        assert cache.n_rejects == 1
+        hit = cache.lookup("s")
+        assert hit is not None  # the old frame's warmth survives
+        assert np.array_equal(pool.read_block("s"), row)
+        assert cache.bytes_in_use() == 4 * pool.page_bytes
+        assert cache.invalidate("s")  # ... and is still reclaimable
+        assert pool.record()["pages_used"] == 0
+        assert cache.bytes_in_use() == 0
+
+    def test_input_support_bitwise_pages(self):
+        cache, pool = self._cache_pool()
+        rng = np.random.default_rng(3)
+        patches = rng.normal(size=(16, 48)).astype(np.float32)
+        row = _row(rng)
+        cache.store("s", row, engine="e0", n_tokens=16, patches=patches)
+        # Hold frame: empty support.
+        assert not cache.input_support("s", patches.copy(), 4).any()
+        # One token in page 2 changes: exactly page 2 is support.
+        mut = patches.copy()
+        mut[9, 0] += 1.0
+        supp = cache.input_support("s", mut, 4)
+        assert supp.tolist() == [False, False, True, False]
+        # No previous frame: everything is support.
+        assert cache.input_support("x", patches, 4).all()
+
+
+class TestIncrementalForward:
+    @pytest.fixture(scope="class")
+    def setup(self):
+        params = init_glom(jax.random.PRNGKey(0), CFG)
+        rng = np.random.default_rng(7)
+        img = (100 * rng.normal(size=(2, 3, 16, 16))).astype(np.float32)
+        levels = np.asarray(
+            glom_forward_tiered(
+                params, jnp.asarray(img), CFG, max_iters=8, threshold=1e-3
+            ).levels
+        )
+        return params, img, levels
+
+    def test_empty_delta_short_circuits_to_min_iters_floor(self, setup):
+        params, img, levels = setup
+        for floor in (1, 2):
+            res = glom_forward_incremental(
+                params, jnp.asarray(img), CFG,
+                max_iters=8, threshold=1e-3, min_iters=floor,
+                levels=jnp.asarray(levels),
+                support_mask=jnp.zeros((2, 16), bool),
+            )
+            assert int(res.iters_run) == floor
+            assert bool(res.row_converged.all())
+
+    def test_threshold0_is_bitwise_tiered(self, setup):
+        """The bitwise contract: threshold 0 disables the support
+        seeding entirely — the incremental call IS glom_forward_tiered,
+        full width, bit for bit."""
+        params, img, levels = setup
+        inc = glom_forward_incremental(
+            params, jnp.asarray(img), CFG,
+            max_iters=6, threshold=0.0, min_iters=1,
+            levels=jnp.asarray(levels),
+            support_mask=jnp.zeros((2, 16), bool),  # would short-circuit
+        )
+        full = glom_forward_tiered(
+            params, jnp.asarray(img), CFG,
+            max_iters=6, threshold=0.0, min_iters=1,
+            levels=jnp.asarray(levels),
+        )
+        assert int(inc.iters_run) == 6 == int(full.iters_run)
+        assert np.array_equal(np.asarray(inc.levels), np.asarray(full.levels))
+
+    def test_dirty_rows_iterate_clean_rows_preconverge(self, setup):
+        params, img, levels = setup
+        supp = np.zeros((2, 16), bool)
+        supp[0, :4] = True  # row 0 dirty, row 1 clean
+        img2 = img.copy()
+        img2[0, :, 0:4, 0:4] += 0.5
+        res = glom_forward_incremental(
+            params, jnp.asarray(img2), CFG,
+            max_iters=8, threshold=1e-3, min_iters=1,
+            levels=jnp.asarray(levels),
+            support_mask=jnp.asarray(supp),
+        )
+        conv = np.asarray(res.row_converged)
+        assert bool(conv[1])  # pre-converged by empty support
+        assert int(res.iters_run) >= 1
+
+
+@pytest.mark.slow
+class TestDeltaReconstructionParity:
+    """THE acceptance lock: threshold-0 / atol-0 delta reconstruction is
+    BITWISE the whole-state warm dispatch — the same paged signature fed
+    an effective base+Σdeltas map vs a whole-state block."""
+
+    def test_threshold0_chain_bitwise_vs_whole_state(self):
+        scfg = dataclasses.replace(
+            DSCFG, exit_threshold=0.0, delta_page_atol=0.0,
+            max_auto_iters=4,
+        )
+        eng = InferenceEngine(CFG, scfg, key=jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        img1 = (100 * rng.normal(size=(1, 3, 16, 16))).astype(np.float32)
+        lv1 = np.asarray(eng.infer(img1, n_valid=1).levels)[0]
+        assert eng.pool.write_back_stream("delta", lv1, 16) is not None
+        assert eng.pool.write_back("whole", lv1, 16)
+
+        def warm(sid, img):
+            prow = np.asarray([eng.pool.lookup(sid)[0]], np.int32)
+            return np.asarray(
+                eng.infer(img, n_valid=1, page_rows=prow).levels
+            )[0]
+
+        img2 = img1 + 0.05 * rng.normal(size=img1.shape).astype(np.float32)
+        out_d = warm("delta", img2)
+        out_w = warm("whole", img2)
+        assert np.array_equal(out_d, out_w)
+        # Advance one more frame THROUGH the chain (atol 0: every page
+        # that moved stores — a real multi-entry reconstruction).
+        assert eng.pool.write_back_stream("delta", out_d, 16) is not None
+        assert eng.pool.write_back("whole", out_w, 16)
+        assert eng.pool.delta_chain_len("delta") >= 1
+        img3 = img2 + 0.05 * rng.normal(size=img1.shape).astype(np.float32)
+        assert np.array_equal(warm("delta", img3), warm("whole", img3))
+
+
+@pytest.mark.slow
+class TestDeltaBatcherEndToEnd:
+    def test_streaming_holds_and_perturbs(self):
+        """The full path: session frames through the DynamicBatcher in
+        delta mode — holds ride the incremental route at the min_iters
+        floor, perturbed frames exit early, identical first frames share
+        one base, and the summary nests price actual pages."""
+        scfg = dataclasses.replace(DSCFG, delta_page_atol=0.1)
+        eng = InferenceEngine(CFG, scfg, key=jax.random.PRNGKey(0))
+        eng.warmup()
+        rng = np.random.default_rng(0)
+        base = (100 * rng.normal(size=(3, 16, 16))).astype(np.float32)
+        streams = ("a", "b")  # two cameras, one scene
+        with DynamicBatcher(engine=eng) as b:
+            frames = {s: base for s in streams}
+            iters_by_frame = []
+            for f in range(4):
+                if f == 2:  # one perturbed frame per stream
+                    for s in streams:
+                        img = frames[s].copy()
+                        img[:, 0:4, 0:4] += (
+                            5.0 * rng.normal(size=(3, 4, 4))
+                        ).astype(np.float32)
+                        frames[s] = img
+                tickets = {
+                    s: b.submit(frames[s], session_id=s) for s in streams
+                }
+                iters_by_frame.append(
+                    {s: t.result(timeout=120.0)[1] for s, t in tickets.items()}
+                )
+            summary = b.summary_record()
+        # Frame 1 (hold) short-circuits to the floor on EVERY stream.
+        assert all(v == scfg.min_iters for v in iters_by_frame[1].values())
+        # The perturbed frame iterates, but below the cold width.
+        assert all(
+            scfg.min_iters <= v < iters_by_frame[0][s]
+            for s, v in iters_by_frame[2].items()
+        )
+        assert summary["n_incremental"] > 0
+        cd = summary["column_cache"]["delta"]
+        assert cd["n_base_shares"] == 1  # camera b aliased camera a's base
+        # HOLD frames skip their write-back entirely (an unchanged input
+        # adds nothing worth storing) — only the perturbed frame stores,
+        # one sparse delta per stream.
+        assert cd["n_delta_writes"] == 2
+        assert cd["n_delta_empty"] == 0
+        assert cd["delta_page_atol"] == 0.1
+        pp = summary["page_pools"]["engine0"]
+        assert pp["pages_used"] + pp["pages_free"] == pp["pages_total"]
+        # ACTUAL pricing: two streams share one base -> under 2 whole rows.
+        assert pp["bytes_in_use"] < 2 * 4 * pp["page_bytes"]
+
+
+def test_delta_requires_pool():
+    with pytest.raises(ValueError, match="page pool"):
+        ServeConfig(delta_streaming=True, page_pool_pages=0)
+
+
+def test_delta_excludes_ragged():
+    with pytest.raises(ValueError, match="bucket route"):
+        ServeConfig(
+            delta_streaming=True, page_pool_pages=8, ragged=True,
+        )
+
+
+def test_page_gather_validated():
+    with pytest.raises(ValueError, match="page_gather"):
+        ServeConfig(page_gather="sometimes")
