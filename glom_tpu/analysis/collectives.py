@@ -52,6 +52,21 @@ AXIS_ARG = {
 # the wire-moving subset that must be record_collective-registered in the
 # wire-accounted modules
 WIRE_MOVING = {"psum", "psum_scatter", "pmean", "all_gather", "all_to_all"}
+# Functions that count as registering the enclosing site with the measured
+# counters: record_collective (byte-only, the PR 2 form) or the shared
+# timing wrapper timed_collective (bytes + site registry + optional
+# io_callback brackets — the capacity observatory's sanctioned route).
+_REGISTERING = {"record_collective", "timed_collective"}
+# Host clocks and callback primitives that mark a HAND-ROLLED timing
+# harness when they share a function chain with a wire-moving collective:
+# the trace-purity checker already bans bare host clocks in traced code,
+# and the per-collective wall-time contract requires every timed site to
+# route through counters.timed_collective (one wrapper = one clock
+# discipline, one record shape, one lint surface).
+_TIMING_PRIMITIVES = {
+    "perf_counter", "monotonic", "perf_counter_ns", "monotonic_ns",
+    "io_callback", "pure_callback", "callback",
+}
 
 
 def _collective_of(call: ast.Call, aliases: dict) -> Optional[str]:
@@ -85,14 +100,35 @@ class CollectiveCoverage(Checker):
             module.relpath.endswith(suffix)
             for suffix in ctx.registration_modules
         )
-        # Pre-collect: per function node, does it call record_collective?
+        # Pre-collect: per function node, does it call a registering
+        # function (record_collective / timed_collective), a timing
+        # primitive, or the shared wrapper specifically? The wrapper takes
+        # the collective as a LAMBDA, so membership checks walk the whole
+        # enclosing-scope CHAIN (lambda -> function -> ...), not just the
+        # innermost scope.
         records_in: set = set()
+        timing_in: set = set()
+        wrapper_in: set = set()
         for node in ast.walk(module.tree):
             if isinstance(node, ast.Call):
                 name = call_name(node)
-                if name and name.split(".")[-1] == "record_collective":
+                leaf = name.split(".")[-1] if name else None
+                if leaf in _REGISTERING:
                     fn = enclosing_function(module.parents, node)
                     records_in.add(id(fn))
+                    if leaf == "timed_collective":
+                        wrapper_in.add(id(fn))
+                if leaf in _TIMING_PRIMITIVES:
+                    fn = enclosing_function(module.parents, node)
+                    timing_in.add(id(fn))
+
+        def scope_chain(node):
+            """Every enclosing function/lambda of `node`, innermost
+            first (module level terminates the chain)."""
+            fn = enclosing_function(module.parents, node)
+            while fn is not None:
+                yield fn
+                fn = enclosing_function(module.parents, fn)
 
         # Module-level string constants (for axis-arg resolution).
         consts = {}
@@ -116,28 +152,52 @@ class CollectiveCoverage(Checker):
             findings.extend(
                 self._check_axis(module, ctx, node, coll, consts, symbol)
             )
-            if (
-                registered_scope
-                and coll in WIRE_MOVING
-                and id(enclosing_function(module.parents, node))
-                not in records_in
-            ):
-                findings.append(
-                    Finding(
-                        checker=self.name,
-                        path=module.relpath,
-                        line=node.lineno,
-                        col=node.col_offset,
-                        message=(
-                            f"lax.{coll} site is not registered with "
-                            "telemetry.counters.record_collective — the "
-                            "measured wire bytes (and comm_model_drift) "
-                            "silently omit it"
-                        ),
-                        symbol=symbol,
-                        key=f"unregistered-{coll}",
+            if registered_scope and coll in WIRE_MOVING:
+                chain = list(scope_chain(node))
+                chain_ids = {id(fn) for fn in chain}
+                if not chain_ids & records_in:
+                    findings.append(
+                        Finding(
+                            checker=self.name,
+                            path=module.relpath,
+                            line=node.lineno,
+                            col=node.col_offset,
+                            message=(
+                                f"lax.{coll} site is not registered with "
+                                "telemetry.counters.record_collective — "
+                                "the measured wire bytes (and "
+                                "comm_model_drift) silently omit it"
+                            ),
+                            symbol=symbol,
+                            key=f"unregistered-{coll}",
+                        )
                     )
-                )
+                if chain_ids & timing_in and not chain_ids & wrapper_in:
+                    # A registered site that hand-rolls its own clock or
+                    # callback harness around the collective: the
+                    # per-collective wall-time contract requires the ONE
+                    # shared wrapper (counters.timed_collective), so
+                    # every timed site shares a clock discipline, record
+                    # shape, and purity audit — and the trace-purity
+                    # checker's host-clock ban stays meaningful.
+                    findings.append(
+                        Finding(
+                            checker=self.name,
+                            path=module.relpath,
+                            line=node.lineno,
+                            col=node.col_offset,
+                            message=(
+                                f"lax.{coll} site is timed with a "
+                                "hand-rolled clock/callback harness — "
+                                "route the timing through "
+                                "counters.timed_collective (the shared "
+                                "timing wrapper; docs/OBSERVABILITY.md, "
+                                "Capacity observatory)"
+                            ),
+                            symbol=symbol,
+                            key=f"hand-rolled-timing-{coll}",
+                        )
+                    )
         return findings
 
     # -- axis resolution ----------------------------------------------------

@@ -196,11 +196,16 @@ def _forward_local(
             # runtime.py) — if a future route records a TP config, the
             # model needs a TP term FIRST or comm_model_drift becomes a
             # permanent false alarm. The per-execution pricing contract
-            # is pinned by test_telemetry's TP counting test.
-            tele_counters.record_collective(
-                "reduce", tele_counters.ring_allreduce_bytes(out, mp)
+            # is pinned by test_telemetry's TP counting test. Routed
+            # through the shared timing wrapper (counters.timed_collective
+            # — the capacity observatory's per-collective wall-time seam):
+            # byte recording is unchanged, and a timing-enabled trace
+            # additionally registers the site for the sampled re-dispatch.
+            return tele_counters.timed_collective(
+                "tp_ffw_psum", MODEL_AXIS, "reduce",
+                tele_counters.ring_allreduce_bytes(out, mp),
+                lambda o: lax.psum(o, MODEL_AXIS), out, collective="psum",
             )
-            return lax.psum(out, MODEL_AXIS)
     if consensus_shard is None and not use_pallas:
         raise ValueError(
             "seq=1 without use_pallas has no per-shard consensus body; pass "
@@ -715,10 +720,11 @@ def make_manual_zero_train_step(
             return grads
 
         def leaf(g):
-            tele_counters.record_collective(
-                "reduce", tele_counters.ring_allreduce_bytes(g, seq)
+            return tele_counters.timed_collective(
+                "zero_seq_psum", SEQ_AXIS, "reduce",
+                tele_counters.ring_allreduce_bytes(g, seq),
+                lambda x: lax.psum(x, SEQ_AXIS), g, collective="psum",
             )
-            return lax.psum(g, SEQ_AXIS)
 
         return jax.tree_util.tree_map(leaf, grads)
 
@@ -733,20 +739,20 @@ def make_manual_zero_train_step(
             # allreduce — a schedule detail comm_volume_model does NOT
             # price (it treats all of G as scattered), so the measured
             # counter is what keeps the drift honest.
-            tele_counters.record_collective(
-                "reduce",
+            return tele_counters.timed_collective(
+                "zero_pmean_fallback", DATA_AXIS, "reduce",
                 tele_counters.ring_reduce_scatter_bytes(
                     g, dp, quantized=quantized
                 ) * 2,
+                lambda x: lax.pmean(x, DATA_AXIS), g, collective="pmean",
             )
-            return lax.pmean(g, DATA_AXIS)
-        tele_counters.record_collective(
-            "reduce",
+        return tele_counters.timed_collective(
+            "zero_psum_scatter", DATA_AXIS, "reduce",
             tele_counters.ring_reduce_scatter_bytes(g, dp, quantized=quantized),
-        )
-        return (
-            lax.psum_scatter(g, DATA_AXIS, scatter_dimension=ax, tiled=True)
-            / dp
+            lambda x: lax.psum_scatter(
+                x, DATA_AXIS, scatter_dimension=ax, tiled=True
+            ) / dp,
+            g, collective="psum_scatter", dim=ax,
         )
 
     def reduce_full(grads):
@@ -792,10 +798,12 @@ def make_manual_zero_train_step(
     def gather_shard(p_shard, ax):
         if ax < 0:
             return p_shard
-        tele_counters.record_collective(
-            "gather", tele_counters.ring_all_gather_bytes(p_shard, dp)
+        return tele_counters.timed_collective(
+            "zero_all_gather", DATA_AXIS, "gather",
+            tele_counters.ring_all_gather_bytes(p_shard, dp),
+            lambda x: lax.all_gather(x, DATA_AXIS, axis=ax, tiled=True),
+            p_shard, collective="all_gather", dim=ax,
         )
-        return lax.all_gather(p_shard, DATA_AXIS, axis=ax, tiled=True)
 
     def sharded_grad_norm(g_shards):
         # sum-of-squares decomposes over the ownership partition: psum the

@@ -15,6 +15,18 @@ gated behind TrainConfig.quantized_reduce, never on by default, and the
 flag is stamped into every metrics record so no run can silently train on
 quantized gradients.
 
+Wire accounting AND wall-time: this module carries no collectives of its
+own — the quantized payload rides parallel/manual.py's registered
+psum_scatter sites, which price the wire at `quantized_wire_bytes` (the
+int8 + scales payload the real collective would carry) through
+counters.timed_collective. The capacity observatory's per-collective
+wall-time therefore times the quantized schedule at its REAL f32 payload
+today (the emulation dequantizes before the collective); when the
+compiler hook lands the ~4x wire cut (ROADMAP item 3), the measured
+wall_ms vs the α-β model's byte-derived prediction is exactly the drift
+signal that will prove the cut is real on the clock, not just in the
+byte counters.
+
 Error bound (locked by tests/test_zero.py): symmetric per-block max-abs
 scaling with round-to-nearest gives |x - dq(q(x))| <= max|block| / (2*127)
 per element — zero blocks are exact (scale guard), and the bound is tight

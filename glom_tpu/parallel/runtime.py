@@ -512,10 +512,38 @@ class DistributedTrainer:
         wire_bytes = tree_bytes_per_replica(
             abstract_state.params, pspecs, axis_sizes
         )
+        # Per-collective wall-time mode (docs/OBSERVABILITY.md, "Capacity
+        # observatory"): resolved ONCE like telemetry_level and stamped.
+        # Only the manual zero>=1 route has registered sites; everywhere
+        # else the mode resolves to "off" (stamped — a record must never
+        # claim a timing harness that didn't run). "full" degrades to
+        # "sampled" loudly: the jit-on-first-call trainer has no AOT seam
+        # for the io_callback brackets (the serve engine's has).
+        from glom_tpu.telemetry.counters import resolve_collective_timing
+
+        timing_sites_reachable = self.use_manual and self.zero_stage >= 1
+        if timing_sites_reachable:
+            self.collective_timing = resolve_collective_timing(
+                tcfg.collective_timing,
+                supports_full=False,
+                path="the manual trainer",
+            )
+        else:
+            resolve_collective_timing(tcfg.collective_timing)  # validate
+            if tcfg.collective_timing != "off":
+                warnings.warn(
+                    "collective_timing has no registered sites on this "
+                    "route (GSPMD, or manual zero_stage 0) — resolving "
+                    "'off'; the stamped mode is the resolved one",
+                    stacklevel=2,
+                )
+            self.collective_timing = "off"
+        self.collective_sampler = None
         self._static_record = {
             "zero_stage": self.zero_stage,
             "quantized_reduce": self.quantized_reduce,
             "telemetry_level": self.telemetry_level,
+            "collective_timing": self.collective_timing,
             **mem,
             **comm_volume_model(
                 wire_bytes,
@@ -537,10 +565,8 @@ class DistributedTrainer:
         # extra trace is not free) and on the path that HAS explicit
         # sites; GSPMD steps carry the model only.
         if (
-            self.telemetry_level != "off"
-            and self.use_manual
-            and self.zero_stage >= 1
-        ):
+            self.telemetry_level != "off" or self.collective_timing != "off"
+        ) and timing_sites_reachable:
             from glom_tpu.telemetry.counters import (
                 CollectiveCounters,
                 comm_drift,
@@ -562,6 +588,23 @@ class DistributedTrainer:
             self._static_record.update(
                 comm_drift(measured, self._static_record)
             )
+            if self.collective_timing != "off":
+                # The sampled-mode harness (telemetry/comm_time.py): the
+                # counting trace just populated the site registry (site,
+                # axis, shard-local shape, scatter/gather dim) — every
+                # collective_timing_interval-th fit-loop logging boundary
+                # re-dispatches each site as its own timed sub-graph and
+                # stamps "collective_time" records with the α-β
+                # comm_time_model drift (fit() wires the probe).
+                from glom_tpu.telemetry.comm_time import (
+                    CollectiveTimeSampler,
+                )
+
+                self.collective_sampler = CollectiveTimeSampler(
+                    self.mesh,
+                    counters.sites,
+                    interval=tcfg.collective_timing_interval,
+                )
 
         from glom_tpu.tracing.memory import model_live_bytes_total
 
@@ -608,6 +651,22 @@ class DistributedTrainer:
             self._model_live_bytes, device=self.mesh.devices.flat[0]
         )
 
+    def collective_time_records(self, *, force: bool = False) -> list:
+        """Stamped "collective_time" rows from the sampled timing harness
+        (empty off-mode, and between sampling intervals unless `force`).
+        fit() drains this at every logging boundary; direct step() drivers
+        (benches) call it themselves."""
+        if self.collective_sampler is None:
+            return []
+        path = f"train-zero{self.zero_stage}"
+        if force:
+            from glom_tpu.telemetry.comm_time import collective_time_records
+
+            return collective_time_records(
+                self.collective_sampler.sample(), path=path, mode="sampled"
+            )
+        return self.collective_sampler.maybe_sample(path=path)
+
     def fit(
         self,
         data: Iterator,
@@ -641,4 +700,8 @@ class DistributedTrainer:
             compile_tracker=self._compile_tracker,
             trace_capture=trace_capture,
             memory_probe=self._memory_record,
+            aux_records_probe=(
+                self.collective_time_records
+                if self.collective_sampler is not None else None
+            ),
         )

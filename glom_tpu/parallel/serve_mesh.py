@@ -97,14 +97,18 @@ def serve_shardings(mesh, params, *, warm: bool = False, paged: bool = False):
     return in_sh, out_sh
 
 
-def _psum_wire(x, axis_name: str, k: int):
+def _psum_wire(x, axis_name: str, k: int, site: str = "serve_psum"):
     """A registered allreduce: the one wrapper every wire-moving psum in
     this module goes through, so the measured counters (and glom-lint's
-    coverage rule) see each site."""
-    tele_counters.record_collective(
-        "reduce", tele_counters.ring_allreduce_bytes(x, k)
+    coverage rule) see each site. `site` names the call site for the
+    per-collective wall-time harness (counters.timed_collective — the
+    capacity observatory's timing seam; distinct witness/quorum sites
+    stamp distinct collective_time rows)."""
+    return tele_counters.timed_collective(
+        site, axis_name, "reduce",
+        tele_counters.ring_allreduce_bytes(x, k),
+        lambda v: lax.psum(v, axis_name), x, collective="psum",
     )
-    return lax.psum(x, axis_name)
 
 
 def _gather_pages_wire(pool_loc, k: int):
@@ -114,10 +118,12 @@ def _gather_pages_wire(pool_loc, k: int):
     registered all_gather before the page-index take. Wire is priced at
     the whole pool shard ((k-1) x local bytes — the provisioning bound;
     ServeConfig.page_gather picks this or the needed-pages exchange)."""
-    tele_counters.record_collective(
-        "gather", tele_counters.ring_all_gather_bytes(pool_loc, k)
+    return tele_counters.timed_collective(
+        "page_pool_all_gather", DATA_AXIS, "gather",
+        tele_counters.ring_all_gather_bytes(pool_loc, k),
+        lambda p: lax.all_gather(p, DATA_AXIS, axis=0, tiled=True),
+        pool_loc, collective="all_gather", dim=0,
     )
-    return lax.all_gather(pool_loc, DATA_AXIS, axis=0, tiled=True)
 
 
 def _scatter_needed_pages_wire(pool_loc, page_idx, k: int, b_loc: int):
@@ -153,12 +159,13 @@ def _scatter_needed_pages_wire(pool_loc, page_idx, k: int, b_loc: int):
         pool_bits[local],
         jnp.zeros((), int_t),
     )  # [k, b_loc*ppr, pt, L, d] as integers
-    tele_counters.record_collective(
-        "reduce_scatter",
+    got = tele_counters.timed_collective(
+        "page_needed_psum_scatter", DATA_AXIS, "reduce_scatter",
         tele_counters.ring_reduce_scatter_bytes(contrib, k),
-    )
-    got = lax.psum_scatter(
-        contrib, DATA_AXIS, scatter_dimension=0, tiled=True
+        lambda c: lax.psum_scatter(
+            c, DATA_AXIS, scatter_dimension=0, tiled=True
+        ),
+        contrib, collective="psum_scatter", dim=0,
     )
     pages = jax.lax.bitcast_convert_type(
         got.reshape(b_loc, ppr, *pool_loc.shape[1:]), pool_loc.dtype
@@ -176,10 +183,10 @@ def _sharded_row_agreement(levels, n: int, seq: int) -> jnp.ndarray:
     eps = 1e-8
     xhat = x / (jnp.linalg.norm(x, axis=-1, keepdims=True) + eps)
     part = jnp.sum(xhat, axis=1, keepdims=True)  # [b_loc, 1, L, d]
-    mean = _psum_wire(part, SEQ_AXIS, seq) / n
+    mean = _psum_wire(part, SEQ_AXIS, seq, site="witness_mean_psum") / n
     mhat = mean / (jnp.linalg.norm(mean, axis=-1, keepdims=True) + eps)
     cos = jnp.sum(jnp.sum(xhat * mhat, axis=-1), axis=1)  # [b_loc, L]
-    return _psum_wire(cos, SEQ_AXIS, seq) / n
+    return _psum_wire(cos, SEQ_AXIS, seq, site="witness_cos_psum") / n
 
 
 def make_serve_forward(
@@ -333,7 +340,8 @@ def make_serve_forward(
         # The quorum target over ALL valid rows: one registered int hop
         # over 'data' outside the loop.
         n_valid = _psum_wire(
-            jnp.sum(valid.astype(jnp.float32)), DATA_AXIS, dp
+            jnp.sum(valid.astype(jnp.float32)), DATA_AXIS, dp,
+            site="quorum_valid_psum",
         )
         need = quorum_need(quorum, n_valid)
 
@@ -342,7 +350,9 @@ def make_serve_forward(
             n_conv_loc = jnp.sum(
                 jnp.logical_and(conv, valid).astype(jnp.int32)
             )
-            n_conv = _psum_wire(n_conv_loc, DATA_AXIS, dp)
+            n_conv = _psum_wire(
+                n_conv_loc, DATA_AXIS, dp, site="quorum_exit_psum"
+            )
             return jnp.logical_and(i < T, n_conv < need)
 
         def body(carry):

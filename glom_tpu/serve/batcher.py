@@ -208,7 +208,7 @@ class _Item:
     __slots__ = (
         "img", "ticket", "session", "levels", "executed", "hops",
         "redispatches", "warm_src", "parent_span", "dispatch_ms",
-        "n_patches", "pages", "patches",
+        "n_patches", "pages", "patches", "t_enq", "phase_ms",
     )
 
     def __init__(
@@ -230,6 +230,15 @@ class _Item:
         self.n_patches = n_patches  # ragged: this row's patch count
         self.pages = None           # pages-warm: the pinned PageHit
         self.patches = None         # delta mode: host-patchified input
+        # When this item last ENTERED a queue (batcher clock): the
+        # dispatch phase split's queue_wait anchor — reset on every
+        # re-enqueue (continuation, failover requeue), so each hop's
+        # queue_wait measures ITS OWN wait, not the request's lifetime.
+        self.t_enq = 0.0
+        # Per-phase accumulation across hops (the rounded per-hop values,
+        # in hop order) — the resolve leaf's phase_ms_total, conserved
+        # bit-exactly by `telemetry trace` (tracectx.PHASE_KEYS).
+        self.phase_ms: dict = {}
 
 
 def _backend_down() -> bool:
@@ -281,6 +290,7 @@ class DynamicBatcher:
         rejoin_threshold: Optional[int] = None,
         rejoin_interval_ms: Optional[float] = None,
         trace: Optional[bool] = None,
+        phase_split: Optional[bool] = None,
         clock=time.perf_counter,
     ):
         if (engine is None) == (engines is None):
@@ -323,6 +333,19 @@ class DynamicBatcher:
         self._trace = (
             trace if trace is not None
             else bool(getattr(scfg, "trace_requests", True)) if scfg else True
+        )
+        # Latency decomposition (schema v7, docs/OBSERVABILITY.md
+        # "Capacity observatory"): every dispatch record splits
+        # latency_ms into queue_wait/pack/h2d/device/resolve, summing to
+        # it BIT-EXACTLY (latency_ms is DEFINED as the left-to-right
+        # float sum of the rounded phase values — tracectx.PHASE_KEYS
+        # order), and the per-request resolve leaf accumulates the same
+        # values per phase. None resolves from the lead engine's
+        # ServeConfig (phase_split, default ON); off stamps the keys as
+        # null and latency_ms reverts to the bare engine dispatch wall.
+        self._phase_split = (
+            phase_split if phase_split is not None
+            else bool(getattr(scfg, "phase_split", True)) if scfg else True
         )
         # Page pools (serve/paged_columns.py): engines carrying a device
         # page pool switch the session cache to PAGES mode — entries are
@@ -481,6 +504,10 @@ class DynamicBatcher:
         self._pad_fraction_sum = 0.0
         self._pad_bytes_wasted = 0
         self._levels0_h2d_bytes = 0
+        # Per-phase latency sums across dispatches (the summary's
+        # latency_phases rollup — mean ms per phase per dispatch, what
+        # `telemetry compare` gates as serve_latency.* costs).
+        self._phase_sums: dict = {}
         # The most recent request's [c, H, W] shape — what the probation
         # health probe dispatches (engine-agnostic: the batcher never
         # assumes a model config). Guarded by _counter_lock: submit()
@@ -703,6 +730,7 @@ class DynamicBatcher:
                 self.n_submitted += 1
                 self._probe_shape = img.shape
             item = _Item(img, ticket, session_id, n_patches=n_patches)
+            item.t_enq = self._clock()
             placed = False
             if target is not None:
                 try:
@@ -1129,6 +1157,14 @@ class DynamicBatcher:
                 self._levels0_h2d_bytes += (
                     rec.get("levels0_h2d_bytes") or 0
                 )
+                from glom_tpu.telemetry.tracectx import PHASE_KEYS
+
+                for k in PHASE_KEYS:
+                    v = rec.get(k)
+                    if isinstance(v, (int, float)):
+                        self._phase_sums[k] = (
+                            self._phase_sums.get(k, 0.0) + v
+                        )
                 self.dispatches.append(rec)
                 for r in resolved:
                     key = str(r["iters"])
@@ -1172,6 +1208,7 @@ class DynamicBatcher:
         warm_survivors: List[_Item] = []
         for item in items:
             item.redispatches += 1
+            item.t_enq = self._clock()  # next hop's queue_wait starts now
             if item.redispatches > self.max_redispatch:
                 with self._counter_lock:
                     self.n_failed += 1
@@ -1302,6 +1339,53 @@ class DynamicBatcher:
                 engine, engine_name, batch, None, {"trace_ids": None}
             )
 
+    def _phase_fields(self, queue_wait_s, pack_s, result, fetch_s):
+        """(phase dict, latency_ms) for one dispatch record — THE
+        latency_ms definition under phase_split: the five phases (rounded
+        to 3 decimals each) summed left to right in tracectx.PHASE_KEYS
+        order, so `telemetry trace`'s extended conservation check can
+        recompute the exact float sum. queue_wait/pack are batcher wall;
+        h2d and the engine-side resolve come from the engine's own split;
+        device is the engine dispatch wall MINUS both (absorbing what the
+        split cannot see — validation, retry backoff — so the phases
+        always partition the whole); the batcher's host fetch of the
+        result rides resolve. Split off: keys stamp null (presence, like
+        the trace-context contract) and latency_ms is the bare engine
+        wall — the pre-v7 reading."""
+        from glom_tpu.telemetry.tracectx import PHASE_KEYS
+
+        if not self._phase_split:
+            return (
+                {k: None for k in PHASE_KEYS},
+                round(1e3 * result.latency_s, 3),
+            )
+        eng_ms = 1e3 * result.latency_s
+        eph = getattr(result, "phases", None) or {}
+        h2d = float(eph.get("h2d_ms") or 0.0)
+        eng_resolve = float(eph.get("resolve_ms") or 0.0)
+        device = max(0.0, eng_ms - h2d - eng_resolve)
+        phases = {
+            "queue_wait_ms": round(max(0.0, 1e3 * queue_wait_s), 3),
+            "pack_ms": round(max(0.0, 1e3 * pack_s), 3),
+            "h2d_ms": round(h2d, 3),
+            "device_ms": round(device, 3),
+            "resolve_ms": round(eng_resolve + max(0.0, 1e3 * fetch_s), 3),
+        }
+        latency_ms = 0.0
+        for k in PHASE_KEYS:
+            latency_ms = latency_ms + phases[k]
+        return phases, latency_ms
+
+    def _note_item_phases(self, item, phases) -> None:
+        """Accumulate one hop's rounded phase values onto the item — the
+        resolve leaf's phase_ms_total, added in hop order so the
+        conservation sum is bit-exact."""
+        if not self._phase_split:
+            return
+        for k, v in phases.items():
+            if isinstance(v, (int, float)):
+                item.phase_ms[k] = item.phase_ms.get(k, 0.0) + v
+
     def _dispatch_one(
         self, engine, engine_name: str, batch, dspan, tfields
     ) -> None:
@@ -1393,6 +1477,14 @@ class DynamicBatcher:
     def _dispatch_batch(
         self, engine, engine_name: str, batch, dspan, tfields
     ) -> None:
+        # Phase anchors: queue_wait ends (and pack begins) the moment the
+        # worker starts processing this batch; the oldest item's enqueue
+        # time anchors the wait (the same "oldest request" convention the
+        # max_delay admission knob uses).
+        t_proc = self._clock()
+        queue_wait_s = t_proc - min(
+            (it.t_enq for it in batch if it.t_enq), default=t_proc
+        )
         n = len(batch)
         iters_override = None
         rung_name = None
@@ -1576,13 +1668,16 @@ class DynamicBatcher:
                         bool(it.pages is not None and not srow[i].any())
                         for i, it in enumerate(batch)
                     ]
+            pack_s = self._clock() - t_proc
             with span("serve_dispatch", aggregator=self.spans):
                 result = engine.infer(imgs, n_valid=n, **kw)
             for sid in pinned:
                 self.cache.unpin(sid)
             pinned = []
+            t_fetch = self._clock()
             with span("serve_fetch", aggregator=self.spans):
                 levels = np.asarray(result.levels[:n])
+            fetch_s = self._clock() - t_fetch
         except BaseException as e:  # noqa: BLE001 — relayed per ticket
             for sid in pinned:
                 self.cache.unpin(sid)
@@ -1605,15 +1700,20 @@ class DynamicBatcher:
         n_resolved = 0
         entry_tier = max((it.hops for it in batch), default=0)
         # This hop's wall span, as the dispatch record will carry it: the
-        # items accumulate EXACTLY these rounded values, in hop order, so
-        # the resolve leaf's dispatch_ms_total equals the sum of its
-        # trace's per-hop latency_ms fields bit-for-bit (the conservation
-        # check in telemetry/tracectx.py is exact, not approximate).
-        latency_ms = round(1e3 * result.latency_s, 3)
+        # items accumulate EXACTLY these values (latency_ms is the
+        # left-to-right float sum of the rounded phase fields under
+        # phase_split — see _phase_fields), in hop order, so the resolve
+        # leaf's dispatch_ms_total AND per-phase phase_ms_total equal the
+        # sums of its trace's per-hop fields bit-for-bit (the
+        # conservation check in telemetry/tracectx.py is exact).
+        phases, latency_ms = self._phase_fields(
+            queue_wait_s, pack_s, result, fetch_s
+        )
         to_resolve: List[tuple] = []  # (item, row index, total iters)
         for i, it in enumerate(batch):
             executed_i = it.executed + result.iters_run
             it.dispatch_ms += latency_ms
+            self._note_item_phases(it, phases)
             if dspan is not None:
                 it.parent_span = dspan  # the next record parents HERE
             open_hop = (
@@ -1628,6 +1728,7 @@ class DynamicBatcher:
                 it.executed = executed_i
                 it.hops += 1
                 it.warm_src = "cont"
+                it.t_enq = self._clock()  # cont-queue wait starts now
                 stragglers.append(it)
             else:
                 # Write-back BEFORE resolve: the moment the caller sees
@@ -1712,6 +1813,7 @@ class DynamicBatcher:
             "tier": entry_tier,
             "pad_fraction": round(1.0 - n / result.bucket, 4),
             "latency_ms": latency_ms,
+            **phases,
             "iters_run": result.iters_run,
             "n_stragglers": len(stragglers),
             "n_cache_warm": n_cache_warm,
@@ -1768,6 +1870,13 @@ class DynamicBatcher:
                         "engine": engine_name,
                         "iters_total": executed_i,
                         "dispatch_ms_total": it.dispatch_ms,
+                        # Per-phase accumulation across this request's
+                        # hops (tracectx conservation reads it); null
+                        # when phase_split is off, like the hop fields.
+                        "phase_ms_total": (
+                            dict(it.phase_ms) if self._phase_split
+                            else None
+                        ),
                         "hops": it.hops,
                         "redispatches": it.redispatches,
                         "latency_ms": round(1e3 * it.ticket._latency_s, 3),
@@ -1794,6 +1903,10 @@ class DynamicBatcher:
             resolve_page_tokens,
         )
 
+        t_proc = self._clock()
+        queue_wait_s = t_proc - min(
+            (it.t_enq for it in batch if it.t_enq), default=t_proc
+        )
         n = len(batch)
         iters_override = None
         rung_name = None
@@ -1865,6 +1978,7 @@ class DynamicBatcher:
             kw = {}
             if iters_override is not None:
                 kw["iters_override"] = iters_override
+            pack_s = self._clock() - t_proc
             with span("serve_dispatch", aggregator=self.spans):
                 result = engine.infer_ragged(
                     flat, counts, page_idx=pidx, **kw
@@ -1872,8 +1986,10 @@ class DynamicBatcher:
             for sid in pinned:
                 self.cache.unpin(sid)
             pinned = []
+            t_fetch = self._clock()
             with span("serve_fetch", aggregator=self.spans):
                 levels_flat = np.asarray(result.levels)
+            fetch_s = self._clock() - t_fetch
         except BaseException as e:  # noqa: BLE001 — relayed per ticket
             for sid in pinned:
                 self.cache.unpin(sid)
@@ -1882,11 +1998,14 @@ class DynamicBatcher:
             )
             return
 
-        latency_ms = round(1e3 * result.latency_s, 3)
+        phases, latency_ms = self._phase_fields(
+            queue_wait_s, pack_s, result, fetch_s
+        )
         resolved: List[dict] = []
         to_resolve: List[tuple] = []
         for i, it in enumerate(batch):
             it.dispatch_ms += latency_ms
+            self._note_item_phases(it, phases)
             if dspan is not None:
                 it.parent_span = dspan
             # Write-back BEFORE resolve, device-to-device: the row's
@@ -1921,6 +2040,7 @@ class DynamicBatcher:
             "pad_fraction": round(pad_tokens / T, 4),
             "pad_tokens": pad_tokens,
             "latency_ms": latency_ms,
+            **phases,
             "iters_run": result.iters_run,
             "n_stragglers": 0,
             "n_cache_warm": n_cache_warm,
@@ -1954,6 +2074,10 @@ class DynamicBatcher:
                         "engine": engine_name,
                         "iters_total": iters,
                         "dispatch_ms_total": it.dispatch_ms,
+                        "phase_ms_total": (
+                            dict(it.phase_ms) if self._phase_split
+                            else None
+                        ),
                         "hops": 0,
                         "redispatches": it.redispatches,
                         "latency_ms": round(1e3 * it.ticket._latency_s, 3),
@@ -1976,6 +2100,106 @@ class DynamicBatcher:
         """Drain the serve-phase span rollups (one "span" record per phase
         seen since the last drain)."""
         return self.spans.records(extra=extra or None)
+
+    def capacity_records(self) -> list:
+        """One stamped "capacity" record per engine (schema v7,
+        docs/OBSERVABILITY.md "Capacity observatory"): the signal the
+        elastic-serving control loop (ROADMAP item 1) reads.
+
+          * service_rate_rps — sustainable requests/s estimated from the
+            engine's own dispatch evidence (valid rows served per second
+            of dispatch wall — the per-bucket latency histograms'
+            aggregate; None before the first dispatch);
+          * queue/continuation/affinity/pool fills — LIVE occupancy of
+            every lane a request can wait in, each normalized to [0, 1];
+          * utilization — the WORST lane (capacity is gone when any lane
+            saturates: a full pool blocks warm streams even with an
+            empty queue);
+          * headroom — 1 - utilization, clamped to [0, 1]; 0.0 for a
+            dead engine (no capacity, whatever its queues say).
+
+        `telemetry watch --slo headroom=X` breaches when headroom drops
+        BELOW X — the one lower-bound rule."""
+        with self._engine_lock:  # LOCK ORDER: _engine_lock -> _counter_lock
+            engines = {
+                name: dict(st) for name, st in self._engine_state.items()
+            }
+            with self._counter_lock:
+                dispatches = list(self.dispatches)
+        qcap = max(1, self._q.maxsize)
+        queue_fill = round(min(1.0, self._q.qsize() / qcap), 4)
+        # The continuation lane holds GROUPS (lists of warm items): its
+        # occupancy is the ITEM count — 8 queued bucket-8 groups are a
+        # saturated lane, not 8/64 of one (stdlib Queue's mutex guards
+        # the snapshot; the lane is unbounded, so the admission queue's
+        # capacity is the normalizer).
+        with self._cont_q.mutex:
+            cont_items = sum(len(g) for g in self._cont_q.queue)
+        cont_fill = round(min(1.0, cont_items / qcap), 4)
+        out = []
+        for i, eng in enumerate(self.engines):
+            name = self._ename(eng, i)
+            st = engines.get(name, {})
+            own = [d for d in dispatches if d.get("engine") == name]
+            # The service-rate denominator is ENGINE-BUSY time (h2d +
+            # device + resolve), not latency_ms — which under
+            # phase_split includes queue_wait, so at saturation (the
+            # exact regime the autoscaler reads this) it would collapse
+            # the estimate several-fold below what the engine sustains.
+            # Dispatches without a phase split fall back to latency_ms
+            # (there it IS the bare engine wall).
+            busy_s = 0.0
+            for d in own:
+                parts = [
+                    d.get(k) for k in ("h2d_ms", "device_ms", "resolve_ms")
+                ]
+                if all(isinstance(v, (int, float)) for v in parts):
+                    busy_s += sum(parts) / 1e3
+                elif isinstance(d.get("latency_ms"), (int, float)):
+                    busy_s += d["latency_ms"] / 1e3
+            served = sum(d.get("n_valid") or 0 for d in own)
+            service_rate = (
+                round(served / busy_s, 3) if busy_s > 0 else None
+            )
+            aq = self._aff_q.get(name)
+            aff_fill = (
+                round(min(1.0, aq.qsize() / max(1, aq.maxsize)), 4)
+                if aq is not None else 0.0
+            )
+            pool = self._pools.get(name)
+            pool_fill = None
+            if pool is not None:
+                pr = pool.record()
+                total = pr.get("pages_total") or 0
+                if total:
+                    pool_fill = round(pr.get("pages_used", 0) / total, 4)
+            alive = bool(st.get("alive", True))
+            lanes = [queue_fill, cont_fill, aff_fill]
+            if pool_fill is not None:
+                lanes.append(pool_fill)
+            utilization = round(max(lanes), 4)
+            headroom = (
+                0.0 if not alive
+                else round(max(0.0, 1.0 - utilization), 4)
+            )
+            out.append(
+                schema.stamp(
+                    {
+                        "engine": name,
+                        "alive": alive,
+                        "headroom": headroom,
+                        "utilization": utilization,
+                        "service_rate_rps": service_rate,
+                        "queue_fill": queue_fill,
+                        "continuation_fill": cont_fill,
+                        "affinity_fill": aff_fill,
+                        "pool_fill": pool_fill,
+                        "n_dispatches": len(own),
+                    },
+                    kind="capacity",
+                )
+            )
+        return out
 
     def summary_record(self) -> dict:
         """The end-of-run "serve" summary event. The iteration histogram
@@ -2013,6 +2237,7 @@ class DynamicBatcher:
                 pad_fraction_sum = self._pad_fraction_sum
                 pad_bytes_wasted = self._pad_bytes_wasted
                 levels0_h2d_bytes = self._levels0_h2d_bytes
+                phase_sums = dict(self._phase_sums)
         rec = {
             "event": "summary",
             "n_requests": n_requests,
@@ -2053,6 +2278,33 @@ class DynamicBatcher:
             ) if n_served else None,
             "engines": engines,
         }
+        if dispatches and phase_sums:
+            # The latency decomposition rollup: MEAN ms per phase per
+            # dispatch (the same five fields every dispatch record splits
+            # latency_ms into, so p99 investigations start from the
+            # summary and drill into `telemetry trace`). Compare flattens
+            # these as serve_latency.* cost rows.
+            from glom_tpu.telemetry.tracectx import PHASE_KEYS
+
+            rec["latency_phases"] = {
+                k: round(phase_sums.get(k, 0.0) / len(dispatches), 3)
+                for k in PHASE_KEYS
+            }
+        # The capacity/headroom rollup, emitted as standalone "capacity"
+        # records on EVERY summary (the watch --slo headroom tail reads
+        # the stream) and nested here for the compare gate.
+        cap = self.capacity_records()
+        if cap:
+            rec["capacity"] = {
+                c["engine"]: {
+                    "headroom": c["headroom"],
+                    "utilization": c["utilization"],
+                    "service_rate_rps": c["service_rate_rps"],
+                }
+                for c in cap
+            }
+            for c in cap:
+                self._emit(c, kind="capacity")
         if self.cache is not None:
             # The streaming column cache's rollup (hits/misses/evictions/
             # bytes vs budget) — the temporal bench and its CI gate read

@@ -403,6 +403,11 @@ def main(argv=None) -> int:
         for engine in engines:
             for rec in engine.stats_records():
                 writer.write(serve_rec(rec))
+            for rec in engine.collective_time_records():
+                # Already stamped kind "collective_time" (sharded route
+                # with timing on; empty otherwise) — the micro-server's
+                # stream carries the wall-time evidence like any log.
+                writer.write(rec)
         return 0 if failed == 0 and served > 0 else 1
     finally:
         writer.close()

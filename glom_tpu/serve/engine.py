@@ -36,6 +36,7 @@ schema "serve" events.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Dict, NamedTuple, Optional, Tuple
 
@@ -60,7 +61,12 @@ class ServeResult(NamedTuple):
     converged — there are no stragglers without a witness).
     `levels0_h2d_bytes` is what the dispatch UPLOADED of warm column
     state (host levels0 x attempts; 0 on the cold and PAGED routes — the
-    zero the ragged bench gate asserts)."""
+    zero the ragged bench gate asserts). `phases` is the engine-side
+    latency decomposition when ServeConfig.phase_split is on
+    ({"h2d_ms", "resolve_ms"} raw floats, summed across retry attempts;
+    the batcher derives device_ms as the engine wall minus both and adds
+    its own queue_wait/pack phases — docs/OBSERVABILITY.md, "Capacity
+    observatory")."""
 
     levels: jax.Array
     iters_run: int
@@ -70,6 +76,7 @@ class ServeResult(NamedTuple):
     row_converged: Optional[np.ndarray] = None
     row_iters: Optional[np.ndarray] = None
     levels0_h2d_bytes: int = 0
+    phases: Optional[dict] = None
 
 
 class RaggedServeResult(NamedTuple):
@@ -86,6 +93,7 @@ class RaggedServeResult(NamedTuple):
     row_converged: np.ndarray
     row_iters: np.ndarray
     levels0_h2d_bytes: int = 0
+    phases: Optional[dict] = None
 
 
 def _resolve_donate(donate: Optional[bool]) -> bool:
@@ -148,6 +156,54 @@ class InferenceEngine:
         self._stats: Dict[Tuple, StepTimeStats] = {}
         self._comm: Dict[Tuple, dict] = {}  # sharded route: counted wire bytes
         self._shardings: Dict = {}  # warm mode -> (in_sh, out_sh)
+        # Per-collective wall-time (docs/OBSERVABILITY.md, "Capacity
+        # observatory"): resolved like telemetry_level. Only the sharded
+        # route has collectives — a single-device engine resolves any
+        # configured mode to "off", loudly, so no record can claim a
+        # timing harness with no sites to time. "full" brackets every
+        # execution of every witness/gather site with io_callbacks
+        # inserted at the AOT trace; "sampled" re-dispatches each site as
+        # its own timed sub-graph every collective_timing_interval-th
+        # dispatch (telemetry/comm_time.py).
+        from glom_tpu.telemetry.counters import (
+            CollectiveTimeLog,
+            resolve_collective_timing,
+        )
+
+        if mesh is not None:
+            self.collective_timing = resolve_collective_timing(
+                scfg.collective_timing, supports_full=True
+            )
+        else:
+            resolve_collective_timing(scfg.collective_timing)  # validate
+            if scfg.collective_timing != "off":
+                import warnings
+
+                warnings.warn(
+                    "collective_timing has no sites on a single-device "
+                    "engine (no collectives) — resolving 'off'",
+                    stacklevel=2,
+                )
+            self.collective_timing = "off"
+        self._coll_log = (
+            CollectiveTimeLog() if self.collective_timing == "full" else None
+        )
+        self._coll_sites: Dict[Tuple, dict] = {}  # (site, shape) -> site
+        self._coll_sampler = None
+        self._coll_samples: list = []  # sampled-mode stamped records
+        self._coll_dispatches = 0
+        self._coll_lock = threading.Lock()
+        # Serializes the SAMPLING PASS itself (sub-graph compiles + timed
+        # dispatches) separately from the cheap counter/buffer lock, so a
+        # concurrent dispatch's tick never stalls behind another thread's
+        # sample — the "cost lands on one dispatch in N" contract.
+        self._coll_sample_lock = threading.Lock()
+        # Host-side toggle for the latency decomposition's engine half
+        # (the input sync + fetch attribution in infer): resolved from
+        # the config, but a plain attribute so the phase-overhead A/B
+        # can flip it per arm on SHARED engines without a recompile —
+        # the split never touches the compiled program.
+        self.phase_split = bool(getattr(scfg, "phase_split", True))
         # Paged column memory (serve/paged_columns.py): page_pool_pages
         # > 0 preallocates THIS engine's device page pool — warm column
         # state lives in HBM pages, assembled in-graph by a page-index
@@ -651,12 +707,28 @@ class InferenceEngine:
             from glom_tpu.telemetry.counters import (
                 CollectiveCounters,
                 recording,
+                timing,
             )
 
             counters = CollectiveCounters()
-            with recording(counters):
+            # The timing context is TRACE-scoped: "full" makes every
+            # registered site lower with its io_callback brackets (the
+            # callbacks close over this engine's log); "sampled"/"off"
+            # insert nothing. Either way the counting trace populates the
+            # site registry the sampler re-dispatches from.
+            with recording(counters), timing(
+                self.collective_timing, self._coll_log
+            ):
                 lowered = jax.jit(fn, **jit_kw).lower(*abstract)
             self._comm[sig] = counters.totals()
+            # A lazy mid-traffic compile runs on a WORKER thread while
+            # another worker's sampling tick reads the registry: the
+            # merge rides the same lock.
+            with self._coll_lock:
+                for site in counters.sites:
+                    self._coll_sites.setdefault(
+                        (site["site"], site["shape"]), site
+                    )
         else:
             lowered = jax.jit(fn, **jit_kw).lower(*abstract)
         compiled = lowered.compile()
@@ -973,6 +1045,16 @@ class InferenceEngine:
         )
         stats = self._stats.setdefault(sig, StepTimeStats())
         attempts = [0]
+        # Latency decomposition (ServeConfig.phase_split, default ON): the
+        # engine attributes its own wall between h2d (staging the inputs,
+        # forced resident with block_until_ready — without the sync the
+        # async transfer would hide inside the compiled call) and resolve
+        # (fetching the outputs back); the compiled call plus whatever the
+        # split cannot see (validation, retry backoff) is the batcher's
+        # device_ms remainder. Accumulated across retry attempts, like
+        # levels0_h2d.
+        split = self.phase_split
+        ph = {"h2d_s": 0.0, "resolve_s": 0.0}
 
         def attempt():
             attempts[0] += 1
@@ -980,7 +1062,10 @@ class InferenceEngine:
                 self._fault_hook(
                     {"bucket": b, "n_valid": n_valid, "attempt": attempts[0]}
                 )
-            args = (self.params, make_input(), mask)
+            t_h = time.perf_counter()
+            staged = make_input()
+            args = (self.params, staged, mask)
+            lv_staged = None
             if warm in ("paged", "paged-inc"):
                 # Snapshot per attempt: the freshest write-backs (the
                 # pool swaps copy-on-write, never donated — safe to read
@@ -989,18 +1074,28 @@ class InferenceEngine:
                 if warm == "paged-inc":
                     args = args + (supp_dev,)
             elif warm:
-                args = args + (make_levels(),)
+                lv_staged = make_levels()
+                args = args + (lv_staged,)
+            if split:
+                jax.block_until_ready(staged)
+                if lv_staged is not None:
+                    jax.block_until_ready(lv_staged)
+                ph["h2d_s"] += time.perf_counter() - t_h
             levels, iters_run, conv, row_iters = fn(*args)
-            iters_host = int(jax.device_get(iters_run))  # syncs: serving
-            # is request/response — the caller needs the answer now, and
-            # the fetch IS the latency being measured.
-            levels.block_until_ready()
-            return (
+            levels.block_until_ready()  # syncs: serving is request/
+            # response — the caller needs the answer now, and the wait IS
+            # the device latency being measured.
+            t_r = time.perf_counter()
+            iters_host = int(jax.device_get(iters_run))
+            out = (
                 levels,
                 iters_host,
                 np.asarray(jax.device_get(conv)),
                 np.asarray(jax.device_get(row_iters)),
             )
+            if split:
+                ph["resolve_s"] += time.perf_counter() - t_r
+            return out
 
         t0 = time.perf_counter()
         if self.retry is not None:
@@ -1011,6 +1106,7 @@ class InferenceEngine:
         dt = time.perf_counter() - t0
         stats.observe(dt, is_compile=False)
         self.levels0_h2d_bytes_total += levels0_h2d[0]
+        self._tick_collective_timing()
         return ServeResult(
             levels=levels,
             iters_run=iters_host,
@@ -1020,6 +1116,11 @@ class InferenceEngine:
             row_converged=conv,
             row_iters=row_iters,
             levels0_h2d_bytes=levels0_h2d[0],
+            phases=(
+                {"h2d_ms": 1e3 * ph["h2d_s"],
+                 "resolve_ms": 1e3 * ph["resolve_s"]}
+                if split else None
+            ),
         )
 
     def infer_ragged(
@@ -1120,6 +1221,8 @@ class InferenceEngine:
         stats = self._stats.setdefault(sig, StepTimeStats())
         n_dev = jnp.asarray(n_host)
         attempts = [0]
+        split = self.phase_split
+        ph = {"h2d_s": 0.0, "resolve_s": 0.0}
 
         def attempt():
             attempts[0] += 1
@@ -1131,18 +1234,26 @@ class InferenceEngine:
                         "attempt": attempts[0],
                     }
                 )
-            args = (self.params, jnp.asarray(patches), n_dev)
+            t_h = time.perf_counter()
+            staged = jnp.asarray(patches)
+            args = (self.params, staged, n_dev)
             if self.pool is not None:
                 args = args + (self.pool.buffer(), jnp.asarray(pidx_host))
+            if split:
+                jax.block_until_ready(staged)
+                ph["h2d_s"] += time.perf_counter() - t_h
             levels, iters_run, conv, row_iters = fn(*args)
-            iters_host = int(jax.device_get(iters_run))
             levels.block_until_ready()
-            return (
+            t_r = time.perf_counter()
+            out = (
                 levels,
-                iters_host,
+                int(jax.device_get(iters_run)),
                 np.asarray(jax.device_get(conv)),
                 np.asarray(jax.device_get(row_iters)),
             )
+            if split:
+                ph["resolve_s"] += time.perf_counter() - t_r
+            return out
 
         t0 = time.perf_counter()
         if self.retry is not None:
@@ -1164,9 +1275,89 @@ class InferenceEngine:
             row_converged=conv,
             row_iters=row_iters,
             levels0_h2d_bytes=0,
+            phases=(
+                {"h2d_ms": 1e3 * ph["h2d_s"],
+                 "resolve_ms": 1e3 * ph["resolve_s"]}
+                if split else None
+            ),
         )
 
     # -- telemetry ---------------------------------------------------------
+
+    def _tick_collective_timing(self) -> None:
+        """Sampled-mode cadence: every collective_timing_interval-th
+        dispatch re-dispatches each registered site as its own timed
+        sub-graph (telemetry/comm_time.py) and buffers the stamped
+        records for collective_time_records(). The sample runs ON the
+        dispatching thread after its result is already resolved — the
+        cost lands on one dispatch in N, which is exactly what the
+        collective-timing overhead A/B prices."""
+        if self.collective_timing != "sampled":
+            return
+        # The cheap lock decides DUE and snapshots the registry; the
+        # sampling pass itself (sub-graph compiles + timed dispatches —
+        # seconds on a first tick) runs under the dedicated sample lock
+        # so a concurrent dispatch's tick only ever waits for the
+        # counter, never for another thread's sample.
+        with self._coll_lock:
+            if not self._coll_sites:
+                return
+            self._coll_dispatches += 1
+            if (
+                self._coll_dispatches
+                % self.scfg.collective_timing_interval != 0
+            ):
+                return
+            sites = list(self._coll_sites.values())
+        from glom_tpu.telemetry.comm_time import (
+            CollectiveTimeSampler,
+            collective_time_records,
+        )
+
+        with self._coll_sample_lock:
+            if self._coll_sampler is None:
+                self._coll_sampler = CollectiveTimeSampler(
+                    self.mesh, sites, interval=1
+                )
+            else:
+                # Sites registered by lazy compiles AFTER the sampler was
+                # built (a new bucket/warm signature) join the rotation —
+                # a frozen registry would silently never time them.
+                self._coll_sampler.update_sites(sites)
+            recs = collective_time_records(
+                self._coll_sampler.sample(), path=self.name,
+                mode="sampled",
+            )
+        with self._coll_lock:
+            self._coll_samples.extend(
+                dict(r, engine=self.name) for r in recs
+            )
+
+    def collective_time_records(self) -> list:
+        """Drain the per-collective wall-time evidence: full-mode
+        io_callback brackets aggregate per (site, axis, bytes); sampled-
+        mode buffered re-dispatch rows pass through. Every row is a
+        stamped schema "collective_time" record carrying the α-β
+        comm_time_model fit + drift; empty when timing is off (the
+        acceptance contract: off-mode leaves NO records)."""
+        out: list = []
+        if self._coll_log is not None:
+            samples = self._coll_log.drain()
+            if samples:
+                from glom_tpu.telemetry.comm_time import (
+                    collective_time_records,
+                )
+
+                out.extend(
+                    dict(r, engine=self.name)
+                    for r in collective_time_records(
+                        samples, path=self.name, mode="full"
+                    )
+                )
+        with self._coll_lock:
+            buffered, self._coll_samples = self._coll_samples, []
+        out.extend(buffered)
+        return out
 
     def _emit(self, rec: dict) -> None:
         from glom_tpu.serve.events import emit_serve

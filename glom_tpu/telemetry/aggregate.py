@@ -257,6 +257,22 @@ def rollup(host_records: "OrderedDict[str, List[dict]]") -> dict:
                     phase, []
                 ).append({"host": host, "step": rec.get("step")})
                 continue
+            if kind == "capacity":
+                # The capacity observatory's headroom rollup: last + min
+                # per engine across the pod — what the scale-out decision
+                # reads at pod scope.
+                h = rec.get("headroom")
+                if isinstance(h, (int, float)) and not isinstance(h, bool):
+                    eng = per_engine.setdefault(
+                        str(rec.get("engine")),
+                        {"n_dispatches": 0, "latency": [], "n_valid": 0,
+                         "n_failovers": 0, "n_deaths": 0, "n_rejoins": 0},
+                    )
+                    eng["headroom_last"] = float(h)
+                    eng["headroom_min"] = min(
+                        float(h), eng.get("headroom_min", float(h))
+                    )
+                continue
             if kind != "serve":
                 continue
             event = rec.get("event")
@@ -435,8 +451,8 @@ def check_barrier_chains(barrier_rounds: Dict[str, Dict[str, list]]) -> List[str
 
 # -- the live SLO monitor ---------------------------------------------------
 
-# rule name -> (what it bounds, unit). All rules are upper bounds:
-# observed > threshold is a breach.
+# rule name -> (what it bounds, unit). Upper bounds unless listed in
+# SLO_LOWER_BOUND_RULES: observed > threshold is a breach.
 SLO_RULES = {
     "p50_ms": "windowed p50 of per-request latency_ms",
     "p95_ms": "windowed p95 of per-request latency_ms",
@@ -445,7 +461,12 @@ SLO_RULES = {
     "shed_rate": "sheds / (sheds + resolved) over the window",
     "failure_rate": "failed responses / responses over the window",
     "mean_iters": "windowed mean of per-request executed iterations",
+    "headroom": "windowed MIN of capacity.headroom across engines "
+    "(LOWER bound: breach when it drops below the threshold — the "
+    "scale-out signal, docs/OBSERVABILITY.md 'Capacity observatory')",
 }
+# Rules where LESS is the emergency: observed < threshold breaches.
+SLO_LOWER_BOUND_RULES = frozenset({"headroom"})
 
 
 def parse_slo(spec: str) -> Tuple[str, float]:
@@ -501,10 +522,23 @@ class SLOMonitor:
         self._latency: deque = deque()   # (t, latency_ms)
         self._iters: deque = deque()     # (t, iters_total)
         self._outcomes: deque = deque()  # (t, "resolved"|"shed"|"failed"|"ok")
+        self._headroom: deque = deque()  # (t, headroom)
         self._latency_traces: set = set()
         self.n_breaches = 0
 
     def observe(self, rec: dict) -> None:
+        if rec.get("kind") == "capacity":
+            # The capacity observatory's per-engine headroom rollup
+            # (serve/batcher.capacity_records, emitted on every summary):
+            # the windowed MIN across engines feeds the one lower-bound
+            # rule — one exhausted engine IS the scale-out signal, even
+            # while its siblings idle.
+            h = rec.get("headroom")
+            if isinstance(h, (int, float)) and not isinstance(h, bool):
+                now = self._clock()
+                self._headroom.append((now, float(h)))
+                self._prune(now)
+            return
         if rec.get("kind") != "serve":
             return
         now = self._clock()
@@ -543,7 +577,7 @@ class SLOMonitor:
             # for days must not grow one entry per request forever.
             if t_id is not None:
                 self._latency_traces.discard(t_id)
-        for q in (self._iters, self._outcomes):
+        for q in (self._iters, self._outcomes, self._headroom):
             while q and q[0][0] < horizon:
                 q.popleft()
 
@@ -589,6 +623,11 @@ class SLOMonitor:
                     sum(iters) / len(iters)
                     if len(iters) >= self.min_samples else None
                 )
+            elif rule == "headroom":
+                vals = [v for _, v in self._headroom]
+                out[rule] = (
+                    min(vals) if len(vals) >= self.min_samples else None
+                )
         return out
 
     def evaluate(self) -> List[dict]:
@@ -607,16 +646,26 @@ class SLOMonitor:
             "shed_rate": len(self._outcomes),
             "failure_rate": len(self._outcomes),
             "mean_iters": len(self._iters),
+            "headroom": len(self._headroom),
         }
         for rule, threshold in sorted(self.rules.items()):
             observed = values.get(rule)
-            if observed is None or observed <= threshold:
+            if observed is None:
+                continue
+            if rule in SLO_LOWER_BOUND_RULES:
+                if observed >= threshold:
+                    continue
+            elif observed <= threshold:
                 continue
             rec = schema.stamp(
                 {
                     "rule": rule,
                     "threshold": threshold,
                     "observed": round(observed, 4),
+                    "bound": (
+                        "lower" if rule in SLO_LOWER_BOUND_RULES
+                        else "upper"
+                    ),
                     "window_s": self.window_s,
                     "n_samples": n_samples.get(rule, len(self._latency)),
                     "wall_time_s": round(time.time(), 3),
@@ -791,8 +840,9 @@ def watch_main(argv: Optional[List[str]] = None) -> int:
             window = (
                 f"{b['window_s']}s" if b["window_s"] is not None else "all"
             )
+            op = "<" if b.get("bound") == "lower" else ">"
             print(
-                f"SLO BREACH: {b['rule']} observed {b['observed']} > "
+                f"SLO BREACH: {b['rule']} observed {b['observed']} {op} "
                 f"threshold {b['threshold']} "
                 f"(n={b['n_samples']}, window={window})",
                 file=sys.stderr,

@@ -59,11 +59,22 @@ _COST_METRIC_TOKENS = (
     # under pins are pressure evidence. bytes_per_stream rides the
     # "bytes" unit token.
     "chain", "compact_deferred",
+    # Capacity-observatory pressure rows (ISSUE 13): occupancy creeping
+    # up regresses even when latency holds (headroom is the matching
+    # BENEFIT token below; collective_time.* wall_ms and the
+    # serve_latency.* phase rows ride the "ms" unit token).
+    "utilization", "fill", "wait",
 )
+# Metric-name tokens that mark a HIGHER-is-better row regardless of the
+# cost heuristics: headroom is capacity LEFT — a serving change that
+# erodes it regresses DOWN, exactly opposite to the occupancy costs.
+_BENEFIT_METRIC_TOKENS = ("headroom",)
 
 
 def lower_is_better(metric: str, unit: str) -> bool:
     unit = unit.lower()
+    if any(tok in metric.lower() for tok in _BENEFIT_METRIC_TOKENS):
+        return False
     if "/s" in unit or unit == "x":
         return False
     if any(tok in unit for tok in _COST_UNIT_TOKENS) or unit == "s":
@@ -162,6 +173,49 @@ def flatten_engine_metrics(rec: dict) -> List[dict]:
                     "kind": "bench",
                 }
             )
+    # The latency decomposition rollup (ISSUE 13): the summary's mean
+    # per-dispatch phase split gates as serve_latency.* COSTS ("ms" unit)
+    # — a change that moves time into queue_wait or h2d regresses even
+    # when total latency holds inside noise.
+    phases = rec.get("latency_phases")
+    if isinstance(phases, dict):
+        for key in sorted(phases):
+            v = phases[key]
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                rows.append(
+                    {
+                        "metric": f"serve_latency.{key}{suffix}",
+                        "value": float(v),
+                        "unit": "ms",
+                        "kind": "bench",
+                    }
+                )
+    # The capacity nest (ISSUE 13): headroom gates as a BENEFIT (the
+    # _BENEFIT_METRIC_TOKENS row — less capacity left is the
+    # regression), utilization as a cost, service rate by its "/s" unit.
+    capacity = rec.get("capacity")
+    if isinstance(capacity, dict):
+        for name in sorted(capacity):
+            st = capacity[name]
+            if not isinstance(st, dict):
+                continue
+            for key, unit in (
+                ("headroom", "fraction"),
+                ("utilization", "fraction"),
+                ("service_rate_rps", "req/s"),
+            ):
+                v = st.get(key)
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    rows.append(
+                        {
+                            "metric": (
+                                f"serve_capacity.{name}.{key}{suffix}"
+                            ),
+                            "value": float(v),
+                            "unit": unit,
+                            "kind": "bench",
+                        }
+                    )
     return rows
 
 
@@ -195,6 +249,41 @@ def load_bench_records(lines) -> Tuple[Dict[str, dict], Dict[str, dict]]:
         if rec.get("kind") == "serve" and rec.get("event") == "summary":
             for row in flatten_engine_metrics(rec):
                 ingest(row)
+            continue
+        if rec.get("kind") == "collective_time" and isinstance(
+            rec.get("site"), str
+        ):
+            # Per-collective wall-time rows (ISSUE 13): wall_ms gates as
+            # a cost by its "ms" unit — a schedule change that slows one
+            # site regresses even when totals hide it. The path (trainer
+            # route or engine name) keys the regime like a config label.
+            v = rec.get("wall_ms")
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                ingest(
+                    {
+                        "metric": (
+                            f"collective_time.{rec.get('path', '?')}."
+                            f"{rec['site']} wall_ms"
+                        ),
+                        "value": float(v),
+                        "unit": "ms",
+                        "kind": "bench",
+                    }
+                )
+            continue
+        if rec.get("kind") == "capacity" and isinstance(
+            rec.get("engine"), str
+        ):
+            h = rec.get("headroom")
+            if isinstance(h, (int, float)) and not isinstance(h, bool):
+                ingest(
+                    {
+                        "metric": f"capacity.{rec['engine']}.headroom",
+                        "value": float(h),
+                        "unit": "fraction",
+                        "kind": "bench",
+                    }
+                )
             continue
         ingest(rec)
     return measured, unmeasured

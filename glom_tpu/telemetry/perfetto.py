@@ -49,6 +49,15 @@ _PID = 1
 _TID_SPANS = 1
 _TID_EVENTS = 90
 _TID_ROLLUPS = 91
+# Capacity-observatory tracks (ISSUE 13): per-(site, axis) collective
+# wall-time counters, per-engine headroom counters, and the dispatch
+# phase split rendered as NESTED slices (one parent slice per dispatch,
+# its five phases as children) so one trace reads
+# queue->pack->h2d->device->resolve end to end.
+_TID_COLLECTIVES = 92
+_TID_CAPACITY = 93
+_TID_DISPATCH = 95
+_TID_PHASES = 96
 _TID_BARRIER_BASE = 100
 
 
@@ -190,6 +199,37 @@ def to_trace_events(records: Iterable[dict]) -> List[dict]:
                     }
                 )
                 flow_seen[fid] = "open"
+        elif kind == "collective_time":
+            # One counter track per (site, axis): the per-collective
+            # wall-time trend over the run — a congested link shows as
+            # one site's counter climbing while its siblings hold.
+            axis = rec.get("axis")
+            name = f"collective:{rec.get('site', '?')}" + (
+                f"@{axis}" if isinstance(axis, str) else ""
+            )
+            raw.append(
+                {
+                    "name": name,
+                    "ph": "C",
+                    "pid": _PID,
+                    "tid": _TID_COLLECTIVES,
+                    "ts": ts,
+                    "args": {"wall_ms": float(rec.get("wall_ms", 0.0))},
+                }
+            )
+        elif kind == "capacity":
+            raw.append(
+                {
+                    "name": f"headroom:{rec.get('engine', '?')}",
+                    "ph": "C",
+                    "pid": _PID,
+                    "tid": _TID_CAPACITY,
+                    "ts": ts,
+                    "args": {
+                        "headroom": float(rec.get("headroom", 0.0))
+                    },
+                }
+            )
         else:
             label = {
                 "train_step": f"step {rec.get('step', '?')}",
@@ -199,6 +239,51 @@ def to_trace_events(records: Iterable[dict]) -> List[dict]:
                 "serve": f"serve:{rec.get('event', '?')}",
                 "recovery": f"recovery:{rec.get('action', '?')}",
             }.get(kind, kind)
+            if (
+                kind == "serve"
+                and rec.get("event") == "dispatch"
+                and isinstance(rec.get("latency_ms"), (int, float))
+                and isinstance(rec.get("device_ms"), (int, float))
+            ):
+                # The dispatch phase split as NESTED slices: the record's
+                # clock reads at stamp time (after the dispatch), so the
+                # parent slice starts latency_ms earlier and the five
+                # phases lay out consecutively under it — one trace shows
+                # where each dispatch's wall went, next to the request
+                # flow arrows.
+                lat_s = float(rec["latency_ms"]) / 1e3
+                t_start = ts - lat_s
+                raw.append(
+                    {
+                        "name": f"dispatch:{rec.get('engine', '?')}",
+                        "ph": "X",
+                        "pid": _PID,
+                        "tid": _TID_DISPATCH,
+                        "ts": t_start,
+                        "dur": lat_s * 1e6,
+                        "args": rec,
+                    }
+                )
+                cursor = t_start
+                for phase in (
+                    "queue_wait_ms", "pack_ms", "h2d_ms", "device_ms",
+                    "resolve_ms",
+                ):
+                    v = rec.get(phase)
+                    if not isinstance(v, (int, float)):
+                        continue
+                    raw.append(
+                        {
+                            "name": phase[: -len("_ms")],
+                            "ph": "X",
+                            "pid": _PID,
+                            "tid": _TID_PHASES,
+                            "ts": cursor,
+                            "dur": float(v) * 1e3,  # ms -> us
+                            "args": {phase: v},
+                        }
+                    )
+                    cursor += float(v) / 1e3
             raw.append(
                 {
                     "name": label,

@@ -44,7 +44,17 @@ from typing import Iterable, List, Optional, Tuple
 # the new "slo_breach" kind is one windowed SLO-rule violation from the
 # live monitor (`python -m glom_tpu.telemetry watch`,
 # telemetry/aggregate.py).
-SCHEMA_VERSION = 6
+# v7 is the capacity observatory (docs/OBSERVABILITY.md): the new
+# "collective_time" kind is one registered collective site's measured
+# wall time (telemetry/comm_time.py — site/axis/bytes/wall_ms/bytes_per_s
+# plus the α-β comm_time_model fit and its drift), the new "capacity"
+# kind is one engine's headroom rollup (service-rate estimate x live
+# queue/continuation/affinity/page-pool occupancy — the signal
+# `telemetry watch --slo headroom=X` tails and the elastic-serving
+# control loop will read), and serve "dispatch" records split latency_ms
+# into queue_wait/pack/h2d/device/resolve phase fields that sum to it
+# bit-exactly (conservation extended by `telemetry trace`).
+SCHEMA_VERSION = 7
 
 _NUM = (int, float)
 _STR = (str,)
@@ -104,6 +114,21 @@ KINDS = {
     # threshold/observed/window_s/n_samples ride per breach. The flight
     # recorder counts these toward its anomaly-storm trigger.
     "slo_breach": {"rule": _STR},
+    # One registered collective site's measured wall time
+    # (telemetry/comm_time.py): `site` names the record_collective-
+    # registered site, `wall_ms` its measured wall clock; axis /
+    # collective / wire_bytes / bytes_per_s / mode ("sampled" | "full")
+    # / comm_time_model_ms / comm_time_model_drift ride per row, and the
+    # `site: "comm_time_model"` row carries the fitted α-β form itself.
+    "collective_time": {"site": _STR, "wall_ms": _NUM},
+    # One engine's capacity/headroom rollup (serve/batcher.py,
+    # docs/OBSERVABILITY.md "Capacity observatory"): `headroom` in [0, 1]
+    # is 1 - the worst live occupancy across the engine's lanes (queue /
+    # continuation / affinity / page pool); service_rate_rps estimates
+    # the sustainable requests/s from the measured dispatch latencies.
+    # `telemetry watch --slo headroom=X` breaches when it drops BELOW X
+    # (the one lower-bound rule).
+    "capacity": {"engine": _STR, "headroom": _NUM},
 }
 
 # Serve events that are REQUEST-scoped and must carry trace context on
@@ -133,6 +158,10 @@ def infer_kind(rec: dict) -> str:
     """Best-effort kind for legacy records written before stamping."""
     if "fault" in rec:
         return "fault"
+    if "site" in rec and "wall_ms" in rec:
+        return "collective_time"
+    if "headroom" in rec and "engine" in rec:
+        return "capacity"
     if "phase" in rec and "round" in rec:
         return "barrier"
     if "backend_state" in rec and ("t" in rec or "event" in rec):

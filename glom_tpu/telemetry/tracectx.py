@@ -47,6 +47,16 @@ from typing import Dict, Iterable, List, Optional
 TRACE_FIELDS = ("trace_id", "span_id", "parent_span")
 TRACE_BATCH_FIELDS = ("trace_ids", "span_id", "parent_spans")
 
+# The serve latency decomposition (schema v7, docs/OBSERVABILITY.md
+# "Capacity observatory"): every dispatch record splits latency_ms into
+# these phase fields, IN THIS ORDER — the batcher defines latency_ms as
+# their left-to-right float sum, so the conservation check below is
+# bit-exact, not approximate. Null values mean ServeConfig.phase_split
+# was off (the keys are still present, like the trace-context contract).
+PHASE_KEYS = (
+    "queue_wait_ms", "pack_ms", "h2d_ms", "device_ms", "resolve_ms"
+)
+
 
 def new_id(nbytes: int = 8) -> str:
     """A fresh random hex id (16 hex chars by default — trace and span
@@ -233,7 +243,47 @@ def conservation(records: Iterable[dict], trace_id: str) -> dict:
     # latency_ms values the dispatch records carry, in the same order —
     # equality here is exact, not approximate.
     ms_ok = leaf.get("dispatch_ms_total") == hop_ms
-    out["ok"] = iters_ok and ms_ok
+    # The v7 phase extension: each hop's phase fields must sum (left to
+    # right, PHASE_KEYS order — the exact float addition the batcher
+    # performed to DEFINE latency_ms) back to that hop's latency_ms, and
+    # the per-phase accumulations across hops must equal the resolve
+    # leaf's phase_ms_total bit for bit. Hops stamped with null phases
+    # (phase_split off) are exempt — the keys' PRESENCE is the schema's
+    # job, conservation only binds measured values.
+    phase_ok = True
+    phase_why = None
+    phase_totals: Dict[str, float] = {}
+    any_phases = False
+    for r in hops:
+        vals = [r.get(k) for k in PHASE_KEYS]
+        if not all(isinstance(v, (int, float)) for v in vals):
+            continue
+        any_phases = True
+        s = 0.0
+        for k, v in zip(PHASE_KEYS, vals):
+            s = s + v
+            phase_totals[k] = phase_totals.get(k, 0.0) + v
+        if s != r.get("latency_ms"):
+            phase_ok = False
+            phase_why = (
+                f"hop phase split does not conserve: phases sum {s}, "
+                f"dispatch record says latency_ms={r.get('latency_ms')}"
+            )
+            break
+    leaf_phases = leaf.get("phase_ms_total")
+    if phase_ok and any_phases and isinstance(leaf_phases, dict):
+        for k in PHASE_KEYS:
+            if leaf_phases.get(k) != phase_totals.get(k, 0.0):
+                phase_ok = False
+                phase_why = (
+                    f"phase {k} does not conserve across hops: hops sum "
+                    f"{phase_totals.get(k, 0.0)}, resolve leaf says "
+                    f"{leaf_phases.get(k)}"
+                )
+                break
+    if any_phases:
+        out["phase_ms_total"] = phase_totals
+    out["ok"] = iters_ok and ms_ok and phase_ok
     if not iters_ok:
         out["why"] = (
             f"iters do not conserve: hops sum {hop_iters}, resolve leaf "
@@ -244,6 +294,8 @@ def conservation(records: Iterable[dict], trace_id: str) -> dict:
             f"wall spans do not conserve: hops sum {hop_ms}, resolve "
             f"leaf says {leaf.get('dispatch_ms_total')}"
         )
+    elif not phase_ok:
+        out["why"] = phase_why
     return out
 
 

@@ -470,6 +470,7 @@ def fit_loop(
     compile_tracker: Optional[set] = None,
     trace_capture=None,
     memory_probe: Optional[Callable[[], dict]] = None,
+    aux_records_probe: Optional[Callable[[], list]] = None,
 ) -> list[dict]:
     """Shared training loop: pull batches, step, log every `log_every`.
     Used by both the single-device Trainer and the DistributedTrainer.
@@ -505,6 +506,11 @@ def fit_loop(
         fit() calls; the CALLER owns close());
       * memory_probe — called at logging steps; its dict (HBM watermarks
         + model drift, tracing.memory.memory_record) rides the record;
+      * aux_records_probe — called at logging steps; returns a list of
+        ALREADY-STAMPED standalone records written to the same stream
+        (the collective-timing sampler's "collective_time" rows —
+        DistributedTrainer wires it; docs/OBSERVABILITY.md, Capacity
+        observatory);
       * flight recorder — every record this loop produces reaches the
         global recorder (via MetricsWriter.write, or directly when no
         writer is attached), and an unhandled exception dumps the buffer
@@ -575,6 +581,17 @@ def fit_loop(
                     metrics_writer.write(srec)
                 else:
                     flight.observe_event(srec)
+            if aux_records_probe is not None:
+                # Already-stamped standalone records minted at the logging
+                # boundary (the collective-timing sampler's
+                # "collective_time" rows — DistributedTrainer wires it):
+                # unlike memory_probe's dict these do NOT merge into the
+                # train_step record; they are their own schema kinds.
+                for arec in aux_records_probe() or []:
+                    if metrics_writer is not None:
+                        metrics_writer.write(arec)
+                    else:
+                        flight.observe_event(arec)
             flagged = [k for k, v in pending_flags if float(v)]
             pending_flags = []
             if rec.get("nonfinite_step"):

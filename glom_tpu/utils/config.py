@@ -256,6 +256,25 @@ class ServeConfig:
     # (`bench_serve.py --trace-ab`). False stamps the context keys as
     # null (explicitly untraced — the schema still lints).
     trace_requests: bool = True
+    # Serve latency decomposition (docs/OBSERVABILITY.md, "Capacity
+    # observatory"): every dispatch record splits latency_ms into
+    # queue_wait / pack / h2d / device / resolve phase fields that sum to
+    # it BIT-EXACTLY (and accumulate into the per-request resolve leaf),
+    # so `telemetry trace` shows where each request's time went across
+    # hops. Default ON — the bar is <2% (`bench_serve.py --phase-ab`);
+    # False stamps the phase keys as null and reverts latency_ms to the
+    # bare engine dispatch wall (the pre-v7 reading).
+    phase_split: bool = True
+    # Per-collective wall-time on the serve mesh (telemetry/comm_time.py,
+    # resolved by counters.resolve_collective_timing — the
+    # telemetry_level discipline): "off" (default), "sampled" (every
+    # collective_timing_interval-th dispatch re-dispatches each witness /
+    # gather site as its own timed sub-graph), "full" (every execution
+    # bracketed by dataflow-ordered io_callbacks, inserted at the AOT
+    # compile). Single-device engines have no collectives: any mode
+    # resolves to "off" there, stamped.
+    collective_timing: str = "off"
+    collective_timing_interval: int = 16
 
     def __post_init__(self):
         if not self.buckets:
@@ -398,6 +417,16 @@ class ServeConfig:
             raise ValueError(
                 f"rejoin_interval_ms {self.rejoin_interval_ms} must be > 0"
             )
+        if self.collective_timing not in ("off", "sampled", "full"):
+            raise ValueError(
+                f"collective_timing {self.collective_timing!r}: one of "
+                "('off', 'sampled', 'full')"
+            )
+        if self.collective_timing_interval < 1:
+            raise ValueError(
+                f"collective_timing_interval "
+                f"{self.collective_timing_interval} must be >= 1"
+            )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -485,4 +514,17 @@ class TrainConfig:
     # large T, under remat (which exists to NOT keep per-iteration
     # residuals), and in GSPMD regions where compile time is precious.
     scan_unroll: bool = False
+    # Per-collective wall-time on the manual path (docs/OBSERVABILITY.md
+    # "Capacity observatory"; resolved by
+    # counters.resolve_collective_timing — the telemetry_level
+    # discipline): "off" (default), "sampled" (every
+    # collective_timing_interval-th fit-loop logging boundary, each
+    # registered zero1-schedule site is re-dispatched as its own timed
+    # sub-graph and stamped as a "collective_time" record with the α-β
+    # comm_time_model drift), "full" (degrades to "sampled" loudly here —
+    # the jit-on-first-call trainer has no AOT seam for the io_callback
+    # brackets). Only the manual zero>=1 route has registered sites; the
+    # GSPMD step resolves to "off", stamped.
+    collective_timing: str = "off"
+    collective_timing_interval: int = 10
     seed: int = 0

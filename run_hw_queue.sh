@@ -240,6 +240,48 @@ step pod_aggregate 300 python -m glom_tpu.telemetry aggregate \
     results/hw_queue/chaos_pod/metrics_h0.jsonl \
     results/hw_queue/chaos_pod/metrics_h1.jsonl --strict --timeline 20
 
+# 9j. Capacity observatory (ISSUE 13, docs/OBSERVABILITY.md): the first
+#     real TPU window measures per-collective wall-time on the manual
+#     zero1 path (the standing hardware-window debt item) and RE-FITS
+#     the α-β comm_time_model from the measured points — the
+#     collective_time rows land in the bench log, so the next window's
+#     drift is priced against THIS window's fit via the compare gate.
+#     Both overhead gates hold the <2% bar on real hardware: the sampled
+#     timing harness amortized at the deployed cadence, and the dispatch
+#     phase split (queue_wait/pack/h2d/device/resolve) on the serve path
+#     — on a real chip the h2d/device split finally prices the PCIe-vs-
+#     HBM boundary the CPU smoke cannot see.
+step collective_timing_ab 1800 python -u bench_train.py --collective-timing-ab
+step collective_timing_gate 120 python - results/hw_queue/collective_timing_ab.log <<'EOF'
+import sys
+from glom_tpu.telemetry import schema
+rows = [r for _, r in schema.iter_json_lines(open(sys.argv[1]))]
+ov = [r for r in rows if r.get("metric", "").startswith("collective_timing_overhead")]
+assert ov, "no collective_timing_overhead row in the A/B log"
+v = ov[-1]["value"]
+assert isinstance(v, (int, float)), f"timing overhead UNMEASURED: {ov[-1]}"
+assert v <= 2.0, f"sampled collective-timing overhead {v}% exceeds the 2% bar"
+sites = [r for r in rows if r.get("kind") == "collective_time"
+         and r.get("site") not in (None, "comm_time_model")]
+model = [r for r in rows if r.get("site") == "comm_time_model"]
+assert sites and model, "no measured collective_time rows / model fit in the log"
+assert all(r["wall_ms"] > 0 for r in sites), "zero wall_ms on a measured site"
+print(f"OK: timing overhead {v}% within 2%; {len(sites)} sites measured, "
+      f"alpha={model[-1]['alpha_ms']}ms beta={model[-1]['beta_ms_per_byte']}ms/B")
+EOF
+step phase_ab 2400 python -u bench_serve.py --phase-ab
+step phase_overhead_gate 120 python - results/hw_queue/phase_ab.log <<'EOF'
+import sys
+from glom_tpu.telemetry import schema
+rows = [r for _, r in schema.iter_json_lines(open(sys.argv[1]))]
+ov = [r for r in rows if r.get("metric", "").startswith("serve_phase_overhead")]
+assert ov, "no serve_phase_overhead row in the phase A/B log"
+v = ov[-1]["value"]
+assert isinstance(v, (int, float)), f"phase overhead UNMEASURED: {ov[-1]}"
+assert v <= 2.0, f"phase-split overhead {v}% exceeds the 2% stamping budget"
+print(f"OK: phase-split overhead {v}% within the 2% budget")
+EOF
+
 # 10. Schema lint: every JSON row this queue produced must validate
 #     against the versioned event schema (glom_tpu/telemetry/schema.py).
 #     Shell noise in the logs is skipped; --allow-unstamped because the
@@ -271,6 +313,8 @@ grep -ah '^{' results/hw_queue/bench_serve.log \
     results/hw_queue/bench_serve_temporal.log \
     results/hw_queue/bench_serve_ragged.log \
     results/hw_queue/bench_serve_delta.log \
+    results/hw_queue/collective_timing_ab.log \
+    results/hw_queue/phase_ab.log \
     > results/hw_queue/serve_candidate.jsonl 2>/dev/null || true
 if [ -f results/serve_baseline.jsonl ]; then
     step serve_compare 300 python -m glom_tpu.telemetry compare \

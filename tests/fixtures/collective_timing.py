@@ -1,0 +1,57 @@
+"""Seeded fixture pair for the collective-coverage checker's
+hand-rolled-timing rule (glom_tpu/analysis/collectives.py, ISSUE 13).
+
+`leaky_timed_reduce` registers its psum's wire bytes (the PR 2 contract
+holds) but brackets the collective with its OWN io_callback clock harness
+— exactly the hand-rolled timing the shared wrapper exists to replace:
+a private clock discipline the trace-purity audit cannot reason about,
+a record shape the schema never sees, and per-shard callback pairs that
+drift from counters.CollectiveTimeLog's. `clean_timed_reduce` is the
+twin routed through `counters.timed_collective` — the ONE sanctioned
+timing route (byte recording + site registry + the full-mode brackets).
+
+This file is a LINT FIXTURE: the test copies its source under a
+registration-scope path (parallel/manual.py) and asserts exactly one
+hand-rolled-timing finding at the leaky psum. Parsed, never imported
+(the stand-ins below keep it import-safe anyway).
+"""
+
+import time
+
+from glom_tpu.telemetry import counters as tele_counters
+
+DATA_AXIS = "data"
+
+
+def io_callback(fn, result_shape, *args):  # pragma: no cover — stand-in
+    del result_shape, args
+    return fn()
+
+
+class lax:  # pragma: no cover — stand-in, parsed not executed
+    @staticmethod
+    def psum(x, axis):
+        del axis
+        return x
+
+
+def leaky_timed_reduce(g, k):
+    """FLAGGED: record_collective registers the bytes, but the timing is
+    a hand-rolled io_callback clock pair around the collective."""
+    tele_counters.record_collective(
+        "reduce", tele_counters.ring_allreduce_bytes(g, k)
+    )
+    t0 = io_callback(lambda: time.perf_counter(), None)
+    out = lax.psum(g, DATA_AXIS)
+    io_callback(lambda: time.perf_counter(), None, t0)
+    return out
+
+
+def clean_timed_reduce(g, k):
+    """CLEAN: the shared wrapper owns the bytes, the site registry, and
+    (under timing('full', log)) the brackets."""
+    return tele_counters.timed_collective(
+        "fixture_psum", DATA_AXIS, "reduce",
+        tele_counters.ring_allreduce_bytes(g, k),
+        lambda x: lax.psum(x, DATA_AXIS), g, collective="psum",
+    )

@@ -1202,3 +1202,92 @@ class TestAxisEnvironment:
         import glom_tpu.parallel.serve_mesh as sm
 
         assert by_checker(run([sm.__file__]), "axis-environment") == []
+
+
+class TestHandRolledCollectiveTiming:
+    """ISSUE 13: a registered site that hand-rolls its own clock/callback
+    harness around a wire-moving collective must route through the ONE
+    shared timing wrapper (counters.timed_collective)."""
+
+    def test_fixture_pair(self, tmp_path):
+        """The seeded acceptance pair (tests/fixtures/collective_timing
+        .py), linted under a registration-scope path: the leaky twin's
+        psum is flagged hand-rolled-timing, the wrapper-routed twin is
+        clean."""
+        src = (FIXTURES / "collective_timing.py").read_text()
+        fs = by_checker(
+            lint(tmp_path, src, name="parallel/manual.py"),
+            "collective-coverage",
+        )
+        timing = [f for f in fs if "hand-rolled" in f.message]
+        assert len(timing) == 1
+        src_lines = src.splitlines()
+        assert "lax.psum(g, DATA_AXIS)" in src_lines[timing[0].line - 1]
+        assert "leaky_timed_reduce" in timing[0].symbol
+        assert "timed_collective" in timing[0].message
+        # Neither twin trips the registration rule (record_collective and
+        # timed_collective both register), and the clean twin trips
+        # NOTHING.
+        assert not any("not registered" in f.message for f in fs)
+        assert not any("clean_timed_reduce" in (f.symbol or "")
+                       for f in fs)
+
+    def test_wrapper_lambda_counts_as_registered(self, tmp_path):
+        """The wrapper takes the collective as a LAMBDA: the coverage
+        rule must walk the enclosing-scope chain, not just the innermost
+        scope, or every wrapper-routed site reads unregistered."""
+        src = (
+            "from jax import lax\n"
+            "from glom_tpu.telemetry import counters as tele_counters\n"
+            "DATA_AXIS = 'data'\n"
+            "def grads(g):\n"
+            "    return tele_counters.timed_collective(\n"
+            "        's', DATA_AXIS, 'reduce', 8,\n"
+            "        lambda x: lax.psum(x, DATA_AXIS), g,\n"
+            "        collective='psum',\n"
+            "    )\n"
+        )
+        assert (
+            by_checker(
+                lint(tmp_path, src, name="parallel/manual.py"),
+                "collective-coverage",
+            )
+            == []
+        )
+
+    def test_timing_primitive_without_collective_is_fine(self, tmp_path):
+        """A clock in a registration-scope module that never touches a
+        collective (a host-side stats helper) is not this rule's
+        business — trace-purity owns reachability from traced entries."""
+        src = (
+            "import time\n"
+            "def stats():\n"
+            "    return time.perf_counter()\n"
+        )
+        assert (
+            by_checker(
+                lint(tmp_path, src, name="parallel/manual.py"),
+                "collective-coverage",
+            )
+            == []
+        )
+
+    def test_hand_rolled_clock_next_to_collective_flagged(self, tmp_path):
+        src = (
+            "import time\n"
+            "from jax import lax\n"
+            "from glom_tpu.telemetry import counters as tele_counters\n"
+            "DATA_AXIS = 'data'\n"
+            "def grads(g):\n"
+            "    tele_counters.record_collective('reduce', 8)\n"
+            "    t0 = time.perf_counter()\n"
+            "    out = lax.psum(g, DATA_AXIS)\n"
+            "    dt = time.perf_counter() - t0\n"
+            "    return out, dt\n"
+        )
+        fs = by_checker(
+            lint(tmp_path, src, name="parallel/manual.py"),
+            "collective-coverage",
+        )
+        assert len(fs) == 1
+        assert "hand-rolled" in fs[0].message and fs[0].line == 8

@@ -46,7 +46,7 @@ class TestSchemaV2Kinds:
             {"metric": "m", "value": None, "error": "backend-init-unavailable"},
             kind="error",
         )
-        assert span["schema_version"] == schema.SCHEMA_VERSION == 6
+        assert span["schema_version"] == schema.SCHEMA_VERSION == 7
         assert schema.validate_record(span) == []
         assert schema.validate_record(err) == []
         # missing required fields are rejected
@@ -471,3 +471,90 @@ class TestBenchArtifactEdgeCases:
         assert compare_main([base, new, "--bench-artifact"]) == 0
         assert compare_main(
             [base, new, "--bench-artifact", "--fail-on-missing"]) == 1
+
+
+class TestCapacityObservatory:
+    """ISSUE 13 classifications: collective_time.* wall_ms and
+    serve_latency.* phase rows are COSTS, capacity headroom is a
+    BENEFIT, and a timing-off run classifies UNMEASURED — never 0.0."""
+
+    def test_direction_vocabulary(self):
+        assert lower_is_better(
+            "collective_time.train-zero1.zero_psum_scatter wall_ms", "ms"
+        )
+        assert lower_is_better("serve_latency.queue_wait_ms (cfg)", "ms")
+        assert lower_is_better(
+            "serve_capacity.engine0.utilization (cfg)", "fraction"
+        )
+        # Headroom is capacity LEFT: higher is better, whatever the unit
+        # heuristics would otherwise say.
+        assert not lower_is_better(
+            "capacity.engine0.headroom", "fraction"
+        )
+        assert not lower_is_better(
+            "serve_capacity.engine0.headroom (cfg)", "fraction"
+        )
+        assert not lower_is_better(
+            "serve_capacity.engine0.service_rate_rps (cfg)", "req/s"
+        )
+
+    def test_collective_time_records_ingest_as_cost_rows(self):
+        rec = json.dumps(schema.stamp(
+            {"site": "zero_all_gather", "axis": "data",
+             "collective": "all_gather", "path": "train-zero1",
+             "mode": "sampled", "wire_bytes": 4096, "wall_ms": 1.5},
+            kind="collective_time",
+        ))
+        measured, unmeasured = load_bench_records([rec])
+        (label,) = measured
+        assert label == "collective_time.train-zero1.zero_all_gather wall_ms"
+        assert measured[label]["values"] == [1.5]
+        assert unmeasured == {}
+
+    def test_capacity_records_ingest_as_headroom_rows(self):
+        rec = json.dumps(schema.stamp(
+            {"engine": "engine0", "headroom": 0.8, "utilization": 0.2},
+            kind="capacity",
+        ))
+        measured, _ = load_bench_records([rec])
+        assert measured["capacity.engine0.headroom"]["values"] == [0.8]
+
+    def test_fixture_pair_timing_regression_and_unmeasured(self):
+        results = compare_files(
+            "tests/fixtures/colltime_base.jsonl",
+            "tests/fixtures/colltime_new.jsonl",
+        )
+        by = {r["metric"]: r for r in results}
+        assert by[
+            "collective_time.train-zero1.zero_psum_scatter wall_ms"
+        ]["status"] == "regression"
+        # Timing OFF in the new run: the site is UNMEASURED — missing,
+        # never a 0.0 that would read as an infinite speedup.
+        gone = by["collective_time.train-zero1.zero_all_gather wall_ms"]
+        assert gone["status"] == "unmeasured-in-new"
+        assert gone.get("new") is None
+        assert by["capacity.engine0.headroom"]["status"] == "regression"
+        assert by["serve_latency.queue_wait_ms (fixture)"][
+            "status"] == "regression"
+        assert by["serve_latency.device_ms (fixture)"]["status"] == "ok"
+        assert compare_main([
+            "tests/fixtures/colltime_base.jsonl",
+            "tests/fixtures/colltime_new.jsonl",
+        ]) == 1
+
+    def test_summary_capacity_nest_flattens(self):
+        rec = json.dumps(schema.stamp(
+            {"event": "summary", "config": "cfg", "n_requests": 4,
+             "engines": {"engine0": {"alive": True, "dispatches": 4}},
+             "capacity": {"engine0": {"headroom": 0.7,
+                                      "utilization": 0.3,
+                                      "service_rate_rps": 12.0}},
+             "latency_phases": {"queue_wait_ms": 3.0, "device_ms": 20.0}},
+            kind="serve",
+        ))
+        measured, _ = load_bench_records([rec])
+        assert measured["serve_capacity.engine0.headroom (cfg)"][
+            "values"] == [0.7]
+        assert measured["serve_latency.device_ms (cfg)"]["values"] == [
+            20.0
+        ]

@@ -306,3 +306,80 @@ class TestBarrierTracksAndFlows:
         assert [e["ph"] for e in flows] == ["s", "t", "t", "f"], flows
         ts = [e["ts"] for e in flows]
         assert ts == sorted(ts), flows
+
+
+class TestCapacityObservatoryTracks:
+    """ISSUE 13: collective_time records render as per-(site, axis)
+    counter tracks, capacity records as per-engine headroom counters,
+    and dispatch records with a phase split as NESTED slices — one
+    trace shows queue->pack->h2d->device->resolve end to end."""
+
+    def test_collective_time_counter_per_site_axis(self):
+        evs = to_trace_events([
+            schema.stamp(
+                {"site": "witness_cos_psum", "axis": "seq",
+                 "collective": "psum", "wall_ms": 1.25, "wall_time": 1.0},
+                kind="collective_time",
+            ),
+            schema.stamp(
+                {"site": "zero_all_gather", "axis": "data",
+                 "collective": "all_gather", "wall_ms": 2.5,
+                 "wall_time": 2.0},
+                kind="collective_time",
+            ),
+        ])
+        counters = [e for e in evs if e["ph"] == "C"]
+        assert {e["name"] for e in counters} == {
+            "collective:witness_cos_psum@seq",
+            "collective:zero_all_gather@data",
+        }
+        assert counters[0]["args"]["wall_ms"] == 1.25
+
+    def test_capacity_headroom_counter_per_engine(self):
+        evs = to_trace_events([
+            schema.stamp(
+                {"engine": "engine0", "headroom": 0.7, "wall_time": 1.0},
+                kind="capacity",
+            ),
+        ])
+        (c,) = [e for e in evs if e["ph"] == "C"]
+        assert c["name"] == "headroom:engine0"
+        assert c["args"]["headroom"] == 0.7
+
+    def test_dispatch_phase_split_renders_nested_slices(self):
+        rec = schema.stamp(
+            {"event": "dispatch", "engine": "engine0", "bucket": 2,
+             "n_valid": 2, "latency_ms": 10.0, "queue_wait_ms": 4.0,
+             "pack_ms": 1.0, "h2d_ms": 0.5, "device_ms": 4.0,
+             "resolve_ms": 0.5, "iters_run": 6, "trace_ids": None,
+             "wall_time": 5.0},
+            kind="serve",
+        )
+        evs = to_trace_events([rec])
+        slices = [e for e in evs if e["ph"] == "X"]
+        parent = [e for e in slices if e["name"].startswith("dispatch:")]
+        phases = [e for e in slices if not e["name"].startswith("dispatch")]
+        assert len(parent) == 1 and len(phases) == 5
+        (p,) = parent
+        assert p["dur"] == 10.0 * 1e3  # ms -> us
+        assert [e["name"] for e in sorted(phases, key=lambda e: e["ts"])] \
+            == ["queue_wait", "pack", "h2d", "device", "resolve"]
+        # The phases tile the parent slice exactly.
+        assert sum(e["dur"] for e in phases) == p["dur"]
+        first = min(phases, key=lambda e: e["ts"])
+        assert first["ts"] == p["ts"]
+        # The dispatch instant (trace-flow anchor) still renders.
+        assert any(
+            e["ph"] == "i" and e["name"] == "serve:dispatch" for e in evs
+        )
+
+    def test_null_phases_render_no_slices(self):
+        rec = schema.stamp(
+            {"event": "dispatch", "engine": "engine0", "bucket": 2,
+             "n_valid": 2, "latency_ms": 10.0, "queue_wait_ms": None,
+             "pack_ms": None, "h2d_ms": None, "device_ms": None,
+             "resolve_ms": None, "trace_ids": None, "wall_time": 5.0},
+            kind="serve",
+        )
+        evs = to_trace_events([rec])
+        assert [e for e in evs if e["ph"] == "X"] == []
