@@ -929,6 +929,178 @@ def run_ragged(cfg, scfg, label: str, *, n_streams: int, n_frames: int,
     return waste
 
 
+def run_ramp(cfg, scfg, label: str, *, profile: str = "4x100,56x0,12x200",
+             max_engines: int = 2) -> dict:
+    """The ELASTIC ramp (docs/SERVING.md "Elastic serving"): an
+    offered-load ramp (low -> spike -> low) driven through the real
+    autoscaler. The fleet starts at ONE engine; the spike must force a
+    scale-out (spawn + warmup off the hot path + admission), the
+    post-spike calm a scale-in (graceful drain + device release) — and
+    every ticket must resolve: the bench ASSERTS tickets-conserved
+    (served+shed+failed == requests with failed == 0) and emits the
+    fleet-size TIMELINE row the CI elastic gate reads:
+
+      * serve_ramp_n_engines_peak (count; the timeline rides the row);
+      * serve_ramp_spawn_ms (ms — the scale-out's off-hot-path price);
+      * serve_ramp_p99 (spike | tail, ms) — recovery made a number;
+      * serve_ramp_tickets_conserved (1.0 only when conservation held).
+    """
+    import dataclasses
+
+    from glom_tpu.serve.batcher import DynamicBatcher, ShedError
+    from glom_tpu.serve.cli import parse_ramp
+    from glom_tpu.serve.elastic import Autoscaler, resolve_policy
+    from glom_tpu.serve.engine import InferenceEngine
+    from glom_tpu.telemetry.sinks import emit
+
+    import numpy as np
+
+    phases = parse_ramp(profile)
+    scfg = dataclasses.replace(
+        scfg,
+        elastic=True, min_engines=1, max_engines=max_engines,
+        elastic_low_water=0.5, elastic_high_water=0.8,
+        elastic_dwell_s=0.1, elastic_cooldown_s=0.5,
+        elastic_window_s=2.0, elastic_interval_s=0.05,
+        elastic_p99_ms=100.0,
+    )
+    engines = _make_engines(cfg, scfg, 1)
+    params = engines[0].params
+    for eng in engines:
+        eng.warmup()
+    rng = np.random.default_rng(7)
+    shape = (cfg.channels, cfg.image_size, cfg.image_size)
+    seq = [len(engines)]
+
+    def factory():
+        i = seq[0]
+        eng = InferenceEngine(cfg, scfg, params=params, name=f"engine{i}")
+        seq[0] += 1
+        return eng
+
+    lat_by_phase: dict = {}
+    n_total = sum(n for n, _ in phases)
+    with DynamicBatcher(engines=engines) as batcher:
+        scaler = Autoscaler(
+            batcher, factory, policy=resolve_policy(scfg),
+            rules={"p99_ms": scfg.elastic_p99_ms},
+            interval_s=scfg.elastic_interval_s,
+        ).start()
+        try:
+            tickets = []
+            for phase, (n, gap) in enumerate(phases):
+                for _ in range(n):
+                    if gap and tickets:
+                        time.sleep(gap)
+                    try:
+                        # HARD traffic (100x scale — the convergence-
+                        # depth lever): every request runs near the full
+                        # budget, so the spike actually queues instead
+                        # of evaporating on a fast host.
+                        tickets.append(
+                            (phase, batcher.submit(
+                                (100.0 * rng.normal(size=shape)).astype(
+                                    np.float32
+                                )
+                            ))
+                        )
+                    except ShedError:
+                        tickets.append((phase, None))
+            for phase, t in tickets:
+                if t is None:
+                    continue
+                try:
+                    _, _, latency_s = t.result(timeout=600.0)
+                except Exception:
+                    continue
+                lat_by_phase.setdefault(phase, []).append(1e3 * latency_s)
+            # Settle: wait (bounded) for the post-spike scale-in.
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if scaler.record()["n_scale_ins"] >= 1:
+                    break
+                time.sleep(0.05)
+        finally:
+            scaler.stop()
+        summary = batcher.summary_record()
+    el = summary.get("elastic") or {}
+    conserved = (
+        summary["n_served"] + summary["n_shed"] + summary["n_failed"]
+        == summary["n_requests"] == n_total
+        and summary["n_failed"] == 0
+    )
+    emit(
+        {
+            "event": "ramp_summary",
+            "config": label,
+            "profile": profile,
+            "n_requests": n_total,
+            "n_served": summary["n_served"],
+            "n_shed": summary["n_shed"],
+            "n_failed": summary["n_failed"],
+            "elastic": el,
+        },
+        kind="serve",
+    )
+    emit(
+        {
+            "metric": f"serve_ramp_n_engines_peak ({label})",
+            "value": el.get("n_engines_peak"),
+            "unit": "count",
+            "n_scale_outs": el.get("n_scale_outs"),
+            "n_scale_ins": el.get("n_scale_ins"),
+            # THE timeline row: [t_rel_s, n_engines] per fleet change —
+            # capacity following load, as data (perfetto renders it as
+            # the fleet counter track).
+            "timeline": el.get("timeline"),
+        }
+    )
+    if el.get("spawn_ms_mean") is not None:
+        emit(
+            {
+                "metric": f"serve_ramp_spawn_ms ({label})",
+                "value": el["spawn_ms_mean"],
+                "unit": "ms",
+                "spawn_ms_max": el.get("spawn_ms_max"),
+            }
+        )
+    q = lambda xs, f: sorted(xs)[min(len(xs) - 1, int(f * len(xs)))]
+    spike = lat_by_phase.get(1, [])
+    tail_all = lat_by_phase.get(len(phases) - 1, [])
+    # Steady-state half = the CHRONOLOGICALLY later half (tail_all is in
+    # submission order): the first tail requests are submitted while the
+    # spike backlog still drains, and their latency is the spike's
+    # shadow, not the scaled fleet's.
+    tail = tail_all[len(tail_all) // 2:]
+    for arm, vals in (("spike", spike), ("tail", tail)):
+        if vals:
+            emit(
+                {
+                    "metric": f"serve_ramp_p99 ({arm}, {label})",
+                    "value": round(q(vals, 0.99), 3),
+                    "unit": "ms",
+                    "n": len(vals),
+                }
+            )
+    emit(
+        {
+            "metric": f"serve_ramp_tickets_conserved ({label})",
+            "value": 1.0 if conserved else 0.0,
+            "unit": "count",
+        }
+    )
+    assert conserved, (
+        "ramp tickets NOT conserved: "
+        f"{ {k: summary[k] for k in ('n_requests', 'n_served', 'n_shed', 'n_failed')} }"
+    )
+    return {
+        "elastic": el,
+        "conserved": conserved,
+        "p99_spike": q(spike, 0.99) if spike else None,
+        "p99_tail": q(tail, 0.99) if tail else None,
+    }
+
+
 def run_trace_ab(cfg, scfg, label: str, *, n_requests: int,
                  n_engines: int = 1, repeats: int = 3) -> dict:
     """Request-tracing overhead A/B (docs/OBSERVABILITY.md, Request
@@ -1194,6 +1366,16 @@ def main(argv=None) -> int:
                     "trace stamping on vs off, emitting the per-arm mean "
                     "latency and serve_trace_overhead in percent — the "
                     "<2% bar (docs/OBSERVABILITY.md, Request tracing)")
+    ap.add_argument("--ramp", action="store_true",
+                    help="run the ELASTIC ramp INSTEAD of the load sweep: "
+                    "an offered-load ramp (low -> spike -> low) through "
+                    "the real autoscaler — the spike must scale the "
+                    "fleet OUT, the calm back IN, with every ticket "
+                    "conserved; emits the n_engines timeline row and "
+                    "spawn/p99 costs (docs/SERVING.md, Elastic serving)")
+    ap.add_argument("--ramp-profile", default="4x100,56x0,12x200",
+                    metavar="N1xG1,...",
+                    help="ramp mode: requests x gap_ms per phase")
     ap.add_argument("--phase-ab", action="store_true",
                     help="run the latency-decomposition overhead A/B: the "
                     "same traffic with the dispatch phase split on vs "
@@ -1270,6 +1452,9 @@ def main(argv=None) -> int:
     if scfg.mesh_data > 1 or scfg.mesh_seq > 1:
         label = f"{label}, mesh={scfg.mesh_data}x{scfg.mesh_seq}"
     del jax  # imported to fail fast before any measurement if broken
+    if args.ramp:
+        run_ramp(cfg, scfg, label, profile=args.ramp_profile)
+        return 0
     if args.trace_ab:
         run_trace_ab(
             cfg, scfg, label,

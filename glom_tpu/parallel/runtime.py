@@ -228,6 +228,21 @@ def make_engine_meshes(
     return [make_serve_mesh(scfg, g) for g in groups[:n_engines]]
 
 
+def engine_mesh_for(
+    scfg, index: int, devices: Optional[list] = None
+):
+    """The mesh for ONE engine replica by fleet index — the elastic
+    scale-out's device-group resolution (serve/elastic.py): a spawned
+    replica takes the NEXT contiguous group the static partitioning
+    would have given it, so a fleet that grew at runtime occupies
+    exactly the devices `--engines N` would have. Raises (loudly — the
+    autoscaler's spawn_rollback path) when the device pool has no group
+    `index` left; returns None on the single-device route."""
+    if index < 0:
+        raise ValueError(f"index {index} must be >= 0")
+    return make_engine_meshes(scfg, index + 1, devices=devices)[index]
+
+
 class DistributedTrainer:
     """Sharded trainer over an explicit device mesh.
 

@@ -59,7 +59,7 @@ def build_parser() -> argparse.ArgumentParser:
         "--scenario",
         choices=[
             "kill-train", "preempt-train", "preempt-pod",
-            "kill-serve", "rejoin-serve",
+            "kill-serve", "rejoin-serve", "ramp-serve",
         ],
         default="kill-train",
         help="kill-train = SIGKILL mid-run (uncatchable; resume must come "
@@ -76,7 +76,13 @@ def build_parser() -> argparse.ArgumentParser:
         "evidence trail; rejoin-serve = kill engine 0 for a BOUNDED fault "
         "window, then require probation to re-admit it (stamped "
         "engine_rejoin) and the run to finish with engine 0 alive and "
-        "serving again",
+        "serving again; ramp-serve = drive a traffic ramp (low -> spike "
+        "-> low) through the ELASTIC micro-server and require the "
+        "autoscaler to scale OUT under the spike and back IN after it, "
+        "with zero failed tickets, exact request conservation across "
+        "both transitions, p99 recovered after the scale-out, and the "
+        "full decision->spawn->admission-open and decision->drain->"
+        "device-release chains present in the JSONL evidence alone",
     )
     p.add_argument("--dir", required=True, help="scenario working directory")
     p.add_argument("--preset", default="mnist")
@@ -99,6 +105,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--engines", type=int, default=2, metavar="N",
         help="kill-serve: engine replicas behind the shared batcher "
         "(engine 0 is the one killed; >= 2 so a sibling exists)",
+    )
+    p.add_argument(
+        "--ramp", default="4x100,56x0,12x250", metavar="N1xG1,...",
+        help="ramp-serve: the offered-load profile (requests x gap_ms "
+        "per phase; phase 1 is the spike that must force scale-out)",
     )
     p.add_argument(
         "--hosts", type=int, default=2, metavar="N",
@@ -381,6 +392,303 @@ def run_kill_serve(args) -> int:
     return 0
 
 
+def run_ramp_serve(args) -> int:
+    """The elastic-serving chaos: a real micro-server run under a
+    traffic RAMP (low -> spike -> low) with the autoscaler on, proven
+    from the JSONL evidence alone (docs/RESILIENCE.md):
+
+      * the spike forces at least one SCALE-OUT and the post-spike calm
+        at least one SCALE-IN (the `elastic` summary nest + timeline);
+      * ZERO failed tickets and EXACT conservation across both
+        transitions: every submitted request resolves (or sheds with a
+        stamped reason — none at this profile), n_served + n_shed +
+        n_failed == n_requests with n_failed == 0;
+      * the spawned engine received NO admitted work before its warmup
+        precompile completed: every warmup record of the spawned engine
+        precedes its admission_open, and no dispatch on it precedes
+        admission_open;
+      * the decision chains are COMPLETE and ordered, joined by
+        decision_id: scale_out_decision -> scale_out -> admission_open,
+        and scale_in_decision -> drain_begin -> drain_flush ->
+        drain_migrate -> drain_release (the engine_release record is the
+        device-release leaf);
+      * p99 RECOVERED after the scale-out: the tail phase's p99 sits
+        strictly below the spike phase's (per-request latencies keyed by
+        request id — the ramp phases are id ranges);
+      * every resolved trace tree still conserves exactly (the v6
+        contract holds across elastic transitions), and the stream
+        schema-lints clean.
+    """
+    workdir = Path(args.dir)
+    workdir.mkdir(parents=True, exist_ok=True)
+    paths = {
+        "metrics": workdir / "serve_metrics.jsonl",
+        "log": workdir / "serve_run.log",
+    }
+    paths["metrics"].unlink(missing_ok=True)
+    phases = []
+    for part in args.ramp.split(","):
+        n_s, _, gap_s = part.partition("x")
+        phases.append((int(n_s), float(gap_s)))
+    total = sum(n for n, _ in phases)
+    cmd = [
+        sys.executable, "-u", "-m", "glom_tpu.serve",
+        "--preset", args.preset,
+        "--ramp", args.ramp,
+        "--elastic",
+        "--min-engines", "1",
+        "--max-engines", "2",
+        "--elastic-low-water", "0.5",
+        "--elastic-high-water", "0.8",
+        "--elastic-dwell", "0.15",
+        "--elastic-cooldown", "0.5",
+        "--elastic-interval", "0.05",
+        "--elastic-window", "2.0",
+        "--elastic-p99-ms", "150",
+        "--elastic-settle", "30",
+        "--iters", "auto",
+        "--buckets", "1,2,4",
+        "--max-batch", "4",
+        "--out", str(paths["metrics"]),
+    ]
+    _note("chaos ramp-serve: launching elastic micro-server",
+          cmd=" ".join(cmd), workdir=str(workdir), total_requests=total)
+    proc = _spawn(cmd, paths["log"])
+    try:
+        rc = proc.wait(timeout=args.timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait(timeout=30.0)
+        _emit(
+            {"error": "serve-hung", "value": None,
+             "note": f"elastic serve worker exceeded {args.timeout}s — a "
+             "hang IS the failure mode this harness exists to catch"},
+            kind="error",
+        )
+        return 1
+    failures: List[str] = []
+    if rc != 0:
+        failures.append(
+            f"serve worker rc={rc} (an elastic ramp must serve every "
+            f"ticket); see {paths['log']}"
+        )
+    recs = _records(paths["metrics"])
+
+    def stream_pos(pred) -> List[int]:
+        return [i for i, r in enumerate(recs) if pred(r)]
+
+    # -- fleet transitions happened at all ---------------------------------
+    outs = [r for r in recs if r.get("event") == "scale_out"]
+    ins = [r for r in recs if r.get("event") == "drain_release"]
+    if not outs:
+        failures.append("no scale_out event: the spike never grew the fleet")
+    if not ins:
+        failures.append("no drain_release event: the calm never shrank it")
+    # -- zero failed tickets + exact conservation --------------------------
+    summaries = [r for r in recs if r.get("event") == "summary"]
+    if not summaries:
+        failures.append("no serve summary record")
+    else:
+        s = summaries[-1]
+        if s.get("n_failed"):
+            failures.append(f"n_failed={s.get('n_failed')} — a ticket "
+                            "FAILED across an elastic transition")
+        if (
+            (s.get("n_served") or 0) + (s.get("n_shed") or 0)
+            + (s.get("n_failed") or 0)
+        ) != s.get("n_requests"):
+            failures.append(
+                "request conservation broken: served+shed+failed != "
+                f"requests in {s}"
+            )
+        if s.get("n_served") != total:
+            failures.append(
+                f"{s.get('n_served')}/{total} requests served (this "
+                "profile must shed nothing)"
+            )
+        el = s.get("elastic") or {}
+        if not el.get("n_scale_outs") or not el.get("n_scale_ins"):
+            failures.append(f"elastic summary does not show a full "
+                            f"out+in cycle: {el}")
+        timeline = el.get("timeline") or []
+        if el.get("n_engines_peak", 0) < 2 or el.get("n_engines", 0) != 1:
+            failures.append(
+                f"fleet timeline does not ramp 1 -> 2 -> 1: {timeline}"
+            )
+    # -- decision -> spawn -> admission chain ------------------------------
+    for out in outs:
+        did = out.get("decision_id")
+        eng = out.get("engine")
+        dec = stream_pos(
+            lambda r: r.get("event") == "scale_out_decision"
+            and r.get("decision_id") == did
+        )
+        adm = stream_pos(
+            lambda r: r.get("event") == "admission_open"
+            and r.get("decision_id") == did
+        )
+        here = stream_pos(
+            lambda r: r.get("event") == "scale_out"
+            and r.get("decision_id") == did
+        )
+        if not (dec and adm and dec[0] < here[0] < adm[0]):
+            failures.append(
+                f"scale-out chain for decision {did} is incomplete or "
+                "out of order (want decision < scale_out < admission_open)"
+            )
+            continue
+        if not out.get("spawn_ms"):
+            failures.append(f"scale_out {did} carries no spawn_ms")
+        if not (out.get("signal") or {}).get("rule"):
+            failures.append(f"scale_out {did} embeds no triggering signal")
+        # Admission-after-precompile: every warmup of the spawned engine
+        # precedes admission_open, and no dispatch on it precedes it.
+        warmups = stream_pos(
+            lambda r: r.get("event") == "warmup" and r.get("engine") == eng
+        )
+        if not warmups:
+            failures.append(f"spawned engine {eng} stamped no warmup "
+                            "compiles — admission opened unwarmed")
+        elif max(warmups) > adm[0]:
+            failures.append(
+                f"engine {eng} warmup compiles continued past "
+                "admission_open — precompile did not complete first"
+            )
+        early = stream_pos(
+            lambda r: r.get("event") == "dispatch" and r.get("engine") == eng
+        )
+        if early and early[0] < adm[0]:
+            failures.append(
+                f"engine {eng} dispatched BEFORE admission_open — work "
+                "was admitted before the precompile finished"
+            )
+    # -- decision -> drain -> release chain --------------------------------
+    drain_chain = (
+        "scale_in_decision", "drain_begin", "drain_flush",
+        "drain_migrate", "drain_release",
+    )
+    for rel in ins:
+        did = rel.get("decision_id")
+        pos = []
+        for evname in drain_chain:
+            at = stream_pos(
+                lambda r, e=evname: r.get("event") == e
+                and r.get("decision_id") == did
+            )
+            if not at:
+                failures.append(
+                    f"drain chain for decision {did} is missing {evname}"
+                )
+                break
+            pos.append(at[0])
+        else:
+            if pos != sorted(pos):
+                failures.append(
+                    f"drain chain for decision {did} is out of order: "
+                    f"{dict(zip(drain_chain, pos))}"
+                )
+            # engine_release is stamped by the engine itself right at
+            # the device free, which the scaler runs BETWEEN the drain
+            # machine's last event and its own drain_release — so the
+            # leaf must sit strictly inside that window, not merely
+            # exist somewhere (a release deferred to shutdown would
+            # break the decision->drain->device-release chain).
+            eng = rel.get("engine")
+            released = stream_pos(
+                lambda r: r.get("event") == "engine_release"
+                and r.get("engine") == eng
+            )
+            if not released:
+                failures.append(
+                    f"drained engine {eng} never stamped "
+                    "engine_release (devices not freed)"
+                )
+            elif not any(pos[-2] < p < pos[-1] for p in released):
+                failures.append(
+                    f"engine_release for {eng} at stream position(s) "
+                    f"{released} sits outside the drain_migrate.."
+                    f"drain_release window ({pos[-2]}, {pos[-1]}) — "
+                    "devices were not freed as part of the drain chain"
+                )
+    # -- p99 recovered after scale-out -------------------------------------
+    lat = {
+        r.get("id"): r.get("latency_ms")
+        for r in recs
+        if r.get("event") == "response" and r.get("ok")
+        and isinstance(r.get("latency_ms"), (int, float))
+    }
+    spike_lo = phases[0][0]
+    spike_hi = spike_lo + phases[1][0]
+    spike = sorted(v for k, v in lat.items() if spike_lo <= k < spike_hi)
+    # Recovery is judged on the tail's STEADY-STATE half: the first tail
+    # requests are submitted while the spike backlog still drains, so
+    # their latency is the spike's shadow, not the scaled fleet's.
+    tail_ids = sorted(k for k in lat if k >= spike_hi)
+    tail_ids = tail_ids[len(tail_ids) // 2:]
+    tail = sorted(lat[k] for k in tail_ids)
+    if spike and tail:
+        q = lambda xs, f: xs[min(len(xs) - 1, int(f * len(xs)))]
+        p99_spike, p99_tail = q(spike, 0.99), q(tail, 0.99)
+        if p99_tail >= p99_spike:
+            failures.append(
+                f"p99 did not recover after scale-out: spike {p99_spike} "
+                f"ms vs tail {p99_tail} ms"
+            )
+    else:
+        failures.append("missing spike/tail latency evidence for the "
+                        "p99-recovery check")
+        p99_spike = p99_tail = None
+    # Breach evidence: the scaler's in-process monitor stamped at least
+    # one upper-bound breach (the spike was SEEN, not just survived)...
+    breaches = [r for r in recs if r.get("kind") == "slo_breach"]
+    if outs and not breaches and not any(
+        (o.get("signal") or {}).get("rule") == "headroom" for o in outs
+    ):
+        failures.append("no slo_breach records and no headroom-signal "
+                        "decision — what triggered the scale-out?")
+    # -- trace conservation across the transitions -------------------------
+    from glom_tpu.telemetry import tracectx
+
+    traces = tracectx.list_traces(recs)
+    resolved_traces = [
+        t for t, info in sorted(traces.items()) if info["resolved"]
+    ]
+    if len(resolved_traces) != total:
+        failures.append(
+            f"{len(resolved_traces)}/{total} resolved trace trees"
+        )
+    bad = []
+    for t in resolved_traces:
+        check = tracectx.conservation(recs, t)
+        if not check["ok"]:
+            bad.append(f"{t}: {check.get('why', '?')}")
+    if bad:
+        failures.append(
+            "trace conservation broken across the elastic transitions: "
+            + "; ".join(bad[:3])
+        )
+    failures.extend(_lint([paths["metrics"]]))
+    summary = {
+        "event": "chaos-summary",
+        "scenario": args.scenario,
+        "ok": not failures,
+        "requests": total,
+        "n_scale_outs": len(outs),
+        "n_scale_ins": len(ins),
+        "n_breaches": len(breaches),
+        "p99_spike_ms": p99_spike,
+        "p99_tail_ms": p99_tail,
+        "n_traces_resolved": len(resolved_traces),
+        "failures": failures[:10],
+    }
+    _emit(summary, kind="summary")
+    if failures:
+        for f in failures:
+            print(f"CHAOS FAIL: {f}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def _pod_worker_cmd(args, workdir: Path, host: int) -> List[str]:
     return [
         sys.executable, "-u", "-m", "glom_tpu.train.cli",
@@ -643,6 +951,8 @@ def run_preempt_pod(args) -> int:
 
 
 def run_scenario(args) -> int:
+    if args.scenario == "ramp-serve":
+        return run_ramp_serve(args)
     if args.scenario in ("kill-serve", "rejoin-serve"):
         return run_kill_serve(args)
     if args.scenario == "preempt-pod":

@@ -258,6 +258,31 @@ def dispatch_fault(
     return hook
 
 
+def spawn_fault(
+    plan: FaultPlan,
+    site: str = "engine-spawn",
+    *,
+    exc_type: Callable[[str], BaseException] = InjectedFault,
+):
+    """Scale-out spawn-failure injector for the elastic autoscaler
+    (serve/elastic.Autoscaler(spawn_hook=...)): raises on scheduled
+    spawn ATTEMPTS before the engine factory runs — the scaler must
+    ROLL BACK loudly (stamped spawn_rollback, no registration, cooldown
+    still charged so a persistent fault cannot hot-spin spawns) instead
+    of admitting a half-built replica. Every injection is a stamped
+    "fault" event, so the ramp-serve chaos run reconciles rollbacks
+    against exactly what was injected."""
+
+    def hook(ctx: dict) -> None:
+        if plan.fires(
+            site,
+            **{k: (ctx or {}).get(k) for k in ("attempt", "n_engines")},
+        ):
+            raise exc_type(f"injected spawn fault at {site}")
+
+    return hook
+
+
 def queue_stall(
     plan: FaultPlan,
     site: str = "queue-stall",

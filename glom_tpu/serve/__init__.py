@@ -23,6 +23,10 @@ between that checkpoint and traffic (docs/SERVING.md). Layers:
                  (one preallocated [pages, page_tokens, L, d] buffer per
                  engine + host page table) behind the zero-transfer warm
                  path and ragged admission
+    elastic    — ElasticPolicy + Autoscaler: the SLO-driven control loop
+                 that spawns fully-warmed replicas at runtime and
+                 gracefully drains them back out (capacity follows load
+                 — docs/SERVING.md "Elastic serving")
     early_exit — glom_forward_auto / glom_forward_tiered: lax.while_loop
                  over column updates with the consensus-agreement delta
                  as the stopping witness (iters="auto"; the tiered form
@@ -45,6 +49,10 @@ _EXPORTS = {
     "QueueFullError": "batcher",
     "ShedError": "batcher",
     "Ticket": "batcher",
+    "Autoscaler": "elastic",
+    "ElasticPolicy": "elastic",
+    "SCALE_EVENTS": "elastic",
+    "resolve_policy": "elastic",
     "ColumnCache": "column_cache",
     "PageHit": "column_cache",
     "column_state_bytes": "column_cache",
@@ -65,8 +73,8 @@ _EXPORTS = {
     "emit_serve": "events",
     "stamp_serve": "events",
 }
-_SUBMODULES = ("batcher", "cli", "column_cache", "early_exit", "engine",
-               "events", "paged_columns")
+_SUBMODULES = ("batcher", "cli", "column_cache", "early_exit", "elastic",
+               "engine", "events", "paged_columns")
 
 __all__ = sorted([*_EXPORTS, *_SUBMODULES])
 

@@ -91,6 +91,21 @@ and leave evidence, never hang):
     DOWN one reversible rung at a time — shedding is the floor of the
     ladder, not the only move.
 
+ELASTIC FLEET (serve/elastic.py, docs/SERVING.md "Elastic serving"): the
+fleet is no longer fixed at construction — `add_engine()` registers a
+fully-warmed replica at runtime (admission opens the moment its worker
+starts), and `drain_engine()` runs the graceful scale-in state machine:
+DRAINING is a first-class engine state distinct from dead (a draining
+worker stops pulling new work, finishes its in-flight dispatch, hands its
+affinity queue back to the shared queue, and exits — never into
+probation), the engine's cache sessions migrate to a sibling pool (or
+invalidate with a stamped `drain` reason), and the engine leaves the
+fleet as DRAINED — excluded from capacity records (a permanent 0.0
+headroom would re-trigger the very autoscaler that drained it) but
+retained in the summary's engines nest as evidence. With no autoscaler
+attached none of this machinery runs and the static fleet is
+byte-for-byte the pre-elastic contract.
+
 Host phases ride tracing.spans (SERVE_PHASES: serve_enqueue, serve_batch,
 serve_dispatch, serve_fetch), aggregated per phase and drained by
 span_records() — the same <1%-overhead rollup form the fit loop uses.
@@ -542,6 +557,25 @@ class DynamicBatcher:
         self._iters_total = 0
         self._counter_lock = threading.Lock()
         self._seq = 0
+        # Elastic fleet state (serve/elastic.py). DRAINING engines stop
+        # admitting but are NOT dead (their in-flight work flushes);
+        # DRAINED engines have left the fleet voluntarily — kept in
+        # `engines`/`_engine_state` as evidence husks (index math and the
+        # summary's engines nest stay stable) but excluded from capacity
+        # records, worker spawns, and the failover fleet-size accounting.
+        # Both ride _engine_lock with the rest of the engine state.
+        self._draining: set = set()
+        self._drained: set = set()
+        # Affinity items a draining worker handed back to the shared
+        # queue on its way out (read by drain_engine's flush event).
+        self._drain_handoff: dict = {}
+        # Event taps (the autoscaler's in-process SLO monitor rides one):
+        # each stamped serve record fans out to every tap after delivery.
+        # A tap must never take down a worker — exceptions are swallowed.
+        self._taps: List = []
+        # The attached Autoscaler (None = static fleet, the default):
+        # summary_record() nests its rollup under "elastic".
+        self._elastic = None
 
     @staticmethod
     def _ename(eng, i: int) -> str:
@@ -556,6 +590,9 @@ class DynamicBatcher:
             self._stop.clear()
             for i, eng in enumerate(self.engines):
                 name = self._ename(eng, i)
+                with self._engine_lock:
+                    if name in self._drained:
+                        continue  # a drained husk never serves again
                 t = threading.Thread(
                     target=self._worker,
                     args=(eng, name),
@@ -604,7 +641,7 @@ class DynamicBatcher:
                 try:
                     got = self._cont_q.get_nowait()  # a continuation group
                 except queue.Empty:
-                    for aq in self._aff_q.values():
+                    for aq in list(self._aff_q.values()):
                         try:
                             got = [aq.get_nowait()]
                             break
@@ -630,8 +667,40 @@ class DynamicBatcher:
     # -- submission --------------------------------------------------------
 
     def _alive_engines(self) -> List[str]:
+        """Engines that can take NEW work: alive and not draining (a
+        draining engine still flushes its in-flight dispatch, but
+        admission, affinity routing, and the ladder-shed vote must all
+        stop seeing it)."""
         with self._engine_lock:
-            return [n for n, st in self._engine_state.items() if st["alive"]]
+            return [
+                n for n, st in self._engine_state.items()
+                if st["alive"] and n not in self._draining
+            ]
+
+    def n_active_engines(self) -> int:
+        """The live serving fleet size (alive, not draining) — the count
+        the elastic policy clamps against."""
+        return len(self._alive_engines())
+
+    def engine_by_name(self, name: str):
+        idx = self._engine_index.get(name)
+        return self.engines[idx] if idx is not None else None
+
+    def add_event_tap(self, tap) -> None:
+        """Subscribe `tap(stamped_record)` to every record this batcher
+        emits — the autoscaler's in-process SLO monitor reads the same
+        stream `telemetry watch` would tail, with no file between.
+        Registration is SETUP-time (before traffic): the list is
+        append-only and the emit path reads a snapshot, so the hot path
+        pays no lock for the common zero-tap case."""
+        self._taps.append(tap)
+
+    def attach_elastic(self, scaler) -> None:
+        """Attach the Autoscaler whose rollup summary_record() nests
+        under "elastic" (serve/elastic.py calls this; a static fleet
+        never does, keeping the summary shape byte-for-byte)."""
+        with self._counter_lock:
+            self._elastic = scaler
 
     def submit(self, img, session_id=None) -> Ticket:
         """Enqueue one [c, H, W] request. Sheds immediately (raises) when
@@ -688,7 +757,7 @@ class DynamicBatcher:
                     **detail,
                 )
             live_ladders = [
-                self._ladders[n] for n in (alive or self._ladders)
+                self._ladders[n] for n in (alive or list(self._ladders))
                 if self._ladders.get(n) is not None
             ]
             if live_ladders:
@@ -741,14 +810,20 @@ class DynamicBatcher:
                 except queue.Full:
                     pass  # fall back to the shared queue
                 if placed:
-                    # Race with a concurrent death: the failure handler
-                    # sets alive=False BEFORE draining the affinity
-                    # queue, so either its drain saw this put, or we see
-                    # the flag here and drain ourselves — the ticket can
-                    # never strand in a queue no worker reads.
+                    # Race with a concurrent death OR drain: the failure
+                    # handler sets alive=False (and drain_engine sets
+                    # the draining flag) BEFORE draining the affinity
+                    # queue, so either that drain saw this put, or we
+                    # see the flag here and drain ourselves — the
+                    # ticket can never strand in a queue no worker
+                    # reads (a draining worker has already stopped
+                    # reading its queue by the time the flag is set).
                     with self._engine_lock:
-                        still_alive = self._engine_state[target]["alive"]
-                    if not still_alive:
+                        serving = (
+                            self._engine_state[target]["alive"]
+                            and target not in self._draining
+                        )
+                    if not serving:
                         self._drain_affinity(target)
             if not placed:
                 try:
@@ -992,6 +1067,19 @@ class DynamicBatcher:
             with self._engine_lock:
                 if not self._engine_state[engine_name]["alive"]:
                     break  # dead: queued work drains to siblings
+                if engine_name in self._draining:
+                    # Voluntary DRAIN (distinct from death — never into
+                    # probation): the in-flight dispatch already
+                    # completed (the flag is checked at loop top), so
+                    # hand the affinity queue back to the shared queue
+                    # and exit; stragglers this worker produced sit in
+                    # the SHARED continuation queue for the siblings.
+                    handed = self._drain_affinity(engine_name)
+                    with self._counter_lock:
+                        self._drain_handoff[engine_name] = (
+                            self._drain_handoff.get(engine_name, 0) + handed
+                        )
+                    return
             self._ladder_observe(engine_name)
             # Continuations first: stragglers are the OLDEST requests in
             # the system; waiting fresh rows fold into their bucket's pad
@@ -1014,8 +1102,22 @@ class DynamicBatcher:
             return  # normal stop-drain exit
         # Dead-engine exit: hand off to probation when rejoin is enabled
         # (N consecutive successful health dispatches re-admit the
-        # engine); otherwise death stays terminal until restart.
-        if self._rejoin_threshold > 0 and not self._stop.is_set():
+        # engine); otherwise death stays terminal until restart. A
+        # DRAINED/DRAINING engine never probes: a drain whose in-flight
+        # flush outlived the join timeout reaches here with alive
+        # already False — its devices are being released, and a rejoin
+        # would re-admit a husk (the flag check below is the guard;
+        # _start_probation re-checks under the lock).
+        with self._engine_lock:
+            voluntary = (
+                engine_name in self._drained
+                or engine_name in self._draining
+            )
+        if (
+            self._rejoin_threshold > 0
+            and not self._stop.is_set()
+            and not voluntary
+        ):
             self._start_probation(engine, engine_name)
 
     # -- engine rejoin (probation re-admit) --------------------------------
@@ -1035,6 +1137,11 @@ class DynamicBatcher:
             st = self._engine_state[engine_name]
             if st["alive"] or st["probation"]:
                 return
+            if (
+                engine_name in self._drained
+                or engine_name in self._draining
+            ):
+                return  # voluntary exit: released husks never probe back
             with self._counter_lock:
                 if self._stop.is_set():
                     return
@@ -1121,6 +1228,256 @@ class DynamicBatcher:
         with self._engine_lock:
             self._engine_state[engine_name]["probation"] = False
 
+    # -- elastic fleet (serve/elastic.py) ----------------------------------
+
+    def add_engine(self, engine, *, name: Optional[str] = None) -> str:
+        """Register a NEW engine replica at runtime — the autoscaler's
+        scale-out landing. The engine must arrive FULLY WARMED: admission
+        opens the instant its worker starts (the scaler runs warmup()
+        before calling this — test-pinned: a spawned engine receives zero
+        admitted work before its precompile completes). Registration
+        mirrors __init__ per-engine setup: ladder (resolved from the
+        engine's own ServeConfig), affinity queue, engine state, page
+        pool (pages-mode fleets stay homogeneous — loudly). Returns the
+        engine's fleet name."""
+        ename = name or getattr(engine, "name", None)
+        pool = getattr(engine, "pool", None)
+        pages_mode = (
+            self.cache is not None
+            and getattr(self.cache, "pools", None) is not None
+        )
+        if pages_mode and pool is None:
+            raise ValueError(
+                "pages-mode fleet: a runtime-added engine must carry a "
+                "page pool (mixed pool/pool-less fleets are unsupported)"
+            )
+        # Resolve the engine's ladder OUTSIDE the locks (pure config).
+        ladder = None
+        escfg = getattr(engine, "scfg", None)
+        if (
+            escfg is not None
+            and getattr(escfg, "ladder", False)
+            and getattr(engine, "cfg", None) is not None
+        ):
+            from glom_tpu.resilience.ladder import DegradationLadder
+
+            ladder = DegradationLadder.from_config(
+                engine.cfg, escfg, writer=self.writer
+            )
+        # Phase 1 — RESERVE the name: the state entry exists (duplicate
+        # registration is impossible from here) but reads alive=False +
+        # probation=True, so admission, affinity routing, drain, and the
+        # capacity stream (state "probation" — excluded from the
+        # headroom min) all ignore the half-registered engine.
+        with self._engine_lock:
+            if ename is None:
+                k = len(self._engine_state)
+                while f"engine{k}" in self._engine_state:
+                    k += 1
+                ename = f"engine{k}"
+            elif ename in self._engine_state:
+                raise ValueError(
+                    f"engine name {ename!r} already registered"
+                )
+            self._engine_state[ename] = {
+                "alive": False,
+                "dispatches": 0,
+                "consecutive_failures": 0,
+                "probation": True,
+                "rejoins": 0,
+            }
+        # Phase 2 — container registration. Each is one atomic setitem/
+        # append on an otherwise construction-time container (the
+        # codebase's convention for these: no reader holds a lock), and
+        # nothing routes to the engine until phase 3 flips it alive.
+        self.engines.append(engine)
+        self._engine_index[ename] = len(self.engines) - 1
+        self._aff_q[ename] = queue.Queue(maxsize=self._q.maxsize)
+        self._ladders[ename] = ladder
+        if pool is not None:
+            self._pools[ename] = pool
+        if pages_mode and pool is not None:
+            self.cache.add_pool(ename, pool)
+        # Phase 3 — open admission, atomically with stop()'s thread
+        # snapshot (the probation-spawn pattern): a stopped batcher
+        # keeps the engine registered but spawns no worker.
+        with self._engine_lock:
+            st = self._engine_state[ename]
+            with self._counter_lock:
+                st["alive"] = True
+                st["probation"] = False
+                if bool(self._threads) and not self._stop.is_set():
+                    t = threading.Thread(
+                        target=self._worker,
+                        args=(engine, ename),
+                        name=f"glom-serve-batcher-{ename}",
+                        daemon=True,
+                    )
+                    t.start()
+                    self._threads.append(t)
+        self._emit(
+            {
+                "event": "engine_add",
+                "engine": ename,
+                "n_engines": self.n_active_engines(),
+            }
+        )
+        return ename
+
+    def begin_drain(self, name: str, *, detail: Optional[dict] = None) -> None:
+        """Enter the DRAINING state: the engine stops admitting (it
+        leaves _alive_engines — affinity routing, the ladder-shed vote,
+        and failover sibling lists all stop seeing it) while its worker
+        finishes the in-flight dispatch and exits. Refuses loudly when
+        the engine is dead, on probation, already draining, or the LAST
+        live engine (a fleet must never drain itself to zero)."""
+        with self._engine_lock:
+            st = self._engine_state.get(name)
+            if st is None:
+                raise ValueError(f"unknown engine {name!r}")
+            if name in self._drained or name in self._draining:
+                raise ValueError(f"engine {name} is already drained/draining")
+            if not st["alive"] or st["probation"]:
+                raise ValueError(
+                    f"engine {name} is not drainable (dead or on "
+                    "probation — drain is a voluntary transition of a "
+                    "HEALTHY engine)"
+                )
+            others = [
+                n for n, s in self._engine_state.items()
+                if n != name and s["alive"] and n not in self._draining
+            ]
+            if not others:
+                raise ValueError(
+                    f"refusing to drain {name}: it is the last live "
+                    "engine (min fleet is 1)"
+                )
+            self._draining.add(name)
+        self._emit(
+            {"event": "drain_begin", "engine": name, **(detail or {})}
+        )
+
+    def _join_worker(self, name: str, timeout: float) -> bool:
+        """Wait for `name`'s worker thread to exit (the in-flight
+        flush). True when it is gone inside the timeout."""
+        tname = f"glom-serve-batcher-{name}"
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._counter_lock:
+                workers = [
+                    t for t in self._threads
+                    if t.name == tname and t.is_alive()
+                ]
+            if not workers:
+                return True
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            workers[0].join(timeout=min(0.5, remaining))
+
+    def _migration_target(self, src: str) -> Optional[str]:
+        """Where a draining engine's cache sessions land: the live
+        non-draining sibling — in pages mode, the one whose pool has the
+        most free pages (best chance every session fits)."""
+        with self._engine_lock:
+            live = [
+                n for n, s in self._engine_state.items()
+                if n != src and s["alive"] and n not in self._draining
+            ]
+        if self.cache is not None and getattr(self.cache, "pools", None):
+            pooled = [
+                (self._pools[n].n_pages - self._pools[n].pages_used(), n)
+                for n in live
+                if n in self._pools
+            ]
+            return max(pooled)[1] if pooled else None
+        return live[0] if live else None
+
+    def drain_engine(
+        self,
+        name: str,
+        *,
+        timeout: float = 60.0,
+        detail: Optional[dict] = None,
+    ) -> dict:
+        """The graceful scale-in state machine (ROADMAP item 1; the
+        autoscaler's actuator, also callable directly):
+
+          1. begin_drain — stop admitting (stamped drain_begin);
+          2. FLUSH — the worker finishes its in-flight dispatch, hands
+             its affinity queue back to the shared queue, and exits;
+             stragglers it produced sit in the SHARED continuation queue
+             for the siblings (stamped drain_flush);
+          3. MIGRATE — every cache session whose state lives on this
+             engine moves to a sibling pool (bitwise — a byte round
+             trip), falling back to a stamped `drain` invalidation when
+             no sibling has page budget (stamped drain_migrate);
+          4. the engine leaves the fleet as DRAINED — distinct from dead
+             (no probation, no failover accounting, no capacity record).
+
+        Device release (engine.release()) is the CALLER's step — the
+        autoscaler stamps drain_release around it. Returns the drain
+        stats. `detail` (e.g. the decision_id) merges into every stamped
+        event so the evidence chain joins."""
+        detail = dict(detail or {})
+        self.begin_drain(name, detail=detail)
+        t0 = time.monotonic()
+        flushed = self._join_worker(name, timeout)
+        # Belt-and-braces: a never-started batcher has no worker to hand
+        # the affinity queue back — drain it here either way.
+        handed = self._drain_affinity(name)
+        with self._counter_lock:
+            handed += self._drain_handoff.pop(name, 0)
+        self._emit(
+            {
+                "event": "drain_flush",
+                "engine": name,
+                "flush_ok": flushed,
+                "n_affinity_handed_back": handed,
+                "continuations_queued": self._cont_q.qsize(),
+                "flush_ms": round(1e3 * (time.monotonic() - t0), 3),
+                **detail,
+            }
+        )
+        stats = {
+            "engine": name,
+            "flush_ok": flushed,
+            "n_migrated": 0,
+            "n_invalidated": 0,
+            "bytes_migrated": 0,
+        }
+        dst = None
+        if self.cache is not None:
+            dst = self._migration_target(name)
+            mig = self.cache.migrate_engine_sessions(
+                name, dst, reason="drain"
+            )
+            stats.update(mig)
+        # Emitted even with no cache (zero counts): the drain chain the
+        # chaos run reconstructs is always complete.
+        self._emit(
+            {
+                "event": "drain_migrate",
+                "engine": name,
+                "dst_engine": dst,
+                "n_migrated": stats["n_migrated"],
+                "n_invalidated": stats["n_invalidated"],
+                "bytes_migrated": stats["bytes_migrated"],
+                **detail,
+            }
+        )
+        with self._engine_lock:
+            st = self._engine_state[name]
+            st["alive"] = False
+            self._draining.discard(name)
+            self._drained.add(name)
+        # The drained pool leaves the fleet maps (its record would
+        # otherwise ride every later summary as live capacity).
+        self._pools.pop(name, None)
+        if self.cache is not None:
+            self.cache.remove_pool(name)
+        return stats
+
     # -- dispatch ----------------------------------------------------------
 
     @staticmethod
@@ -1181,15 +1538,28 @@ class DynamicBatcher:
         with self._engine_lock:  # LOCK ORDER: _engine_lock -> _counter_lock
             st = self._engine_state[engine_name]
             st["consecutive_failures"] += 1
+            # The single-engine fleet never marks itself dead (it keeps
+            # serving/retrying) — DRAINED husks and DRAINING engines
+            # don't count toward the fleet size: while a sibling drains,
+            # the one remaining admitting engine IS the single-engine
+            # fleet and must keep that contract rather than kill all
+            # admission. (_engine_state mirrors the engines list
+            # one-to-one — the lock-clean fleet count.)
+            fleet = (
+                len(self._engine_state)
+                - len(self._drained)
+                - len(self._draining)
+            )
             if (
                 st["consecutive_failures"] >= self.engine_fail_threshold
-                and len(self.engines) > 1
+                and fleet > 1
             ):
                 st["alive"] = False
             siblings = [
                 n
                 for n, s in self._engine_state.items()
                 if n != engine_name and s["alive"]
+                and n not in self._draining
             ]
             return {"alive": st["alive"], "siblings": siblings}
 
@@ -1246,20 +1616,23 @@ class DynamicBatcher:
             self.n_redispatched += requeued
         return requeued
 
-    def _drain_affinity(self, engine_name: str) -> None:
-        """A dead engine's affinity queue drains back to the SHARED
-        queue (its streams cold-start on a sibling — the pages died with
-        the pool). Tickets that no longer fit anywhere fail fast."""
+    def _drain_affinity(self, engine_name: str) -> int:
+        """A dead (or draining) engine's affinity queue drains back to
+        the SHARED queue (its streams serve on a sibling — cold after a
+        death, still warm after a drain-migration). Tickets that no
+        longer fit anywhere fail fast. Returns how many moved."""
         aq = self._aff_q.get(engine_name)
         if aq is None:
-            return
+            return 0
+        moved = 0
         while True:
             try:
                 item = aq.get_nowait()
             except queue.Empty:
-                return
+                return moved
             try:
                 self._q.put_nowait(item)
+                moved += 1
             except queue.Full:
                 with self._counter_lock:
                     self.n_failed += 1
@@ -2094,7 +2467,12 @@ class DynamicBatcher:
     def _emit(self, rec: dict, kind: str = "serve") -> None:
         from glom_tpu.serve.events import emit_serve
 
-        emit_serve(self.writer, rec, kind=kind)
+        stamped = emit_serve(self.writer, rec, kind=kind)
+        for tap in list(self._taps):
+            try:
+                tap(stamped)
+            except Exception:  # noqa: BLE001 — a tap never kills a worker
+                pass
 
     def span_records(self, **extra) -> list:
         """Drain the serve-phase span rollups (one "span" record per phase
@@ -2119,11 +2497,20 @@ class DynamicBatcher:
             dead engine (no capacity, whatever its queues say).
 
         `telemetry watch --slo headroom=X` breaches when headroom drops
-        BELOW X — the one lower-bound rule."""
+        BELOW X — the one lower-bound rule.
+
+        Every record stamps `state` ("ok" | "draining" | "probation" |
+        "dead"): the SLO monitor EXCLUDES draining/probation engines
+        from the headroom windowed-min (a deliberately draining engine's
+        headroom would otherwise fire a permanent false breach that
+        re-triggers the very autoscaler that caused it), and DRAINED
+        engines emit no record at all — they left the fleet."""
         with self._engine_lock:  # LOCK ORDER: _engine_lock -> _counter_lock
             engines = {
                 name: dict(st) for name, st in self._engine_state.items()
             }
+            draining = set(self._draining)
+            drained = set(self._drained)
             with self._counter_lock:
                 dispatches = list(self.dispatches)
         qcap = max(1, self._q.maxsize)
@@ -2139,6 +2526,8 @@ class DynamicBatcher:
         out = []
         for i, eng in enumerate(self.engines):
             name = self._ename(eng, i)
+            if name in drained:
+                continue  # voluntarily left the fleet: no capacity record
             st = engines.get(name, {})
             own = [d for d in dispatches if d.get("engine") == name]
             # The service-rate denominator is ENGINE-BUSY time (h2d +
@@ -2182,11 +2571,18 @@ class DynamicBatcher:
                 0.0 if not alive
                 else round(max(0.0, 1.0 - utilization), 4)
             )
+            state = (
+                "draining" if name in draining
+                else "probation" if st.get("probation")
+                else "ok" if alive
+                else "dead"
+            )
             out.append(
                 schema.stamp(
                     {
                         "engine": name,
                         "alive": alive,
+                        "state": state,
                         "headroom": headroom,
                         "utilization": utilization,
                         "service_rate_rps": service_rate,
@@ -2214,7 +2610,17 @@ class DynamicBatcher:
             engines = {
                 name: dict(st) for name, st in self._engine_state.items()
             }
+            # Drain-state annotation: added ONLY on fleets that actually
+            # drained (the static path's engines nest stays byte-for-byte
+            # the pre-elastic shape, pinned by tests).
+            for name in self._draining:
+                if name in engines:
+                    engines[name]["draining"] = True
+            for name in self._drained:
+                if name in engines:
+                    engines[name]["drained"] = True
             with self._counter_lock:
+                elastic = self._elastic
                 dispatches = list(self.dispatches)
                 hist = dict(self._iters_hist)
                 by_tier = {
@@ -2317,6 +2723,12 @@ class DynamicBatcher:
             rec["page_pools"] = {
                 name: pool.record() for name, pool in self._pools.items()
             }
+        if elastic is not None:
+            # The autoscaler's rollup (serve/elastic.py): scale counts,
+            # spawn latency, migration totals, and the fleet-size
+            # timeline — `telemetry compare` flattens it as
+            # serve_elastic.* rows (spawn_ms / migrated_bytes as costs).
+            rec["elastic"] = elastic.record()
         # Ladder/retry rollups: flat on a single-engine summary (the PR 6
         # record shape, pinned by tests), NESTED per engine under
         # `engines` on fan-out — a flat merge would let the last engine's

@@ -143,7 +143,98 @@ def build_parser() -> argparse.ArgumentParser:
         "--flight-recorder", default=None, metavar="DIR",
         help="crash flight recorder over the serve event stream",
     )
+    p.add_argument(
+        "--elastic", action="store_true",
+        help="SLO-driven elastic serving (serve/elastic.py, "
+        "docs/SERVING.md): run the Autoscaler control loop — scale OUT "
+        "spawns a fully-warmed engine replica at runtime (admission "
+        "opens only after precompile), scale IN gracefully drains the "
+        "least-loaded engine (migrate cache sessions, release devices). "
+        "The fleet starts at --min-engines; --engines is ignored",
+    )
+    p.add_argument(
+        "--min-engines", type=int, default=None, metavar="N",
+        help="elastic: the fleet never drains below N (default preset's)",
+    )
+    p.add_argument(
+        "--max-engines", type=int, default=None, metavar="N",
+        help="elastic: the fleet never grows past N (default preset's)",
+    )
+    p.add_argument(
+        "--elastic-low-water", type=float, default=None, metavar="H",
+        help="scale OUT when worst eligible headroom sits below H for "
+        "the dwell (default preset's)",
+    )
+    p.add_argument(
+        "--elastic-high-water", type=float, default=None, metavar="H",
+        help="scale IN when worst eligible headroom sits above H for "
+        "the dwell (default preset's)",
+    )
+    p.add_argument(
+        "--elastic-dwell", type=float, default=None, metavar="S",
+        help="min-dwell hysteresis: a water-mark condition must hold "
+        "continuously this long before it may act",
+    )
+    p.add_argument(
+        "--elastic-cooldown", type=float, default=None, metavar="S",
+        help="post-action cooldown before the next decision",
+    )
+    p.add_argument(
+        "--elastic-interval", type=float, default=None, metavar="S",
+        help="control-tick cadence (capacity records are emitted live "
+        "each tick)",
+    )
+    p.add_argument(
+        "--elastic-window", type=float, default=None, metavar="S",
+        help="signal window shared by the policy and its SLO monitor "
+        "(breaches age out of it; shorter = faster post-spike recovery)",
+    )
+    p.add_argument(
+        "--elastic-p99-ms", type=float, default=None, metavar="MS",
+        help="arm the in-process SLO monitor's p99 rule: a windowed "
+        "breach forces scale-out and vetoes scale-in",
+    )
+    p.add_argument(
+        "--elastic-shed-rate", type=float, default=None, metavar="R",
+        help="arm the shed-rate SLO rule (same precedence as p99)",
+    )
+    p.add_argument(
+        "--elastic-settle", type=float, default=0.0, metavar="S",
+        help="after the last ticket resolves, keep the loop running up "
+        "to S seconds or until a scale-in lands — the ramp scenario's "
+        "deterministic window for the post-spike drain",
+    )
+    p.add_argument(
+        "--ramp", default=None, metavar="N1xG1,N2xG2,...",
+        help="offered-load RAMP traffic instead of --synthetic: each "
+        "phase submits N seeded synthetic requests paced G ms apart "
+        "(e.g. '6x120,48x0,10x150' = low, spike, low) — the chaos "
+        "ramp-serve scenario's traffic shape (docs/RESILIENCE.md)",
+    )
     return p
+
+
+def parse_ramp(spec: str):
+    """'6x120,48x0,10x150' -> [(6, 0.12), (48, 0.0), (10, 0.15)] —
+    (requests, per-request gap seconds) per phase. Loud on malformed
+    phases (a typo'd ramp that silently serves nothing is worse than
+    none)."""
+    phases = []
+    for part in spec.split(","):
+        n_s, sep, gap_s = part.partition("x")
+        if not sep:
+            raise ValueError(
+                f"--ramp phase {part!r}: expected NxGAP_MS"
+            )
+        n, gap = int(n_s), float(gap_s)
+        if n < 1 or gap < 0:
+            raise ValueError(
+                f"--ramp phase {part!r}: need N >= 1 and GAP_MS >= 0"
+            )
+        phases.append((n, gap / 1e3))
+    if not phases:
+        raise ValueError(f"--ramp {spec!r}: no phases")
+    return phases
 
 
 def _req_source(args) -> Iterable[Tuple[object, int, object]]:
@@ -172,12 +263,23 @@ def _req_source(args) -> Iterable[Tuple[object, int, object]]:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    if (args.synthetic is None) == (args.requests is None):
+    n_sources = sum(
+        x is not None for x in (args.synthetic, args.requests, args.ramp)
+    )
+    if n_sources != 1:
         print(
-            "exactly one of --synthetic N or --requests FILE required",
+            "exactly one of --synthetic N, --requests FILE, or "
+            "--ramp N1xG1,... required",
             file=sys.stderr,
         )
         return 2
+    ramp_phases = None
+    if args.ramp is not None:
+        try:
+            ramp_phases = parse_ramp(args.ramp)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
 
     import numpy as np
 
@@ -227,6 +329,23 @@ def main(argv=None) -> int:
         overrides["column_cache_bytes"] = args.column_cache_bytes
     if args.column_cache_ttl is not None:
         overrides["column_cache_ttl_s"] = args.column_cache_ttl
+    if args.elastic:
+        overrides["elastic"] = True
+    for flag, field in (
+        ("min_engines", "min_engines"),
+        ("max_engines", "max_engines"),
+        ("elastic_low_water", "elastic_low_water"),
+        ("elastic_high_water", "elastic_high_water"),
+        ("elastic_dwell", "elastic_dwell_s"),
+        ("elastic_cooldown", "elastic_cooldown_s"),
+        ("elastic_interval", "elastic_interval_s"),
+        ("elastic_window", "elastic_window_s"),
+        ("elastic_p99_ms", "elastic_p99_ms"),
+        ("elastic_shed_rate", "elastic_shed_rate"),
+    ):
+        v = getattr(args, flag)
+        if v is not None:
+            overrides[field] = v
     if overrides:
         scfg = dataclasses.replace(scfg, **overrides)
     if args.engines < 1:
@@ -255,12 +374,15 @@ def main(argv=None) -> int:
         from glom_tpu.models.core import init_glom
 
         params = init_glom(jax.random.PRNGKey(0), cfg)
+        # Elastic mode starts at the policy floor (--engines is the
+        # STATIC fleet size); scale-out spawns the rest at runtime.
+        n_init = scfg.min_engines if scfg.elastic else args.engines
         if scfg.mesh_data > 1 or scfg.mesh_seq > 1:
             from glom_tpu.parallel.runtime import make_engine_meshes
 
-            meshes = make_engine_meshes(scfg, args.engines)
+            meshes = make_engine_meshes(scfg, n_init)
         else:
-            meshes = [None] * args.engines
+            meshes = [None] * n_init
         kill_idx, kill_plan = None, None
         if args.kill_engine is not None:
             # "IDX:after=K": engine IDX's dispatch hook raises on every
@@ -271,9 +393,9 @@ def main(argv=None) -> int:
 
             idx_s, _, window = args.kill_engine.partition(":after=")
             kill_idx = int(idx_s)
-            if not 0 <= kill_idx < args.engines:
+            if not 0 <= kill_idx < n_init:
                 print(f"--kill-engine index {kill_idx} outside 0.."
-                      f"{args.engines - 1}", file=sys.stderr)
+                      f"{n_init - 1}", file=sys.stderr)
                 return 2
             after_s, _, until_s = window.partition(",until=")
             kill_plan = FaultPlan(writer=writer)
@@ -285,7 +407,7 @@ def main(argv=None) -> int:
                 fault="engine-dead",
             )
         engines = []
-        for i in range(args.engines):
+        for i in range(n_init):
             hook = None
             if kill_plan is not None and i == kill_idx:
                 hook = dispatch_fault(kill_plan, f"engine{i}-dispatch")
@@ -327,11 +449,83 @@ def main(argv=None) -> int:
             base = rng_img(zlib.crc32(str(session).encode()) & 0x7FFFFFFF)
             return base + 0.05 * rng_img((1 << 20) + seed)
 
+        def req_plan():
+            """(rid, seed, session, gap_s) per request: the flat
+            --synthetic/--requests source at the constant
+            --request-gap-ms, or the --ramp phases at each phase's own
+            pace (a stamped note marks every phase boundary, so the
+            chaos driver can split its p99 windows on evidence)."""
+            flat_gap = max(0.0, args.request_gap_ms) / 1e3
+            if ramp_phases is None:
+                for rid, seed, session in _req_source(args):
+                    yield rid, seed, session, flat_gap
+                return
+            streams = args.streams or 0
+            i = 0
+            for phase, (n, gap) in enumerate(ramp_phases):
+                writer.write(
+                    serve_rec(
+                        {
+                            "event": "ramp_phase",
+                            "phase": phase,
+                            "n_requests": n,
+                            "gap_ms": round(1e3 * gap, 3),
+                        }
+                    )
+                )
+                for _ in range(n):
+                    session = f"s{i % streams}" if streams > 0 else None
+                    yield i, i, session, gap
+                    i += 1
+
         served = failed = 0
-        gap_s = max(0.0, args.request_gap_ms) / 1e3
+        scaler = None
         with DynamicBatcher(engines=engines, writer=writer) as batcher:
+            if scfg.elastic:
+                from glom_tpu.serve.elastic import (
+                    Autoscaler,
+                    resolve_policy,
+                )
+
+                spawn_seq = [len(engines)]
+
+                def engine_factory():
+                    # A brand-new replica on its OWN device group (the
+                    # next contiguous partition slot —
+                    # parallel/runtime.engine_mesh_for); shared params —
+                    # fan-out serves one model. The autoscaler runs
+                    # warmup() before registration; a group-exhausted
+                    # device pool raises into its spawn_rollback path.
+                    i = spawn_seq[0]
+                    mesh = None
+                    if scfg.mesh_data > 1 or scfg.mesh_seq > 1:
+                        from glom_tpu.parallel.runtime import (
+                            engine_mesh_for,
+                        )
+
+                        mesh = engine_mesh_for(scfg, i)
+                    eng = InferenceEngine(
+                        cfg, scfg, params=params, writer=writer,
+                        mesh=mesh, name=f"engine{i}",
+                    )
+                    spawn_seq[0] += 1
+                    return eng
+
+                rules = {}
+                if scfg.elastic_p99_ms is not None:
+                    rules["p99_ms"] = scfg.elastic_p99_ms
+                if scfg.elastic_shed_rate is not None:
+                    rules["shed_rate"] = scfg.elastic_shed_rate
+                scaler = Autoscaler(
+                    batcher, engine_factory,
+                    policy=resolve_policy(scfg),
+                    rules=rules,
+                    writer=writer,
+                    interval_s=scfg.elastic_interval_s,
+                    warm_degraded_iters=degraded_iters,
+                ).start()
             tickets = []
-            for rid, seed, session in _req_source(args):
+            for rid, seed, session, gap_s in req_plan():
                 if gap_s and tickets:
                     time.sleep(gap_s)
                 try:
@@ -397,10 +591,20 @@ def main(argv=None) -> int:
                         }
                     )
                 )
+            if scaler is not None:
+                # The settle window: the ramp's post-spike drain lands
+                # here (bounded — the loop exits the moment a scale-in
+                # completes, so an idle fleet never waits the full S).
+                deadline = time.monotonic() + max(0.0, args.elastic_settle)
+                while time.monotonic() < deadline:
+                    if scaler.record()["n_scale_ins"] >= 1:
+                        break
+                    time.sleep(0.05)
+                scaler.stop()
             writer.write(serve_rec(batcher.summary_record()))
             for rec in batcher.span_records():
                 writer.write(rec)
-        for engine in engines:
+        for engine in batcher.engines:
             for rec in engine.stats_records():
                 writer.write(serve_rec(rec))
             for rec in engine.collective_time_records():

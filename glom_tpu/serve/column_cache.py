@@ -167,9 +167,9 @@ class ColumnCache:
         absent or the cache is in host mode) — the SESSION-AFFINITY
         routing read (serve/batcher.py routes a stream to the engine
         holding its pages). A peek: no LRU touch, no counters."""
-        if self.pools is None:
-            return None
         with self._lock:
+            if self.pools is None:
+                return None
             entry = self._entries.get(session_id)
             return entry.engine if entry is not None else None
 
@@ -225,13 +225,15 @@ class ColumnCache:
     def unpin(self, session_id: str) -> None:
         """Release a pin taken by lookup(pin=True) (pages mode no-op
         otherwise)."""
-        if self.pools is None:
-            return
         with self._lock:
+            if self.pools is None:
+                return
             entry = self._entries.get(session_id)
-            engine = entry.engine if entry is not None else None
-        if engine is not None:
-            self.pools[engine].unpin(session_id)
+            pool = (
+                self.pools.get(entry.engine) if entry is not None else None
+            )
+        if pool is not None:
+            pool.unpin(session_id)
 
     def _sweep_expired_locked(self, events: List[dict]) -> int:
         """Drop EVERY expired entry (caller holds the lock) — the
@@ -310,10 +312,12 @@ class ColumnCache:
         victims; pinned blocks (in-flight readers) are skipped."""
         now = self._clock()
         events: List[dict] = []
-        if self.pools is not None:
+        with self._lock:
+            pages_mode = self.pools is not None
+            pool = self.pools[engine] if pages_mode else None
+        if pages_mode:
             if n_tokens is None:
                 raise ValueError("pages mode store() needs n_tokens")
-            pool = self.pools[engine]
             if self.delta and getattr(pool, "delta", False):
                 return self._store_delta(
                     session_id, levels, engine, n_tokens, pool, now,
@@ -671,6 +675,138 @@ class ColumnCache:
                 )
         self._flush(events)
         return len(victims)
+
+    # -- elastic drain (serve/elastic.py, docs/SERVING.md) -----------------
+
+    def add_pool(self, engine: str, pool) -> None:
+        """Register a runtime-added engine's pool (the batcher's
+        add_engine calls this in pages mode)."""
+        with self._lock:
+            if self.pools is None:
+                raise ValueError(
+                    "add_pool on a host-mode cache (the fleet was built "
+                    "without page pools)"
+                )
+            self.pools[engine] = pool
+
+    def remove_pool(self, engine: str) -> None:
+        """Unregister a drained engine's pool. Any entry still pointing
+        at it (a migration raced a concurrent store) is invalidated
+        first — an entry must never reference a pool the cache no
+        longer knows."""
+        events: List[dict] = []
+        with self._lock:
+            if self.pools is None or engine not in self.pools:
+                return
+            leftover = [
+                (sid, e) for sid, e in self._entries.items()
+                if e.engine == engine
+            ]
+            for sid, entry in leftover:
+                self._drop(sid, entry)
+                self.n_invalidations += 1
+                events.append(
+                    {
+                        "event": "cache_invalidate",
+                        "session": sid,
+                        "engine": engine,
+                        "reason": "drain",
+                        "bytes": entry.nbytes,
+                    }
+                )
+            self.pools.pop(engine, None)
+        self._flush(events)
+
+    def migrate_engine_sessions(
+        self, src: str, dst: Optional[str], *, reason: str = "drain"
+    ) -> dict:
+        """Move every session whose state lives on `src` to `dst` — the
+        drain state machine's migration step (docs/SERVING.md, "Elastic
+        serving").
+
+        HOST mode: the cached state is a host array ANY engine already
+        warms from — the entry simply re-tags to `dst` (zero bytes
+        moved). PAGES mode: each session's paged columns round-trip
+        src-pool -> host -> dst-pool — a pure byte copy, so the sibling
+        serves BITWISE the state the drained engine held (delta chains
+        migrate as their resolved effective state and restart a fresh
+        base on the destination). A session that cannot land — no
+        destination, destination pool out of page budget, or pinned by
+        an in-flight read — is INVALIDATED with the stamped `reason`:
+        never silently dropped, never left pointing at a released pool.
+
+        Returns {"n_migrated", "n_invalidated", "bytes_migrated"}."""
+        out = {"n_migrated": 0, "n_invalidated": 0, "bytes_migrated": 0}
+        with self._lock:
+            sids = [
+                sid for sid, e in self._entries.items() if e.engine == src
+            ]
+            host_mode = self.pools is None
+            src_pool = None if host_mode else self.pools.get(src)
+            dst_pool = (
+                self.pools.get(dst)
+                if not host_mode and dst is not None else None
+            )
+        events: List[dict] = []
+        for sid in sids:
+            if host_mode:
+                if dst is None:
+                    if self.invalidate(sid, reason=reason):
+                        out["n_invalidated"] += 1
+                    continue
+                with self._lock:
+                    e = self._entries.get(sid)
+                    if e is not None and e.engine == src:
+                        e.engine = dst
+                        out["n_migrated"] += 1
+                continue
+            migrated = False
+            if (
+                src_pool is not None
+                and dst_pool is not None
+                and not src_pool.is_pinned(sid)
+            ):
+                got = src_pool.lookup(sid)
+                row = src_pool.read_block(sid) if got is not None else None
+                if row is not None:
+                    n_tokens = got[1]
+                    if getattr(dst_pool, "delta", False):
+                        stored = (
+                            dst_pool.write_back_stream(sid, row, n_tokens)
+                            is not None
+                        )
+                    else:
+                        stored = dst_pool.write_back(sid, row, n_tokens)
+                    if stored:
+                        with self._lock:
+                            e = self._entries.get(sid)
+                            if e is not None and e.engine == src:
+                                e.engine = dst
+                                migrated = True
+                            if self.delta:
+                                self._recount_locked()
+                        if migrated:
+                            src_pool.free(sid, reason="drain-migrate")
+                            out["n_migrated"] += 1
+                            out["bytes_migrated"] += int(row.nbytes)
+                            events.append(
+                                {
+                                    "event": "cache_migrate",
+                                    "session": sid,
+                                    "src_engine": src,
+                                    "dst_engine": dst,
+                                    "bytes": int(row.nbytes),
+                                }
+                            )
+                        else:
+                            # The entry vanished mid-copy (TTL/evict
+                            # raced): the dst copy is an orphan — free it.
+                            dst_pool.free(sid, reason="migrate-raced")
+            if not migrated:
+                if self.invalidate(sid, reason=reason):
+                    out["n_invalidated"] += 1
+        self._flush(events)
+        return out
 
     # -- internals ---------------------------------------------------------
 

@@ -1,0 +1,622 @@
+"""SLO-driven elastic serving: the control loop that closes PR 13's loop.
+
+The capacity observatory can SEE trouble — every summary carries per-engine
+`headroom` records and `telemetry watch --slo` stamps breaches — but until
+now nothing could ACT: the fleet was pinned at the static `--engines N` the
+operator guessed before traffic arrived. This module is the actuator:
+
+  * `ElasticPolicy` is the pure decision core — a windowed low/high-water
+    policy over the fleet's worst eligible headroom plus the live SLO
+    breach signal, with MIN-DWELL hysteresis (a condition must hold
+    continuously for `dwell_s` before it may act — a one-tick dip never
+    spawns hardware), a post-action COOLDOWN (the fleet's response to the
+    last action must land in the window before the next is considered),
+    and hard `min_engines`/`max_engines` clamps. Fake-clock injectable,
+    no threads, no engines — the tier-1 policy suite drives it directly.
+
+  * `Autoscaler` is the supervised control thread: each tick it pulls the
+    batcher's live capacity records (probation/draining engines are
+    EXCLUDED from the headroom signal — a deliberately draining engine's
+    0.0 would otherwise re-trigger the very loop that drained it),
+    evaluates its in-process `SLOMonitor` (p99 / shed-rate rules over the
+    batcher's own resolve/shed stream, fed by an event tap — breaches
+    stamp live `slo_breach` records), asks the policy, and CHANGES THE
+    FLEET:
+
+      - scale-OUT builds a brand-new engine replica via the injected
+        `engine_factory` (its own device group — serve/cli.py resolves
+        one through parallel/runtime.make_engine_meshes), runs the FULL
+        `warmup()` precompile OFF the hot path, and only then registers
+        it with the batcher (worker, ladder, retry, affinity queue, page
+        pool) — admission opens strictly after precompile completes
+        (test-pinned). A factory/warmup failure (the `spawn_fault`
+        injector rides here) ROLLS BACK loudly: a stamped
+        `spawn_rollback` event, no registration, cooldown still charged
+        so a persistent fault cannot hot-spin spawns.
+
+      - scale-IN picks the LEAST-LOADED eligible engine (max headroom)
+        and runs the batcher's graceful drain state machine
+        (serve/batcher.drain_engine: stop admitting -> flush the
+        in-flight dispatch and hand the affinity queue back -> migrate
+        the engine's cache sessions' paged columns to a sibling pool,
+        falling back to stamped `drain` invalidation when no sibling has
+        page budget -> join the worker), then releases the engine's
+        device state (`InferenceEngine.release`). `draining` is a
+        first-class engine state distinct from `dead` — failover
+        accounting, headroom aggregation, and the rejoin path never
+        confuse a voluntary drain with a crash.
+
+Every decision and transition is a stamped schema-v8 "serve" event
+(`scale_out_decision` / `scale_out` / `admission_open` /
+`scale_in_decision` / `drain_begin` / `drain_flush` / `drain_migrate` /
+`drain_release` / `spawn_rollback`), each carrying the `decision_id` that
+chains it to its decision and the triggering SIGNAL WINDOW embedded on
+the decision record — the `ramp-serve` chaos scenario reconstructs the
+full decision->spawn->admit and decision->drain->release chains from the
+JSONL evidence alone (docs/RESILIENCE.md).
+
+With `ServeConfig.elastic=False` (the default) none of this constructs:
+the static `--engines N` path is byte-for-byte the PR 13 contract.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from glom_tpu.telemetry import schema
+
+
+# The serve-event vocabulary of one elastic action, in chain order
+# (docs/OBSERVABILITY.md "Elastic serving events"). perfetto renders
+# these as global instants; the `n_engines` they carry samples the fleet
+# counter track.
+SCALE_EVENTS = (
+    "scale_out_decision",
+    "scale_out",
+    "admission_open",
+    "spawn_rollback",
+    "scale_in_decision",
+    "drain_begin",
+    "drain_flush",
+    "drain_migrate",
+    "drain_release",
+)
+
+
+class ElasticPolicy:
+    """The pure scale-out/scale-in decision core (no threads, no engines).
+
+    Signals, in PRECEDENCE order:
+
+      1. SLO breaches (`note_breach`, fed from the monitor's upper-bound
+         rules — p99, shed_rate): a breach inside the window forces
+         scale-out consideration even while headroom looks fine (latency
+         is the contract; queue occupancy is only its proxy), and VETOES
+         scale-in outright — capacity is never removed from a fleet that
+         is currently failing its SLO.
+      2. Headroom low/high water (`observe_headroom`, one worst-eligible
+         sample per control tick): below `low_water` continuously for
+         `dwell_s` arms scale-out; above `high_water` continuously for
+         `dwell_s` (and no breach) arms scale-in.
+
+    `decide(n_engines)` returns None or {"action", "signal"} with the
+    triggering signal window embedded — the decision record stamps it
+    verbatim. `acted()` starts the cooldown and resets both dwell
+    anchors (the fleet's new shape must re-earn any further action)."""
+
+    def __init__(
+        self,
+        *,
+        min_engines: int = 1,
+        max_engines: int = 4,
+        low_water: float = 0.15,
+        high_water: float = 0.6,
+        dwell_s: float = 2.0,
+        cooldown_s: float = 5.0,
+        window_s: float = 10.0,
+        clock=time.monotonic,
+    ):
+        if min_engines < 1:
+            raise ValueError(f"min_engines {min_engines} must be >= 1")
+        if max_engines < min_engines:
+            raise ValueError(
+                f"max_engines {max_engines} must be >= min_engines "
+                f"{min_engines}"
+            )
+        if not 0.0 <= low_water < high_water <= 1.0:
+            raise ValueError(
+                f"need 0 <= low_water ({low_water}) < high_water "
+                f"({high_water}) <= 1"
+            )
+        if dwell_s < 0 or cooldown_s < 0:
+            raise ValueError(
+                f"dwell_s {dwell_s} and cooldown_s {cooldown_s} must be >= 0"
+            )
+        if window_s <= 0:
+            raise ValueError(f"window_s {window_s} must be > 0")
+        self.min_engines = min_engines
+        self.max_engines = max_engines
+        self.low_water = low_water
+        self.high_water = high_water
+        self.dwell_s = dwell_s
+        self.cooldown_s = cooldown_s
+        self.window_s = window_s
+        self._clock = clock
+        self._samples: deque = deque()   # (t, worst eligible headroom)
+        self._breaches: deque = deque()  # (t, rule)
+        self._below_since: Optional[float] = None
+        self._above_since: Optional[float] = None
+        self._last_action_t: Optional[float] = None
+        self._last_action: Optional[str] = None
+
+    def _prune(self, now: float) -> None:
+        horizon = now - self.window_s
+        for q in (self._samples, self._breaches):
+            while q and q[0][0] < horizon:
+                q.popleft()
+
+    def observe_headroom(self, headroom: float) -> None:
+        """Feed one control tick's WORST eligible headroom (the min
+        across engines that are neither draining nor on probation —
+        serve/batcher.capacity_records stamps the state). The dwell
+        anchors track how long the value has been continuously past a
+        water mark; crossing back resets them — the hysteresis that
+        keeps a value oscillating AROUND a mark from ever acting."""
+        now = self._clock()
+        self._samples.append((now, float(headroom)))
+        if headroom < self.low_water:
+            if self._below_since is None:
+                self._below_since = now
+        else:
+            self._below_since = None
+        if headroom > self.high_water:
+            if self._above_since is None:
+                self._above_since = now
+        else:
+            self._above_since = None
+        self._prune(now)
+
+    def note_breach(self, rule: str) -> None:
+        """One live SLO breach (the monitor's upper-bound rules). Ages
+        out of the window like any sample."""
+        self._breaches.append((self._clock(), str(rule)))
+        self._prune(self._clock())
+
+    def active_breaches(self) -> List[str]:
+        self._prune(self._clock())
+        return sorted({rule for _, rule in self._breaches})
+
+    def _signal(self, now: float, rule: str) -> dict:
+        """The triggering signal window the decision record embeds: the
+        rule that fired, the last observed value, the water marks, and
+        the trailing samples (time-relative, bounded) — enough to replay
+        WHY from the JSONL alone."""
+        tail = list(self._samples)[-32:]
+        return {
+            "rule": rule,
+            "observed": round(tail[-1][1], 4) if tail else None,
+            "low_water": self.low_water,
+            "high_water": self.high_water,
+            "dwell_s": self.dwell_s,
+            "window_s": self.window_s,
+            "breaches": self.active_breaches(),
+            "samples": [
+                [round(t - now, 3), round(h, 4)] for t, h in tail
+            ],
+        }
+
+    def decide(self, n_engines: int) -> Optional[dict]:
+        """The next fleet action at the current signals, or None. Clamped
+        to [min_engines, max_engines]; silent inside the cooldown."""
+        now = self._clock()
+        self._prune(now)
+        if (
+            self._last_action_t is not None
+            and now - self._last_action_t < self.cooldown_s
+        ):
+            return None
+        breaches = self.active_breaches()
+        below = (
+            self._below_since is not None
+            and now - self._below_since >= self.dwell_s
+        )
+        above = (
+            self._above_since is not None
+            and now - self._above_since >= self.dwell_s
+        )
+        if (breaches or below) and n_engines < self.max_engines:
+            rule = breaches[0] if breaches else "headroom"
+            return {"action": "scale_out", "signal": self._signal(now, rule)}
+        if breaches:
+            # Breach precedence: a breaching fleet never scales IN, no
+            # matter how idle its queues look (shed_rate breaches are
+            # exactly the idle-queues-because-we-reject shape).
+            return None
+        if above and n_engines > self.min_engines:
+            return {"action": "scale_in", "signal": self._signal(now, "headroom")}
+        return None
+
+    def acted(self, action: str) -> None:
+        now = self._clock()
+        self._last_action_t = now
+        self._last_action = action
+        # The fleet changed shape: both dwell conditions must re-earn
+        # their hold from scratch under the NEW capacity.
+        self._below_since = None
+        self._above_since = None
+
+    @staticmethod
+    def pick_drain_target(capacity_records: List[dict]) -> Optional[str]:
+        """The least-loaded drainable engine: max headroom among records
+        whose stamped state is "ok" (never a draining, probation, or
+        dead engine). Ties break on name for determinism."""
+        eligible = [
+            c for c in capacity_records
+            if c.get("state") == "ok"
+            and isinstance(c.get("headroom"), (int, float))
+        ]
+        if not eligible:
+            return None
+        best = max(eligible, key=lambda c: (c["headroom"], c["engine"]))
+        return best["engine"]
+
+
+def resolve_policy(scfg, *, clock=time.monotonic) -> ElasticPolicy:
+    """The one ServeConfig -> policy resolution (the ladder pattern)."""
+    return ElasticPolicy(
+        min_engines=scfg.min_engines,
+        max_engines=scfg.max_engines,
+        low_water=scfg.elastic_low_water,
+        high_water=scfg.elastic_high_water,
+        dwell_s=scfg.elastic_dwell_s,
+        cooldown_s=scfg.elastic_cooldown_s,
+        window_s=scfg.elastic_window_s,
+        clock=clock,
+    )
+
+
+class Autoscaler:
+    """The supervised control loop around one DynamicBatcher.
+
+    `engine_factory()` must return a NOT-yet-registered engine replica
+    (fresh name, own device group/mesh when configured) — the scaler
+    runs its full `warmup()` precompile before the batcher ever sees it.
+    `spawn_hook` is the chaos seam (resilience/faults.spawn_fault):
+    called once per spawn attempt with {"attempt", "n_engines"}; a raise
+    there — or anywhere in factory/warmup — is a failed scale-out and
+    rolls back loudly. `rules` arms the in-process SLO monitor's
+    upper-bound triggers (e.g. {"p99_ms": 250.0, "shed_rate": 0.05});
+    the headroom low/high-water signal always rides the capacity
+    records directly.
+
+    Use as a context manager (or start()/stop()); `tick()` is public so
+    the fake-clock tests drive one evaluation without any thread."""
+
+    def __init__(
+        self,
+        batcher,
+        engine_factory: Callable[[], object],
+        *,
+        policy: Optional[ElasticPolicy] = None,
+        rules: Optional[Dict[str, float]] = None,
+        writer=None,
+        interval_s: float = 0.5,
+        spawn_hook=None,
+        warm_degraded_iters: Optional[int] = None,
+        clock=time.monotonic,
+    ):
+        from glom_tpu.telemetry.aggregate import SLOMonitor
+
+        if interval_s <= 0:
+            raise ValueError(f"interval_s {interval_s} must be > 0")
+        self.batcher = batcher
+        self.engine_factory = engine_factory
+        scfg = getattr(batcher.engine, "scfg", None)
+        if policy is None:
+            if scfg is None:
+                policy = ElasticPolicy(clock=clock)
+            else:
+                policy = resolve_policy(scfg, clock=clock)
+        self.policy = policy
+        self.writer = writer
+        self.interval_s = interval_s
+        self.spawn_hook = spawn_hook
+        self.warm_degraded_iters = warm_degraded_iters
+        self._clock = clock
+        self.monitor = SLOMonitor(
+            dict(rules or {}),
+            window_s=policy.window_s,
+            writer=writer,
+            clock=clock,
+        )
+        # The batcher's event tap feeds the monitor every emitted serve
+        # record (resolve leaves, sheds) — the autoscaler sees the same
+        # stream `telemetry watch` would tail, in process, with no file.
+        batcher.add_event_tap(self.monitor.observe)
+        batcher.attach_elastic(self)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # Counters + the fleet timeline, guarded by one lock: the control
+        # thread writes, record()/summary readers snapshot.
+        self._lock = threading.Lock()
+        self._t0 = clock()
+        self._decision_seq = 0
+        self._spawn_attempts = 0
+        self.n_scale_outs = 0
+        self.n_scale_ins = 0
+        self.n_spawn_failures = 0
+        self.n_ticks = 0
+        self.n_migrated_sessions = 0
+        self.n_invalidated_sessions = 0
+        self.migrated_bytes = 0
+        self._spawn_ms: List[float] = []
+        self._timeline: List[list] = [
+            [0.0, batcher.n_active_engines()]
+        ]
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Autoscaler":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="glom-serve-autoscaler", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=60.0)
+        self._thread = None
+
+    def __enter__(self) -> "Autoscaler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _run(self) -> None:
+        # Supervised: one tick's exception is stamped evidence, never the
+        # loop's death — a control plane that silently stops controlling
+        # is the failure mode this file exists to not have.
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except BaseException as e:  # noqa: BLE001 — stamped, loop lives
+                self._emit(
+                    {
+                        "error": "autoscaler-tick",
+                        "value": None,
+                        "note": f"{type(e).__name__}: {e}"[:300],
+                    },
+                    kind="error",
+                )
+
+    # -- the control tick --------------------------------------------------
+
+    def tick(self) -> Optional[dict]:
+        """One evaluation: capacity -> signals -> policy -> (maybe) act.
+        Returns the decision taken, or None."""
+        caps = self.batcher.capacity_records()
+        for c in caps:
+            # Live capacity on the stream each tick (the summary-only
+            # cadence is too coarse for a watch tailing the scale loop)
+            # and into the monitor (which skips probation/draining
+            # headroom — the capacity-record contract).
+            self._emit(c, kind=None)
+            self.monitor.observe(c)
+        eligible = [
+            c["headroom"] for c in caps
+            if c.get("state") == "ok"
+            and isinstance(c.get("headroom"), (int, float))
+        ]
+        if eligible:
+            self.policy.observe_headroom(min(eligible))
+        for b in self.monitor.evaluate():
+            # Lower-bound rules (headroom) are the policy's OWN water
+            # marks — only upper-bound breaches (p99, shed_rate) feed
+            # the breach-precedence signal.
+            if b.get("bound") != "lower":
+                self.policy.note_breach(b["rule"])
+        with self._lock:
+            self.n_ticks += 1
+        n = self.batcher.n_active_engines()
+        decision = self.policy.decide(n)
+        if decision is None:
+            return None
+        if decision["action"] == "scale_out":
+            self._scale_out(n, decision["signal"])
+        else:
+            self._scale_in(n, decision["signal"], caps)
+        return decision
+
+    def _next_decision(self) -> int:
+        with self._lock:
+            self._decision_seq += 1
+            return self._decision_seq
+
+    def _note_fleet(self, n: int) -> None:
+        with self._lock:
+            self._timeline.append(
+                [round(self._clock() - self._t0, 3), n]
+            )
+
+    def _scale_out(self, n: int, signal: dict) -> None:
+        decision_id = self._next_decision()
+        self._emit(
+            {
+                "event": "scale_out_decision",
+                "decision_id": decision_id,
+                "n_engines": n,
+                "signal": signal,
+            }
+        )
+        with self._lock:
+            self._spawn_attempts += 1
+            attempt = self._spawn_attempts
+        t0 = self._clock()
+        try:
+            if self.spawn_hook is not None:
+                self.spawn_hook({"attempt": attempt, "n_engines": n})
+            engine = self.engine_factory()
+            # The FULL precompile, off the hot path: every bucket
+            # signature (and the ladder's degraded route when armed)
+            # compiles before admission can open. A fake engine without
+            # warmup() is the policy tests' no-op.
+            warmup = getattr(engine, "warmup", None)
+            if callable(warmup):
+                warmup()
+                if self.warm_degraded_iters is not None:
+                    warmup(iters_override=self.warm_degraded_iters)
+        except BaseException as e:  # noqa: BLE001 — rollback is the contract
+            # FAILED scale-out: no registration, loud evidence, cooldown
+            # still charged (a persistently failing spawn must not retry
+            # every tick at full speed).
+            with self._lock:
+                self.n_spawn_failures += 1
+            self.policy.acted("spawn_rollback")
+            self._emit(
+                {
+                    "event": "spawn_rollback",
+                    "decision_id": decision_id,
+                    "n_engines": n,
+                    "exception": f"{type(e).__name__}: {e}"[:300],
+                }
+            )
+            return
+        spawn_ms = round(1e3 * (self._clock() - t0), 3)
+        name = self.batcher.add_engine(engine)
+        with self._lock:
+            self.n_scale_outs += 1
+            self._spawn_ms.append(spawn_ms)
+        self.policy.acted("scale_out")
+        self._note_fleet(n + 1)
+        self._emit(
+            {
+                "event": "scale_out",
+                "decision_id": decision_id,
+                "engine": name,
+                "spawn_ms": spawn_ms,
+                "n_engines": n + 1,
+                "signal": signal,
+            }
+        )
+        # Admission is OPEN from add_engine's worker start — stamped as
+        # its own transition so the chaos chain check can pin the order:
+        # decision -> (warmup inside spawn_ms) -> admission.
+        self._emit(
+            {
+                "event": "admission_open",
+                "decision_id": decision_id,
+                "engine": name,
+                "n_engines": n + 1,
+            }
+        )
+
+    def _scale_in(self, n: int, signal: dict, caps: List[dict]) -> None:
+        target = self.policy.pick_drain_target(caps)
+        if target is None:
+            return
+        decision_id = self._next_decision()
+        self._emit(
+            {
+                "event": "scale_in_decision",
+                "decision_id": decision_id,
+                "engine": target,
+                "n_engines": n,
+                "signal": signal,
+            }
+        )
+        try:
+            stats = self.batcher.drain_engine(
+                target, detail={"decision_id": decision_id}
+            )
+        except ValueError as e:
+            # Raced a death/concurrent drain: the fleet can no longer
+            # spare the target — stamped, no action, cooldown charged.
+            self.policy.acted("drain_abort")
+            self._emit(
+                {
+                    "event": "drain_abort",
+                    "decision_id": decision_id,
+                    "engine": target,
+                    "exception": f"{type(e).__name__}: {e}"[:300],
+                }
+            )
+            return
+        engine = self.batcher.engine_by_name(target)
+        release = getattr(engine, "release", None)
+        if callable(release):
+            release()
+        with self._lock:
+            self.n_scale_ins += 1
+            self.n_migrated_sessions += stats.get("n_migrated", 0)
+            self.n_invalidated_sessions += stats.get("n_invalidated", 0)
+            self.migrated_bytes += stats.get("bytes_migrated", 0)
+        self.policy.acted("scale_in")
+        self._note_fleet(n - 1)
+        self._emit(
+            {
+                "event": "drain_release",
+                "decision_id": decision_id,
+                "engine": target,
+                "n_engines": n - 1,
+                **{
+                    k: stats.get(k)
+                    for k in (
+                        "n_migrated", "n_invalidated", "bytes_migrated",
+                        "flush_ok",
+                    )
+                },
+            }
+        )
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _emit(self, rec: dict, kind: Optional[str] = "serve") -> None:
+        from glom_tpu.tracing.flight import write_or_observe
+
+        if kind is None:
+            # Already-stamped records (the capacity rollup) pass through.
+            write_or_observe(self.writer, rec)
+            return
+        if kind == "serve":
+            from glom_tpu.serve.events import emit_serve
+
+            emit_serve(self.writer, rec)
+            return
+        write_or_observe(self.writer, schema.stamp(rec, kind=kind))
+
+    def record(self) -> dict:
+        """The `elastic` summary nest (serve/batcher.summary_record nests
+        it; `telemetry compare` flattens it as serve_elastic.* rows with
+        spawn latency and migration bytes classified as costs)."""
+        with self._lock:
+            spawn_ms = list(self._spawn_ms)
+            rec = {
+                "n_scale_outs": self.n_scale_outs,
+                "n_scale_ins": self.n_scale_ins,
+                "n_spawn_failures": self.n_spawn_failures,
+                "n_ticks": self.n_ticks,
+                "n_migrated_sessions": self.n_migrated_sessions,
+                "n_invalidated_sessions": self.n_invalidated_sessions,
+                "migrated_bytes": self.migrated_bytes,
+                "spawn_ms_mean": (
+                    round(sum(spawn_ms) / len(spawn_ms), 3)
+                    if spawn_ms else None
+                ),
+                "spawn_ms_max": max(spawn_ms) if spawn_ms else None,
+                "n_engines": self.batcher.n_active_engines(),
+                "n_engines_peak": max(n for _, n in self._timeline),
+                # The fleet-size timeline ([t_rel_s, n_engines] per
+                # change): the bench's n_engines row and perfetto's
+                # counter track both read it.
+                "timeline": [list(e) for e in self._timeline],
+            }
+        return rec

@@ -281,6 +281,10 @@ class InferenceEngine:
         # raise here is exactly a transient backend failure as far as the
         # retry policy and the batcher are concerned. None in production.
         self._fault_hook = fault_hook
+        # Flipped by release() after a graceful drain: the engine is an
+        # evidence husk — its device state is freed and it must never
+        # serve again (the batcher already removed it from the fleet).
+        self.released = False
 
     # -- signatures --------------------------------------------------------
 
@@ -1358,6 +1362,23 @@ class InferenceEngine:
             buffered, self._coll_samples = self._coll_samples, []
         out.extend(buffered)
         return out
+
+    def release(self) -> None:
+        """Free this engine's device-side state after a graceful drain
+        (serve/elastic.py scale-in, step 4: release devices). Drops the
+        memoized compiled executables, the sharding/cold-init caches,
+        and the page pool's buffer + table — the HBM a drained replica
+        was holding. The object stays a valid EVIDENCE husk (name,
+        stats_records, collective_time_records) but can no longer serve;
+        the batcher has already removed it from the fleet, so nothing
+        dispatches here again."""
+        self._compiled.clear()
+        self._shardings.clear()
+        self._cold_levels = None
+        self.released = True
+        if self.pool is not None:
+            self.pool.release()
+        self._emit({"event": "engine_release"})
 
     def _emit(self, rec: dict) -> None:
         from glom_tpu.serve.events import emit_serve

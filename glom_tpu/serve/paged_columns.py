@@ -875,6 +875,18 @@ class PagedColumnPool:
         self._flush([ev])
         return len(src)
 
+    def release(self) -> None:
+        """A drained engine's device release (serve/elastic.py): free
+        every block (one stamped page_free totals event), then drop the
+        HBM buffer reference itself — the bytes a scaled-in replica was
+        holding. The pool stays a valid accounting husk (record() keeps
+        working) but any further write/read fails loudly on the None
+        buffer — a dispatch against a released pool is a
+        fleet-bookkeeping bug, not a degraded mode."""
+        self.free_all(reason="drain-release")
+        with self._lock:
+            self._buffer = None
+
     # -- observability -----------------------------------------------------
 
     def _flush(self, events) -> None:

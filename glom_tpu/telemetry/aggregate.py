@@ -532,7 +532,14 @@ class SLOMonitor:
             # (serve/batcher.capacity_records, emitted on every summary):
             # the windowed MIN across engines feeds the one lower-bound
             # rule — one exhausted engine IS the scale-out signal, even
-            # while its siblings idle.
+            # while its siblings idle. Engines stamped DRAINING or
+            # PROBATION are excluded: a deliberately draining engine's
+            # headroom is not load, and counting it would fire a
+            # permanent false breach that re-triggers the very
+            # autoscaler that caused the drain (schema v8,
+            # serve/elastic.py).
+            if rec.get("state") in ("draining", "probation"):
+                return
             h = rec.get("headroom")
             if isinstance(h, (int, float)) and not isinstance(h, bool):
                 now = self._clock()

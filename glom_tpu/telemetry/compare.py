@@ -64,6 +64,11 @@ _COST_METRIC_TOKENS = (
     # BENEFIT token below; collective_time.* wall_ms and the
     # serve_latency.* phase rows ride the "ms" unit token).
     "utilization", "fill", "wait",
+    # Elastic-serving damage rows (ISSUE 15): a drain that INVALIDATES
+    # sessions (no sibling page budget) lost warmth a migration would
+    # have kept; spawn rollbacks are failed scale-outs. spawn_ms and
+    # migrated_bytes ride the "ms"/"bytes" unit tokens.
+    "invalidated", "spawn_failures",
 )
 # Metric-name tokens that mark a HIGHER-is-better row regardless of the
 # cost heuristics: headroom is capacity LEFT — a serving change that
@@ -216,6 +221,30 @@ def flatten_engine_metrics(rec: dict) -> List[dict]:
                             "kind": "bench",
                         }
                     )
+    # The elastic nest (ISSUE 15): the autoscaler's rollup flattens as
+    # serve_elastic.* rows — spawn latency ("ms") and migration bytes
+    # ("bytes") gate as COSTS by unit; spawn failures and invalidated
+    # sessions by the failure-ish metric tokens; scale counts ride as
+    # plain counts (how often the loop acts is workload, not quality).
+    elastic = rec.get("elastic")
+    if isinstance(elastic, dict):
+        for key in sorted(elastic):
+            v = elastic[key]
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                continue  # the timeline list is perfetto's, not a row
+            unit = (
+                "ms" if "_ms" in key
+                else "bytes" if "bytes" in key
+                else "count"
+            )
+            rows.append(
+                {
+                    "metric": f"serve_elastic.{key}{suffix}",
+                    "value": float(v),
+                    "unit": unit,
+                    "kind": "bench",
+                }
+            )
     return rows
 
 

@@ -56,9 +56,25 @@ _TID_ROLLUPS = 91
 # queue->pack->h2d->device->resolve end to end.
 _TID_COLLECTIVES = 92
 _TID_CAPACITY = 93
+_TID_FLEET = 94
 _TID_DISPATCH = 95
 _TID_PHASES = 96
 _TID_BARRIER_BASE = 100
+
+# The elastic-serving transition vocabulary (serve/elastic.SCALE_EVENTS —
+# mirrored literally: this module stays pure-stdlib importable and the
+# serve package pulls jax).
+_SCALE_EVENTS = (
+    "scale_out_decision",
+    "scale_out",
+    "admission_open",
+    "spawn_rollback",
+    "scale_in_decision",
+    "drain_begin",
+    "drain_flush",
+    "drain_migrate",
+    "drain_release",
+)
 
 
 CLOCK_KEYS = ("t_start", "wall_time_s", "wall_time", "t")
@@ -230,6 +246,35 @@ def to_trace_events(records: Iterable[dict]) -> List[dict]:
                     },
                 }
             )
+        elif kind == "serve" and rec.get("event") in _SCALE_EVENTS:
+            # Elastic fleet transitions (schema v8, serve/elastic.py):
+            # each decision/transition is a full-height GLOBAL instant —
+            # a scale-out reads as a line the latency recovery then
+            # answers — and any record carrying n_engines samples the
+            # fleet-size counter track (capacity following load, drawn).
+            raw.append(
+                {
+                    "name": f"elastic:{rec.get('event')}",
+                    "ph": "i",
+                    "s": "g",
+                    "pid": _PID,
+                    "tid": _TID_EVENTS,
+                    "ts": ts,
+                    "args": rec,
+                }
+            )
+            n = rec.get("n_engines")
+            if isinstance(n, (int, float)) and not isinstance(n, bool):
+                raw.append(
+                    {
+                        "name": "fleet:n_engines",
+                        "ph": "C",
+                        "pid": _PID,
+                        "tid": _TID_FLEET,
+                        "ts": ts,
+                        "args": {"n_engines": float(n)},
+                    }
+                )
         else:
             label = {
                 "train_step": f"step {rec.get('step', '?')}",

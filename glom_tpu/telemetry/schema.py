@@ -54,7 +54,20 @@ from typing import Iterable, List, Optional, Tuple
 # control loop will read), and serve "dispatch" records split latency_ms
 # into queue_wait/pack/h2d/device/resolve phase fields that sum to it
 # bit-exactly (conservation extended by `telemetry trace`).
-SCHEMA_VERSION = 7
+# v8 is elastic serving (glom_tpu/serve/elastic.py, docs/SERVING.md
+# "Elastic serving"): new serve events for the autoscaler's decision and
+# transition chain — "scale_out_decision"/"scale_in_decision" (the
+# triggering signal window embedded), "scale_out" (+spawn_ms),
+# "admission_open" (a spawned replica opens for traffic strictly after
+# its warmup precompile), "spawn_rollback" (a failed scale-out rolled
+# back loudly), "drain_begin"/"drain_flush"/"drain_migrate"/
+# "drain_release" (the graceful scale-in state machine), "engine_add",
+# "cache_migrate" (one session's paged columns moved to a sibling pool)
+# — each carrying the decision_id that chains it to its decision; and
+# "capacity" records now stamp `state` ("ok" | "draining" | "probation"
+# | "dead") so the SLO monitor can EXCLUDE deliberately draining or
+# probing engines from the headroom windowed-min.
+SCHEMA_VERSION = 8
 
 _NUM = (int, float)
 _STR = (str,)

@@ -275,6 +275,36 @@ class ServeConfig:
     # resolves to "off" there, stamped.
     collective_timing: str = "off"
     collective_timing_interval: int = 16
+    # SLO-driven elastic serving (glom_tpu/serve/elastic.py,
+    # docs/SERVING.md "Elastic serving"): elastic=True runs an Autoscaler
+    # control loop next to the batcher that reads the live capacity
+    # records (headroom) plus in-process SLO breaches and CHANGES the
+    # fleet — scale-out spawns a fully-warmed engine replica at runtime
+    # (admission opens only after precompile), scale-in gracefully drains
+    # the least-loaded engine (stop admitting -> flush -> migrate cache
+    # sessions -> release devices). False (the default) keeps the static
+    # --engines N fleet byte-for-byte. The policy is windowed low/high
+    # water with min-dwell hysteresis and a post-action cooldown, clamped
+    # to [min_engines, max_engines]:
+    #   * worst eligible headroom < elastic_low_water continuously for
+    #     elastic_dwell_s (or any armed upper-bound SLO breach —
+    #     elastic_p99_ms / elastic_shed_rate, None = not armed) scales
+    #     OUT; a breach also VETOES scale-in (breach precedence);
+    #   * worst eligible headroom > elastic_high_water continuously for
+    #     elastic_dwell_s scales IN (drain the max-headroom engine).
+    # elastic_interval_s paces the control ticks; elastic_window_s is
+    # the signal window the policy and its SLO monitor share.
+    elastic: bool = False
+    min_engines: int = 1
+    max_engines: int = 4
+    elastic_low_water: float = 0.15
+    elastic_high_water: float = 0.6
+    elastic_dwell_s: float = 2.0
+    elastic_cooldown_s: float = 5.0
+    elastic_window_s: float = 10.0
+    elastic_interval_s: float = 0.5
+    elastic_p99_ms: Optional[float] = None
+    elastic_shed_rate: Optional[float] = None
 
     def __post_init__(self):
         if not self.buckets:
@@ -426,6 +456,39 @@ class ServeConfig:
             raise ValueError(
                 f"collective_timing_interval "
                 f"{self.collective_timing_interval} must be >= 1"
+            )
+        if self.min_engines < 1:
+            raise ValueError(f"min_engines {self.min_engines} must be >= 1")
+        if self.max_engines < self.min_engines:
+            raise ValueError(
+                f"max_engines {self.max_engines} must be >= min_engines "
+                f"{self.min_engines}"
+            )
+        if not 0.0 <= self.elastic_low_water < self.elastic_high_water <= 1.0:
+            raise ValueError(
+                f"need 0 <= elastic_low_water ({self.elastic_low_water}) < "
+                f"elastic_high_water ({self.elastic_high_water}) <= 1"
+            )
+        if self.elastic_dwell_s < 0 or self.elastic_cooldown_s < 0:
+            raise ValueError(
+                f"elastic_dwell_s {self.elastic_dwell_s} and "
+                f"elastic_cooldown_s {self.elastic_cooldown_s} must be >= 0"
+            )
+        if self.elastic_window_s <= 0 or self.elastic_interval_s <= 0:
+            raise ValueError(
+                f"elastic_window_s {self.elastic_window_s} and "
+                f"elastic_interval_s {self.elastic_interval_s} must be > 0"
+            )
+        if self.elastic_p99_ms is not None and self.elastic_p99_ms <= 0:
+            raise ValueError(
+                f"elastic_p99_ms {self.elastic_p99_ms} must be > 0 or None"
+            )
+        if self.elastic_shed_rate is not None and not (
+            0.0 <= self.elastic_shed_rate <= 1.0
+        ):
+            raise ValueError(
+                f"elastic_shed_rate {self.elastic_shed_rate} must be in "
+                "[0, 1] or None"
             )
 
 

@@ -282,6 +282,30 @@ assert v <= 2.0, f"phase-split overhead {v}% exceeds the 2% stamping budget"
 print(f"OK: phase-split overhead {v}% within the 2% budget")
 EOF
 
+# 9k. Elastic serving ramp gate (ISSUE 15, docs/SERVING.md "Elastic
+#     serving"): the offered-load ramp through the REAL autoscaler on
+#     real hardware — the spike must scale the fleet OUT (spawn + full
+#     AOT warmup off the hot path, admission strictly after precompile),
+#     the calm must scale it back IN (graceful drain: migrate sessions,
+#     release devices), and every ticket must be conserved. On TPU the
+#     spawn_ms row finally prices a real device-group warmup (the number
+#     a production autoscaler's dwell must exceed), and the row joins
+#     the 11b serve baseline so spawn-latency regressions gate.
+step ramp_serve 2400 python -u bench_serve.py --ramp
+step ramp_serve_gate 120 python - results/hw_queue/ramp_serve.log <<'EOF'
+import sys
+from glom_tpu.telemetry import schema
+rows = [r for _, r in schema.iter_json_lines(open(sys.argv[1]))]
+peak = [r for r in rows if r.get("metric", "").startswith("serve_ramp_n_engines_peak")]
+cons = [r for r in rows if r.get("metric", "").startswith("serve_ramp_tickets_conserved")]
+assert peak and cons, "ramp rows missing from the elastic bench log"
+assert peak[-1]["value"] >= 2, f"fleet never scaled out: {peak[-1]}"
+assert peak[-1]["n_scale_ins"] >= 1, f"fleet never scaled back in: {peak[-1]}"
+assert cons[-1]["value"] == 1.0, f"ramp tickets NOT conserved: {cons[-1]}"
+tl = peak[-1]["timeline"]
+print(f"OK: fleet timeline {tl}, tickets conserved")
+EOF
+
 # 10. Schema lint: every JSON row this queue produced must validate
 #     against the versioned event schema (glom_tpu/telemetry/schema.py).
 #     Shell noise in the logs is skipped; --allow-unstamped because the
@@ -315,6 +339,7 @@ grep -ah '^{' results/hw_queue/bench_serve.log \
     results/hw_queue/bench_serve_delta.log \
     results/hw_queue/collective_timing_ab.log \
     results/hw_queue/phase_ab.log \
+    results/hw_queue/ramp_serve.log \
     > results/hw_queue/serve_candidate.jsonl 2>/dev/null || true
 if [ -f results/serve_baseline.jsonl ]; then
     step serve_compare 300 python -m glom_tpu.telemetry compare \

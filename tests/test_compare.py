@@ -46,7 +46,7 @@ class TestSchemaV2Kinds:
             {"metric": "m", "value": None, "error": "backend-init-unavailable"},
             kind="error",
         )
-        assert span["schema_version"] == schema.SCHEMA_VERSION == 7
+        assert span["schema_version"] == schema.SCHEMA_VERSION == 8
         assert schema.validate_record(span) == []
         assert schema.validate_record(err) == []
         # missing required fields are rejected
@@ -558,3 +558,43 @@ class TestCapacityObservatory:
         assert measured["serve_latency.device_ms (cfg)"]["values"] == [
             20.0
         ]
+
+    def test_summary_elastic_nest_flattens_with_cost_directions(self):
+        """The elastic nest (ISSUE 15) flattens as serve_elastic.* rows:
+        spawn latency ("ms") and migration bytes ("bytes") classify as
+        COSTS, invalidated sessions/spawn failures by metric token; the
+        timeline list never becomes a row."""
+        from glom_tpu.telemetry.compare import lower_is_better
+
+        rec = json.dumps(schema.stamp(
+            {"event": "summary", "config": "cfg", "n_requests": 4,
+             "engines": {"engine0": {"alive": True, "dispatches": 4}},
+             "elastic": {"n_scale_outs": 1, "n_scale_ins": 1,
+                         "n_spawn_failures": 0,
+                         "n_migrated_sessions": 3,
+                         "n_invalidated_sessions": 1,
+                         "migrated_bytes": 4096,
+                         "spawn_ms_mean": 950.0,
+                         "n_engines_peak": 2,
+                         "timeline": [[0.0, 1], [2.0, 2]]}},
+            kind="serve",
+        ))
+        measured, _ = load_bench_records([rec])
+        assert measured["serve_elastic.spawn_ms_mean (cfg)"]["values"] == [
+            950.0
+        ]
+        assert measured["serve_elastic.spawn_ms_mean (cfg)"]["rec"][
+            "unit"] == "ms"
+        assert measured["serve_elastic.migrated_bytes (cfg)"]["rec"][
+            "unit"] == "bytes"
+        assert "serve_elastic.timeline (cfg)" not in measured
+        assert lower_is_better("serve_elastic.spawn_ms_mean (cfg)", "ms")
+        assert lower_is_better(
+            "serve_elastic.migrated_bytes (cfg)", "bytes"
+        )
+        assert lower_is_better(
+            "serve_elastic.n_invalidated_sessions (cfg)", "count"
+        )
+        assert lower_is_better(
+            "serve_elastic.n_spawn_failures (cfg)", "count"
+        )

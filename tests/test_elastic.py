@@ -1,0 +1,787 @@
+"""SLO-driven elastic serving (glom_tpu/serve/elastic.py, ISSUE 15).
+
+The tier-1 locks:
+
+  * POLICY CORE under a fake clock, no engine spawns: min-dwell
+    hysteresis (no flapping across the water marks), cooldown, min/max
+    clamps, breach-vs-headroom signal precedence, drain-target
+    selection;
+  * the AUTOSCALER actuator against a real DynamicBatcher with fake
+    engines: a spawned replica receives ZERO admitted work before its
+    warmup() precompile completes (test-pinned), a failed spawn rolls
+    back loudly (stamped spawn_rollback, fleet unchanged), a scale-in
+    runs the full drain chain (drain_begin -> drain_flush ->
+    drain_migrate -> drain_release, one decision_id) with DRAINED
+    distinct from dead (no probation, no capacity record);
+  * CAPACITY-RECORD state stamping (ok/draining/probation/dead) and the
+    SLO monitor's headroom exclusion of draining/probation engines;
+  * session MIGRATION: a drained engine's paged columns are bitwise-
+    served from the sibling pool, or invalidated with the stamped
+    `drain` reason when the sibling has no page budget;
+  * the STATIC path (no autoscaler attached) keeps the summary record
+    shape byte-for-byte — no elastic nest, no drain keys.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from glom_tpu.serve.batcher import DynamicBatcher
+from glom_tpu.serve.elastic import (
+    Autoscaler,
+    ElasticPolicy,
+    resolve_policy,
+)
+from glom_tpu.serve.engine import ServeResult
+from glom_tpu.telemetry import schema
+from glom_tpu.telemetry.aggregate import SLOMonitor
+from glom_tpu.utils.config import ServeConfig
+
+IMG = np.zeros((3, 8, 8), np.float32)
+
+
+class Sink:
+    def __init__(self):
+        self.records = []
+
+    def write(self, rec):
+        self.records.append(rec)
+
+    def events(self, *names):
+        return [r for r in self.records if r.get("event") in names]
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def advance(self, dt):
+        self.t += dt
+
+    def __call__(self):
+        return self.t
+
+
+class FakeEngine:
+    """Engine-shaped probe that records warmup/dispatch ORDER — the
+    admission-after-precompile pin reads it."""
+
+    def __init__(self, name="engine0", buckets=(1, 2, 4)):
+        self.name = name
+        self.scfg = ServeConfig(
+            buckets=buckets, max_batch=max(buckets), max_delay_ms=2.0,
+            queue_depth=16,
+        )
+        self.warmed = False
+        self.released = False
+        self.calls = []
+        self.infer_before_warmup = 0
+
+    def warmup(self, *a, **kw):
+        self.warmed = True
+        return {}
+
+    def release(self):
+        self.released = True
+
+    def pick_bucket(self, n):
+        for b in self.scfg.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"n={n} exceeds the largest bucket")
+
+    def infer(self, imgs, n_valid=None, **kw):
+        if not self.warmed:
+            self.infer_before_warmup += 1
+        b = imgs.shape[0]
+        self.calls.append((b, n_valid))
+        return ServeResult(
+            levels=np.zeros((b, 16, 3, 16), np.float32),
+            iters_run=4,
+            latency_s=0.0,
+            bucket=b,
+            compiled=False,
+        )
+
+
+def _policy(clock, **kw):
+    kw.setdefault("min_engines", 1)
+    kw.setdefault("max_engines", 4)
+    kw.setdefault("low_water", 0.2)
+    kw.setdefault("high_water", 0.7)
+    kw.setdefault("dwell_s", 1.0)
+    kw.setdefault("cooldown_s", 3.0)
+    kw.setdefault("window_s", 10.0)
+    return ElasticPolicy(clock=clock, **kw)
+
+
+class ScriptedPolicy(ElasticPolicy):
+    """Actuator-test policy: decide() pops scripted actions."""
+
+    def __init__(self, actions):
+        super().__init__(min_engines=1, max_engines=8)
+        self._actions = list(actions)
+
+    def decide(self, n_engines):
+        if not self._actions:
+            return None
+        return {"action": self._actions.pop(0), "signal": {"rule": "test"}}
+
+
+# ---------------------------------------------------------------------------
+# the policy core (fake clock, no engines)
+# ---------------------------------------------------------------------------
+
+
+class TestElasticPolicy:
+    def test_dwell_gates_scale_out(self):
+        """One low sample never acts; the condition must hold
+        CONTINUOUSLY for dwell_s."""
+        clk = FakeClock()
+        p = _policy(clk)
+        p.observe_headroom(0.05)
+        assert p.decide(1) is None  # below low, but 0s of dwell
+        clk.advance(0.5)
+        p.observe_headroom(0.05)
+        assert p.decide(1) is None  # 0.5s < dwell 1.0
+        clk.advance(0.6)
+        p.observe_headroom(0.05)
+        d = p.decide(1)
+        assert d is not None and d["action"] == "scale_out"
+        assert d["signal"]["rule"] == "headroom"
+        assert d["signal"]["observed"] == 0.05
+
+    def test_hysteresis_no_flapping_across_the_marks(self):
+        """A value OSCILLATING around a water mark resets the dwell
+        anchor every crossing — it never accumulates enough hold to
+        act, in either direction."""
+        clk = FakeClock()
+        p = _policy(clk)
+        for _ in range(40):  # 20s of oscillation >> dwell
+            clk.advance(0.5)
+            p.observe_headroom(0.1)   # below low
+            assert p.decide(2) is None
+            clk.advance(0.5)
+            p.observe_headroom(0.5)   # back between the marks: reset
+            assert p.decide(2) is None
+
+    def test_dwell_gates_scale_in(self):
+        clk = FakeClock()
+        p = _policy(clk)
+        p.observe_headroom(0.9)
+        assert p.decide(2) is None
+        clk.advance(1.1)
+        p.observe_headroom(0.9)
+        d = p.decide(2)
+        assert d is not None and d["action"] == "scale_in"
+
+    def test_cooldown_blocks_the_next_action(self):
+        clk = FakeClock()
+        p = _policy(clk)
+        p.observe_headroom(0.05)
+        clk.advance(1.1)
+        p.observe_headroom(0.05)
+        assert p.decide(1)["action"] == "scale_out"
+        p.acted("scale_out")
+        # The condition keeps holding, but the cooldown gates:
+        clk.advance(1.5)
+        p.observe_headroom(0.05)
+        assert p.decide(2) is None  # 1.5s < cooldown 3.0
+        clk.advance(2.0)  # cooldown passed; dwell re-accumulates from
+        p.observe_headroom(0.05)   # the post-action below-samples
+        assert p.decide(2)["action"] == "scale_out"
+
+    def test_min_max_clamps(self):
+        clk = FakeClock()
+        p = _policy(clk, min_engines=2, max_engines=3)
+        p.observe_headroom(0.05)
+        clk.advance(1.1)
+        p.observe_headroom(0.05)
+        assert p.decide(3) is None  # at max: no scale-out
+        assert p.decide(2)["action"] == "scale_out"
+        p2 = _policy(clk, min_engines=2, max_engines=3)
+        p2.observe_headroom(0.9)
+        clk.advance(1.1)
+        p2.observe_headroom(0.9)
+        assert p2.decide(2) is None  # at min: no scale-in
+        assert p2.decide(3)["action"] == "scale_in"
+
+    def test_breach_precedence(self):
+        """An SLO breach forces scale-out consideration even at
+        comfortable headroom, and VETOES scale-in outright."""
+        clk = FakeClock()
+        p = _policy(clk)
+        # Headroom comfortably high AND sustained — scale-in would arm...
+        p.observe_headroom(0.9)
+        clk.advance(1.1)
+        p.observe_headroom(0.9)
+        p.note_breach("p99_ms")
+        # ...but the breach wins both ways:
+        d = p.decide(2)
+        assert d is not None and d["action"] == "scale_out"
+        assert d["signal"]["rule"] == "p99_ms"
+        assert p.decide(8) is None  # clamped at max AND breach vetoes in
+
+    def test_breach_ages_out_of_the_window(self):
+        clk = FakeClock()
+        p = _policy(clk, window_s=5.0)
+        p.note_breach("p99_ms")
+        clk.advance(6.0)
+        p.observe_headroom(0.9)
+        clk.advance(1.1)
+        p.observe_headroom(0.9)
+        d = p.decide(2)
+        assert d is not None and d["action"] == "scale_in"
+
+    def test_acted_resets_dwell_anchors(self):
+        clk = FakeClock()
+        p = _policy(clk, cooldown_s=0.0)
+        p.observe_headroom(0.05)
+        clk.advance(1.1)
+        p.observe_headroom(0.05)
+        assert p.decide(1)["action"] == "scale_out"
+        p.acted("scale_out")
+        # No cooldown, but the dwell must re-earn its hold from scratch
+        # under the new fleet shape:
+        assert p.decide(2) is None
+
+    def test_signal_window_embedded(self):
+        clk = FakeClock()
+        p = _policy(clk)
+        for _ in range(3):
+            clk.advance(0.6)
+            p.observe_headroom(0.1)
+        d = p.decide(1)
+        sig = d["signal"]
+        assert sig["low_water"] == 0.2 and sig["high_water"] == 0.7
+        assert sig["dwell_s"] == 1.0 and len(sig["samples"]) == 3
+        assert all(t <= 0 for t, _ in sig["samples"])
+
+    def test_drain_target_least_loaded_eligible_only(self):
+        caps = [
+            {"engine": "e0", "state": "ok", "headroom": 0.4},
+            {"engine": "e1", "state": "ok", "headroom": 0.9},
+            {"engine": "e2", "state": "draining", "headroom": 1.0},
+            {"engine": "e3", "state": "probation", "headroom": 1.0},
+            {"engine": "e4", "state": "dead", "headroom": 0.0},
+        ]
+        assert ElasticPolicy.pick_drain_target(caps) == "e1"
+        assert ElasticPolicy.pick_drain_target(caps[2:]) is None
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ElasticPolicy(min_engines=0)
+        with pytest.raises(ValueError):
+            ElasticPolicy(min_engines=3, max_engines=2)
+        with pytest.raises(ValueError):
+            ElasticPolicy(low_water=0.8, high_water=0.5)
+        with pytest.raises(ValueError):
+            ElasticPolicy(window_s=0)
+
+    def test_resolve_policy_from_config(self):
+        scfg = ServeConfig(
+            elastic=True, min_engines=2, max_engines=5,
+            elastic_low_water=0.1, elastic_high_water=0.8,
+            elastic_dwell_s=0.5, elastic_cooldown_s=1.0,
+        )
+        p = resolve_policy(scfg)
+        assert (p.min_engines, p.max_engines) == (2, 5)
+        assert (p.low_water, p.high_water) == (0.1, 0.8)
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            ServeConfig(min_engines=0)
+        with pytest.raises(ValueError):
+            ServeConfig(min_engines=3, max_engines=1)
+        with pytest.raises(ValueError):
+            ServeConfig(elastic_low_water=0.9, elastic_high_water=0.5)
+        with pytest.raises(ValueError):
+            ServeConfig(elastic_shed_rate=1.5)
+
+
+# ---------------------------------------------------------------------------
+# the autoscaler actuator (real batcher, fake engines, no control thread)
+# ---------------------------------------------------------------------------
+
+
+def _batcher(n=1, writer=None, **kw):
+    engines = [FakeEngine(name=f"engine{i}") for i in range(n)]
+    for e in engines:
+        e.warmup()
+    b = DynamicBatcher(engines=engines, writer=writer, **kw)
+    return b, engines
+
+
+class TestAutoscalerScaleOut:
+    def test_spawn_warms_before_admission(self):
+        """THE admission pin: a freshly spawned engine receives zero
+        admitted work before its warmup() precompile completes, and the
+        decision -> scale_out -> admission_open chain is stamped in
+        order with one decision_id."""
+        sink = Sink()
+        b, _ = _batcher(1, writer=sink)
+        spawned = []
+
+        def factory():
+            e = FakeEngine(name="engine1")
+            spawned.append(e)
+            return e
+
+        with b:
+            sc = Autoscaler(
+                b, factory, policy=ScriptedPolicy(["scale_out"]),
+                writer=sink,
+            )
+            assert sc.tick() is not None
+            assert b.n_active_engines() == 2
+            for _ in range(8):
+                b.submit(IMG)
+            # Serve everything through the two-engine fleet.
+            deadline = time.monotonic() + 10.0
+            while b.summary_record()["n_served"] < 8:
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+        (eng,) = spawned
+        assert eng.warmed and eng.infer_before_warmup == 0
+        chain = sink.events(
+            "scale_out_decision", "scale_out", "admission_open"
+        )
+        assert [r["event"] for r in chain] == [
+            "scale_out_decision", "scale_out", "admission_open"
+        ]
+        assert len({r["decision_id"] for r in chain}) == 1
+        out = sink.events("scale_out")[0]
+        assert out["engine"] == "engine1" and out["n_engines"] == 2
+        assert isinstance(out["spawn_ms"], float)
+        assert out["signal"]["rule"] == "test"
+
+    def test_spawn_fault_rolls_back(self):
+        """A failed scale-out leaves the fleet UNCHANGED, stamps
+        spawn_rollback (+ the injected fault's own ground-truth event),
+        and charges the cooldown so a persistent fault cannot hot-spin
+        spawns."""
+        from glom_tpu.resilience.faults import FaultPlan, spawn_fault
+
+        sink = Sink()
+        b, _ = _batcher(1, writer=sink)
+        plan = FaultPlan(writer=sink)
+        plan.register("engine-spawn", at=(0,), fault="spawn-fault")
+        calls = []
+
+        def factory():
+            calls.append(1)
+            return FakeEngine(name="engine1")
+
+        with b:
+            sc = Autoscaler(
+                b, factory,
+                policy=ScriptedPolicy(["scale_out", "scale_out"]),
+                writer=sink, spawn_hook=spawn_fault(plan),
+            )
+            sc.tick()
+            assert b.n_active_engines() == 1 and not calls
+            assert sc.n_spawn_failures == 1
+            # The cooldown was charged: the scripted policy ignores it
+            # here, but the real policy's acted() ran — next tick's
+            # spawn attempt (index 1) is past the fault window and lands.
+            sc.tick()
+            assert b.n_active_engines() == 2 and len(calls) == 1
+        rb = sink.events("spawn_rollback")
+        assert len(rb) == 1 and "InjectedFault" in rb[0]["exception"]
+        faults = [
+            r for r in sink.records
+            if r.get("kind") == "fault" and r.get("site") == "engine-spawn"
+        ]
+        assert len(faults) == 1
+
+    def test_factory_failure_also_rolls_back(self):
+        sink = Sink()
+        b, _ = _batcher(1, writer=sink)
+
+        def factory():
+            raise RuntimeError("no devices left")
+
+        with b:
+            sc = Autoscaler(
+                b, factory, policy=ScriptedPolicy(["scale_out"]),
+                writer=sink,
+            )
+            sc.tick()
+            assert b.n_active_engines() == 1
+        assert sink.events("spawn_rollback")
+
+    def test_add_engine_duplicate_name_raises(self):
+        b, _ = _batcher(1)
+        with pytest.raises(ValueError):
+            b.add_engine(FakeEngine(name="engine0"))
+
+    def test_max_engines_never_exceeded_by_real_policy(self):
+        """Breach-driven scale-out through the REAL policy clamps at
+        max_engines: the breach keeps firing, the fleet stops at 2."""
+        sink = Sink()
+        b, _ = _batcher(1, writer=sink)
+        clk = FakeClock()
+        pol = _policy(clk, max_engines=2, dwell_s=0.0, cooldown_s=0.0)
+        k = [0]
+
+        def factory():
+            k[0] += 1
+            return FakeEngine(name=f"spawn{k[0]}")
+
+        with b:
+            sc = Autoscaler(b, factory, policy=pol, writer=sink)
+            for _ in range(5):
+                clk.advance(1.0)
+                pol.note_breach("p99_ms")  # persistent breach in-window
+                sc.tick()
+        assert b.n_active_engines() == 2 and k[0] == 1
+
+    def test_tick_feeds_only_eligible_headroom(self):
+        """The control tick's headroom sample is the min across 'ok'
+        engines only — a draining engine's value never reaches the
+        policy."""
+        sink = Sink()
+        b, _ = _batcher(2, writer=sink)
+        seen = []
+
+        class Recording(ElasticPolicy):
+            def observe_headroom(self, h):
+                seen.append(h)
+                super().observe_headroom(h)
+
+        b.begin_drain("engine0")
+        sc = Autoscaler(
+            b, lambda: FakeEngine(), writer=sink,
+            policy=Recording(min_engines=1, max_engines=4),
+        )
+        sc.tick()
+        caps = {c["engine"]: c for c in b.capacity_records()}
+        assert seen == [caps["engine1"]["headroom"]]
+
+
+class TestAutoscalerScaleIn:
+    def test_drain_chain_and_release(self):
+        """The graceful drain: decision -> drain_begin -> drain_flush ->
+        drain_migrate -> drain_release, one decision_id; the drained
+        engine is DRAINED (not dead): worker gone, no probation, no
+        capacity record, release() called — and every later request is
+        served by the survivor with conservation intact."""
+        sink = Sink()
+        b, engines = _batcher(2, writer=sink, rejoin_threshold=3)
+        with b:
+            for _ in range(4):
+                b.submit(IMG)
+            sc = Autoscaler(
+                b, lambda: FakeEngine(), writer=sink,
+                policy=ScriptedPolicy(["scale_in"]),
+            )
+            deadline = time.monotonic() + 10.0
+            while b.summary_record()["n_served"] < 4:
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            assert sc.tick() is not None
+            assert b.n_active_engines() == 1
+            drained = sink.events("drain_release")[0]["engine"]
+            # DRAINED is distinct from dead: no probation thread spun up
+            # for the voluntary exit (rejoin_threshold is armed!).
+            assert not sink.events("engine_probation")
+            for _ in range(6):
+                b.submit(IMG)
+            deadline = time.monotonic() + 10.0
+            while b.summary_record()["n_served"] < 10:
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            s = b.summary_record()
+            assert s["n_failed"] == 0 and s["n_served"] == 10
+            assert s["engines"][drained]["drained"] is True
+            # The drained engine emits no capacity record...
+            caps = b.capacity_records()
+            assert drained not in {c["engine"] for c in caps}
+            # ...and the survivor reads "ok".
+            assert all(c["state"] == "ok" for c in caps)
+            assert s["elastic"]["n_scale_ins"] == 1
+            assert s["elastic"]["n_engines"] == 1
+        eng = b.engine_by_name(drained)
+        assert eng is not None and eng.released
+        chain = sink.events(
+            "scale_in_decision", "drain_begin", "drain_flush",
+            "drain_migrate", "drain_release",
+        )
+        assert [r["event"] for r in chain] == [
+            "scale_in_decision", "drain_begin", "drain_flush",
+            "drain_migrate", "drain_release",
+        ]
+        assert len({r.get("decision_id") for r in chain}) == 1
+
+    def test_drain_refuses_last_live_engine(self):
+        b, _ = _batcher(1)
+        with b:
+            with pytest.raises(ValueError):
+                b.drain_engine("engine0")
+            # Still serving after the refusal:
+            t = b.submit(IMG)
+            t.result(timeout=10.0)
+
+    def test_drain_target_follows_least_loaded(self):
+        """The scaler drains the max-headroom 'ok' engine (the capacity
+        records decide, not engine order)."""
+        sink = Sink()
+        b, engines = _batcher(2, writer=sink)
+        with b:
+            # Load engine0's affinity lane is impractical with fakes —
+            # instead pin via capacity: both idle => headroom ties at
+            # 1.0, tie breaks to the LAST name (deterministic).
+            sc = Autoscaler(
+                b, lambda: FakeEngine(), writer=sink,
+                policy=ScriptedPolicy(["scale_in"]),
+            )
+            sc.tick()
+            assert sink.events("scale_in_decision")[0]["engine"] == "engine1"
+
+    def test_draining_state_stamped_and_excluded_from_admission(self):
+        sink = Sink()
+        b, _ = _batcher(2, writer=sink)
+        b.begin_drain("engine0")
+        caps = {c["engine"]: c for c in b.capacity_records()}
+        assert caps["engine0"]["state"] == "draining"
+        assert caps["engine1"]["state"] == "ok"
+        assert b._alive_engines() == ["engine1"]
+        assert b.n_active_engines() == 1
+
+    def test_drained_engine_never_enters_probation(self):
+        """Review pin: a drain whose in-flight flush outlives the join
+        timeout reaches the worker's dead-exit with alive already False
+        — the probation path must refuse the voluntary exit (a rejoin
+        would re-admit a RELEASED husk)."""
+        b, engines = _batcher(2, rejoin_threshold=2)
+        with b:
+            b.drain_engine("engine0")
+        # The husk is drained; even a direct probation attempt refuses.
+        b._start_probation(engines[0], "engine0")
+        with b._engine_lock:
+            st = dict(b._engine_state["engine0"])
+        assert not st["probation"] and not st["alive"]
+        assert "engine0" in b._drained
+
+    def test_last_admitting_engine_survives_failures_during_drain(self):
+        """Review pin: while a sibling DRAINS, the one remaining
+        admitting engine IS the single-engine fleet — consecutive
+        failures must not mark it dead (the keeps-serving contract)."""
+        b, _ = _batcher(2)
+        b.begin_drain("engine1")
+        for _ in range(5):  # way past engine_fail_threshold
+            state = b._note_failure("engine0")
+        assert state["alive"], "last admitting engine marked dead while "
+        "its sibling drained"
+        assert b._alive_engines() == ["engine0"]
+
+    def test_drain_never_started_batcher(self):
+        """drain_engine on a never-started batcher still completes (no
+        worker to join) — the affinity queue is handed back here."""
+        sink = Sink()
+        b, _ = _batcher(2, writer=sink)
+        stats = b.drain_engine("engine0", timeout=1.0)
+        assert stats["flush_ok"] is True
+        assert sink.events("drain_begin") and sink.events("drain_flush")
+
+
+# ---------------------------------------------------------------------------
+# capacity-state satellite: the SLO monitor's headroom exclusion
+# ---------------------------------------------------------------------------
+
+
+class TestHeadroomExclusion:
+    @staticmethod
+    def _cap(engine, headroom, state):
+        return schema.stamp(
+            {"engine": engine, "headroom": headroom, "state": state},
+            kind="capacity",
+        )
+
+    def test_draining_and_probation_excluded(self):
+        """A draining engine's 0.0 headroom must NOT drag the windowed
+        min — it would fire a permanent false breach that re-triggers
+        the very autoscaler that caused the drain."""
+        m = SLOMonitor({"headroom": 0.5}, window_s=60.0)
+        m.observe(self._cap("e0", 0.9, "ok"))
+        m.observe(self._cap("e1", 0.0, "draining"))
+        m.observe(self._cap("e2", 0.0, "probation"))
+        assert m.observed()["headroom"] == 0.9
+        assert m.evaluate() == []
+
+    def test_dead_and_ok_still_count(self):
+        """A DEAD engine's 0.0 stays a real signal (an involuntary
+        death IS lost capacity), as does any ok engine."""
+        m = SLOMonitor({"headroom": 0.5}, window_s=60.0)
+        m.observe(self._cap("e0", 0.9, "ok"))
+        m.observe(self._cap("e1", 0.0, "dead"))
+        assert m.observed()["headroom"] == 0.0
+        assert len(m.evaluate()) == 1
+
+    def test_stateless_records_still_count(self):
+        """Pre-v8 capacity records (no state key) keep the old
+        behavior — the exclusion never hides a legacy stream."""
+        m = SLOMonitor({"headroom": 0.5}, window_s=60.0)
+        m.observe(
+            schema.stamp({"engine": "e0", "headroom": 0.1}, kind="capacity")
+        )
+        assert m.observed()["headroom"] == 0.1
+
+
+# ---------------------------------------------------------------------------
+# device-group resolution for a runtime spawn
+# ---------------------------------------------------------------------------
+
+
+class TestEngineMeshFor:
+    def test_next_contiguous_group_and_exhaustion(self):
+        """A spawned replica takes the group the static partitioning
+        would have given it; an exhausted pool raises loudly (the
+        spawn_rollback path)."""
+        from glom_tpu.parallel.runtime import engine_mesh_for
+
+        scfg = ServeConfig(buckets=(2, 4), max_batch=4, mesh_data=2)
+        m0 = engine_mesh_for(scfg, 0)
+        m3 = engine_mesh_for(scfg, 3)  # 8 virtual devices / 2 per group
+        assert m0 is not None and m3 is not None
+        assert list(m0.devices.flat) != list(m3.devices.flat)
+        with pytest.raises(ValueError):
+            engine_mesh_for(scfg, 4)
+        # Single-device route: no mesh at any index.
+        assert engine_mesh_for(
+            ServeConfig(buckets=(1, 2), max_batch=2), 7
+        ) is None
+
+
+# ---------------------------------------------------------------------------
+# session migration: bitwise to a sibling pool, or stamped invalidation
+# ---------------------------------------------------------------------------
+
+
+class TestSessionMigration:
+    @staticmethod
+    def _pools(dst_pages=16):
+        from glom_tpu.serve.column_cache import ColumnCache
+        from glom_tpu.serve.paged_columns import PagedColumnPool
+        from glom_tpu.utils.config import GlomConfig
+
+        cfg = GlomConfig(dim=16, levels=3, image_size=8, patch_size=2)
+        mk = lambda name, pages: PagedColumnPool(
+            cfg,
+            ServeConfig(page_pool_pages=pages, page_tokens=4),
+            name=name,
+        )
+        pools = {"A": mk("A", 16), "B": mk("B", dst_pages)}
+        cache = ColumnCache(budget_bytes=1 << 24, pools=pools)
+        return cfg, pools, cache
+
+    def test_migrate_bitwise_to_sibling_pool(self):
+        """A drained engine's session is bitwise-served from the sibling
+        pool after migration: the bytes round-trip src -> host -> dst
+        with no float op anywhere."""
+        cfg, pools, cache = self._pools()
+        rng = np.random.default_rng(0)
+        state = rng.normal(size=(cfg.num_patches, cfg.levels, cfg.dim))
+        state = state.astype(np.float32)
+        assert cache.store("s0", state, engine="A", n_tokens=cfg.num_patches)
+        out = cache.migrate_engine_sessions("A", "B", reason="drain")
+        assert out["n_migrated"] == 1 and out["n_invalidated"] == 0
+        assert out["bytes_migrated"] == state.nbytes
+        hit = cache.lookup("s0")
+        assert hit is not None and hit.engine == "B"
+        assert np.array_equal(pools["B"].read_block("s0"), state)
+        assert pools["A"].pages_used() == 0  # src pages freed
+
+    def test_no_budget_invalidates_with_drain_reason(self):
+        """No page budget on the sibling: the session is INVALIDATED
+        with the stamped `drain` reason — never silently dropped."""
+        cfg, pools, cache = self._pools(dst_pages=4)
+        full = np.ones(
+            (cfg.num_patches, cfg.levels, cfg.dim), np.float32
+        )
+        sink = Sink()
+        cache.writer = sink
+        # Fill B so the migration target has no room (16 patches / 4
+        # page_tokens = 4 pages per session; B holds exactly one).
+        assert cache.store("b0", full, engine="B", n_tokens=cfg.num_patches)
+        assert cache.store("a0", full * 2, engine="A", n_tokens=cfg.num_patches)
+        # Pin B's block so eviction cannot make room either.
+        cache.lookup("b0", pin=True)
+        out = cache.migrate_engine_sessions("A", "B", reason="drain")
+        assert out["n_migrated"] == 0 and out["n_invalidated"] == 1
+        assert cache.lookup("a0") is None
+        inv = [
+            r for r in sink.records
+            if r.get("event") == "cache_invalidate"
+            and r.get("reason") == "drain"
+        ]
+        assert inv and pools["A"].pages_used() == 0
+
+    def test_no_destination_invalidates(self):
+        cfg, pools, cache = self._pools()
+        full = np.ones((cfg.num_patches, cfg.levels, cfg.dim), np.float32)
+        assert cache.store("a0", full, engine="A", n_tokens=cfg.num_patches)
+        out = cache.migrate_engine_sessions("A", None, reason="drain")
+        assert out["n_invalidated"] == 1 and cache.lookup("a0") is None
+
+    def test_host_mode_retags(self):
+        """Host-mode entries are engine-agnostic arrays: migration is a
+        zero-byte re-tag."""
+        from glom_tpu.serve.column_cache import ColumnCache
+
+        cache = ColumnCache(budget_bytes=1 << 20)
+        cache.store("s0", np.ones((4, 2, 4), np.float32), engine="A")
+        out = cache.migrate_engine_sessions("A", "B", reason="drain")
+        assert out == {
+            "n_migrated": 1, "n_invalidated": 0, "bytes_migrated": 0
+        }
+        assert cache.lookup("s0") is not None
+
+    def test_remove_pool_invalidates_leftovers(self):
+        cfg, pools, cache = self._pools()
+        full = np.ones((cfg.num_patches, cfg.levels, cfg.dim), np.float32)
+        assert cache.store("a0", full, engine="A", n_tokens=cfg.num_patches)
+        cache.remove_pool("A")
+        assert cache.lookup("a0") is None
+        assert "A" not in cache.pools
+
+    def test_pool_release_frees_and_drops_buffer(self):
+        cfg, pools, cache = self._pools()
+        full = np.ones((cfg.num_patches, cfg.levels, cfg.dim), np.float32)
+        assert cache.store("a0", full, engine="A", n_tokens=cfg.num_patches)
+        cache.remove_pool("A")
+        pools["A"].release()
+        rec = pools["A"].record()
+        assert rec["pages_used"] == 0
+        assert pools["A"].buffer() is None
+
+
+# ---------------------------------------------------------------------------
+# static-path contract: no autoscaler => byte-for-byte the PR 13 shape
+# ---------------------------------------------------------------------------
+
+
+class TestStaticPathUnchanged:
+    def test_summary_shape_has_no_elastic_keys(self):
+        b, _ = _batcher(2)
+        with b:
+            for _ in range(3):
+                b.submit(IMG)
+            deadline = time.monotonic() + 10.0
+            while b.summary_record()["n_served"] < 3:
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+        s = b.summary_record()
+        assert "elastic" not in s
+        for st in s["engines"].values():
+            assert "draining" not in st and "drained" not in st
+            assert set(st) == {
+                "alive", "dispatches", "consecutive_failures",
+                "probation", "rejoins",
+            }
+
+    def test_capacity_record_state_ok(self):
+        b, _ = _batcher(1)
+        (c,) = b.capacity_records()
+        assert c["state"] == "ok" and c["alive"] is True

@@ -346,6 +346,40 @@ class TestCapacityObservatoryTracks:
         assert c["name"] == "headroom:engine0"
         assert c["args"]["headroom"] == 0.7
 
+    def test_scale_events_render_global_instants_and_fleet_counter(self):
+        """Elastic transitions (schema v8) are FULL-HEIGHT instants and
+        every n_engines-carrying record samples the fleet counter —
+        capacity following load, drawn."""
+        evs = to_trace_events([
+            schema.stamp(
+                {"event": "scale_out_decision", "decision_id": 1,
+                 "n_engines": 1, "wall_time": 1.0},
+                kind="serve",
+            ),
+            schema.stamp(
+                {"event": "scale_out", "decision_id": 1,
+                 "engine": "engine1", "n_engines": 2, "spawn_ms": 900.0,
+                 "wall_time": 2.0},
+                kind="serve",
+            ),
+            schema.stamp(
+                {"event": "drain_release", "decision_id": 2,
+                 "engine": "engine1", "n_engines": 1, "wall_time": 3.0},
+                kind="serve",
+            ),
+        ])
+        instants = [
+            e for e in evs if e["ph"] == "i" and e.get("s") == "g"
+        ]
+        assert {e["name"] for e in instants} == {
+            "elastic:scale_out_decision", "elastic:scale_out",
+            "elastic:drain_release",
+        }
+        fleet = [e for e in evs if e["ph"] == "C"
+                 and e["name"] == "fleet:n_engines"]
+        assert [e["args"]["n_engines"] for e in fleet] == [1.0, 2.0, 1.0]
+        assert len({e["tid"] for e in fleet}) == 1
+
     def test_dispatch_phase_split_renders_nested_slices(self):
         rec = schema.stamp(
             {"event": "dispatch", "engine": "engine0", "bucket": 2,

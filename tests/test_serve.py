@@ -738,11 +738,26 @@ class TestMultiEngineFanOut:
         good.name = "good"
         with DynamicBatcher(engines=[bad, good], max_batch=2,
                             max_delay_ms=10.0, writer=sink) as b:
-            tickets = [b.submit(IMG) for _ in range(6)]
+            # PACED submissions until "bad" has demonstrably taken (and
+            # failed) a batch: the fairness rotation hands the idle
+            # worker the next request, so the failover path runs
+            # deterministically — an all-at-once burst made ONE pickup
+            # race decide whether it ran at all (this test was flaky
+            # exactly that way).
+            tickets = [b.submit(IMG)]
+            deadline = time.monotonic() + 10.0
+            while not any(
+                r.get("event") == "engine_failover" for r in sink.records
+            ):
+                assert time.monotonic() < deadline, "bad never dispatched"
+                time.sleep(0.02)
+                tickets.append(b.submit(IMG))
+            tickets += [b.submit(IMG) for _ in range(2)]
             outs = [t.result(timeout=10.0) for t in tickets]
             summary = b.summary_record()
+        n = len(tickets)
         assert all(o[1] == 6 for o in outs)
-        assert summary["n_served"] == 6 and summary["n_failed"] == 0
+        assert summary["n_served"] == n and summary["n_failed"] == 0
         assert summary["n_redispatched"] >= 1
         assert not summary["engines"]["bad"]["alive"]
         assert summary["engines"]["bad"]["dispatches"] == 0
@@ -1166,7 +1181,24 @@ class TestRequestTracing:
         sink = Sink()
         with DynamicBatcher(engines=[bad, good], max_batch=4,
                             max_delay_ms=10.0, writer=sink) as b:
-            tickets = [b.submit(IMG) for _ in range(3)]
+            # PACED submissions (one per pickup) until the failing
+            # engine has demonstrably taken a batch: the fairness
+            # rotation hands the idle worker the next request, so "bad"
+            # deterministically dispatches within a few requests — an
+            # all-at-once burst would make ONE pickup race decide
+            # whether the failover path runs at all (this test was
+            # flaky exactly that way).
+            tickets = [b.submit(IMG)]
+            deadline = time.monotonic() + 10.0
+            while not any(
+                r.get("event") == "engine_failover" for r in sink.records
+            ):
+                assert time.monotonic() < deadline, "bad never dispatched"
+                time.sleep(0.02)
+                tickets.append(b.submit(IMG))
+            # A couple more rides AFTER the failover so post-failover
+            # serving (and its continuations) cross the trace too.
+            tickets += [b.submit(IMG) for _ in range(2)]
             outs = [t.result(timeout=10.0) for t in tickets]
         recs = sink.records
         for r in recs:
