@@ -929,6 +929,256 @@ def run_ragged(cfg, scfg, label: str, *, n_streams: int, n_frames: int,
     return waste
 
 
+def run_banded_ab(cfg, scfg, label: str, *, n_streams: int, n_frames: int,
+                  perturb: float) -> dict:
+    """Block-banded consensus vs the windowed gather, and aliased vs
+    copy-on-write pool write-backs, over the SAME ragged streamed
+    traffic (docs/SERVING.md, "Block-banded ragged consensus" / "Pool
+    aliasing").
+
+    Three arms serve identical mixed-resolution frame streams through
+    the ragged paged route:
+
+      * windowed     — the per-token W-fold k/v gather, CoW pool writes;
+      * banded       — the per-page block-banded route, CoW pool writes;
+      * banded-alias — banded attention + in-place pool aliasing.
+
+    The measured numbers: `serve_ragged_peak_window_bytes` per arm (the
+    duplicated k/v working set at the largest DISPATCHED signature —
+    banded must sit strictly below windowed: the gate's cost row),
+    `serve_ragged_max_signature_pages` per arm (the largest signature
+    the windowed arm's top-of-ladder byte budget admits — it must
+    strictly GROW under banded), `serve_pool_bytes_moved` per arm
+    (aliased write-backs must move fewer bytes than CoW),
+    `serve_levels0_h2d_bytes` per arm (zero on the pool warm path,
+    aliasing or not), and the threshold-0 `serve_banded_parity` row: one
+    mixed dispatch through both attentions, compared BITWISE on every
+    row's page span — the 1.0-or-fail gate (unused trailing pages sit
+    outside the contract; tests/test_banded_alias.py). Returns
+    {arm: peak_window_bytes}."""
+    import dataclasses
+
+    import numpy as np
+
+    from glom_tpu.serve.batcher import DynamicBatcher, ShedError
+    from glom_tpu.serve.column_cache import column_state_bytes
+    from glom_tpu.serve.early_exit import ragged_window_bytes
+    from glom_tpu.serve.paged_columns import (
+        pages_for_tokens,
+        resolve_page_tokens,
+    )
+    from glom_tpu.telemetry.sinks import emit
+
+    if scfg.iters != "auto":
+        emit(
+            {"note": "banded A/B skipped: the configured route is not "
+             "iters='auto' (the ragged warm path needs the auto route)"},
+            kind="note",
+        )
+        return {}
+    rng = np.random.default_rng(17)
+    p = cfg.patch_size
+    side = cfg.image_size
+    sizes = sorted(
+        {max(p, (side * f // (4 * p)) * p) for f in (4, 3, 2)}, reverse=True
+    )
+    stream_size = [sizes[s % len(sizes)] for s in range(n_streams)]
+    bases = [
+        (100.0 * rng.normal(size=(cfg.channels, hw, hw))).astype(np.float32)
+        for hw in stream_size
+    ]
+    frames = [
+        [
+            (bases[s] + perturb * rng.normal(size=bases[s].shape)).astype(
+                np.float32
+            )
+            for _ in range(n_frames)
+        ]
+        for s in range(n_streams)
+    ]
+
+    pt = resolve_page_tokens(cfg, scfg)
+    ppr = pages_for_tokens(cfg.num_patches, pt)
+    window = ppr * pt
+    itemsize = 2 if scfg.compute_dtype == "bfloat16" else 4
+    cache_bytes = (n_streams + 1) * column_state_bytes(cfg, scfg)
+    ragged_base = dict(
+        ragged=True, page_pool_pages=(n_streams + 2) * ppr, page_tokens=pt,
+        max_continuations=0, column_cache_bytes=cache_bytes,
+    )
+    arms = (
+        ("windowed", dataclasses.replace(
+            scfg, ragged_attention="windowed", **ragged_base)),
+        ("banded", dataclasses.replace(
+            scfg, ragged_attention="banded", **ragged_base)),
+        ("banded-alias", dataclasses.replace(
+            scfg, ragged_attention="banded", pool_aliasing=True,
+            **ragged_base)),
+    )
+    peak: dict = {}
+    for arm, arm_scfg in arms:
+        attention = "windowed" if arm == "windowed" else "banded"
+        engines = _make_engines(cfg, arm_scfg, 1)
+        engine = engines[0]
+        engine.warmup_ragged()
+        top_pages = max(engine.ragged_page_buckets)
+        served = 0
+        with DynamicBatcher(engines=engines) as batcher:
+            for f in range(n_frames):
+                tickets = []
+                for s in range(n_streams):
+                    try:
+                        tickets.append(
+                            batcher.submit(frames[s][f], session_id=f"s{s}")
+                        )
+                    except ShedError:
+                        continue
+                for t in tickets:
+                    try:
+                        t.result(timeout=600.0)
+                        served += 1
+                    except Exception:
+                        continue
+            summary = batcher.summary_record()
+            dispatches = list(batcher.dispatches)
+        pool_rec = engine.pool.record() if engine.pool is not None else {}
+        sig_pages = [d["n_pages"] for d in dispatches if d.get("ragged")]
+        emit(dict(summary, config=f"{arm}, {label}"), kind="serve")
+        if not sig_pages:
+            emit(
+                {
+                    "metric": (
+                        f"serve_ragged_peak_window_bytes ({arm}, {label})"
+                    ),
+                    "value": None,
+                    "unit": "bytes",
+                    "error": "no-requests-served",
+                    "note": f"UNMEASURED: banded A/B {arm} served nothing",
+                },
+                kind="error",
+            )
+            continue
+        peak[arm] = ragged_window_bytes(
+            max(sig_pages) * pt, window, cfg.levels, cfg.dim, itemsize,
+            pt, attention=attention,
+        )
+        emit(
+            {
+                "metric": (
+                    f"serve_ragged_peak_window_bytes ({arm}, {label})"
+                ),
+                "value": peak[arm],
+                "unit": "bytes",
+                "peak_signature_pages": max(sig_pages),
+                "window": window,
+                "served": served,
+            }
+        )
+        # The admission headroom the smaller working set buys: the
+        # largest signature whose duplicated k/v set still fits the
+        # WINDOWED route's budget at its top-of-ladder signature. Both
+        # routes are linear in the page count, so one page prices the
+        # whole ladder.
+        budget = ragged_window_bytes(
+            top_pages * pt, window, cfg.levels, cfg.dim, itemsize, pt,
+            attention="windowed",
+        )
+        per_page = ragged_window_bytes(
+            pt, window, cfg.levels, cfg.dim, itemsize, pt,
+            attention=attention,
+        )
+        emit(
+            {
+                "metric": (
+                    f"serve_ragged_max_signature_pages ({arm}, {label})"
+                ),
+                "value": budget // per_page,
+                "unit": "pages",
+                "byte_budget": budget,
+                "bytes_per_page": per_page,
+            }
+        )
+        emit(
+            {
+                "metric": f"serve_levels0_h2d_bytes ({arm}, {label})",
+                "value": summary["levels0_h2d_bytes"],
+                "unit": "bytes",
+                "n_page_warm": summary["n_page_warm"],
+            }
+        )
+        alias = pool_rec.get("alias") or {}
+        emit(
+            {
+                "metric": f"serve_pool_bytes_moved ({arm}, {label})",
+                "value": (
+                    pool_rec.get("cow_bytes_moved", 0)
+                    + alias.get("alias_bytes_moved", 0)
+                ),
+                "unit": "bytes",
+                "cow_bytes_moved": pool_rec.get("cow_bytes_moved", 0),
+                "alias_bytes_moved": alias.get("alias_bytes_moved", 0),
+                "n_alias_fallbacks": alias.get("n_alias_fallbacks", 0),
+                "alias_rate": alias.get("alias_rate"),
+                "n_writebacks": pool_rec.get("n_writebacks", 0),
+            }
+        )
+
+    # Threshold-0 parity probe: ONE mixed dispatch through both
+    # attentions (fresh engines, identical default params), bitwise on
+    # every row's page span — CI reads this row as a 1.0-or-fail gate.
+    ew = _make_engines(
+        cfg,
+        dataclasses.replace(scfg, ragged_attention="windowed", **ragged_base),
+        1,
+    )[0]
+    eb = _make_engines(
+        cfg,
+        dataclasses.replace(scfg, ragged_attention="banded", **ragged_base),
+        1,
+    )[0]
+    counts = [cfg.num_patches, max(1, cfg.num_patches // 4)]
+    pages = [pages_for_tokens(c, pt) for c in counts]
+    T = ew.pick_pages(sum(pages)) * pt
+    flat = np.zeros((T, cfg.patch_dim), np.float32)
+    starts, off = [], 0
+    for c, k in zip(counts, pages):
+        starts.append(off * pt)
+        flat[off * pt:off * pt + c] = rng.normal(size=(c, cfg.patch_dim))
+        off += k
+    rw = ew.infer_ragged(flat, counts, iters_override=2)
+    rb = eb.infer_ragged(flat, counts, iters_override=2)
+    lw, lb = np.asarray(rw.levels), np.asarray(rb.levels)
+    bitwise = all(
+        np.array_equal(lw[s:s + k * pt], lb[s:s + k * pt])
+        for s, k in zip(starts, pages)
+    )
+    emit(
+        {
+            "metric": f"serve_banded_parity ({label})",
+            "value": 1.0 if bitwise else 0.0,
+            "unit": "bool",
+            "note": "threshold-0 banded vs windowed mixed dispatch, "
+            "bitwise on every row's page span",
+            "counts": counts,
+        }
+    )
+    if "windowed" in peak and "banded" in peak:
+        # Informational (kind "note"): the per-arm rows are what gate.
+        emit(
+            {
+                "note": "banded working-set saving",
+                "config": label,
+                "windowed_peak_bytes": peak["windowed"],
+                "banded_peak_bytes": peak["banded"],
+                "fold": round(
+                    peak["windowed"] / max(peak["banded"], 1), 1
+                ),
+            },
+            kind="note",
+        )
+    return peak
+
+
 def run_ramp(cfg, scfg, label: str, *, profile: str = "4x100,56x0,12x200",
              max_engines: int = 2) -> dict:
     """The ELASTIC ramp (docs/SERVING.md "Elastic serving"): an
@@ -1322,6 +1572,16 @@ def main(argv=None) -> int:
                     "through the ragged page ladder, measuring pad-waste "
                     "fraction, warm/cold dispatch latency, and warm-path "
                     "levels0 upload bytes per arm (docs/SERVING.md)")
+    ap.add_argument("--banded-ab", action="store_true",
+                    help="run the block-banded vs windowed ragged "
+                    "consensus A/B INSTEAD of the load sweep: the same "
+                    "mixed-resolution streamed traffic under the "
+                    "windowed gather, the banded route, and banded + "
+                    "in-place pool aliasing — emitting the peak "
+                    "duplicated k/v working set per arm, the largest "
+                    "admissible ragged signature under the windowed "
+                    "byte budget, pool bytes moved per arm, and the "
+                    "threshold-0 bitwise parity row (docs/SERVING.md)")
     ap.add_argument("--temporal", action="store_true",
                     help="run the streaming warm-vs-cold A/B INSTEAD of "
                     "the load sweep: frame-sequence traffic per stream "
@@ -1467,6 +1727,14 @@ def main(argv=None) -> int:
             cfg, scfg, label,
             n_requests=n_requests,
             n_engines=args.engines,
+        )
+        return 0
+    if args.banded_ab:
+        run_banded_ab(
+            cfg, scfg, label,
+            n_streams=args.streams,
+            n_frames=args.frames,
+            perturb=args.perturb if args.perturb is not None else 0.05,
         )
         return 0
     if args.ragged:

@@ -43,11 +43,23 @@ now tracks, per class:
     (...)`, `self._step(...)`), the use-after-donation rule applies as
     in the intra-function case.
 
+Aliased-pool dispatch pinning (ISSUE 16): with in-place pool aliasing
+(ServeConfig.pool_aliasing) the pool's write-back DONATES the buffer on
+its own seam — any dispatch still reading it must hold a read pin
+(`PagedColumnPool.acquire_read()` / `release_read()`) so the seam falls
+back to copy-on-write instead of invalidating the in-flight read. The
+static form: a value obtained from a bare `.buffer()` call that flows
+into a donating dispatch is a finding (`alias-unpinned-dispatch`) — the
+fix is acquiring through the pin API, whose return value this rule
+deliberately does not taint. Compile-time `.buffer()` reads (dtype /
+shape probes that never reach a dispatch) stay clean.
+
 Branch structure is ignored (statement order by line); `*args` splats at
 call sites are skipped (positions unknowable — the runtime copy-guard in
 engine.infer stays the defense there), and cross-MODULE handle flows
 remain out of reach; docs/ANALYSIS.md says so. The seeded acceptance
-pair is tests/fixtures/donation_memo.py.
+pairs are tests/fixtures/donation_memo.py and
+tests/fixtures/alias_pool.py.
 """
 
 from __future__ import annotations
@@ -372,7 +384,23 @@ class DonationSafety(Checker):
         donations: List[Tuple[int, str, str]] = []  # (line, var, callee)
         rebinds: Dict[str, List[int]] = {}
         uses: List[Tuple[int, int, ast.Name]] = []
+        # Aliased-pool pinning: lines where a name was bound from a bare
+        # `.buffer()` call (the unpinned read), keyed by name — compared
+        # against the LATEST binding at each dispatch site, so a rebind
+        # through acquire_read() clears the hazard.
+        buffer_lines: Dict[str, set] = {}
+        alias_hits: List[Tuple[int, int, str, str]] = []
         for node in info.body_nodes():
+            if isinstance(node, ast.Assign) and (
+                isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Attribute)
+                and node.value.func.attr == "buffer"
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        buffer_lines.setdefault(t.id, set()).add(
+                            node.lineno
+                        )
             if isinstance(node, ast.Call):
                 spec = callee = None
                 if isinstance(node.func, ast.Name):
@@ -391,6 +419,26 @@ class DonationSafety(Checker):
                             spec == ALL_POSITIONS or pos in spec
                         ):
                             donations.append((node.lineno, arg.id, callee))
+                    for arg in node.args:
+                        if (
+                            isinstance(arg, ast.Call)
+                            and isinstance(arg.func, ast.Attribute)
+                            and arg.func.attr == "buffer"
+                        ):
+                            alias_hits.append(
+                                (
+                                    arg.lineno,
+                                    arg.col_offset,
+                                    "buffer()",
+                                    callee,
+                                )
+                            )
+                        elif isinstance(arg, ast.Name):
+                            # membership filtered below — buffer_lines
+                            # may not be complete yet mid-walk
+                            alias_hits.append(
+                                (node.lineno, arg.col_offset, arg.id, callee)
+                            )
             if isinstance(node, ast.Name):
                 if isinstance(node.ctx, ast.Store):
                     rebinds.setdefault(node.id, []).append(node.lineno)
@@ -398,6 +446,34 @@ class DonationSafety(Checker):
                     uses.append((node.lineno, node.col_offset, node))
 
         findings: List[Finding] = []
+        for line, col, what, callee in alias_hits:
+            if what != "buffer()":
+                if what not in buffer_lines:
+                    continue
+                # The latest binding at the dispatch site decides: a
+                # rebind from acquire_read() (or anything else) between
+                # the bare read and the dispatch clears the hazard.
+                binds = [r for r in rebinds.get(what, []) if r <= line]
+                if not binds or max(binds) not in buffer_lines[what]:
+                    continue
+            findings.append(
+                Finding(
+                    checker=self.name,
+                    path=module.relpath,
+                    line=line,
+                    col=col,
+                    message=(
+                        f"{what} from a bare pool.buffer() flows into "
+                        f"donating dispatch {callee}(...) without a read "
+                        "pin — under pool aliasing the pool's donated "
+                        "write-back can invalidate it mid-dispatch; "
+                        "acquire via acquire_read()/release_read() so "
+                        "the write seam falls back to copy-on-write"
+                    ),
+                    symbol=info.qualname,
+                    key="alias-unpinned-dispatch",
+                )
+            )
         for dline, var, callee in donations:
             for uline, col, name in uses:
                 if name.id != var or uline <= dline:

@@ -1643,7 +1643,16 @@ class DynamicBatcher:
     def _ragged_chunks(self, engine, batch) -> List[list]:
         """Split a gathered ragged batch so each chunk's total pages fit
         the largest ragged signature (one chunk in the common case — the
-        default ladder tops at max_batch x pages-per-full-row)."""
+        default ladder tops at max_batch x pages-per-full-row).
+
+        Rung selection is PAD-AWARE, not token round-up only: packing
+        one more row can escalate the chunk onto the next ladder rung,
+        and on a fine ladder the escalation's round-up pad can exceed
+        the pad of closing the chunk where it is and starting the row
+        fresh — the one-token-overflow-doubles-the-band shape. Compare
+        both pads in pages and close early only when it strictly wins
+        (ties pack, preserving the coarse-ladder behavior where
+        escalation is always at least as tight)."""
         from glom_tpu.serve.paged_columns import (
             pages_for_tokens,
             resolve_page_tokens,
@@ -1660,7 +1669,23 @@ class DynamicBatcher:
         pages = 0
         for it in batch:
             need = pages_for_tokens(it.n_patches, pt)
-            if cur and pages + need > top:
+            close = False
+            if cur:
+                if pages + need > top:
+                    close = True
+                else:
+                    rung_grow = engine.pick_pages(pages + need)
+                    rung_cur = engine.pick_pages(pages)
+                    if rung_grow > rung_cur:
+                        # Escalating pad vs close-here pad: the current
+                        # chunk's round-up plus the row opening its own
+                        # chunk at its own rung.
+                        pad_grow = rung_grow - (pages + need)
+                        pad_close = (rung_cur - pages) + (
+                            engine.pick_pages(need) - need
+                        )
+                        close = pad_grow > pad_close
+            if close:
                 chunks.append(cur)
                 cur, pages = [], 0
             cur.append(it)
@@ -2267,10 +2292,12 @@ class DynamicBatcher:
         """One RAGGED dispatch (docs/SERVING.md, "Ragged admission"):
         rows of differing patch counts pack page-aligned onto a flat
         token axis sized by a ragged-ladder page count — no worst-row
-        bucket shape, no pad rows. Warm state rides the page pool ONLY
-        (session hits pin their pages and the dispatch carries indices);
-        every row resolves on this hop (the ragged route has no
-        continuation queue — ServeConfig forbids the combination)."""
+        bucket shape, no pad rows. Warm state rides the page pool
+        (session hits pin their pages and the dispatch carries indices)
+        — EXCEPT continuation groups: straggler rows re-enter carrying
+        their mid-flight columns as a flat levels0 with their REMAINING
+        budget (ragged x continuation composition; a continuation's
+        state is unresolved, so it has no pages to ride)."""
         from glom_tpu.serve.paged_columns import (
             pages_for_tokens,
             resolve_page_tokens,
@@ -2291,6 +2318,16 @@ class DynamicBatcher:
             rung_name = RUNGS[rung]
             if rung >= CAPPED_ITERS:
                 iters_override = ladder.degraded_iters
+        scfg = getattr(engine, "scfg", None)
+        budget = getattr(engine, "auto_budget", None)
+        tiered = (
+            scfg is not None
+            and getattr(scfg, "max_continuations", 0) > 0
+            and getattr(engine, "iters_key", None) == "auto"
+            and iters_override is None
+            and budget is not None
+        )
+        has_cont = any(it.warm_src == "cont" for it in batch)
         pool = self._pools.get(engine_name)
         pages_mode = (
             pool is not None
@@ -2301,12 +2338,15 @@ class DynamicBatcher:
         pinned: List[str] = []
         if self.cache is not None:
             for it in batch:
-                if it.session is None:
+                if it.session is None or it.levels is not None:
                     continue
-                if not pages_mode:
+                if not pages_mode or has_cont:
                     # A host-array cache cannot warm a ragged dispatch
                     # (the route has no levels0 input by design — that
-                    # is the transfer being killed): stamped as a miss.
+                    # is the transfer being killed), and a continuation
+                    # group's dispatch is the levels0 program (pages do
+                    # not compose with it — folded fresh rows go cold):
+                    # stamped as a miss either way.
                     n_cache_miss += 1
                     continue
                 hit = self.cache.lookup(it.session, pin=True)
@@ -2351,6 +2391,32 @@ class DynamicBatcher:
             kw = {}
             if iters_override is not None:
                 kw["iters_override"] = iters_override
+            if has_cont:
+                # Ragged x continuation composition: straggler rows carry
+                # their mid-flight columns into the flat levels0 at their
+                # row's page span; folded-in fresh rows take the engine's
+                # cold init (bitwise what the forward would build itself;
+                # pad slots stay zeros — the witness masks them). A cont
+                # dispatch is the levels0 program — mutually exclusive
+                # with page indices at the engine, so pidx is dropped.
+                cold = np.asarray(engine.cold_levels())
+                lv0 = np.zeros((T, *cold.shape[1:]), cold.dtype)
+                for it, start in zip(batch, starts):
+                    c = it.n_patches
+                    if it.levels is not None:
+                        lv0[start:start + c] = it.levels
+                    else:
+                        lv0[start:start + c] = cold[:c]
+                kw["levels0"] = lv0
+                pidx = None
+                prior = max((it.executed for it in batch), default=0)
+                remaining = max(1, budget - prior) if budget else None
+                if (
+                    iters_override is None
+                    and remaining is not None
+                    and remaining < budget
+                ):
+                    kw["auto_budget"] = remaining
             pack_s = self._clock() - t_proc
             with span("serve_dispatch", aggregator=self.spans):
                 result = engine.infer_ragged(
@@ -2374,17 +2440,44 @@ class DynamicBatcher:
         phases, latency_ms = self._phase_fields(
             queue_wait_s, pack_s, result, fetch_s
         )
+        conv = result.row_converged
+        stragglers: List[_Item] = []
         resolved: List[dict] = []
+        n_resolved = 0
+        entry_tier = max((it.hops for it in batch), default=0)
         to_resolve: List[tuple] = []
         for i, it in enumerate(batch):
+            executed_i = it.executed + result.iters_run
             it.dispatch_ms += latency_ms
             self._note_item_phases(it, phases)
             if dspan is not None:
                 it.parent_span = dspan
+            open_hop = (
+                tiered
+                and conv is not None
+                and not self._stop.is_set()
+                and it.hops < scfg.max_continuations
+                and executed_i < budget
+            )
+            if open_hop and not bool(conv[i]):
+                # The straggler carries its ROW SPAN (the unit the
+                # banded parity contract covers) into the continuation
+                # queue; next hop it repacks page-aligned as a ragged
+                # row with the remaining budget.
+                it.levels = np.array(
+                    levels_flat[starts[i]:starts[i] + it.n_patches]
+                )
+                it.executed = executed_i
+                it.hops += 1
+                it.warm_src = "cont"
+                it.t_enq = self._clock()  # cont-queue wait starts now
+                stragglers.append(it)
+                continue
             # Write-back BEFORE resolve, device-to-device: the row's
             # converged columns go straight from the dispatch output
             # into owned pool pages (the next frame's warm state never
-            # visits the host).
+            # visits the host). Stragglers skip it — their state is
+            # mid-flight, not a frame worth warming from.
             if pages_mode and it.session is not None:
                 self.cache.store(
                     it.session,
@@ -2393,8 +2486,29 @@ class DynamicBatcher:
                     n_tokens=it.n_patches,
                 )
             row_levels = levels_flat[starts[i]:starts[i] + it.n_patches]
-            to_resolve.append((it, row_levels, result.iters_run))
-            resolved.append({"iters": result.iters_run, "tier": 0})
+            to_resolve.append((it, row_levels, executed_i))
+            resolved.append({"iters": executed_i, "tier": it.hops})
+            n_resolved += 1
+        if stragglers:
+            self._cont_q.put(stragglers)
+            worst = max(it.executed for it in stragglers)
+            cont = {
+                "event": "continuation",
+                "engine": engine_name,
+                "ragged": True,
+                "n_stragglers": len(stragglers),
+                "executed_iters": worst,
+                "remaining_budget": budget - worst,
+                "hop": max(it.hops for it in stragglers),
+                "trace_ids": (
+                    [it.ticket.trace_id for it in stragglers]
+                    if self._trace else None
+                ),
+            }
+            if self._trace:
+                cont["span_id"] = tracectx.new_span_id()
+                cont["parent_spans"] = [dspan] * len(stragglers)
+            self._emit(cont)
         pad_tokens = T - sum(counts)
         tok_bytes = self._token_state_bytes(engine)
         rec = {
@@ -2405,9 +2519,9 @@ class DynamicBatcher:
             "n_valid": n,
             "n_pages": pages_sig,
             "n_tokens": sum(counts),
-            "warm_state": n_cache_warm > 0,
+            "warm_state": n_cache_warm > 0 or has_cont,
             "paged": n_cache_warm > 0,
-            "tier": 0,
+            "tier": entry_tier,
             # Token-based pad accounting: the ragged pad tax is the page
             # tails plus the ladder round-up — row axis padding is GONE.
             "pad_fraction": round(pad_tokens / T, 4),
@@ -2415,11 +2529,11 @@ class DynamicBatcher:
             "latency_ms": latency_ms,
             **phases,
             "iters_run": result.iters_run,
-            "n_stragglers": 0,
+            "n_stragglers": len(stragglers),
             "n_cache_warm": n_cache_warm,
             "n_cache_miss": n_cache_miss,
             "n_page_warm": n_cache_warm,
-            "levels0_h2d_bytes": 0,
+            "levels0_h2d_bytes": getattr(result, "levels0_h2d_bytes", 0),
             "compiled": result.compiled,
             **tfields,
         }
@@ -2431,13 +2545,14 @@ class DynamicBatcher:
             rec["iters_override"] = iters_override
         self._note_dispatch(
             engine_name, rec, resolved,
-            n_served=len(batch),
-            n_degraded=len(batch) if iters_override is not None else 0,
-            n_continued=0,
+            n_served=n_resolved,
+            n_degraded=n_resolved if iters_override is not None else 0,
+            n_continued=len(stragglers),
         )
         for it, row_levels, iters in to_resolve:
             it.ticket._resolve(
-                row_levels, iters, hops=0, dispatch_ms=it.dispatch_ms,
+                row_levels, iters,
+                hops=it.hops, dispatch_ms=it.dispatch_ms,
             )
             if self._trace:
                 self._emit(
@@ -2451,7 +2566,7 @@ class DynamicBatcher:
                             dict(it.phase_ms) if self._phase_split
                             else None
                         ),
-                        "hops": 0,
+                        "hops": it.hops,
                         "redispatches": it.redispatches,
                         "latency_ms": round(1e3 * it.ticket._latency_s, 3),
                         "trace_id": it.ticket.trace_id,

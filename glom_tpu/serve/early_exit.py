@@ -527,6 +527,95 @@ def ragged_consensus_attention(
     return out.astype(levels.dtype)
 
 
+def banded_ragged_consensus_attention(
+    levels: jnp.ndarray,
+    *,
+    row_start: jnp.ndarray,
+    row_len: jnp.ndarray,
+    window: int,
+    page_tokens: int,
+    attend_self: bool = False,
+) -> jnp.ndarray:
+    """Block-banded consensus attention: the PAGE-granular form of
+    ragged_consensus_attention. Rows occupy whole pages with page-aligned
+    starts, so every page belongs to exactly one row and all page_tokens
+    tokens in it share (row_start, row_len) — the k/v band can therefore
+    be gathered once per PAGE (W/page_tokens pages) instead of once per
+    token (W positions), shrinking the duplicated window working set from
+    T*W to T*W/page_tokens column states. Masks (self slot, band
+    validity) are computed from the same per-token (widx, wvalid)
+    predicates as the windowed route, so at threshold 0 the output is
+    BITWISE the windowed gather's (locked by tests/test_paged_columns.py
+    and the --banded-ab gate)."""
+    T = levels.shape[0]
+    L = levels.shape[1]
+    d = levels.shape[-1]
+    pt = page_tokens
+    if T % pt or window % pt:
+        raise ValueError(
+            f"banded consensus needs page-aligned shapes: T={T}, "
+            f"window={window}, page_tokens={pt}"
+        )
+    P = T // pt
+    Wp = window // pt
+    q = levels.reshape(P, pt, L, d)
+    k = l2norm(levels, axis=-1).reshape(P, pt, L, d)
+    v = levels.reshape(P, pt, L, d)
+    # Every token in a page shares its row's flat start (page-aligned
+    # rows), so the band's first page is a per-page scalar.
+    band_page0 = row_start[::pt] // pt                      # [P]
+    wp = jnp.arange(Wp, dtype=jnp.int32)
+    band = jnp.clip(band_page0[:, None] + wp[None, :], 0, P - 1)
+    kb = k[band].reshape(P, Wp * pt, L, d)                  # [P, W, L, d]
+    vb = v[band].reshape(P, Wp * pt, L, d)
+    scale = d ** -0.5
+    sim = jnp.einsum(
+        "pqld,pwld->pqlw", q, kb, preferred_element_type=jnp.float32
+    ).reshape(T, L, window)
+    sim = sim * scale
+    w = jnp.arange(window, dtype=jnp.int32)
+    widx = row_start[:, None] + w[None, :]                  # [T, W]
+    wvalid = w[None, :] < row_len[:, None]                  # [T, W]
+    if not attend_self:
+        self_slot = widx == jnp.arange(T, dtype=jnp.int32)[:, None]
+        sim = jnp.where(self_slot[:, None, :], TOKEN_ATTEND_SELF_VALUE, sim)
+    sim = jnp.where(wvalid[:, None, :], sim, max_neg_value(sim.dtype))
+    attn = jax.nn.softmax(sim, axis=-1).astype(levels.dtype)
+    out = jnp.einsum(
+        "pqlw,pwld->pqld", attn.reshape(P, pt, L, window), vb,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(T, L, d).astype(levels.dtype)
+
+
+def ragged_window_bytes(
+    T: int, window: int, levels: int, dim: int, itemsize: int,
+    page_tokens: int, attention: str = "windowed",
+) -> int:
+    """Peak duplicated k/v working-set bytes one consensus iteration
+    materializes beyond the flat [T, L, d] state: the windowed gather
+    copies W column states per TOKEN (k and v), the banded route W per
+    PAGE — a page_tokens-fold reduction. This is the number the
+    --banded-ab gate prices (serve_ragged.peak_window_bytes) and the
+    bound that caps the largest admissible ragged signature per chip."""
+    per_pos = 2 * levels * dim * itemsize  # k + v, one column state
+    if attention == "windowed":
+        return T * window * per_pos
+    if attention in ("banded", "banded-pallas"):
+        # The pallas kernel streams pages without materializing the band,
+        # but its jnp fallback (and the interpret route) still build it —
+        # price the banded working set for both.
+        return (
+            (T // page_tokens)
+            * (window // page_tokens)
+            * page_tokens
+            * per_pos
+        )
+    raise ValueError(
+        f"attention {attention!r}: 'windowed', 'banded', or 'banded-pallas'"
+    )
+
+
 def ragged_row_agreement(
     levels: jnp.ndarray, row_weight: jnp.ndarray, row_id: jnp.ndarray,
     n_patches: jnp.ndarray,
@@ -566,6 +655,7 @@ def glom_forward_ragged(
     page_idx: Optional[jnp.ndarray] = None,
     compute_dtype=None,
     use_pallas: bool = False,
+    ragged_attention: str = "windowed",
 ) -> RaggedResult:
     """The ragged paged GLOM forward: one dispatch over a flat
     page-aligned token axis.
@@ -584,6 +674,13 @@ def glom_forward_ragged(
     (serve/paged_columns.py). threshold=0.0 keeps the bitwise contract:
     no row ever converges, exactly max_iters updates run, and each row's
     state equals its lone ragged dispatch bit-for-bit.
+
+    ragged_attention selects the consensus gather: "windowed" (the
+    row-windowed per-token gather), "banded" (the page-blocked band —
+    same values, W/page_tokens-fold smaller duplicated working set,
+    bitwise the windowed route at threshold 0), or "banded-pallas" (the
+    streaming kernel in kernels/banded_consensus.py — kernel-parity
+    tolerance off the bitwise contract, like the fused dense route).
     """
     if cfg.local_consensus_radius > 0:
         raise ValueError(
@@ -666,14 +763,44 @@ def glom_forward_ragged(
         levels = init_flat[None]
     divisor = contribution_divisor(cfg.levels, jnp.float32)
 
-    def consensus_fn(lv):
-        return ragged_consensus_attention(
-            lv[0],
-            row_start=row_start_tok,
-            row_len=row_len_tok,
-            window=window,
-            attend_self=cfg.consensus_self,
-        )[None]
+    if ragged_attention == "banded-pallas":
+        from glom_tpu.kernels import banded_ragged_consensus
+
+        def consensus_fn(lv):
+            return banded_ragged_consensus(
+                lv[0],
+                row_start=row_start_tok,
+                row_len=row_len_tok,
+                window=window,
+                page_tokens=page_tokens,
+                attend_self=cfg.consensus_self,
+            )[None]
+    elif ragged_attention == "banded":
+
+        def consensus_fn(lv):
+            return banded_ragged_consensus_attention(
+                lv[0],
+                row_start=row_start_tok,
+                row_len=row_len_tok,
+                window=window,
+                page_tokens=page_tokens,
+                attend_self=cfg.consensus_self,
+            )[None]
+    elif ragged_attention == "windowed":
+
+        def consensus_fn(lv):
+            return ragged_consensus_attention(
+                lv[0],
+                row_start=row_start_tok,
+                row_len=row_len_tok,
+                window=window,
+                attend_self=cfg.consensus_self,
+            )[None]
+    else:
+        raise ValueError(
+            f"ragged_attention={ragged_attention!r}: 'windowed', 'banded' "
+            "or 'banded-pallas'"
+        )
 
     def step(lv):
         return update_step(

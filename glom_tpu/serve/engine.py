@@ -578,6 +578,7 @@ class InferenceEngine:
         iters_override: Optional[int] = None,
         *,
         auto_budget: Optional[int] = None,
+        cont: bool = False,
     ):
         """The ragged signature's pure forward
         (serve/early_exit.glom_forward_ragged): (params, patches
@@ -585,7 +586,11 @@ class InferenceEngine:
         [T, L, d], iters_run, row_converged [R], row_iters [R]). The
         pool args exist exactly when the engine owns a page pool — one
         program serves cold and page-warm ragged dispatches (cold pages
-        are index -1)."""
+        are index -1). cont=True builds the CONTINUATION variant
+        instead: (params, patches, n_patches, levels0 [T, L, d]) —
+        straggler groups re-enter with host-carried warm state (ragged x
+        continuation composition; page warmth does not apply, the rows'
+        columns are mid-flight, not resolved)."""
         from glom_tpu.serve.early_exit import glom_forward_ragged
 
         cfg, scfg = self.cfg, self.scfg
@@ -614,8 +619,21 @@ class InferenceEngine:
             quorum=scfg.exit_quorum,
             compute_dtype=compute_dtype,
             use_pallas=scfg.use_pallas,
+            ragged_attention=scfg.ragged_attention,
         )
-        if self.pool is not None:
+        if cont:
+
+            def fn(params, patches, n_patches, levels0):
+                res = glom_forward_ragged(
+                    params, patches, cfg, n_patches=n_patches,
+                    levels0=levels0, **kw,
+                )
+                return (
+                    res.levels, res.iters_run,
+                    res.row_converged, res.row_iters,
+                )
+
+        elif self.pool is not None:
 
             def fn(params, patches, n_patches, pool, page_idx):
                 res = glom_forward_ragged(
@@ -639,6 +657,18 @@ class InferenceEngine:
                 )
 
         return fn
+
+    def _ragged_key(self, pages: int) -> str:
+        """The ragged signature's bucket key. The attention mode rides
+        the key when it departs from the default windowed gather — a
+        banded program is a DIFFERENT compiled artifact (same bitwise
+        outputs at threshold 0, per the parity suite), so it must not
+        collide with a windowed signature compiled earlier in the same
+        process."""
+        mode = self.scfg.ragged_attention
+        if mode == "windowed":
+            return f"ragged{pages}"
+        return f"ragged{pages}:{mode}"
 
     def _compile(
         self,
@@ -694,8 +724,11 @@ class InferenceEngine:
                 (lv_abs,) if warm else ()
             )
         # Donate the image batch, and the warm levels carry with it. The
-        # POOL is never donated: it is the persistent page store every
-        # later dispatch reads (write-backs swap it copy-on-write).
+        # POOL is never donated BY A DISPATCH: it is the persistent page
+        # store every later dispatch reads. Write-backs update it on the
+        # pool's own seam — copy-on-write by default, donated in place
+        # under ServeConfig.pool_aliasing, gated by the read pins this
+        # dispatch holds via acquire_read (serve/paged_columns.py).
         donate = (
             ((1, 3) if warm is True else (1,)) if self._donate else ()
         )
@@ -759,14 +792,20 @@ class InferenceEngine:
         iters_override: Optional[int] = None,
         *,
         auto_budget: Optional[int] = None,
+        cont: bool = False,
     ):
         """AOT-compile one RAGGED page-count signature (flat token axis
         of pages x page_tokens; the pool args exactly when the engine
-        owns one). Same warmup-event discipline as the bucket route."""
+        owns one). Same warmup-event discipline as the bucket route.
+        cont=True compiles the continuation variant (warm levels0 rides
+        the dispatch; the straggler re-entry path)."""
+        if cont:
+            warm = "cont"
+        else:
+            warm = "pool" if self.pool is not None else "ragged"
         sig = self.signature(
-            f"ragged{pages}", iters_override,
-            auto_budget=auto_budget,
-            warm="pool" if self.pool is not None else "ragged",
+            self._ragged_key(pages), iters_override,
+            auto_budget=auto_budget, warm=warm,
         )
         if sig in self._compiled:
             return self._compiled[sig]
@@ -784,15 +823,30 @@ class InferenceEngine:
             lambda t: jax.ShapeDtypeStruct(t.shape, t.dtype), self.params
         )
         abstract = (params_abs, patches_abs, n_abs)
-        if self.pool is not None:
-            pool_abs = jax.ShapeDtypeStruct(
-                (self.pool.n_pages, pt, cfg.levels, cfg.dim),
-                self.pool.buffer().dtype,
+        if cont:
+            lv_dtype = (
+                self._compute_dtype if self._compute_dtype is not None
+                else jnp.float32
             )
-            pidx_abs = jax.ShapeDtypeStruct((pages,), jnp.int32)
-            abstract = abstract + (pool_abs, pidx_abs)
-        donate = (1,) if self._donate else ()
-        fn = self._build_ragged_fn(iters_override, auto_budget=auto_budget)
+            lv_abs = jax.ShapeDtypeStruct(
+                (T, cfg.levels, cfg.dim), lv_dtype
+            )
+            abstract = abstract + (lv_abs,)
+            # Patches AND the carried levels donate — the straggler's
+            # warm state is consumed by exactly this dispatch.
+            donate = (1, 3) if self._donate else ()
+        else:
+            if self.pool is not None:
+                pool_abs = jax.ShapeDtypeStruct(
+                    (self.pool.n_pages, pt, cfg.levels, cfg.dim),
+                    self.pool.buffer().dtype,
+                )
+                pidx_abs = jax.ShapeDtypeStruct((pages,), jnp.int32)
+                abstract = abstract + (pool_abs, pidx_abs)
+            donate = (1,) if self._donate else ()
+        fn = self._build_ragged_fn(
+            iters_override, auto_budget=auto_budget, cont=cont
+        )
         t0 = time.perf_counter()
         compiled = jax.jit(fn, donate_argnums=donate).lower(
             *abstract
@@ -848,7 +902,7 @@ class InferenceEngine:
         out = {}
         for p in pages if pages is not None else self.ragged_page_buckets:
             sig = self.signature(
-                f"ragged{p}",
+                self._ragged_key(p),
                 warm="pool" if self.pool is not None else "ragged",
             )
             already = sig in self._compiled
@@ -1070,25 +1124,33 @@ class InferenceEngine:
             staged = make_input()
             args = (self.params, staged, mask)
             lv_staged = None
-            if warm in ("paged", "paged-inc"):
-                # Snapshot per attempt: the freshest write-backs (the
-                # pool swaps copy-on-write, never donated — safe to read
-                # from any number of in-flight dispatches).
-                args = args + (self.pool.buffer(), pidx_dev)
-                if warm == "paged-inc":
-                    args = args + (supp_dev,)
-            elif warm:
-                lv_staged = make_levels()
-                args = args + (lv_staged,)
-            if split:
-                jax.block_until_ready(staged)
-                if lv_staged is not None:
-                    jax.block_until_ready(lv_staged)
-                ph["h2d_s"] += time.perf_counter() - t_h
-            levels, iters_run, conv, row_iters = fn(*args)
-            levels.block_until_ready()  # syncs: serving is request/
-            # response — the caller needs the answer now, and the wait IS
-            # the device latency being measured.
+            pinned = False
+            try:
+                if warm in ("paged", "paged-inc"):
+                    # Snapshot per attempt: the freshest write-backs,
+                    # PINNED for the dispatch's lifetime — under pool
+                    # aliasing the pin blocks donation of the buffer
+                    # this program reads (a CoW pool is unaffected; the
+                    # pin is a free counter).
+                    args = args + (self.pool.acquire_read(), pidx_dev)
+                    pinned = True
+                    if warm == "paged-inc":
+                        args = args + (supp_dev,)
+                elif warm:
+                    lv_staged = make_levels()
+                    args = args + (lv_staged,)
+                if split:
+                    jax.block_until_ready(staged)
+                    if lv_staged is not None:
+                        jax.block_until_ready(lv_staged)
+                    ph["h2d_s"] += time.perf_counter() - t_h
+                levels, iters_run, conv, row_iters = fn(*args)
+                levels.block_until_ready()  # syncs: serving is request/
+                # response — the caller needs the answer now, and the
+                # wait IS the device latency being measured.
+            finally:
+                if pinned:
+                    self.pool.release_read()
             t_r = time.perf_counter()
             iters_host = int(jax.device_get(iters_run))
             out = (
@@ -1133,6 +1195,7 @@ class InferenceEngine:
         n_patches,
         *,
         page_idx=None,
+        levels0=None,
         auto_budget: Optional[int] = None,
         iters_override: Optional[int] = None,
     ) -> RaggedServeResult:
@@ -1148,7 +1211,11 @@ class InferenceEngine:
         int32 pool pages per dispatch-page slot, -1 = cold (requires the
         engine's pool; None = all cold). Warm state rides the POOL ONLY
         — there is no host levels0 on this route, which is exactly what
-        `levels0_h2d_bytes == 0` asserts."""
+        `levels0_h2d_bytes == 0` asserts. EXCEPT the continuation
+        re-entry: levels0 [T, L, d] flat (row-packed like patches)
+        carries straggler groups' mid-flight columns back in (mutually
+        exclusive with page_idx — unresolved state has no pages), and
+        its H2D bytes are reported, not asserted zero."""
         if self.mesh is not None:
             raise ValueError("ragged dispatch: single-device route only")
         if iters_override is not None and (
@@ -1204,7 +1271,25 @@ class InferenceEngine:
             raise ValueError(
                 "page_idx needs a page pool (ServeConfig.page_pool_pages)"
             )
-        if self.pool is not None:
+        cont = levels0 is not None
+        if cont:
+            if page_idx is not None:
+                raise ValueError(
+                    "levels0 OR page_idx: a continuation's columns are "
+                    "mid-flight, not pool-resident"
+                )
+            lv_dtype = (
+                self._compute_dtype if self._compute_dtype is not None
+                else jnp.float32
+            )
+            lv_host = np.asarray(levels0)
+            if lv_host.shape != (T, self.cfg.levels, self.cfg.dim):
+                raise ValueError(
+                    f"levels0 shape {lv_host.shape} != "
+                    f"({T}, {self.cfg.levels}, {self.cfg.dim}) (flat "
+                    "row-packed, page padded like patches)"
+                )
+        if self.pool is not None and not cont:
             pidx_host = (
                 np.full((P,), -1, np.int32) if page_idx is None
                 else np.asarray(page_idx, np.int32)
@@ -1213,27 +1298,31 @@ class InferenceEngine:
                 raise ValueError(
                     f"page_idx shape {pidx_host.shape} != ({P},)"
                 )
+        if cont:
+            warm = "cont"
+        else:
+            warm = "pool" if self.pool is not None else "ragged"
         sig = self.signature(
-            f"ragged{P}", iters_override,
-            auto_budget=auto_budget,
-            warm="pool" if self.pool is not None else "ragged",
+            self._ragged_key(P), iters_override,
+            auto_budget=auto_budget, warm=warm,
         )
         compiled_before = sig in self._compiled
         fn = self._compile_ragged(
-            P, iters_override, auto_budget=auto_budget
+            P, iters_override, auto_budget=auto_budget, cont=cont
         )
         stats = self._stats.setdefault(sig, StepTimeStats())
         n_dev = jnp.asarray(n_host)
         attempts = [0]
         split = self.phase_split
         ph = {"h2d_s": 0.0, "resolve_s": 0.0}
+        levels0_h2d = [0]
 
         def attempt():
             attempts[0] += 1
             if self._fault_hook is not None:
                 self._fault_hook(
                     {
-                        "bucket": f"ragged{P}",
+                        "bucket": self._ragged_key(P),
                         "n_valid": sum(1 for n in n_list if n > 0),
                         "attempt": attempts[0],
                     }
@@ -1241,13 +1330,29 @@ class InferenceEngine:
             t_h = time.perf_counter()
             staged = jnp.asarray(patches)
             args = (self.params, staged, n_dev)
-            if self.pool is not None:
-                args = args + (self.pool.buffer(), jnp.asarray(pidx_host))
-            if split:
-                jax.block_until_ready(staged)
-                ph["h2d_s"] += time.perf_counter() - t_h
-            levels, iters_run, conv, row_iters = fn(*args)
-            levels.block_until_ready()
+            pinned = False
+            try:
+                if cont:
+                    lv_staged = jnp.asarray(lv_host.astype(lv_dtype))
+                    levels0_h2d[0] += lv_staged.nbytes
+                    args = args + (lv_staged,)
+                elif self.pool is not None:
+                    # Pin the snapshot for the dispatch's whole
+                    # lifetime: under pool aliasing the pin blocks
+                    # donation of the buffer this program reads (a CoW
+                    # pool is unaffected — the pin is a free counter).
+                    args = args + (
+                        self.pool.acquire_read(), jnp.asarray(pidx_host)
+                    )
+                    pinned = True
+                if split:
+                    jax.block_until_ready(staged)
+                    ph["h2d_s"] += time.perf_counter() - t_h
+                levels, iters_run, conv, row_iters = fn(*args)
+                levels.block_until_ready()
+            finally:
+                if pinned:
+                    self.pool.release_read()
             t_r = time.perf_counter()
             out = (
                 levels,
@@ -1262,7 +1367,7 @@ class InferenceEngine:
         t0 = time.perf_counter()
         if self.retry is not None:
             out = self.retry.run(
-                attempt, bucket=f"ragged{P}",
+                attempt, bucket=self._ragged_key(P),
                 n_valid=sum(1 for n in n_list if n > 0),
             )
         else:
@@ -1270,6 +1375,7 @@ class InferenceEngine:
         levels, iters_host, conv, row_iters = out
         dt = time.perf_counter() - t0
         stats.observe(dt, is_compile=False)
+        self.levels0_h2d_bytes_total += levels0_h2d[0]
         return RaggedServeResult(
             levels=levels,
             iters_run=iters_host,
@@ -1278,7 +1384,7 @@ class InferenceEngine:
             compiled=not compiled_before,
             row_converged=conv,
             row_iters=row_iters,
-            levels0_h2d_bytes=0,
+            levels0_h2d_bytes=levels0_h2d[0],
             phases=(
                 {"h2d_ms": 1e3 * ph["h2d_s"],
                  "resolve_ms": 1e3 * ph["resolve_s"]}

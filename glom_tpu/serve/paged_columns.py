@@ -29,12 +29,25 @@ indirection — this module keeps warm column state WHERE IT IS USED:
     force-frees (the dispatch that observed the death demotes its rows
     to cold on requeue — serve/batcher.py).
 
-The pool buffer is updated copy-on-write (a write-back builds the next
+The pool buffer defaults to copy-on-write (a write-back builds the next
 buffer functionally and swaps the reference under the lock): in-flight
 dispatches keep reading the buffer they snapshotted, so the scatter is
-never donated — true in-place aliasing would require serializing every
-dispatch against every write-back. XLA reuses the dropped buffer's HBM;
-the transient double-residency window is one write-back wide.
+never donated. That is correct but doubles pool traffic — every
+write-back copies the whole pool to change a few pages. With
+`ServeConfig.pool_aliasing` the pool promotes write-backs to DONATED
+in-graph updates behind an explicit serialization seam: dispatches pin
+the buffer they read (`acquire_read`/`release_read` — the engine wraps
+every pool dispatch in the pair), a write-back donates the buffer ONLY
+when no read pin is live (bumping the pool EPOCH — the donated buffer
+is dead, the epoch names the new one), and falls back to CoW LOUDLY
+(stamped `alias_fallback`, counted) when a snapshot is pinned. Chain
+compaction and defrag stay CoW (their src/dst page ranges can overlap
+— an in-place scatter would read half-moved state). Refcounted shared
+bases and delta chains are unaffected: the page TABLE never aliases,
+only the buffer update does. Aliasing off is byte-for-byte the old CoW
+behavior. XLA reuses the dropped buffer's HBM either way; under CoW the
+transient double-residency window is one write-back wide, under
+aliasing it is gone.
 
 Accounting: every alloc/free/defrag is a stamped "serve" event
 (`page_alloc`/`page_free`/`page_defrag`, docs/OBSERVABILITY.md) and
@@ -213,6 +226,20 @@ class PagedColumnPool:
         self.n_compact_deferred = 0
         self.n_base_shares = 0
         self.n_superseded = 0
+        # In-place aliasing (ServeConfig.pool_aliasing, module
+        # docstring): donated write-backs gated by the read-pin count;
+        # the epoch counts buffer identities (every donated update kills
+        # the previous buffer). Bytes-moved counters price the A/B in
+        # the analytic live-bytes form — a CoW write copies the whole
+        # pool to change a few pages, an aliased write moves only the
+        # pages written.
+        self.aliasing = bool(getattr(scfg, "pool_aliasing", False))
+        self._epoch = 0
+        self._read_pins = 0
+        self.n_alias_writes = 0
+        self.n_alias_fallbacks = 0
+        self.alias_bytes_moved = 0
+        self.cow_bytes_moved = 0
         # THE preallocated buffer: pages x page_tokens x L x d, zeros.
         # One allocation up front — warm traffic never grows it.
         buf = jnp.zeros(
@@ -232,9 +259,48 @@ class PagedColumnPool:
         """The current pool buffer (snapshot for one dispatch). The
         reference swaps copy-on-write under the lock; pinned pages stay
         valid in every later buffer, so a dispatch built from (buffer,
-        pinned indices) reads a consistent state."""
+        pinned indices) reads a consistent state. NOT safe as a dispatch
+        handle under aliasing — a donated write-back invalidates
+        unpinned snapshots; the dispatch path takes `acquire_read()`
+        instead (glom-lint's donation-safety flags the bare form)."""
         with self._lock:
             return self._buffer
+
+    def acquire_read(self):
+        """Pin the CURRENT buffer for one dispatch and return it. While
+        any read pin is live, write-backs cannot donate (they fall back
+        to CoW, stamped `alias_fallback`), so the returned reference
+        stays valid for the dispatch's whole lifetime — snapshot through
+        block_until_ready. Pair with `release_read()` in a finally.
+        With aliasing off this is `buffer()` plus a free counter."""
+        with self._lock:
+            if self._buffer is None:
+                raise RuntimeError(
+                    f"pool {self.name!r} released: dispatch against a "
+                    "drained replica is a fleet-bookkeeping bug"
+                )
+            self._read_pins += 1
+            return self._buffer
+
+    def release_read(self) -> None:
+        """Drop one dispatch's read pin (the `acquire_read` pair)."""
+        with self._lock:
+            if self._read_pins <= 0:
+                raise RuntimeError(
+                    "release_read without a matching acquire_read"
+                )
+            self._read_pins -= 1
+
+    def read_pins(self) -> int:
+        with self._lock:
+            return self._read_pins
+
+    def epoch(self) -> int:
+        """Buffer-identity counter: bumps on every DONATED write-back
+        (the previous buffer is dead). CoW swaps keep the epoch — the
+        old snapshot stays readable."""
+        with self._lock:
+            return self._epoch
 
     def pages_used(self) -> int:
         with self._lock:
@@ -391,12 +457,28 @@ class PagedColumnPool:
 
     # -- device-side data movement ----------------------------------------
 
-    def _writeback_fn(self, k: int, n: int):
+    def _donate_jit_kw(self, donate: bool) -> dict:
+        """donate_argnums for the pool arg, TPU only — CPU jit ignores
+        donation (with a warning), so off-TPU the "aliased" write is the
+        same functional scatter and only the accounting differs. The
+        seam logic (pins, epoch, fallback) is platform-independent."""
+        if not donate:
+            return {}
+        import jax
+
+        if jax.devices()[0].platform != "tpu":
+            return {}
+        return {"donate_argnums": (0,)}
+
+    def _writeback_fn(self, k: int, n: int, *, donate: bool = False):
         """Memoized jitted scatter for a (pages, tokens) shape class:
         pad the row's [n, L, d] columns to whole pages and set them at
         the block's indices. Functional update — the result is the NEXT
-        pool buffer (copy-on-write; see module docstring)."""
-        key = (k, n)
+        pool buffer. donate=True is the aliasing seam's in-place
+        variant: the input pool buffer is donated, so the scatter
+        updates the pages in place instead of copying the pool (see
+        module docstring; only `_scatter_locked` may call it)."""
+        key = (k, n, bool(donate))
         if key not in self._scatter_fns:
             import jax
             import jax.numpy as jnp
@@ -411,8 +493,55 @@ class PagedColumnPool:
                 )
                 return pool.at[idx].set(flat.reshape(k, pt, L, d))
 
-            self._scatter_fns[key] = jax.jit(fn)
+            self._scatter_fns[key] = jax.jit(
+                fn, **self._donate_jit_kw(donate)
+            )
         return self._scatter_fns[key]
+
+    def _scatter_locked(
+        self,
+        make_fn,
+        args,
+        *,
+        pages_written: int,
+        session_id: Optional[str],
+        events: List[dict],
+    ) -> None:
+        """The ONE write seam (caller holds the lock): route a buffer
+        update through aliasing when enabled AND no dispatch holds a
+        read pin — the donated scatter kills the previous buffer, so
+        the epoch bumps and `page_alias` stamps what moved. Any live
+        pin forces the CoW fallback LOUDLY (`alias_fallback` + counter):
+        correct, just back to paying the whole-pool copy. make_fn(donate)
+        returns the memoized jitted scatter for that variant."""
+        if self.aliasing and self._read_pins == 0:
+            self._buffer = make_fn(True)(self._buffer, *args)
+            self._epoch += 1
+            self.n_alias_writes += 1
+            self.alias_bytes_moved += pages_written * self.page_bytes
+            events.append(
+                {
+                    "event": "page_alias",
+                    "session": session_id,
+                    "n_pages": pages_written,
+                    "epoch": self._epoch,
+                    "bytes_moved": pages_written * self.page_bytes,
+                }
+            )
+        else:
+            self._buffer = make_fn(False)(self._buffer, *args)
+            self.cow_bytes_moved += self.pool_bytes
+            if self.aliasing:
+                self.n_alias_fallbacks += 1
+                events.append(
+                    {
+                        "event": "alias_fallback",
+                        "session": session_id,
+                        "n_pages": pages_written,
+                        "read_pins": self._read_pins,
+                        "bytes_moved": self.pool_bytes,
+                    }
+                )
 
     def write_back(self, session_id: str, levels_row, n_tokens: int) -> bool:
         """Copy one resolved row's converged columns device-to-device
@@ -426,14 +555,25 @@ class PagedColumnPool:
         import jax.numpy as jnp
 
         k = len(pages)
-        fn = self._writeback_fn(k, n_tokens)
         idx = jnp.asarray(np.asarray(pages, np.int32))
+        events: List[dict] = []
         with self._lock:
             # The scatter runs under the lock: buffer swaps serialize
             # (two concurrent write-backs must not both extend the same
-            # parent buffer and drop one update on the swap).
-            self._buffer = fn(self._buffer, idx, levels_row)
+            # parent buffer and drop one update on the swap), and the
+            # read-pin check that gates donation is atomic with the
+            # update itself.
+            self._scatter_locked(
+                lambda donate: self._writeback_fn(
+                    k, n_tokens, donate=donate
+                ),
+                (idx, levels_row),
+                pages_written=k,
+                session_id=session_id,
+                events=events,
+            )
             self.n_writebacks += 1
+        self._flush(events)
         return True
 
     # -- delta streaming (docs/SERVING.md, "Delta streaming") --------------
@@ -486,11 +626,14 @@ class PagedColumnPool:
             self._residual_fns[key] = jax.jit(fn)
         return self._residual_fns[key]
 
-    def _delta_scatter_fn(self, kc: int, k: int, n: int):
+    def _delta_scatter_fn(self, kc: int, k: int, n: int, *, donate: bool = False):
         """Memoized scatter of `kc` CHANGED pages out of a row's `k`:
         (pool, dst_idx [kc], row [n, L, d], ordinals [kc]) -> next pool
-        buffer (functional, copy-on-write like every write path)."""
-        key = (kc, k, n)
+        buffer (functional by default; donate=True is the aliasing
+        seam's in-place variant — only `_scatter_locked` may call it).
+        Delta pages scatter to FRESH pool pages, so the donated update
+        never overwrites a page any effective map still resolves to."""
+        key = (kc, k, n, bool(donate))
         if key not in self._delta_scatter_fns:
             import jax
             import jax.numpy as jnp
@@ -504,7 +647,9 @@ class PagedColumnPool:
                 ).reshape(k, pt, *row.shape[1:])
                 return pool.at[dst_idx].set(flat[ordinals])
 
-            self._delta_scatter_fns[key] = jax.jit(fn)
+            self._delta_scatter_fns[key] = jax.jit(
+                fn, **self._donate_jit_kw(donate)
+            )
         return self._delta_scatter_fns[key]
 
     def _copy_pages_fn(self, k: int):
@@ -647,9 +792,14 @@ class PagedColumnPool:
                     if pages is None:
                         self._flush(events)
                         return None
-                    fn = self._writeback_fn(need, n_tokens)
-                    self._buffer = fn(
-                        self._buffer, self._idx(pages), levels_row
+                    self._scatter_locked(
+                        lambda donate: self._writeback_fn(
+                            need, n_tokens, donate=donate
+                        ),
+                        (self._idx(pages), levels_row),
+                        pages_written=need,
+                        session_id=session_id,
+                        events=events,
                     )
                     self.n_writebacks += 1
                     base = _BaseBlock(pages, n_tokens, hkey=content_hash)
@@ -699,12 +849,18 @@ class PagedColumnPool:
                     if pages is None:
                         self._flush(events)
                         return None
-                    fn = self._delta_scatter_fn(len(ordinals), need, n_tokens)
-                    self._buffer = fn(
-                        self._buffer,
-                        self._idx(pages),
-                        levels_row,
-                        self._idx(ordinals),
+                    self._scatter_locked(
+                        lambda donate: self._delta_scatter_fn(
+                            len(ordinals), need, n_tokens, donate=donate
+                        ),
+                        (
+                            self._idx(pages),
+                            levels_row,
+                            self._idx(ordinals),
+                        ),
+                        pages_written=len(ordinals),
+                        session_id=session_id,
+                        events=events,
                     )
                     blk.deltas.append(dict(zip(ordinals, pages)))
                     self.n_delta_writes += 1
@@ -801,12 +957,17 @@ class PagedColumnPool:
             self._gather_fns[key] = jax.jit(fn)
         import jax.numpy as jnp
 
-        with self._lock:
-            buf = self._buffer
-        flat = self._gather_fns[key](
-            buf, jnp.asarray(np.asarray(pages, np.int32))
-        )
-        return np.asarray(flat)[:n_tokens]
+        # The gather runs OUTSIDE the lock but under a read pin: without
+        # it an aliased write-back could donate (kill) the snapshot
+        # mid-gather.
+        buf = self.acquire_read()
+        try:
+            flat = self._gather_fns[key](
+                buf, jnp.asarray(np.asarray(pages, np.int32))
+            )
+            return np.asarray(flat)[:n_tokens]
+        finally:
+            self.release_read()
 
     def defrag(self) -> int:
         """Compact allocated, UNPINNED pages toward low indices (one
@@ -918,7 +1079,23 @@ class PagedColumnPool:
                 "n_alloc_fails": self.n_alloc_fails,
                 "n_writebacks": self.n_writebacks,
                 "n_defrag_moves": self.n_defrag_moves,
+                # CoW traffic priced analytically (whole pool per CoW
+                # write) — the aliasing A/B's baseline side, present
+                # with aliasing off so the comparison has both arms.
+                "cow_bytes_moved": self.cow_bytes_moved,
             }
+            if self.aliasing:
+                writes = self.n_alias_writes + self.n_alias_fallbacks
+                rec["alias"] = {
+                    "epoch": self._epoch,
+                    "n_alias_writes": self.n_alias_writes,
+                    "n_alias_fallbacks": self.n_alias_fallbacks,
+                    "alias_bytes_moved": self.alias_bytes_moved,
+                    "alias_rate": (
+                        round(self.n_alias_writes / writes, 4)
+                        if writes else None
+                    ),
+                }
             if self.delta:
                 # The delta rollup the acceptance reads: bytes_per_stream
                 # is ACTUAL pool pages over live sessions (shared bases

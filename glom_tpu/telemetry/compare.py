@@ -69,6 +69,14 @@ _COST_METRIC_TOKENS = (
     # have kept; spawn rollbacks are failed scale-outs. spawn_ms and
     # migrated_bytes ride the "ms"/"bytes" unit tokens.
     "invalidated", "spawn_failures",
+    # Banded-consensus + pool-aliasing rows (ISSUE 16): the duplicated
+    # k/v working set regresses UP (peak_window_bytes rides the "bytes"
+    # unit token too — the name token keeps intent explicit), and
+    # alias fallbacks are pinned writes that fell back to full-pool
+    # copy-on-write — more of them is more bytes moved.
+    # serve_ragged_max_signature_pages has NEITHER token: it rate-
+    # classifies, so the admission ceiling SHRINKING is the regression.
+    "peak_window", "alias_fallback",
 )
 # Metric-name tokens that mark a HIGHER-is-better row regardless of the
 # cost heuristics: headroom is capacity LEFT — a serving change that

@@ -196,6 +196,18 @@ class ServeConfig:
     # [bucket, n] layout maps onto whole pages).
     page_pool_pages: int = 0
     page_tokens: int = 0
+    # In-place pool aliasing (docs/SERVING.md "Pool aliasing"): True
+    # promotes pool write-backs from copy-on-write buffer swaps (every
+    # write re-materializes the WHOLE pool buffer) to DONATED in-graph
+    # scatter updates — the write-back aliases the pool's own pages, so
+    # pool bytes moved per write drop from pool_bytes to the written
+    # pages only. The dispatch/write-back serialization seam: dispatches
+    # hold a READ PIN on the buffer snapshot (acquire_read/release_read)
+    # and every aliased write advances the pool EPOCH; a write that finds
+    # pins outstanding falls back to CoW LOUDLY (alias_fallback event +
+    # counter) so an in-flight dispatch never reads a donated buffer.
+    # False (default) keeps the CoW discipline byte-for-byte.
+    pool_aliasing: bool = False
     # Ragged admission (docs/SERVING.md "Ragged admission"): requests with
     # DIFFERING patch counts (mixed resolutions/aspect ratios) share one
     # dispatch sized by total PAGES instead of padding every row to the
@@ -206,6 +218,24 @@ class ServeConfig:
     # grid to build a radius mask from — the engine validates loudly).
     ragged: bool = False
     ragged_pages: Tuple[int, ...] = ()
+    # Ragged consensus gather (serve/early_exit.py, docs/SERVING.md
+    # "Block-banded ragged consensus"):
+    #   "windowed"      — the row-windowed per-token gather (the PR 11
+    #                     form): W k/v column states duplicated per TOKEN
+    #                     per iteration;
+    #   "banded"        — the page-blocked band: pages are the blocks,
+    #                     each token attends within its row's page band
+    #                     computed from the flat [T, L, d] state — the
+    #                     duplicated working set shrinks page_tokens-fold
+    #                     and the output is BITWISE the windowed route at
+    #                     threshold 0 (the house parity rule; locked by
+    #                     tests and the --banded-ab gate);
+    #   "banded-pallas" — the streaming Pallas kernel
+    #                     (kernels/banded_consensus.py) reading k/v pages
+    #                     in place — kernel-parity TOLERANCE, like the
+    #                     fused dense route; falls back to "banded" off
+    #                     TPU.
+    ragged_attention: str = "windowed"
     # Delta streaming (glom_tpu/serve/paged_columns.py, docs/SERVING.md
     # "Delta streaming"): instead of rewriting a session's whole [n, L, d]
     # column state every frame, each session keeps a paged BASE plus a
@@ -396,12 +426,26 @@ class ServeConfig:
                 f"page_tokens {self.page_tokens} must be >= 0 (0 resolves "
                 "from the model's patch count)"
             )
-        if self.ragged and self.max_continuations > 0:
+        # Ragged admission COMPOSES with the continuation queue (ISSUE
+        # 16 lifted the PR 11 exclusivity): straggler rows carry their
+        # flat page-aligned state through the host levels0 form
+        # (glom_forward_ragged's continuation carry) and re-enter as
+        # ragged rows with their remaining budget. Only the fixed route
+        # stays incompatible — a fixed iteration count has no stragglers.
+        if self.ragged and self.max_continuations > 0 and self.iters != "auto":
             raise ValueError(
-                "ragged admission and the continuation queue are "
-                "exclusive: a ragged dispatch has no host levels0 carry "
-                "for straggler re-buckets (rows resolve with their state "
-                "at quorum exit — the pre-two-tier contract)"
+                "ragged continuations need iters='auto': a fixed route "
+                "has no convergence witness to leave stragglers behind"
+            )
+        if self.ragged_attention not in ("windowed", "banded", "banded-pallas"):
+            raise ValueError(
+                f"ragged_attention {self.ragged_attention!r}: 'windowed', "
+                "'banded', or 'banded-pallas'"
+            )
+        if self.pool_aliasing and self.page_pool_pages <= 0:
+            raise ValueError(
+                "pool_aliasing needs a device page pool "
+                "(page_pool_pages > 0): there is no buffer to alias"
             )
         if self.ragged_pages:
             if list(self.ragged_pages) != sorted(set(self.ragged_pages)):

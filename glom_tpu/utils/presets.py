@@ -182,9 +182,18 @@ _register(
             # full-resolution streams at 4 pages each), warm frames
             # assembled in-graph with ZERO host->device levels0 bytes.
             # Ragged admission stays a workload opt-in (bench_serve.py
-            # --ragged; it is exclusive with the continuation queue).
+            # --ragged / --banded-ab; it composes with the continuation
+            # queue on the auto route — stragglers re-enter ragged with
+            # their remaining budget). When opted in, the BANDED
+            # consensus route prices the duplicated k/v working set per
+            # PAGE instead of per token (64x smaller here), which is
+            # what lets a 16-row ragged signature fit one chip at all;
+            # aliased write-backs land pages in place instead of
+            # copying the 1 GiB pool per write.
             page_pool_pages=2728,
             page_tokens=64,
+            ragged_attention="banded",
+            pool_aliasing=True,
         ),
     )
 )

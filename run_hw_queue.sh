@@ -216,6 +216,50 @@ print(f"OK: iters {iters['whole-state']} -> {iters['delta']}, bytes/stream "
       f"{bps['whole-state']} -> {bps['delta']}, parity bitwise")
 EOF
 
+# 9l. Block-banded consensus + pool-aliasing A/B gate (ISSUE 16,
+#     docs/SERVING.md "Block-banded ragged consensus" / "Pool
+#     aliasing"): the same ragged streamed traffic under the windowed
+#     gather vs the banded route vs banded + in-place aliasing. On real
+#     hardware this prices what the CPU smoke cannot: the HBM the
+#     W-fold k/v gather actually duplicates per dispatch (the banded
+#     working set is page_tokens-fold smaller — the admission ceiling
+#     moves), and the pool bytes the donated in-place write-back stops
+#     copying. The gate requires banded peak_window_bytes STRICTLY
+#     below windowed, the largest admissible ragged signature STRICTLY
+#     larger, aliased pool bytes moved STRICTLY below CoW with the
+#     warm path still zero-transfer, and the threshold-0 parity row
+#     BITWISE — rows feed the step 11b serve baseline (peak-window and
+#     pool-bytes rows gate as costs).
+step bench_serve_banded 2400 python -u bench_serve.py --banded-ab --streams 8 --frames 6
+step banded_gate 120 python - results/hw_queue/bench_serve_banded.log <<'EOF'
+import sys
+from glom_tpu.telemetry import schema
+rows = [r for _, r in schema.iter_json_lines(open(sys.argv[1]))]
+peak, sig, moved, h2d, parity = {}, {}, {}, {}, None
+for r in rows:
+    m = r.get("metric", "")
+    if m.startswith("serve_ragged_peak_window_bytes ("):
+        peak[m.split("(")[1].split(",")[0]] = r["value"]
+    if m.startswith("serve_ragged_max_signature_pages ("):
+        sig[m.split("(")[1].split(",")[0]] = r["value"]
+    if m.startswith("serve_pool_bytes_moved ("):
+        moved[m.split("(")[1].split(",")[0]] = r["value"]
+    if m.startswith("serve_levels0_h2d_bytes ("):
+        h2d[m.split("(")[1].split(",")[0]] = (r["value"], r.get("n_page_warm", 0))
+    if m.startswith("serve_banded_parity ("):
+        parity = r["value"]
+assert set(peak) == {"windowed", "banded", "banded-alias"}, f"arms missing: {peak}"
+assert peak["banded"] < peak["windowed"], f"banded working set not smaller: {peak}"
+assert sig["banded"] > sig["windowed"], f"max signature did not grow: {sig}"
+assert moved["banded-alias"] < moved["banded"], f"aliasing moved no fewer bytes: {moved}"
+b, w = h2d.get("banded-alias", (None, 0))
+assert b == 0 and w > 0, f"aliased warm path not zero-transfer: {h2d}"
+assert parity == 1.0, "threshold-0 banded vs windowed dispatch is NOT bitwise"
+print(f"OK: peak window {peak['windowed']} -> {peak['banded']} bytes; max "
+      f"signature {sig['windowed']} -> {sig['banded']} pages; pool bytes "
+      f"{moved['banded']} -> {moved['banded-alias']}; parity bitwise")
+EOF
+
 # 9g. Request-tracing overhead gate + pod aggregation (this round's
 #     tentpole, docs/OBSERVABILITY.md): full trace stamping (ids minted
 #     per submit, per-dispatch scope, per-request resolve leaves) must
@@ -337,6 +381,7 @@ grep -ah '^{' results/hw_queue/bench_serve.log \
     results/hw_queue/bench_serve_temporal.log \
     results/hw_queue/bench_serve_ragged.log \
     results/hw_queue/bench_serve_delta.log \
+    results/hw_queue/bench_serve_banded.log \
     results/hw_queue/collective_timing_ab.log \
     results/hw_queue/phase_ab.log \
     results/hw_queue/ramp_serve.log \

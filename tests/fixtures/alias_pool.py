@@ -1,0 +1,84 @@
+"""Seeded acceptance pair for donation-safety's aliased-pool pinning
+rule (analysis/donation.py, ISSUE 16): with in-place pool aliasing the
+pool's write-back DONATES the shared buffer on its own seam, so any
+dispatch still reading it must hold a read pin — `acquire_read()` /
+`release_read()` — to force the seam onto the copy-on-write fallback.
+
+LeakyPoolEngine feeds a bare `pool.buffer()` into its donating compiled
+dispatch (both the bound-name and the inline-call shapes) — on an
+aliasing pool a concurrent write-back invalidates that buffer
+mid-dispatch, a race no CPU test reproduces. SafePoolEngine pins through
+acquire_read()/release_read() around the same dispatch and must scan
+clean; so must its compile-time `pool.buffer().dtype` probe (a read that
+never reaches a dispatch — the engine's real warmup shape).
+
+NOT imported by production code; tests/test_analysis.py runs the checker
+over this file and asserts the unpinned dispatches are flagged at
+file:line on the leaky class only.
+"""
+
+import jax
+
+
+class LeakyPoolEngine:
+    """Bare buffer() into a donating dispatch — flagged twice (named and
+    inline), the exact hazard pool aliasing's read-pin seam exists for."""
+
+    def __init__(self, pool):
+        self.pool = pool
+        self._compiled = {}
+
+    def _fwd(self, params, buf, idx):
+        return buf[idx] * 2
+
+    def _compile(self, sig, abstract):
+        if sig in self._compiled:
+            return self._compiled[sig]
+        compiled = jax.jit(self._fwd, donate_argnums=(0,)).lower(
+            abstract, abstract, abstract
+        ).compile()
+        self._compiled[sig] = compiled
+        return compiled
+
+    def infer(self, sig, abstract, params, idx):
+        fn = self._compile(sig, abstract)
+        buf = self.pool.buffer()  # BUG: no read pin
+        return fn(params, buf, idx)
+
+    def infer_inline(self, sig, abstract, params, idx):
+        fn = self._compile(sig, abstract)
+        return fn(params, self.pool.buffer(), idx)  # BUG: no read pin
+
+
+class SafePoolEngine:
+    """Same dispatch, pinned reads: acquire_read() holds the pool's CoW
+    fallback open for the dispatch's lifetime."""
+
+    def __init__(self, pool):
+        self.pool = pool
+        self._compiled = {}
+
+    def _fwd(self, params, buf, idx):
+        return buf[idx] * 2
+
+    def _compile(self, sig, abstract):
+        if sig in self._compiled:
+            return self._compiled[sig]
+        compiled = jax.jit(self._fwd, donate_argnums=(0,)).lower(
+            abstract, abstract, abstract
+        ).compile()
+        self._compiled[sig] = compiled
+        return compiled
+
+    def infer(self, sig, abstract, params, idx):
+        fn = self._compile(sig, abstract)
+        buf = self.pool.acquire_read()
+        try:
+            return fn(params, buf, idx)
+        finally:
+            self.pool.release_read()
+
+    def probe_dtype(self, sig):
+        # Compile-time probe: a bare buffer() read that never reaches a
+        # dispatch stays clean.
+        return self.pool.buffer().dtype

@@ -400,6 +400,82 @@ class TestDonationSafety:
         }, fs
         assert all("Safe" not in f.symbol for f in fs)
 
+    def test_alias_unpinned_dispatch_flagged(self, tmp_path):
+        """A bare pool.buffer() flowing into a donating dispatch is an
+        alias-unpinned-dispatch finding (ISSUE 16) — the pool's donated
+        write-back can invalidate the buffer mid-dispatch."""
+        src = (
+            "import jax\n"
+            "class Engine:\n"
+            "    def _compile(self, sig):\n"
+            "        self._compiled[sig] = jax.jit(\n"
+            "            lambda p, b: b, donate_argnums=(0,)\n"
+            "        )\n"
+            "        return self._compiled[sig]\n"
+            "    def infer(self, sig, params):\n"
+            "        fn = self._compile(sig)\n"
+            "        buf = self.pool.buffer()\n"
+            "        return fn(params, buf)\n"
+        )
+        fs = by_checker(lint(tmp_path, src), "donation-safety")
+        assert len(fs) == 1
+        assert fs[0].key == "alias-unpinned-dispatch"
+        assert "acquire_read" in fs[0].message
+
+    def test_alias_pinned_rebind_clean(self, tmp_path):
+        """Rebinding the name through acquire_read() before the dispatch
+        clears the hazard — the latest binding decides."""
+        src = (
+            "import jax\n"
+            "class Engine:\n"
+            "    def _compile(self, sig):\n"
+            "        self._compiled[sig] = jax.jit(\n"
+            "            lambda p, b: b, donate_argnums=(0,)\n"
+            "        )\n"
+            "        return self._compiled[sig]\n"
+            "    def infer(self, sig, params):\n"
+            "        fn = self._compile(sig)\n"
+            "        buf = self.pool.buffer()\n"
+            "        buf = self.pool.acquire_read()\n"
+            "        try:\n"
+            "            return fn(params, buf)\n"
+            "        finally:\n"
+            "            self.pool.release_read()\n"
+        )
+        assert by_checker(lint(tmp_path, src), "donation-safety") == []
+
+    def test_alias_compile_time_probe_clean(self, tmp_path):
+        """A bare buffer() read that never reaches a dispatch (the
+        engine's compile-time dtype probe) stays clean."""
+        src = (
+            "import jax\n"
+            "class Engine:\n"
+            "    def _compile(self, sig):\n"
+            "        dt = self.pool.buffer().dtype\n"
+            "        self._compiled[sig] = jax.jit(\n"
+            "            lambda p, b: b, donate_argnums=(0,)\n"
+            "        )\n"
+            "        return self._compiled[sig]\n"
+        )
+        assert by_checker(lint(tmp_path, src), "donation-safety") == []
+
+    def test_alias_fixture_pair(self):
+        """The seeded acceptance pair (tests/fixtures/alias_pool.py):
+        both unpinned dispatch shapes flagged, the pinned twin and its
+        compile-time probe clean."""
+        from glom_tpu.analysis import run
+
+        fs = by_checker(
+            run([str(FIXTURES / "alias_pool.py")]), "donation-safety"
+        )
+        alias = [f for f in fs if f.key == "alias-unpinned-dispatch"]
+        symbols = {f.symbol for f in alias}
+        assert symbols == {
+            "LeakyPoolEngine.infer",
+            "LeakyPoolEngine.infer_inline",
+        }, fs
+        assert all("Safe" not in f.symbol for f in fs)
+
 
 # ---------------------------------------------------------------------------
 # schema-emit

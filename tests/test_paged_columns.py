@@ -526,9 +526,69 @@ class TestRaggedBatcher:
         assert s["n_requests"] == s["n_served"] + s["n_shed"] + s["n_failed"]
 
 
-def test_ragged_requires_no_continuations():
-    with pytest.raises(ValueError, match="exclusive"):
-        ServeConfig(iters="auto", ragged=True, max_continuations=2)
+def test_ragged_continuations_need_auto_route():
+    """Ragged COMPOSES with the continuation queue now (ISSUE 16) — but
+    only on the auto route: a fixed iteration count has no witness, so
+    there are no stragglers to re-enter."""
+    ServeConfig(iters="auto", ragged=True, max_continuations=2)
+    with pytest.raises(ValueError, match="auto"):
+        ServeConfig(iters=4, ragged=True, max_continuations=2)
+
+
+@pytest.mark.slow
+class TestRaggedContinuation:
+    def test_ragged_straggler_bitwise_parity_and_iter_conservation(self):
+        """Ragged x continuation composition (ISSUE 16): a ragged
+        straggler exited at the quorum re-enters the RAGGED route as a
+        row carrying its mid-flight columns and remaining budget, and
+        lands on BITWISE the same final columns, after the same TOTAL
+        iteration count, as its lone ragged run to convergence (the
+        dense two-tier correctness lock, on the page axis)."""
+        rng = np.random.default_rng(21)
+        # Seeded convergence disparity: the 10x rows settle by iter 10,
+        # the 1x row needs 12 — so the 0.5 quorum exits the cold
+        # dispatch with the 1x row mid-flight.
+        easy = [
+            (10.0 * rng.normal(size=(CFG.channels, 16, 16))).astype(
+                np.float32
+            )
+            for _ in range(2)
+        ]
+        hard = rng.normal(size=(CFG.channels, 16, 16)).astype(np.float32)
+        scfg = dataclasses.replace(
+            SCFG, exit_threshold=1e-3, max_auto_iters=16,
+            exit_quorum=0.5, max_continuations=3,
+        )
+        params = init_glom(jax.random.PRNGKey(0), CFG)
+        eng = InferenceEngine(CFG, scfg, params=params, name="e0")
+        b = DynamicBatcher(engines=[eng])
+        tickets = [b.submit(easy[0]), b.submit(hard), b.submit(easy[1])]
+        b.start()  # all queued before the worker runs: ONE cold dispatch
+        outs = [t.result(timeout=300.0) for t in tickets]
+        summary = b.summary_record()
+        b.stop()
+        assert summary["n_served"] == 3 and summary["n_failed"] == 0
+        assert summary["n_continued"] >= 1  # the hard row re-entered
+        # Reference: the hard row alone on the ragged route, run to its
+        # own convergence in ONE dispatch (a quorum of one row is the
+        # row itself).
+        ref_eng = InferenceEngine(
+            CFG,
+            dataclasses.replace(
+                scfg, exit_quorum=1.0, max_continuations=0
+            ),
+            params=params,
+        )
+        ref = ref_eng.infer_ragged(
+            *_flat(
+                [_patchify_host(hard, 4)], pages_sig=ref_eng.pick_pages(4)
+            )
+        )
+        levels, total_iters, _ = outs[1]
+        assert total_iters == ref.iters_run
+        np.testing.assert_array_equal(
+            levels, np.asarray(ref.levels)[0:16]
+        )
 
 
 def test_ragged_ladder_must_hold_a_full_row():

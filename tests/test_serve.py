@@ -11,6 +11,7 @@ The two acceptance locks:
 """
 
 import time
+import types
 
 import jax
 import jax.numpy as jnp
@@ -723,6 +724,162 @@ class TestContinuationQueue:
         assert iters_run == 2 * (1 + eng.scfg.max_continuations)
         assert summary["n_served"] == 1
         assert summary["n_continued"] == eng.scfg.max_continuations
+
+
+class RaggedTieredFakeEngine:
+    """Host-side ragged x continuation policy probe (ISSUE 16): the cold
+    ragged dispatch exits at the quorum after 3 iters with the LAST
+    packed row unconverged; the continuation hop converges everyone in
+    whatever budget it was handed. Records every call's shape/budget."""
+
+    def __init__(self, name="rfake0"):
+        self.scfg = ServeConfig(
+            buckets=(1, 2, 4), max_batch=4, max_delay_ms=5.0,
+            queue_depth=8, iters="auto", max_auto_iters=6,
+            exit_quorum=0.5, max_continuations=2, ragged=True,
+            page_tokens=4, dispatch_retries=0,
+        )
+        self.cfg = CFG  # n=16 tokens -> 4 pages of 4
+        self.iters_key = "auto"
+        self.auto_budget = 6
+        self.pool = None
+        self.ragged_page_buckets = (4, 8, 12, 16)
+        self.name = name
+        self.calls = []
+
+    def pick_pages(self, n):
+        for p in self.ragged_page_buckets:
+            if n <= p:
+                return p
+        raise ValueError(f"{n} pages exceeds the ladder")
+
+    def cold_levels(self):
+        return np.zeros(
+            (CFG.num_patches, CFG.levels, CFG.dim), np.float32
+        )
+
+    def infer_ragged(self, flat, counts, page_idx=None, levels0=None,
+                     auto_budget=None, **kw):
+        from glom_tpu.serve.engine import RaggedServeResult
+
+        warm = levels0 is not None
+        T = flat.shape[0]
+        self.calls.append(
+            {"pages": T // 4, "counts": list(counts), "warm": warm,
+             "auto_budget": auto_budget}
+        )
+        iters = (auto_budget or 3) if warm else 3
+        conv = np.ones((len(counts),), bool)
+        if not warm:
+            conv[-1] = False  # the last packed row straggles
+        return RaggedServeResult(
+            levels=np.zeros((T, CFG.levels, CFG.dim), np.float32),
+            iters_run=iters, latency_s=0.0, pages=T // 4,
+            compiled=False, row_converged=conv,
+            row_iters=np.full((len(counts),), iters, np.int32),
+        )
+
+
+class TestRaggedContinuationQueue:
+    def test_ragged_straggler_conserves_budget_3_plus_3(self):
+        """THE ragged x continuation conservation lock (ISSUE 16): a
+        ragged straggler's two hops total exactly the budget — 3 cold
+        + 3 continuation == 6 — and the continuation dispatch re-enters
+        the RAGGED route carrying the REMAINING budget."""
+        eng = RaggedTieredFakeEngine()
+        sink = Sink()
+        b = DynamicBatcher(eng, max_batch=4, max_delay_ms=10.0,
+                           writer=sink)
+        tickets = [b.submit(IMG) for _ in range(3)]
+        b.start()  # all queued before the worker runs: ONE cold dispatch
+        outs = [t.result(timeout=10.0) for t in tickets]
+        summary = b.summary_record()
+        b.stop()
+        assert sorted(o[1] for o in outs) == [3, 3, 6]
+        assert summary["n_served"] == 3 and summary["n_failed"] == 0
+        assert summary["n_continued"] == 1
+        assert summary["iters_histogram"] == {"3": 2, "6": 1}
+        assert summary["iters_histogram_by_tier"] == {
+            "0": {"3": 2}, "1": {"6": 1},
+        }
+        # The warm hop re-entered RAGGED: one row repacked alone at its
+        # own ladder rung, capped at the remaining budget (6 - 3).
+        warm_calls = [c for c in eng.calls if c["warm"]]
+        assert len(warm_calls) == 1
+        assert warm_calls[0]["auto_budget"] == 3
+        assert warm_calls[0]["counts"] == [16]
+        assert warm_calls[0]["pages"] == 4
+        cont = [r for r in sink.records if r.get("event") == "continuation"]
+        assert cont and cont[0]["n_stragglers"] == 1
+        assert cont[0]["ragged"] is True
+        for r in sink.records + [summary]:
+            assert schema.validate_record(r) == [], r
+
+
+class _ChunkLadderEngine:
+    """Bare ladder probe for _ragged_chunks: page math only, no device."""
+
+    def __init__(self, buckets):
+        self.ragged_page_buckets = buckets
+        self.pool = None
+        self.cfg = CFG
+        self.scfg = ServeConfig(
+            buckets=(1, 2, 4), max_batch=4, page_tokens=4
+        )
+
+    def pick_pages(self, n):
+        for p in self.ragged_page_buckets:
+            if n <= p:
+                return p
+        raise ValueError(f"{n} pages exceeds the ladder")
+
+
+class TestRaggedChunkPadAwareness:
+    """Pad-aware rung selection in _ragged_chunks (ISSUE 16): closing a
+    chunk early must beat escalating onto the next ladder rung whenever
+    the escalation's round-up pad exceeds the close-here pad."""
+
+    @staticmethod
+    def _rows(n, n_patches=4):
+        return [types.SimpleNamespace(n_patches=n_patches) for _ in range(n)]
+
+    @staticmethod
+    def _pad_pages(engine, chunks):
+        from glom_tpu.serve.paged_columns import pages_for_tokens
+
+        pad = 0
+        for chunk in chunks:
+            pages = sum(pages_for_tokens(it.n_patches, 4) for it in chunk)
+            pad += engine.pick_pages(pages) - pages
+        return pad
+
+    def test_fine_ladder_closes_early_for_zero_pad(self):
+        """Five one-page rows on a (1,2,4,8) ladder: token round-up
+        alone packs all five at rung 8 (pad 3); the pad-aware split
+        closes chunks where escalation loses — zero pad total."""
+        eng = _ChunkLadderEngine((1, 2, 4, 8))
+        chunks = DynamicBatcher._ragged_chunks(None, eng, self._rows(5))
+        assert [len(c) for c in chunks] == [2, 2, 1]
+        assert self._pad_pages(eng, chunks) == 0
+
+    def test_coarse_ladder_ties_pack_into_one_chunk(self):
+        """The same five rows on the default-shaped coarse ladder: the
+        escalation pad TIES the close-here pad (3 == 3), and ties must
+        pack — one dispatch, the pre-pad-awareness behavior."""
+        eng = _ChunkLadderEngine((4, 8, 12, 16))
+        chunks = DynamicBatcher._ragged_chunks(None, eng, self._rows(5))
+        assert [len(c) for c in chunks] == [5]
+        assert self._pad_pages(eng, chunks) == 3
+
+    def test_top_rung_overflow_still_splits(self):
+        """Pad-awareness never overrides the hard cap: rows whose total
+        exceeds the top signature split there regardless of pads."""
+        eng = _ChunkLadderEngine((1, 2, 4))
+        chunks = DynamicBatcher._ragged_chunks(
+            None, eng, self._rows(3, n_patches=8)
+        )
+        assert [len(c) for c in chunks] == [2, 1]
+        assert self._pad_pages(eng, chunks) == 0
 
 
 class TestMultiEngineFanOut:
