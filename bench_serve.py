@@ -1231,6 +1231,18 @@ def run_ramp(cfg, scfg, label: str, *, profile: str = "4x100,56x0,12x200",
     lat_by_phase: dict = {}
     n_total = sum(n for n, _ in phases)
     with DynamicBatcher(engines=engines) as batcher:
+        # The ramp is a FORECASTED run (ISSUE 17 acceptance): every
+        # closed window stamps a "forecast" record carrying its
+        # predicted-vs-realized error — the evidence PR 18's
+        # anticipatory policy will consume.
+        from glom_tpu.telemetry.forecast import ForecastEmitter
+
+        batcher.enable_admission_events()
+        forecaster = ForecastEmitter(
+            lambda r: emit(r, kind="forecast"),
+            interval_s=0.25, window_s=2.0, horizon_s=0.5,
+        )
+        batcher.add_event_tap(forecaster.tap)
         scaler = Autoscaler(
             batcher, factory, policy=resolve_policy(scfg),
             rules={"p99_ms": scfg.elastic_p99_ms},
@@ -1272,6 +1284,7 @@ def run_ramp(cfg, scfg, label: str, *, profile: str = "4x100,56x0,12x200",
                 time.sleep(0.05)
         finally:
             scaler.stop()
+        forecaster.close()
         summary = batcher.summary_record()
     el = summary.get("elastic") or {}
     conserved = (
@@ -1348,6 +1361,200 @@ def run_ramp(cfg, scfg, label: str, *, profile: str = "4x100,56x0,12x200",
         "conserved": conserved,
         "p99_spike": q(spike, 0.99) if spike else None,
         "p99_tail": q(tail, 0.99) if tail else None,
+    }
+
+
+def run_workload(cfg, scfg, label: str, records, *, source: str,
+                 time_scale: float = 1.0, workload_out=None,
+                 max_engines: int = 2, hard: bool = True) -> dict:
+    """Drive a WORKLOAD artifact through the real elastic stack
+    (docs/SERVING.md "Record and replay"): re-offer the records with
+    faithful inter-arrival pacing, score a live load forecast on every
+    window, and assert ticket conservation — the workload observatory's
+    end-to-end gate. `records` come from a recorded run
+    (--replay FILE) or a scenario generator (--scenario NAME); either
+    way the run emits:
+
+      * "forecast" rows (kind forecast) with forecast_abs_err stamped
+        on EVERY window — finite once predictions mature;
+      * serve_workload_pacing_lag (ms) — how late the replay offered
+        vs the artifact's arrival times;
+      * serve_workload_forecast_abs_err (rps) — the matured mean;
+      * serve_workload_n_engines_peak (count, with the timeline) and
+        serve_workload_tickets_conserved — the elastic gate pair;
+      * optionally re-records ITS OWN offered traffic to workload_out,
+        closing the record -> replay -> record loop.
+    """
+    import dataclasses
+
+    from glom_tpu.serve import workload as wl
+    from glom_tpu.serve.batcher import DynamicBatcher, ShedError
+    from glom_tpu.serve.elastic import Autoscaler, resolve_policy
+    from glom_tpu.serve.engine import InferenceEngine
+    from glom_tpu.telemetry.forecast import ForecastEmitter
+    from glom_tpu.telemetry.sinks import emit
+
+    scfg = dataclasses.replace(
+        scfg,
+        elastic=True, min_engines=1, max_engines=max_engines,
+        elastic_low_water=0.5, elastic_high_water=0.8,
+        elastic_dwell_s=0.1, elastic_cooldown_s=0.5,
+        elastic_window_s=2.0, elastic_interval_s=0.05,
+        elastic_p99_ms=100.0,
+    )
+    engines = _make_engines(cfg, scfg, 1)
+    params = engines[0].params
+    for eng in engines:
+        eng.warmup()
+    seq = [len(engines)]
+
+    def factory():
+        i = seq[0]
+        eng = InferenceEngine(cfg, scfg, params=params, name=f"engine{i}")
+        seq[0] += 1
+        return eng
+
+    n_total = len(records)
+    signatures = []
+    with DynamicBatcher(engines=engines) as batcher:
+        recorder = wl.WorkloadRecorder().attach(batcher)
+        forecaster = ForecastEmitter(
+            lambda r: emit(r, kind="forecast"),
+            interval_s=0.25, window_s=2.0, horizon_s=0.5,
+        )
+        batcher.add_event_tap(forecaster.tap)
+        scaler = Autoscaler(
+            batcher, factory, policy=resolve_policy(scfg),
+            rules={"p99_ms": scfg.elastic_p99_ms},
+            interval_s=scfg.elastic_interval_s,
+        ).start()
+        try:
+            tickets = []
+
+            def offer(rec, i):
+                signatures.append(rec.get("signature"))
+                img = wl.synth_input(rec, i)
+                if hard:
+                    # HARD traffic (the ramp's 100x convergence-depth
+                    # lever): the replayed load must queue, not
+                    # evaporate, or the autoscaler has nothing to do.
+                    img = 100.0 * img
+                try:
+                    tickets.append(
+                        batcher.submit(img, session_id=rec.get("session"))
+                    )
+                except ShedError:
+                    raise  # replay() counts it; traffic drives on
+
+            stats = wl.replay(records, offer, time_scale=time_scale)
+            for t in tickets:
+                try:
+                    t.result(timeout=600.0)
+                except Exception:  # noqa: BLE001 — summary counts it
+                    pass
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if scaler.record()["n_scale_ins"] >= 1:
+                    break
+                time.sleep(0.05)
+        finally:
+            scaler.stop()
+        forecaster.close()
+        summary = batcher.summary_record()
+        captured = recorder.records()
+        if workload_out:
+            recorder.write(workload_out, source=f"bench:{source}")
+    el = summary.get("elastic") or {}
+    conserved = (
+        summary["n_served"] + summary["n_shed"] + summary["n_failed"]
+        == summary["n_requests"] == n_total
+        and summary["n_failed"] == 0
+    )
+    # The determinism contract (ISSUE 17 acceptance): the replayed
+    # stream re-offers the artifact's signature sequence EXACTLY —
+    # what the recorder captured must match what the artifact said.
+    sig_match = signatures == [r.get("signature") for r in records] and (
+        [c.get("signature") for c in captured] == signatures
+    )
+    emit(
+        {
+            "event": "workload_summary",
+            "config": label,
+            "source": source,
+            "n_requests": n_total,
+            "n_served": summary["n_served"],
+            "n_shed": summary["n_shed"],
+            "n_failed": summary["n_failed"],
+            "signature_sequence_match": sig_match,
+            "forecast_windows": forecaster.n_windows,
+            "elastic": el,
+            **stats,
+        },
+        kind="serve",
+    )
+    emit(
+        {
+            "metric": f"serve_workload_pacing_lag ({source}, {label})",
+            "value": stats["pacing_lag_mean_ms"],
+            "unit": "ms",
+            "pacing_lag_max_ms": stats["pacing_lag_max_ms"],
+        }
+    )
+    mae = forecaster.forecaster._err_sum / forecaster.forecaster._n_scored \
+        if forecaster.forecaster._n_scored else None
+    if mae is not None:
+        emit(
+            {
+                "metric": (
+                    f"serve_workload_forecast_abs_err ({source}, {label})"
+                ),
+                "value": round(mae, 4),
+                "unit": "rps",
+                "n_scored": forecaster.forecaster._n_scored,
+                "n_windows": forecaster.n_windows,
+            }
+        )
+    lead = forecaster.lead_model.lead_time_ms()
+    if lead is not None:
+        emit(
+            {
+                "metric": f"serve_workload_lead_time_ms ({source}, {label})",
+                "value": lead,
+                "unit": "ms",
+                "n_spawns": len(forecaster.lead_model._samples),
+            }
+        )
+    emit(
+        {
+            "metric": f"serve_workload_n_engines_peak ({source}, {label})",
+            "value": el.get("n_engines_peak"),
+            "unit": "count",
+            "timeline": el.get("timeline"),
+        }
+    )
+    emit(
+        {
+            "metric": (
+                f"serve_workload_tickets_conserved ({source}, {label})"
+            ),
+            "value": 1.0 if (conserved and sig_match) else 0.0,
+            "unit": "count",
+        }
+    )
+    assert conserved, (
+        "workload tickets NOT conserved: "
+        f"{ {k: summary[k] for k in ('n_requests', 'n_served', 'n_shed', 'n_failed')} }"
+    )
+    assert sig_match, (
+        "replayed signature sequence diverged from the artifact "
+        f"(offered {len(signatures)}, recorded {len(captured)}, "
+        f"artifact {n_total})"
+    )
+    return {
+        "elastic": el,
+        "conserved": conserved,
+        "stats": stats,
+        "n_forecast_windows": forecaster.n_windows,
     }
 
 
@@ -1636,6 +1843,28 @@ def main(argv=None) -> int:
     ap.add_argument("--ramp-profile", default="4x100,56x0,12x200",
                     metavar="N1xG1,...",
                     help="ramp mode: requests x gap_ms per phase")
+    ap.add_argument("--replay", default=None, metavar="FILE",
+                    help="replay a recorded workload artifact "
+                    "(serve/workload.py) through the real elastic stack "
+                    "INSTEAD of the load sweep: faithful inter-arrival "
+                    "pacing, a scored live forecast on every window, "
+                    "ticket conservation asserted")
+    ap.add_argument("--scenario", default=None,
+                    choices=("diurnal", "flash-crowd", "rolling-outage"),
+                    help="generate a workload scenario (pure-stdlib, "
+                    "seeded) and drive it like --replay — chaos-grade "
+                    "elastic traffic reproducible from a seed alone")
+    ap.add_argument("--scenario-duration", type=float, default=6.0,
+                    metavar="S", help="scenario length in seconds")
+    ap.add_argument("--scenario-seed", type=int, default=0, metavar="K",
+                    help="scenario arrival-process seed")
+    ap.add_argument("--time-scale", type=float, default=1.0, metavar="X",
+                    help="replay/scenario: stretch (>1) or compress (<1) "
+                    "the inter-arrival gaps")
+    ap.add_argument("--workload-out", default=None, metavar="FILE",
+                    help="replay/scenario: re-record THIS run's offered "
+                    "traffic as a workload artifact (closes the "
+                    "record -> replay -> record loop)")
     ap.add_argument("--phase-ab", action="store_true",
                     help="run the latency-decomposition overhead A/B: the "
                     "same traffic with the dispatch phase split on vs "
@@ -1712,6 +1941,54 @@ def main(argv=None) -> int:
     if scfg.mesh_data > 1 or scfg.mesh_seq > 1:
         label = f"{label}, mesh={scfg.mesh_data}x{scfg.mesh_seq}"
     del jax  # imported to fail fast before any measurement if broken
+    if args.replay or args.scenario:
+        from glom_tpu.serve.workload import generate, load_workload
+
+        if args.replay:
+            records = load_workload(args.replay)
+            source = args.replay
+            # A faithful replay re-offers the artifact's exact shapes —
+            # if the artifact was recorded against a different model
+            # config (a preset server, say, vs this driver's fallback
+            # cfg), rebuild the engine config around the recorded
+            # resolution instead of failing every ticket on a shape
+            # mismatch. Only unambiguous fixed-resolution artifacts
+            # qualify; mixed/ragged traffic keeps the configured cfg.
+            shapes = {
+                tuple(r["shape"]) for r in records
+                if r.get("shape") is not None
+                and str(r.get("signature", "")).startswith("bucket:")
+            }
+            if len(shapes) == 1:
+                (c, h, w), = shapes
+                if h == w and (c, h) != (cfg.channels, cfg.image_size):
+                    patch = next(
+                        p for p in (cfg.patch_size, 7, 4, 2, 1)
+                        if h % p == 0
+                    )
+                    cfg = dataclasses.replace(
+                        cfg, channels=c, image_size=h, patch_size=patch,
+                    )
+                    emit(
+                        {"note": f"replay artifact carries {c}x{h}x{w} "
+                         "requests; rebuilding the engine config to "
+                         "match the recorded resolution"},
+                        kind="note",
+                    )
+        else:
+            records = generate(
+                args.scenario, args.scenario_duration,
+                seed=args.scenario_seed,
+                shapes=((cfg.channels, cfg.image_size, cfg.image_size),),
+            )
+            source = f"scenario:{args.scenario}"
+        run_workload(
+            cfg, scfg, label, records,
+            source=source,
+            time_scale=args.time_scale,
+            workload_out=args.workload_out,
+        )
+        return 0
     if args.ramp:
         run_ramp(cfg, scfg, label, profile=args.ramp_profile)
         return 0

@@ -170,6 +170,22 @@ class Ticket:
         self._latency_s: Optional[float] = None
         self._error: Optional[BaseException] = None
         self.t_submit = time.perf_counter()
+        # Armed by the workload observatory (enable_admission_events):
+        # called EXACTLY once with (ticket, "served"|"failed") at the
+        # terminal, whichever path got there — the single choke point
+        # covering resolve, redispatch exhaustion, and stop()-drain, so
+        # the recorder never needs a per-failure-site event.
+        self._settle_cb = None
+
+    def _settled(self, outcome: str) -> None:
+        cb = self._settle_cb
+        if cb is None:
+            return
+        self._settle_cb = None  # terminal states are terminal
+        try:
+            cb(self, outcome)
+        except Exception:  # noqa: BLE001 — evidence never kills a worker
+            pass
 
     def _resolve(self, levels, iters_run, hops=None, dispatch_ms=None):
         self._levels = levels
@@ -178,11 +194,13 @@ class Ticket:
         self.dispatch_ms = dispatch_ms
         self._latency_s = time.perf_counter() - self.t_submit
         self._done.set()
+        self._settled("served")
 
     def _fail(self, exc: BaseException):
         self._error = exc
         self._latency_s = time.perf_counter() - self.t_submit
         self._done.set()
+        self._settled("failed")
 
     def done(self) -> bool:
         return self._done.is_set()
@@ -576,6 +594,29 @@ class DynamicBatcher:
         # The attached Autoscaler (None = static fleet, the default):
         # summary_record() nests its rollup under "elastic".
         self._elastic = None
+        # Workload observatory (schema v9, serve/workload.py): armed by
+        # enable_admission_events() at setup time. Off (the default) the
+        # hot path pays one boolean read — no per-request events.
+        self._admit_events = False
+        # Drained-husk RETENTION (ROADMAP item 4 housekeeping): a
+        # long-lived elastic server accumulates one evidence husk per
+        # scale-in forever. When the lead ServeConfig bounds retention
+        # (husk_max / husk_max_age_s; None = keep all, the pre-v9
+        # shape), the oldest husks are RETIRED — removed from `engines`/
+        # `_engine_state` entirely, their counters folded into the
+        # _husks_retired rollup and stamped as an `engine_husk_retired`
+        # event, so summary conservation still reconciles. The state
+        # half (this bookkeeping) rides _engine_lock; the container
+        # half follows add_engine's lock-free atomic-op convention
+        # (see _prune_husks).
+        self._husk_max = getattr(scfg, "husk_max", None) if scfg else None
+        self._husk_max_age_s = (
+            getattr(scfg, "husk_max_age_s", None) if scfg else None
+        )
+        self._husk_drained_at: dict = {}  # name -> batcher-clock drain time
+        self._husks_retired: dict = {
+            "n": 0, "dispatches": 0, "rejoins": 0, "age_s_max": 0.0,
+        }
 
     @staticmethod
     def _ename(eng, i: int) -> str:
@@ -695,6 +736,72 @@ class DynamicBatcher:
         pays no lock for the common zero-tap case."""
         self._taps.append(tap)
 
+    def enable_admission_events(self) -> None:
+        """Arm per-request ADMISSION evidence (schema v9, the workload
+        observatory — serve/workload.py): every submit() emits one
+        compact "admit" event BEFORE the shed checks (a shed request was
+        still OFFERED, and a replay must re-offer it), carrying arrival
+        time, shape signature, and session; every ticket's terminal
+        emits a "settle" event ("served" | "failed") via the ticket
+        callback, so the recorder stitches outcomes without a hook at
+        every failure site. Setup-time like add_event_tap; the un-armed
+        hot path pays one boolean read."""
+        self._admit_events = True
+
+    def _signature(self, img, session_id) -> str:
+        """The request's SHAPE CLASS — the unit the replay driver
+        re-offers and the forecast buckets by: ragged admission priced
+        per page ("ragged:<N>p"), delta streaming per session frame
+        ("delta:CxHxW"), everything else by its image dims
+        ("bucket:CxHxW"). Computed from np.shape WITHOUT converting the
+        input (the admit event precedes the shed checks, which must not
+        pay an asarray); malformed shapes fall through to the bucket
+        form — submit's own validation raises the loud error."""
+        shape = tuple(np.shape(img))
+        dims = "x".join(str(int(d)) for d in shape)
+        if self._ragged and len(shape) == 3:
+            try:
+                cfg = getattr(self.engine, "cfg", None)
+                p = cfg.patch_size
+                tokens = (shape[1] // p) * (shape[2] // p)
+                pool = next(iter(self._pools.values()), None)
+                if pool is not None:
+                    pt = pool.page_tokens
+                else:
+                    from glom_tpu.serve.paged_columns import (
+                        resolve_page_tokens,
+                    )
+
+                    pt = resolve_page_tokens(cfg, self.engine.scfg)
+                pages = max(1, -(-tokens // pt))
+                return f"ragged:{pages}p"
+            except Exception:  # noqa: BLE001 — evidence, not validation
+                return f"ragged:{dims}"
+        scfg = getattr(self.engine, "scfg", None)
+        if session_id is not None and getattr(
+            scfg, "delta_streaming", False
+        ):
+            return f"delta:{dims}"
+        return f"bucket:{dims}"
+
+    def _settle_event(self, ticket: Ticket, outcome: str) -> None:
+        """The per-request terminal leaf of the armed admission stream
+        (Ticket._settled calls it exactly once, whichever path got
+        there). Sheds keep their richer "shed" leaf; the recorder
+        prefers it over the settle's "failed"."""
+        self._emit(
+            {
+                "event": "settle",
+                "request_id": ticket.request_id,
+                "outcome": outcome,
+                "latency_ms": (
+                    round(1e3 * ticket._latency_s, 3)
+                    if ticket._latency_s is not None else None
+                ),
+                "trace_id": ticket.trace_id,
+            }
+        )
+
     def attach_elastic(self, scaler) -> None:
         """Attach the Autoscaler whose rollup summary_record() nests
         under "elastic" (serve/elastic.py calls this; a static fleet
@@ -733,6 +840,23 @@ class DynamicBatcher:
             )
         else:
             ticket = Ticket(rid)
+        if self._admit_events:
+            # The workload observatory's arrival record: emitted BEFORE
+            # the shed checks — a shed request was offered traffic, and
+            # the replay driver must re-offer it. np.shape reads lists
+            # and arrays alike; conversion stays where it was.
+            ticket._settle_cb = self._settle_event
+            self._emit(
+                {
+                    "event": "admit",
+                    "request_id": rid,
+                    "t": round(self._clock(), 6),
+                    "signature": self._signature(img, session_id),
+                    "shape": [int(d) for d in np.shape(img)],
+                    "session": session_id,
+                    "trace_id": ticket.trace_id,
+                }
+            )
         with span("serve_enqueue", aggregator=self.spans):
             if self.shed_when_down and _backend_down():
                 # trace_id rides the exception's detail too, so a caller
@@ -1466,17 +1590,113 @@ class DynamicBatcher:
                 **detail,
             }
         )
+        # Namedness is decided here, outside the lock (engine_by_name's
+        # convention): only NAMED husks enter _husk_drained_at and are
+        # ever retirement candidates — removing an unnamed engine (test
+        # fakes keyed by list index) would renumber its siblings'
+        # evidence.
+        eng = self.engine_by_name(name)
+        named = getattr(eng, "name", None) is not None
         with self._engine_lock:
             st = self._engine_state[name]
             st["alive"] = False
             self._draining.discard(name)
             self._drained.add(name)
+            if named:
+                self._husk_drained_at[name] = self._clock()
         # The drained pool leaves the fleet maps (its record would
         # otherwise ride every later summary as live capacity).
         self._pools.pop(name, None)
         if self.cache is not None:
             self.cache.remove_pool(name)
+        self._prune_husks()
         return stats
+
+    def _prune_husks(self) -> None:
+        """Drained-husk RETENTION (schema v9): bound the evidence husks a
+        long-lived elastic server keeps. With husk_max / husk_max_age_s
+        unset (the default) this is a no-op and every husk is retained —
+        the pre-v9 shape. Otherwise the oldest husks past either bound
+        are RETIRED: removed from `engines`/`_engine_state`/`_drained`,
+        their counters folded into the _husks_retired rollup
+        (summary_record nests it, so per-engine dispatch totals still
+        reconcile against the globals), and one `engine_husk_retired`
+        event stamped per retirement. Unnamed engines (test fakes keyed
+        by list index) are never retired — removing one would renumber
+        its siblings' evidence."""
+        if self._husk_max is None and self._husk_max_age_s is None:
+            return
+        now = self._clock()
+        retired = []  # (name, age_s, reason, dispatches, rejoins)
+        # Phase 1 — select victims and retire their STATE under the
+        # lock. Popping _engine_state is the commit point: concurrent
+        # prunes race to it and the loser skips, so each husk retires
+        # exactly once and the conservation fold is exact. With the
+        # name out of _engine_state/_drained nothing routes to, drains,
+        # or reports the husk any more. Only NAMED husks ever enter
+        # _husk_drained_at (the drain site decides), so no unnamed
+        # engine is ever selected here.
+        with self._engine_lock:
+            husks = sorted(
+                (n for n in self._drained if n in self._husk_drained_at),
+                key=lambda n: self._husk_drained_at[n],
+            )
+            marked = {}
+            if self._husk_max_age_s is not None:
+                for n in husks:
+                    age = now - self._husk_drained_at[n]
+                    if age > self._husk_max_age_s:
+                        marked[n] = "age-bound"
+            if self._husk_max is not None:
+                kept = [n for n in husks if n not in marked]
+                for n in kept[: max(0, len(kept) - self._husk_max)]:
+                    marked[n] = "count-bound"
+            for n in husks:
+                if n not in marked:
+                    continue
+                st = self._engine_state.pop(n, None)
+                if st is None:
+                    continue  # a concurrent prune won the commit
+                self._drained.discard(n)
+                age = now - self._husk_drained_at.pop(n)
+                self._drain_handoff.pop(n, None)
+                fold = self._husks_retired
+                fold["n"] += 1
+                fold["dispatches"] += st.get("dispatches", 0)
+                fold["rejoins"] += st.get("rejoins", 0)
+                fold["age_s_max"] = round(max(fold["age_s_max"], age), 3)
+                retired.append(
+                    (n, age, marked[n], st.get("dispatches", 0),
+                     st.get("rejoins", 0))
+                )
+        # Phase 2 — container teardown OUTSIDE the lock, mirroring
+        # add_engine's registration convention: `engines`/
+        # `_engine_index`/`_aff_q`/`_ladders` are the lock-free
+        # containers no reader guards, so they are trimmed with single
+        # atomic ops only. The husk serves nothing (phase 1 already
+        # unregistered it), so the brief window where the list and the
+        # index disagree is visible only to fleet observers, never to a
+        # dispatch.
+        for name, age, reason, dispatches, rejoins in retired:
+            self._ladders.pop(name, None)
+            self._aff_q.pop(name, None)
+            idx = self._engine_index.get(name)
+            if idx is not None:
+                del self.engines[idx]
+                self._engine_index = {
+                    self._ename(eng, i): i
+                    for i, eng in enumerate(self.engines)
+                }
+            self._emit(
+                {
+                    "event": "engine_husk_retired",
+                    "engine": name,
+                    "reason": reason,
+                    "age_s": round(age, 3),
+                    "dispatches": dispatches,
+                    "rejoins": rejoins,
+                }
+            )
 
     # -- dispatch ----------------------------------------------------------
 
@@ -2620,6 +2840,9 @@ class DynamicBatcher:
         headroom would otherwise fire a permanent false breach that
         re-triggers the very autoscaler that caused it), and DRAINED
         engines emit no record at all — they left the fleet."""
+        # Age-bounded husks retire on the capacity cadence (the
+        # autoscaler calls this every tick), not only at the next drain.
+        self._prune_husks()
         with self._engine_lock:  # LOCK ORDER: _engine_lock -> _counter_lock
             engines = {
                 name: dict(st) for name, st in self._engine_state.items()
@@ -2759,6 +2982,7 @@ class DynamicBatcher:
                 pad_bytes_wasted = self._pad_bytes_wasted
                 levels0_h2d_bytes = self._levels0_h2d_bytes
                 phase_sums = dict(self._phase_sums)
+            husks_retired = dict(self._husks_retired)
         rec = {
             "event": "summary",
             "n_requests": n_requests,
@@ -2799,6 +3023,12 @@ class DynamicBatcher:
             ) if n_served else None,
             "engines": engines,
         }
+        if husks_retired.get("n"):
+            # Retention trimmed the engines nest: the folded counters
+            # keep the books whole (global dispatch totals == the nest's
+            # sum + these) — added only when a husk actually retired, so
+            # unbounded-retention summaries keep the pre-v9 shape.
+            rec["husks_retired"] = husks_retired
         if dispatches and phase_sums:
             # The latency decomposition rollup: MEAN ms per phase per
             # dispatch (the same five fields every dispatch record splits

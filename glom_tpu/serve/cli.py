@@ -211,6 +211,42 @@ def build_parser() -> argparse.ArgumentParser:
         "(e.g. '6x120,48x0,10x150' = low, spike, low) — the chaos "
         "ramp-serve scenario's traffic shape (docs/RESILIENCE.md)",
     )
+    p.add_argument(
+        "--replay", default=None, metavar="FILE",
+        help="replay a recorded workload artifact (serve/workload.py, "
+        "docs/SERVING.md 'Record and replay'): re-offer its requests "
+        "with faithful inter-arrival pacing and session structure — "
+        "the fourth traffic source, exclusive with the others",
+    )
+    p.add_argument(
+        "--replay-time-scale", type=float, default=1.0, metavar="X",
+        help="stretch (>1) or compress (<1) the replayed inter-arrival "
+        "gaps (1.0 = as recorded)",
+    )
+    p.add_argument(
+        "--record-workload", default=None, metavar="FILE",
+        help="record this run's offered traffic as a schema-v9 workload "
+        "artifact (arrival time, shape signature, session, outcome) — "
+        "replayable later with --replay",
+    )
+    p.add_argument(
+        "--forecast", action="store_true",
+        help="emit scored short-horizon 'forecast' records over the "
+        "live arrival rate plus a spawn-lead-time model "
+        "(telemetry/forecast.py): every window stamps "
+        "predicted-vs-realized forecast_abs_err",
+    )
+    p.add_argument(
+        "--husk-max", type=int, default=None, metavar="N",
+        help="elastic: retain at most N drained-engine evidence husks "
+        "in the summary (oldest retire into a stamped "
+        "engine_husk_retired record; default: retain all)",
+    )
+    p.add_argument(
+        "--husk-max-age", type=float, default=None, metavar="S",
+        help="elastic: retire a drained husk S seconds after its drain "
+        "(default: retain forever)",
+    )
     return p
 
 
@@ -264,12 +300,13 @@ def _req_source(args) -> Iterable[Tuple[object, int, object]]:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     n_sources = sum(
-        x is not None for x in (args.synthetic, args.requests, args.ramp)
+        x is not None
+        for x in (args.synthetic, args.requests, args.ramp, args.replay)
     )
     if n_sources != 1:
         print(
-            "exactly one of --synthetic N, --requests FILE, or "
-            "--ramp N1xG1,... required",
+            "exactly one of --synthetic N, --requests FILE, "
+            "--ramp N1xG1,..., or --replay FILE required",
             file=sys.stderr,
         )
         return 2
@@ -278,6 +315,17 @@ def main(argv=None) -> int:
         try:
             ramp_phases = parse_ramp(args.ramp)
         except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+    replay_records = None
+    if args.replay is not None:
+        # Loud before the engines spend a warmup: an unreadable or empty
+        # artifact is an argv error, not a mid-run surprise.
+        from glom_tpu.serve.workload import load_workload
+
+        try:
+            replay_records = load_workload(args.replay)
+        except (OSError, ValueError) as e:
             print(str(e), file=sys.stderr)
             return 2
 
@@ -342,6 +390,8 @@ def main(argv=None) -> int:
         ("elastic_window", "elastic_window_s"),
         ("elastic_p99_ms", "elastic_p99_ms"),
         ("elastic_shed_rate", "elastic_shed_rate"),
+        ("husk_max", "husk_max"),
+        ("husk_max_age", "husk_max_age_s"),
     ):
         v = getattr(args, flag)
         if v is not None:
@@ -481,6 +531,21 @@ def main(argv=None) -> int:
         served = failed = 0
         scaler = None
         with DynamicBatcher(engines=engines, writer=writer) as batcher:
+            recorder = None
+            if args.record_workload is not None:
+                from glom_tpu.serve.workload import WorkloadRecorder
+
+                recorder = WorkloadRecorder().attach(batcher)
+            forecaster = None
+            if args.forecast:
+                from glom_tpu.telemetry.forecast import ForecastEmitter
+                from glom_tpu.tracing.flight import write_or_observe
+
+                batcher.enable_admission_events()
+                forecaster = ForecastEmitter(
+                    lambda r: write_or_observe(writer, r)
+                )
+                batcher.add_event_tap(forecaster.tap)
             if scfg.elastic:
                 from glom_tpu.serve.elastic import (
                     Autoscaler,
@@ -525,33 +590,82 @@ def main(argv=None) -> int:
                     warm_degraded_iters=degraded_iters,
                 ).start()
             tickets = []
-            for rid, seed, session, gap_s in req_plan():
-                if gap_s and tickets:
-                    time.sleep(gap_s)
-                try:
-                    tickets.append(
-                        (rid, batcher.submit(
-                            frame_img(seed, session), session_id=session
-                        ))
-                    )
-                except ShedError as e:
-                    failed += 1
-                    # The shed exception's detail carries the minted
-                    # trace_id (serve/batcher.submit), so even a rejected
-                    # request's response joins its trace's shed leaf.
-                    writer.write(
-                        serve_rec(
-                            {
-                                "event": "response",
-                                "id": rid,
-                                "ok": False,
-                                "reason": f"{type(e).__name__}: {e}"[:200],
-                                "trace_id": getattr(e, "detail", {}).get(
-                                    "trace_id"
-                                ),
-                            }
+            if replay_records is not None:
+                from glom_tpu.serve import workload as wl
+
+                def offer(rec, i):
+                    rid = rec.get("request_id", i)
+                    try:
+                        tickets.append(
+                            (rid, batcher.submit(
+                                wl.synth_input(rec, i),
+                                session_id=rec.get("session"),
+                            ))
                         )
+                    except ShedError as e:
+                        writer.write(
+                            serve_rec(
+                                {
+                                    "event": "response",
+                                    "id": rid,
+                                    "ok": False,
+                                    "reason": (
+                                        f"{type(e).__name__}: {e}"[:200]
+                                    ),
+                                    "trace_id": getattr(
+                                        e, "detail", {}
+                                    ).get("trace_id"),
+                                }
+                            )
+                        )
+                        raise  # replay counts it as shed and drives on
+
+                stats = wl.replay(
+                    replay_records, offer,
+                    time_scale=args.replay_time_scale,
+                )
+                failed += stats["n_shed"]
+                writer.write(
+                    serve_rec(
+                        {
+                            "event": "replay_summary",
+                            "source": args.replay,
+                            "time_scale": args.replay_time_scale,
+                            **stats,
+                        }
                     )
+                )
+            else:
+                for rid, seed, session, gap_s in req_plan():
+                    if gap_s and tickets:
+                        time.sleep(gap_s)
+                    try:
+                        tickets.append(
+                            (rid, batcher.submit(
+                                frame_img(seed, session), session_id=session
+                            ))
+                        )
+                    except ShedError as e:
+                        failed += 1
+                        # The shed exception's detail carries the minted
+                        # trace_id (serve/batcher.submit), so even a
+                        # rejected request's response joins its trace's
+                        # shed leaf.
+                        writer.write(
+                            serve_rec(
+                                {
+                                    "event": "response",
+                                    "id": rid,
+                                    "ok": False,
+                                    "reason": (
+                                        f"{type(e).__name__}: {e}"[:200]
+                                    ),
+                                    "trace_id": getattr(
+                                        e, "detail", {}
+                                    ).get("trace_id"),
+                                }
+                            )
+                        )
             for rid, ticket in tickets:
                 try:
                     levels, iters_run, latency_s = ticket.result(timeout=300.0)
@@ -601,9 +715,29 @@ def main(argv=None) -> int:
                         break
                     time.sleep(0.05)
                 scaler.stop()
+            if forecaster is not None:
+                # Flush the final partial window + lead-time model while
+                # the stream is still open: the run's LAST traffic still
+                # scores the forecast.
+                forecaster.close()
             writer.write(serve_rec(batcher.summary_record()))
             for rec in batcher.span_records():
                 writer.write(rec)
+            if recorder is not None:
+                n_rec = recorder.write(
+                    args.record_workload,
+                    source=f"serve-cli:{args.preset}",
+                )
+                writer.write(
+                    serve_rec(
+                        {
+                            "event": "workload_recorded",
+                            "path": args.record_workload,
+                            "n_requests": n_rec,
+                            **recorder.summary(),
+                        }
+                    )
+                )
         for engine in batcher.engines:
             for rec in engine.stats_records():
                 writer.write(serve_rec(rec))

@@ -589,7 +589,17 @@ class Autoscaler:
         if kind == "serve":
             from glom_tpu.serve.events import emit_serve
 
-            emit_serve(self.writer, rec)
+            stamped = emit_serve(self.writer, rec)
+            # Scale events join the batcher's tap fan-out: the forecast
+            # emitter's spawn-lead-time model (telemetry/forecast.py)
+            # reads spawn_ms from the same in-process stream `telemetry
+            # watch` would tail — the scale_out record must not exist
+            # only on disk. Taps never kill the control loop.
+            for tap in list(getattr(self.batcher, "_taps", ())):
+                try:
+                    tap(stamped)
+                except Exception:  # noqa: BLE001
+                    pass
             return
         write_or_observe(self.writer, schema.stamp(rec, kind=kind))
 
@@ -612,6 +622,10 @@ class Autoscaler:
                     if spawn_ms else None
                 ),
                 "spawn_ms_max": max(spawn_ms) if spawn_ms else None,
+                # The RAW spawn latencies, in spawn order: the lead-time
+                # model (telemetry/forecast.py SpawnLeadTimeModel) fits
+                # its percentile from these, not from the mean/max pair.
+                "spawn_ms": spawn_ms,
                 "n_engines": self.batcher.n_active_engines(),
                 "n_engines_peak": max(n for _, n in self._timeline),
                 # The fleet-size timeline ([t_rel_s, n_engines] per

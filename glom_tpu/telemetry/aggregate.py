@@ -464,6 +464,10 @@ SLO_RULES = {
     "headroom": "windowed MIN of capacity.headroom across engines "
     "(LOWER bound: breach when it drops below the threshold — the "
     "scale-out signal, docs/OBSERVABILITY.md 'Capacity observatory')",
+    "forecast_abs_err": "windowed mean of forecast.forecast_abs_err "
+    "across matured windows (schema v9, telemetry/forecast.py): the "
+    "load forecast's predicted-vs-realized error — a drifting model "
+    "breaches here before PR 18's policy would act on bad predictions",
 }
 # Rules where LESS is the emergency: observed < threshold breaches.
 SLO_LOWER_BOUND_RULES = frozenset({"headroom"})
@@ -523,6 +527,7 @@ class SLOMonitor:
         self._iters: deque = deque()     # (t, iters_total)
         self._outcomes: deque = deque()  # (t, "resolved"|"shed"|"failed"|"ok")
         self._headroom: deque = deque()  # (t, headroom)
+        self._forecast_err: deque = deque()  # (t, forecast_abs_err)
         self._latency_traces: set = set()
         self.n_breaches = 0
 
@@ -544,6 +549,17 @@ class SLOMonitor:
             if isinstance(h, (int, float)) and not isinstance(h, bool):
                 now = self._clock()
                 self._headroom.append((now, float(h)))
+                self._prune(now)
+            return
+        if rec.get("kind") == "forecast":
+            # Forecast evidence (schema v9, telemetry/forecast.py): only
+            # matured windows carry a numeric forecast_abs_err — null
+            # means the horizon hasn't elapsed yet and is NOT a zero, so
+            # it never enters the window.
+            err = rec.get("forecast_abs_err")
+            if isinstance(err, (int, float)) and not isinstance(err, bool):
+                now = self._clock()
+                self._forecast_err.append((now, float(err)))
                 self._prune(now)
             return
         if rec.get("kind") != "serve":
@@ -584,7 +600,9 @@ class SLOMonitor:
             # for days must not grow one entry per request forever.
             if t_id is not None:
                 self._latency_traces.discard(t_id)
-        for q in (self._iters, self._outcomes, self._headroom):
+        for q in (
+            self._iters, self._outcomes, self._headroom, self._forecast_err
+        ):
             while q and q[0][0] < horizon:
                 q.popleft()
 
@@ -635,6 +653,12 @@ class SLOMonitor:
                 out[rule] = (
                     min(vals) if len(vals) >= self.min_samples else None
                 )
+            elif rule == "forecast_abs_err":
+                vals = [v for _, v in self._forecast_err]
+                out[rule] = (
+                    sum(vals) / len(vals)
+                    if len(vals) >= self.min_samples else None
+                )
         return out
 
     def evaluate(self) -> List[dict]:
@@ -654,6 +678,7 @@ class SLOMonitor:
             "failure_rate": len(self._outcomes),
             "mean_iters": len(self._iters),
             "headroom": len(self._headroom),
+            "forecast_abs_err": len(self._forecast_err),
         }
         for rule, threshold in sorted(self.rules.items()):
             observed = values.get(rule)

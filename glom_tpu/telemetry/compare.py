@@ -77,6 +77,12 @@ _COST_METRIC_TOKENS = (
     # serve_ragged_max_signature_pages has NEITHER token: it rate-
     # classifies, so the admission ceiling SHRINKING is the regression.
     "peak_window", "alias_fallback",
+    # Workload-observatory rows (ISSUE 17): forecast error growing is a
+    # worse forecast, and a longer spawn lead time means the
+    # anticipatory policy must act earlier — both regress UP
+    # (lead_time_ms also rides the "ms" unit token; the name token
+    # covers the flattened forecast.*.lead_time rows).
+    "forecast_abs_err", "lead_time",
 )
 # Metric-name tokens that mark a HIGHER-is-better row regardless of the
 # cost heuristics: headroom is capacity LEFT — a serving change that
@@ -318,6 +324,40 @@ def load_bench_records(lines) -> Tuple[Dict[str, dict], Dict[str, dict]]:
                         "metric": f"capacity.{rec['engine']}.headroom",
                         "value": float(h),
                         "unit": "fraction",
+                        "kind": "bench",
+                    }
+                )
+            continue
+        if rec.get("kind") == "forecast" and isinstance(
+            rec.get("metric"), str
+        ):
+            # Forecast-quality rows (ISSUE 17): the matured
+            # predicted-vs-realized error and the spawn lead time gate
+            # as COSTS (forecast_abs_err/lead_time name tokens) — a
+            # change that makes the forecast worse, or the fleet slower
+            # to spawn, regresses even though both live on "forecast"
+            # records, not bench rows. Unmatured windows (null error)
+            # are honest gaps, not zeros — skipped, never ingested.
+            series = rec["metric"]
+            err = rec.get("forecast_abs_err")
+            if isinstance(err, (int, float)) and not isinstance(err, bool):
+                ingest(
+                    {
+                        "metric": f"forecast.{series}.forecast_abs_err",
+                        "value": float(err),
+                        "unit": "count",
+                        "kind": "bench",
+                    }
+                )
+            lead = rec.get("lead_time_ms")
+            if isinstance(lead, (int, float)) and not isinstance(
+                lead, bool
+            ):
+                ingest(
+                    {
+                        "metric": f"forecast.{series}.lead_time_ms",
+                        "value": float(lead),
+                        "unit": "ms",
                         "kind": "bench",
                     }
                 )

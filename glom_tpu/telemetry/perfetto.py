@@ -59,7 +59,15 @@ _TID_CAPACITY = 93
 _TID_FLEET = 94
 _TID_DISPATCH = 95
 _TID_PHASES = 96
+# Workload-observatory tracks (ISSUE 17): the offered arrival rate
+# (a trailing-window counter over "workload" records and live "admit"
+# events) and the scored forecast series render as counters beside
+# fleet:n_engines — load, the fleet's answer, and the forecast that
+# should have anticipated it, on adjacent tracks.
+_TID_FORECAST = 97
+_TID_WORKLOAD = 98
 _TID_BARRIER_BASE = 100
+_ARRIVAL_WINDOW_S = 1.0  # the arrival-rate counter's trailing window
 
 # The elastic-serving transition vocabulary (serve/elastic.SCALE_EVENTS —
 # mirrored literally: this module stays pure-stdlib importable and the
@@ -123,6 +131,7 @@ def to_trace_events(records: Iterable[dict]) -> List[dict]:
     flow_seen: dict = {}  # barrier flow id -> "open"
     trace_flows: dict = {}  # trace_id -> [(ts, is_leaf), ...]
     barrier_tracks: dict = {}  # tid -> track label
+    arrival_window: List[float] = []  # trailing arrival ts (seconds)
     for i, rec in enumerate(records):
         kind = rec.get("kind", schema.infer_kind(rec))
         fallback = i * 1e-3  # 1ms spacing keeps clockless records ordered
@@ -275,6 +284,62 @@ def to_trace_events(records: Iterable[dict]) -> List[dict]:
                         "args": {"n_engines": float(n)},
                     }
                 )
+        elif kind == "forecast":
+            # Forecast evidence (schema v9, telemetry/forecast.py): each
+            # window samples a counter track per metric beside the fleet
+            # and arrival tracks — predicted vs observed load, and the
+            # scored error once the horizon matures. Null errors (the
+            # window not yet matured) are honest gaps, never zeros.
+            args = {}
+            for key in (
+                "predicted",
+                "observed_rate_rps",
+                "realized",
+                "forecast_abs_err",
+                "lead_time_ms",
+            ):
+                val = rec.get(key)
+                if isinstance(val, (int, float)) and not isinstance(
+                    val, bool
+                ):
+                    args[key] = float(val)
+            if args:
+                raw.append(
+                    {
+                        "name": f"forecast:{rec.get('metric', '?')}",
+                        "ph": "C",
+                        "pid": _PID,
+                        "tid": _TID_FORECAST,
+                        "ts": ts,
+                        "args": args,
+                    }
+                )
+        elif kind == "workload" or (
+            kind == "serve" and rec.get("event") == "admit"
+        ):
+            # Offered load (schema v9, serve/workload.py): every workload
+            # artifact row — and every live "admit" event — advances a
+            # trailing-window arrival-rate counter. Per-arrival instants
+            # would drown the events track at serving volume; the rate
+            # curve is the readable form.
+            arrival_window.append(ts)
+            cutoff = ts - _ARRIVAL_WINDOW_S
+            while arrival_window and arrival_window[0] < cutoff:
+                arrival_window.pop(0)
+            raw.append(
+                {
+                    "name": "workload:arrival_rps",
+                    "ph": "C",
+                    "pid": _PID,
+                    "tid": _TID_WORKLOAD,
+                    "ts": ts,
+                    "args": {
+                        "arrival_rps": round(
+                            len(arrival_window) / _ARRIVAL_WINDOW_S, 3
+                        )
+                    },
+                }
+            )
         else:
             label = {
                 "train_step": f"step {rec.get('step', '?')}",
@@ -401,6 +466,22 @@ def to_trace_events(records: Iterable[dict]) -> List[dict]:
         if "dur" in e:
             e["dur"] = round(e["dur"], 3)
     raw.sort(key=lambda e: e["ts"])
+    # Name the workload-observatory tracks when they carry samples.
+    named_tids = {e["tid"] for e in raw}
+    for tid, label in (
+        (_TID_FORECAST, "forecast"),
+        (_TID_WORKLOAD, "workload arrivals"),
+    ):
+        if tid in named_tids:
+            raw.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": _PID,
+                    "tid": tid,
+                    "args": {"name": label},
+                }
+            )
     # Name the per-host barrier tracks (metadata events; ts-less).
     for tid, label in sorted(barrier_tracks.items()):
         raw.append(

@@ -67,7 +67,25 @@ from typing import Iterable, List, Optional, Tuple
 # "capacity" records now stamp `state` ("ok" | "draining" | "probation"
 # | "dead") so the SLO monitor can EXCLUDE deliberately draining or
 # probing engines from the headroom windowed-min.
-SCHEMA_VERSION = 8
+# v9 is the workload observatory (serve/workload.py,
+# telemetry/forecast.py, docs/OBSERVABILITY.md "Workload observatory"):
+# the new "workload" kind is one OFFERED request — arrival time `t`
+# (seconds, run-relative), shape `signature` ("bucket:CxHxW" |
+# "ragged:<pages>p" | "delta:CxHxW"), and `outcome` ("served" | "shed" |
+# "failed" | "unresolved" | "offered" — the last is a scenario-generated
+# request not yet realized); a workload JSONL artifact replays
+# deterministically (bench_serve.py --replay, python -m glom_tpu.serve
+# --replay). The new "forecast" kind is one scored short-horizon
+# prediction — `metric` names the forecast series ("arrival_rate_rps",
+# "service_rate_rps", "spawn_lead_time"), `horizon_s` how far ahead it
+# looked, and the `forecast_abs_err` KEY must be PRESENT on every
+# record (null = no prediction matured yet — degenerate fits pin
+# honestly like the α-β model; an ABSENT key means the emitter never
+# scored itself, which is a lint failure, not a silent gap). The new
+# serve event "engine_husk_retired" folds a pruned drained-husk's
+# counters into the evidence stream so summary conservation still
+# reconciles after retention trims the engines nest.
+SCHEMA_VERSION = 9
 
 _NUM = (int, float)
 _STR = (str,)
@@ -142,6 +160,22 @@ KINDS = {
     # `telemetry watch --slo headroom=X` breaches when it drops BELOW X
     # (the one lower-bound rule).
     "capacity": {"engine": _STR, "headroom": _NUM},
+    # One OFFERED serving request (serve/workload.py WorkloadRecorder,
+    # docs/OBSERVABILITY.md "Workload observatory"): `t` is the arrival
+    # time in run-relative seconds, `signature` the admission shape
+    # ("bucket:CxHxW" | "ragged:<pages>p" | "delta:CxHxW"), `outcome`
+    # what became of it ("served" | "shed" | "failed" | "unresolved" |
+    # "offered"). session / shape / seed / latency_ms / detail ride
+    # per record; a stream of these IS the replayable artifact.
+    "workload": {"t": _NUM, "signature": _STR, "outcome": _STR},
+    # One scored short-horizon prediction (telemetry/forecast.py):
+    # `metric` names the series, `horizon_s` the look-ahead. predicted /
+    # realized / forecast_abs_err / lead_time_ms / trend_per_s /
+    # seasonal / n_samples / reason ride per record; the
+    # forecast_abs_err KEY must be present on every v9 record (null =
+    # nothing matured yet; absent = the emitter never scored itself —
+    # enforced by validate_record below).
+    "forecast": {"metric": _STR, "horizon_s": _NUM},
 }
 
 # Serve events that are REQUEST-scoped and must carry trace context on
@@ -251,6 +285,24 @@ def validate_record(rec: object) -> List[str]:
             f"serve.{rec.get('event')} record (v{v}) carries no trace "
             f"context key ({'/'.join(_TRACE_KEYS)}) — see "
             "telemetry/tracectx.py"
+        )
+    if (
+        kind == "forecast"
+        and isinstance(v, int)
+        and v >= 9
+        and "forecast_abs_err" not in rec
+    ):
+        # v9's forecast-quality contract (the trace-presence pattern):
+        # every forecast record must carry its predicted-vs-realized
+        # error KEY — null while no prediction has matured (degenerate
+        # fits pin honestly), but never silently absent, so an emitter
+        # that stopped scoring itself is a lint failure the moment it
+        # writes, not a quiet gap in the gate.
+        errs.append(
+            f"forecast.{rec.get('metric')} record (v{v}) carries no "
+            "forecast_abs_err key — predicted-vs-realized error must be "
+            "stamped on every window (null = not matured; absent = "
+            "unscored; see telemetry/forecast.py)"
         )
     try:
         json.dumps(rec)

@@ -335,6 +335,16 @@ class ServeConfig:
     elastic_interval_s: float = 0.5
     elastic_p99_ms: Optional[float] = None
     elastic_shed_rate: Optional[float] = None
+    # Drained-husk retention (schema v9, docs/OBSERVABILITY.md "Workload
+    # observatory"): a scale-in leaves the drained engine in the summary
+    # as an evidence husk. None (both defaults) retains every husk
+    # forever — the pre-v9 shape. husk_max keeps at most N husks (oldest
+    # retire first); husk_max_age_s retires a husk once it has been
+    # drained that long. Retirement folds the husk's counters into the
+    # summary's husks_retired nest and stamps one engine_husk_retired
+    # event, so conservation still reconciles after the trim.
+    husk_max: Optional[int] = None
+    husk_max_age_s: Optional[float] = None
 
     def __post_init__(self):
         if not self.buckets:
@@ -533,6 +543,14 @@ class ServeConfig:
             raise ValueError(
                 f"elastic_shed_rate {self.elastic_shed_rate} must be in "
                 "[0, 1] or None"
+            )
+        if self.husk_max is not None and self.husk_max < 0:
+            raise ValueError(
+                f"husk_max {self.husk_max} must be >= 0 or None"
+            )
+        if self.husk_max_age_s is not None and self.husk_max_age_s < 0:
+            raise ValueError(
+                f"husk_max_age_s {self.husk_max_age_s} must be >= 0 or None"
             )
 
 

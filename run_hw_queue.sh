@@ -350,6 +350,42 @@ tl = peak[-1]["timeline"]
 print(f"OK: fleet timeline {tl}, tickets conserved")
 EOF
 
+# 9m. Workload-observatory gate (ISSUE 17, docs/SERVING.md "Record and
+#     replay"): a seeded diurnal scenario replayed through the REAL
+#     autoscaler on real hardware. The gate requires exact ticket
+#     conservation + the same per-request signature sequence as the
+#     artifact, AND live forecast evidence: forecast records on every
+#     closed window, each carrying the forecast_abs_err key, with at
+#     least one matured (finite) predicted-vs-realized error — a
+#     forecast that never scores is the silent-absence failure this
+#     observatory exists to kill. Rows join the 11b serve baseline so
+#     pacing/forecast regressions gate.
+step workload_serve 2400 python -u bench_serve.py --scenario diurnal \
+    --scenario-duration 6
+step workload_gate 120 python - results/hw_queue/workload_serve.log <<'EOF'
+import sys
+from glom_tpu.telemetry import schema
+rows = [r for _, r in schema.iter_json_lines(open(sys.argv[1]))]
+cons = [r for r in rows
+        if r.get("metric", "").startswith("serve_workload_tickets_conserved")]
+assert cons, "workload rows missing from the bench log"
+assert cons[-1]["value"] == 1.0, f"replay tickets NOT conserved: {cons[-1]}"
+ws = [r for r in rows if r.get("event") == "workload_summary"][-1]
+assert ws["signature_sequence_match"] is True, ws
+fc = [r for r in rows if r.get("kind") == "forecast"]
+assert fc, "no forecast records emitted over the scenario"
+missing = [r for r in fc if "forecast_abs_err" not in r]
+assert not missing, f"forecast records without the error key: {missing[:2]}"
+scored = [r for r in fc
+          if isinstance(r.get("forecast_abs_err"), (int, float))]
+assert scored, "no forecast window ever matured (error never scored)"
+lag = [r for r in rows
+       if r.get("metric", "").startswith("serve_workload_pacing_lag")]
+print(f"OK: {len(fc)} forecast records ({len(scored)} scored, last "
+      f"abs_err {scored[-1]['forecast_abs_err']}), pacing lag "
+      f"{lag[-1]['value'] if lag else '?'}ms, tickets conserved")
+EOF
+
 # 10. Schema lint: every JSON row this queue produced must validate
 #     against the versioned event schema (glom_tpu/telemetry/schema.py).
 #     Shell noise in the logs is skipped; --allow-unstamped because the
@@ -385,6 +421,7 @@ grep -ah '^{' results/hw_queue/bench_serve.log \
     results/hw_queue/collective_timing_ab.log \
     results/hw_queue/phase_ab.log \
     results/hw_queue/ramp_serve.log \
+    results/hw_queue/workload_serve.log \
     > results/hw_queue/serve_candidate.jsonl 2>/dev/null || true
 if [ -f results/serve_baseline.jsonl ]; then
     step serve_compare 300 python -m glom_tpu.telemetry compare \

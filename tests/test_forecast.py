@@ -1,0 +1,302 @@
+"""Forecast evidence (glom_tpu/telemetry/forecast.py, ISSUE 17).
+
+The tier-1 locks:
+
+  * LoadForecaster's trend fit extrapolates a clean linear series and
+    SCORES every prediction once the series passes its target —
+    forecast_abs_err rides every record, null until matured, never
+    absent (the v9 presence contract);
+  * degenerate fits pin honestly: insufficient samples, zero time span,
+    and the empty window all stamp predicted null + the reason;
+  * seasonality joins the fit only after >= 2 observed seasons
+    ("season-immature" before that) and then carries the phase
+    deviation;
+  * SpawnLeadTimeModel scores its prior estimate against each realized
+    spawn before absorbing it, and pins to "no-spawn-evidence" when
+    empty;
+  * ForecastEmitter under a fake clock: windows close on tap activity
+    at interval_s cadence, admit events become arrival-rate samples,
+    scale_out spawn_ms becomes lead-time records, close() flushes the
+    tail — and every emitted record validates at schema v9.
+
+All fake-clock, no jit, no sleeps.
+"""
+
+import threading
+
+import pytest
+
+from glom_tpu.telemetry import schema
+from glom_tpu.telemetry.forecast import (
+    ForecastEmitter,
+    LoadForecaster,
+    SpawnLeadTimeModel,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def advance(self, dt):
+        self.t += dt
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# the load forecaster
+# ---------------------------------------------------------------------------
+
+
+class TestLoadForecaster:
+    def test_trend_extrapolates_linear_series(self):
+        """A perfectly linear series forecasts its own continuation:
+        value = 2t, horizon 2s ahead of t=10 -> 24."""
+        f = LoadForecaster("rps", window_s=20.0, horizon_s=2.0)
+        for t in range(11):
+            f.observe(float(t), 2.0 * t)
+        rec = f.forecast(10.0)
+        assert rec["kind"] == "forecast" and rec["metric"] == "rps"
+        assert rec["predicted"] == pytest.approx(24.0, abs=1e-6)
+        assert rec["trend_per_s"] == pytest.approx(2.0, abs=1e-6)
+        assert "forecast_abs_err" in rec  # the v9 presence contract
+        assert schema.validate_record(rec) == []
+
+    def test_prediction_scores_once_target_passes(self):
+        """forecast() queues the prediction; the first observe() past
+        t + horizon scores it and the NEXT record carries the error."""
+        f = LoadForecaster("rps", window_s=20.0, horizon_s=2.0)
+        for t in range(6):
+            f.observe(float(t), 10.0)  # flat series
+        first = f.forecast(5.0)  # predicts 10.0 at t=7
+        assert first["forecast_abs_err"] is None and first["n_scored"] == 0
+        f.observe(8.0, 14.0)  # past the target; realized interp != 10
+        scored = f.forecast(8.0)
+        assert scored["n_scored"] == 1
+        # Realized at t=7 interpolates between (5, 10) and (8, 14).
+        realized = 10.0 + (14.0 - 10.0) * (7.0 - 5.0) / (8.0 - 5.0)
+        assert scored["forecast_abs_err"] == pytest.approx(
+            abs(10.0 - realized), abs=1e-3
+        )
+        assert scored["realized"] == pytest.approx(realized, abs=1e-3)
+        assert scored["forecast_mae"] == scored["forecast_abs_err"]
+        assert schema.validate_record(scored) == []
+
+    def test_degenerate_insufficient_samples(self):
+        f = LoadForecaster("rps")
+        f.observe(0.0, 1.0)
+        rec = f.forecast(0.0)
+        assert rec["predicted"] is None
+        assert rec["reason"] == "insufficient-samples"
+        assert rec["forecast_abs_err"] is None  # key present, value null
+        assert schema.validate_record(rec) == []
+
+    def test_degenerate_zero_time_span(self):
+        f = LoadForecaster("rps")
+        for _ in range(4):
+            f.observe(3.0, 5.0)  # four samples, one instant
+        rec = f.forecast(3.0)
+        assert rec["predicted"] is None
+        assert rec["reason"] == "zero-time-span"
+        assert rec["n_samples"] == 4
+
+    def test_empty_window_forecasts_null(self):
+        rec = LoadForecaster("rps").forecast(0.0)
+        assert rec["predicted"] is None
+        assert rec["reason"] == "insufficient-samples"
+        assert rec["n_samples"] == 0
+        assert schema.validate_record(rec) == []
+
+    def test_window_prunes_old_samples(self):
+        f = LoadForecaster("rps", window_s=5.0)
+        for t in range(12):
+            f.observe(float(t), 1.0)
+        assert f.forecast(11.0)["n_samples"] <= 6
+
+    def test_seasonality_needs_two_full_seasons(self):
+        """One observed season stamps trend-only + "season-immature";
+        two+ seasons carry the phase deviation in the fit."""
+        import math
+
+        f = LoadForecaster(
+            "rps", window_s=8.0, horizon_s=1.0, season_s=8.0,
+            season_buckets=4,
+        )
+        rate = lambda t: 10.0 + 5.0 * math.sin(2 * math.pi * t / 8.0)
+        for i in range(8):  # one season at 1 Hz
+            f.observe(i * 1.0, rate(i * 1.0))
+        early = f.forecast(7.0)
+        assert early["seasonal"] is None
+        assert early["reason"] == "season-immature"
+        for i in range(8, 25):  # two more seasons
+            f.observe(i * 1.0, rate(i * 1.0))
+        late = f.forecast(24.0)
+        assert late["seasonal"] is not None
+        assert "reason" not in late
+        assert schema.validate_record(late) == []
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            LoadForecaster("rps", window_s=0)
+        with pytest.raises(ValueError):
+            LoadForecaster("rps", horizon_s=-1.0)
+        with pytest.raises(ValueError):
+            LoadForecaster("rps", season_s=0.0)
+        with pytest.raises(ValueError):
+            LoadForecaster("rps", min_samples=1)
+        with pytest.raises(ValueError):
+            LoadForecaster("rps", season_buckets=1)
+
+
+# ---------------------------------------------------------------------------
+# the spawn-lead-time model
+# ---------------------------------------------------------------------------
+
+
+class TestSpawnLeadTimeModel:
+    def test_no_evidence_pins_null(self):
+        m = SpawnLeadTimeModel()
+        assert m.lead_time_ms() is None
+        rec = m.record()
+        assert rec["kind"] == "forecast"
+        assert rec["metric"] == "spawn_lead_time"
+        assert rec["lead_time_ms"] is None
+        assert rec["reason"] == "no-spawn-evidence"
+        assert rec["forecast_abs_err"] is None
+        assert schema.validate_record(rec) == []
+
+    def test_scores_prior_estimate_then_absorbs(self):
+        m = SpawnLeadTimeModel(quantile=0.9)
+        m.observe(100.0)  # no prior -> nothing scored
+        assert m.record()["n_scored"] == 0
+        assert m.lead_time_ms() == 100.0
+        m.observe(140.0)  # prior estimate was 100 -> abs err 40
+        rec = m.record()
+        assert rec["n_scored"] == 1
+        assert rec["forecast_abs_err"] == pytest.approx(40.0)
+        assert rec["lead_time_ms"] == 140.0  # p90 nearest-rank of {100,140}
+        assert rec["horizon_s"] == pytest.approx(0.14)
+        assert schema.validate_record(rec) == []
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            SpawnLeadTimeModel(quantile=0.0)
+        with pytest.raises(ValueError):
+            SpawnLeadTimeModel(quantile=1.5)
+        with pytest.raises(ValueError):
+            SpawnLeadTimeModel(max_samples=0)
+
+
+# ---------------------------------------------------------------------------
+# the live emitter (fake clock, fake tap stream)
+# ---------------------------------------------------------------------------
+
+
+def _admit(i=0):
+    return {"kind": "serve", "event": "admit", "request_id": f"r{i}"}
+
+
+class TestForecastEmitter:
+    def test_window_closes_on_interval_and_emits_rate(self):
+        clk = FakeClock()
+        out = []
+        em = ForecastEmitter(
+            out.append, interval_s=0.5, window_s=5.0, horizon_s=1.0,
+            clock=clk,
+        )
+        em.tap(_admit(0))  # opens the window at t=0
+        clk.advance(0.25)
+        em.tap(_admit(1))
+        assert out == []  # interval not yet elapsed
+        clk.advance(0.25)
+        em.tap(_admit(2))  # t=0.5 closes the window (3 arrivals / 0.5s)
+        assert len(out) == 1 and em.n_windows == 1
+        rec = out[0]
+        assert rec["kind"] == "forecast"
+        assert rec["metric"] == "arrival_rate_rps"
+        assert rec["observed_rate_rps"] == pytest.approx(6.0)
+        assert "forecast_abs_err" in rec
+        assert schema.validate_record(rec) == []
+
+    def test_forecast_matures_across_windows(self):
+        """Constant-rate traffic over enough windows: predictions mature
+        and forecast_abs_err turns numeric (and small)."""
+        clk = FakeClock()
+        out = []
+        em = ForecastEmitter(
+            out.append, interval_s=0.5, window_s=5.0, horizon_s=0.5,
+            clock=clk,
+        )
+        rid = 0
+        for _ in range(10):  # 10 windows, 2 admits each -> 4 rps
+            em.tap(_admit(rid)); rid += 1
+            clk.advance(0.25)
+            em.tap(_admit(rid)); rid += 1
+            clk.advance(0.25)
+        scored = [r for r in out if r["forecast_abs_err"] is not None]
+        assert scored, "no prediction matured over 10 windows"
+        assert scored[-1]["forecast_abs_err"] < 1.0  # ~flat series
+        for r in out:
+            assert "forecast_abs_err" in r
+            assert schema.validate_record(r) == []
+
+    def test_scale_out_feeds_lead_model(self):
+        clk = FakeClock()
+        out = []
+        em = ForecastEmitter(out.append, interval_s=10.0, clock=clk)
+        em.tap({"kind": "serve", "event": "scale_out", "spawn_ms": 80.0})
+        leads = [r for r in out if r.get("metric") == "spawn_lead_time"]
+        assert len(leads) == 1 and leads[0]["lead_time_ms"] == 80.0
+        assert em.lead_model.lead_time_ms() == 80.0
+
+    def test_close_flushes_partial_window_and_lead_record(self):
+        clk = FakeClock()
+        out = []
+        em = ForecastEmitter(out.append, interval_s=10.0, clock=clk)
+        em.tap(_admit(0))
+        clk.advance(1.0)
+        em.close()
+        kinds = [(r.get("metric"), r.get("observed_rate_rps")) for r in out]
+        assert ("arrival_rate_rps", 1.0) in kinds  # the flushed tail
+        assert any(m == "spawn_lead_time" for m, _ in kinds)
+        for r in out:
+            assert schema.validate_record(r) == []
+
+    def test_idle_stream_emits_nothing(self):
+        out = []
+        em = ForecastEmitter(out.append, interval_s=0.1, clock=FakeClock())
+        em.tap({"kind": "serve", "event": "summary"})  # no t0 traffic yet
+        assert out == [] or all(
+            r.get("metric") != "arrival_rate_rps" or r["n_samples"] == 0
+            for r in out
+        )
+
+    def test_taps_are_thread_safe(self):
+        """Concurrent taps from submit + worker threads never drop an
+        arrival or corrupt a window."""
+        clk = FakeClock()
+        out = []
+        lock = threading.Lock()
+
+        def emit(r):
+            with lock:
+                out.append(r)
+
+        em = ForecastEmitter(emit, interval_s=1e9, clock=clk)
+        threads = [
+            threading.Thread(
+                target=lambda k=k: [em.tap(_admit(k * 50 + j)) for j in range(50)]
+            )
+            for k in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert em._window_arrivals == 200
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ForecastEmitter(lambda r: None, interval_s=0.0)
