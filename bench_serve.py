@@ -1558,6 +1558,238 @@ def run_workload(cfg, scfg, label: str, records, *, source: str,
     }
 
 
+def run_elastic_ab(cfg, scfg, label: str, records, *, source: str,
+                   time_scale: float = 1.0, out_prefix: str = "elastic_ab",
+                   max_engines: int = 2, gate: bool = False) -> dict:
+    """Anticipatory-vs-reactive autoscaling A/B over ONE replayed
+    workload artifact (docs/SERVING.md "Anticipatory autoscaling"): the
+    same records drive two independent fleets —
+
+      * reactive       — the PR 14 baseline (no forecast wired, no
+                         warm pool); and
+      * anticipatory   — the PR 18 policy (forecast + spawn-lead-time
+                         model + one warm-pool spare),
+
+    each writing its decisions, serve events, and forecasts to its OWN
+    JSONL file ({out_prefix}_{arm}.jsonl) so the decision chains stay
+    per-arm and `python -m glom_tpu.telemetry audit` scores each arm's
+    counterfactual regret independently. Emits per-arm
+    serve_elastic_ab_p99 / _failed / _regret rows plus the deltas
+    (anticipatory minus reactive; negative = anticipation won). With
+    gate=True (the flash-crowd CI gate) the run ASSERTS the
+    anticipatory arm shed-or-failed no more tickets AND landed a
+    strictly lower p99 than the reactive arm.
+    """
+    import dataclasses
+
+    from glom_tpu.serve import workload as wl
+    from glom_tpu.serve.batcher import DynamicBatcher, ShedError
+    from glom_tpu.serve.elastic import Autoscaler, resolve_policy
+    from glom_tpu.serve.engine import InferenceEngine
+    from glom_tpu.serve.events import stamp_serve
+    from glom_tpu.telemetry import schema
+    from glom_tpu.telemetry.audit import audit_records, load_records
+    from glom_tpu.telemetry.forecast import ForecastEmitter
+    from glom_tpu.telemetry.sinks import emit
+    from glom_tpu.utils.metrics import MetricsWriter
+
+    scfg_base = dataclasses.replace(
+        scfg,
+        elastic=True, min_engines=1, max_engines=max_engines,
+        elastic_low_water=0.5, elastic_high_water=0.8,
+        elastic_dwell_s=0.1, elastic_cooldown_s=0.5,
+        elastic_window_s=2.0, elastic_interval_s=0.05,
+        elastic_p99_ms=100.0,
+    )
+    n_total = len(records)
+    q = lambda xs, f: sorted(xs)[min(len(xs) - 1, int(f * len(xs)))]
+
+    def _arm(arm: str, *, anticipatory: bool, warm_pool: int) -> dict:
+        scfg_arm = dataclasses.replace(
+            scfg_base,
+            elastic_anticipatory=anticipatory,
+            warm_pool=warm_pool,
+        )
+        path = f"{out_prefix}_{arm}.jsonl"
+        writer = MetricsWriter(path, echo=False)
+        engines = _make_engines(cfg, scfg_arm, 1)
+        params = engines[0].params
+        for eng in engines:
+            eng.warmup()
+        seq = [len(engines)]
+
+        def factory():
+            i = seq[0]
+            eng = InferenceEngine(
+                cfg, scfg_arm, params=params, name=f"engine{i}"
+            )
+            seq[0] += 1
+            return eng
+
+        latencies: list = []
+        with DynamicBatcher(engines=engines, writer=writer) as batcher:
+            batcher.enable_admission_events()
+            forecaster = ForecastEmitter(
+                lambda r: writer.write(
+                    schema.stamp(dict(r), kind="forecast")
+                ),
+                # A 1 s window matures the fit within the scenario's
+                # pre-crowd base phase; the 2 s default never closes
+                # enough scored windows before the burst lands.
+                interval_s=0.25, window_s=1.0, horizon_s=0.5,
+            )
+            batcher.add_event_tap(forecaster.tap)
+            scaler = Autoscaler(
+                batcher, factory, policy=resolve_policy(scfg_arm),
+                rules={"p99_ms": scfg_arm.elastic_p99_ms},
+                writer=writer,
+                interval_s=scfg_arm.elastic_interval_s,
+                # The reactive arm IS the PR 14 baseline: no forecast
+                # wired even though the emitter runs (its rows score the
+                # counterfactual), no spares.
+                forecast=forecaster if anticipatory else None,
+                warm_pool=warm_pool,
+                fleet=arm,
+            ).start()
+            try:
+                tickets = []
+
+                def offer(rec, i):
+                    # HARD traffic, same 100x lever as run_workload: the
+                    # crowd must queue or neither arm has anything to do.
+                    img = 100.0 * wl.synth_input(rec, i)
+                    tickets.append(
+                        batcher.submit(img, session_id=rec.get("session"))
+                    )
+
+                stats = wl.replay(records, offer, time_scale=time_scale)
+                for t in tickets:
+                    try:
+                        _, _, latency_s = t.result(timeout=600.0)
+                        latencies.append(1e3 * latency_s)
+                    except Exception:  # noqa: BLE001 — summary counts it
+                        pass
+            finally:
+                scaler.stop()
+            forecaster.close()
+            srec = scaler.record()
+            summary = batcher.summary_record()
+            writer.write(stamp_serve(dict(summary)))
+        writer.close()
+        audit = audit_records(load_records(path))
+        assert not audit["errors"], (
+            f"{arm} arm decision chain failed its own audit: "
+            f"{audit['errors'][:3]}"
+        )
+        failed = summary["n_shed"] + summary["n_failed"]
+        return {
+            "arm": arm,
+            "path": path,
+            "p99_ms": round(q(latencies, 0.99), 3) if latencies else None,
+            "n_served": summary["n_served"],
+            "failed": failed,
+            "regret": audit["regret_total"],
+            "regret_per_decision": audit["regret_per_decision"],
+            "n_decisions": srec["n_decisions"],
+            "decisions_late": srec["decisions_late"],
+            "spawn_lead_violations": srec["spawn_lead_violations"],
+            "n_promotions": srec["n_promotions"],
+            "pacing_lag_mean_ms": stats["pacing_lag_mean_ms"],
+            "conserved": (
+                summary["n_served"] + summary["n_shed"]
+                + summary["n_failed"] == summary["n_requests"] == n_total
+            ),
+        }
+
+    arms = {
+        "reactive": _arm("reactive", anticipatory=False, warm_pool=0),
+        "anticipatory": _arm("anticipatory", anticipatory=True,
+                             warm_pool=1),
+    }
+    emit(
+        {
+            "event": "elastic_ab_summary",
+            "config": label,
+            "source": source,
+            "n_requests": n_total,
+            "arms": arms,
+        },
+        kind="serve",
+    )
+    for arm, r in arms.items():
+        if r["p99_ms"] is not None:
+            emit(
+                {
+                    "metric": f"serve_elastic_ab_p99 ({arm}, {source}, "
+                              f"{label})",
+                    "value": r["p99_ms"],
+                    "unit": "ms",
+                    "n": r["n_served"],
+                }
+            )
+        emit(
+            {
+                "metric": f"serve_elastic_ab_failed ({arm}, {source}, "
+                          f"{label})",
+                "value": r["failed"],
+                "unit": "count",
+            }
+        )
+        emit(
+            {
+                "metric": f"serve_elastic_ab_regret ({arm}, {source}, "
+                          f"{label})",
+                "value": r["regret"],
+                "unit": "count",
+                "regret_per_decision": r["regret_per_decision"],
+                "n_decisions": r["n_decisions"],
+                "decisions_late": r["decisions_late"],
+                "spawn_lead_violations": r["spawn_lead_violations"],
+                "log": r["path"],
+            }
+        )
+    rx, ax = arms["reactive"], arms["anticipatory"]
+    if rx["p99_ms"] is not None and ax["p99_ms"] is not None:
+        emit(
+            {
+                "metric": f"serve_elastic_ab_p99_delta ({source}, {label})",
+                "value": round(ax["p99_ms"] - rx["p99_ms"], 3),
+                "unit": "ms",
+            }
+        )
+    emit(
+        {
+            "metric": f"serve_elastic_ab_failed_delta ({source}, {label})",
+            "value": ax["failed"] - rx["failed"],
+            "unit": "count",
+        }
+    )
+    emit(
+        {
+            "metric": f"serve_elastic_ab_regret_delta ({source}, {label})",
+            "value": round(ax["regret"] - rx["regret"], 6),
+            "unit": "count",
+        }
+    )
+    assert rx["conserved"] and ax["conserved"], (
+        f"elastic A/B tickets NOT conserved: reactive={rx}, "
+        f"anticipatory={ax}"
+    )
+    if gate:
+        assert ax["failed"] <= rx["failed"], (
+            "anticipatory arm shed/failed MORE tickets than reactive: "
+            f"{ax['failed']} > {rx['failed']}"
+        )
+        assert (
+            ax["p99_ms"] is not None and rx["p99_ms"] is not None
+            and ax["p99_ms"] < rx["p99_ms"]
+        ), (
+            "anticipatory arm did not beat reactive p99: "
+            f"{ax['p99_ms']} vs {rx['p99_ms']}"
+        )
+    return arms
+
+
 def run_trace_ab(cfg, scfg, label: str, *, n_requests: int,
                  n_engines: int = 1, repeats: int = 3) -> dict:
     """Request-tracing overhead A/B (docs/OBSERVABILITY.md, Request
@@ -1858,9 +2090,28 @@ def main(argv=None) -> int:
                     metavar="S", help="scenario length in seconds")
     ap.add_argument("--scenario-seed", type=int, default=0, metavar="K",
                     help="scenario arrival-process seed")
+    ap.add_argument("--scenario-crowd-rps", type=float, default=None,
+                    metavar="R",
+                    help="flash-crowd only: crowd arrival rate during "
+                    "the burst (default 50; raise past one engine's "
+                    "service rate to force a genuine capacity crunch "
+                    "for the --elastic-ab gate)")
     ap.add_argument("--time-scale", type=float, default=1.0, metavar="X",
                     help="replay/scenario: stretch (>1) or compress (<1) "
                     "the inter-arrival gaps")
+    ap.add_argument("--elastic-ab", action="store_true",
+                    help="with --replay/--scenario: drive the SAME "
+                    "records through a reactive (PR 14 baseline) and an "
+                    "anticipatory (forecast + warm pool) fleet, each "
+                    "logging its decision chain to its own JSONL file, "
+                    "and score counterfactual regret per arm "
+                    "(docs/SERVING.md 'Anticipatory autoscaling'); "
+                    "flash-crowd runs GATE on the p99 + failed-ticket "
+                    "deltas")
+    ap.add_argument("--elastic-ab-out", default="elastic_ab",
+                    metavar="PREFIX",
+                    help="per-arm decision-log path prefix "
+                    "(PREFIX_reactive.jsonl / PREFIX_anticipatory.jsonl)")
     ap.add_argument("--workload-out", default=None, metavar="FILE",
                     help="replay/scenario: re-record THIS run's offered "
                     "traffic as a workload artifact (closes the "
@@ -1976,12 +2227,30 @@ def main(argv=None) -> int:
                         kind="note",
                     )
         else:
+            scen_kw = {}
+            if args.scenario_crowd_rps is not None:
+                if args.scenario != "flash-crowd":
+                    ap.error("--scenario-crowd-rps only applies to "
+                             "--scenario flash-crowd")
+                scen_kw["crowd_rps"] = args.scenario_crowd_rps
             records = generate(
                 args.scenario, args.scenario_duration,
                 seed=args.scenario_seed,
                 shapes=((cfg.channels, cfg.image_size, cfg.image_size),),
+                **scen_kw,
             )
             source = f"scenario:{args.scenario}"
+        if args.elastic_ab:
+            run_elastic_ab(
+                cfg, scfg, label, records,
+                source=source,
+                time_scale=args.time_scale,
+                out_prefix=args.elastic_ab_out,
+                # The acceptance gate rides the flash-crowd scenario:
+                # the crowd is exactly the shape anticipation must beat.
+                gate="flash-crowd" in source,
+            )
+            return 0
         run_workload(
             cfg, scfg, label, records,
             source=source,

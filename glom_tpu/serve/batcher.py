@@ -1354,7 +1354,13 @@ class DynamicBatcher:
 
     # -- elastic fleet (serve/elastic.py) ----------------------------------
 
-    def add_engine(self, engine, *, name: Optional[str] = None) -> str:
+    def add_engine(
+        self,
+        engine,
+        *,
+        name: Optional[str] = None,
+        detail: Optional[dict] = None,
+    ) -> str:
         """Register a NEW engine replica at runtime — the autoscaler's
         scale-out landing. The engine must arrive FULLY WARMED: admission
         opens the instant its worker starts (the scaler runs warmup()
@@ -1362,8 +1368,11 @@ class DynamicBatcher:
         admitted work before its precompile completes). Registration
         mirrors __init__ per-engine setup: ladder (resolved from the
         engine's own ServeConfig), affinity queue, engine state, page
-        pool (pages-mode fleets stay homogeneous — loudly). Returns the
-        engine's fleet name."""
+        pool (pages-mode fleets stay homogeneous — loudly). `detail`
+        merges into the stamped engine_add event (the autoscaler threads
+        the owning decision_id/fleet through it, so the audit CLI can
+        chain the registration to its decision). Returns the engine's
+        fleet name."""
         ename = name or getattr(engine, "name", None)
         pool = getattr(engine, "pool", None)
         pages_mode = (
@@ -1444,6 +1453,7 @@ class DynamicBatcher:
                 "event": "engine_add",
                 "engine": ename,
                 "n_engines": self.n_active_engines(),
+                **(detail or {}),
             }
         )
         return ename

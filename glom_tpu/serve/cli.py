@@ -237,6 +237,30 @@ def build_parser() -> argparse.ArgumentParser:
         "predicted-vs-realized forecast_abs_err",
     )
     p.add_argument(
+        "--elastic-anticipatory", action="store_true",
+        help="elastic: act on PREDICTED load at now + spawn lead time "
+        "instead of waiting for live breaches — the policy consumes the "
+        "forecaster's latest scored window plus the spawn-lead-time "
+        "quantile, and every decision is stamped as a schema-v10 "
+        "'decision' record carrying its full evidence bundle "
+        "(auditable with `python -m glom_tpu.telemetry audit`). "
+        "Implies --forecast",
+    )
+    p.add_argument(
+        "--elastic-target-utilization", type=float, default=None,
+        metavar="U",
+        help="anticipatory: scale out when predicted arrival rate "
+        "exceeds U * fleet service rate (0 < U <= 1; default preset's)",
+    )
+    p.add_argument(
+        "--warm-pool", type=int, default=None, metavar="N",
+        help="elastic: hold N pre-spawned, precompiled spare engines "
+        "OUTSIDE admission; scale-out promotes a spare (milliseconds) "
+        "instead of paying a cold spawn, scale-in demotes the drained "
+        "engine back into the pool. Every promotion/demotion is stamped "
+        "with its owning decision_id",
+    )
+    p.add_argument(
         "--husk-max", type=int, default=None, metavar="N",
         help="elastic: retain at most N drained-engine evidence husks "
         "in the summary (oldest retire into a stamped "
@@ -392,10 +416,14 @@ def main(argv=None) -> int:
         ("elastic_shed_rate", "elastic_shed_rate"),
         ("husk_max", "husk_max"),
         ("husk_max_age", "husk_max_age_s"),
+        ("elastic_target_utilization", "elastic_target_utilization"),
+        ("warm_pool", "warm_pool"),
     ):
         v = getattr(args, flag)
         if v is not None:
             overrides[field] = v
+    if args.elastic_anticipatory:
+        overrides["elastic_anticipatory"] = True
     if overrides:
         scfg = dataclasses.replace(scfg, **overrides)
     if args.engines < 1:
@@ -537,7 +565,11 @@ def main(argv=None) -> int:
 
                 recorder = WorkloadRecorder().attach(batcher)
             forecaster = None
-            if args.forecast:
+            if args.forecast or scfg.elastic_anticipatory:
+                # Anticipatory scaling FEEDS on the forecaster — a
+                # policy told to act on predicted load with no
+                # prediction source would silently degrade to reactive
+                # forever, so --elastic-anticipatory implies --forecast.
                 from glom_tpu.telemetry.forecast import ForecastEmitter
                 from glom_tpu.tracing.flight import write_or_observe
 
@@ -588,6 +620,8 @@ def main(argv=None) -> int:
                     writer=writer,
                     interval_s=scfg.elastic_interval_s,
                     warm_degraded_iters=degraded_iters,
+                    forecast=forecaster,
+                    warm_pool=scfg.warm_pool,
                 ).start()
             tickets = []
             if replay_records is not None:

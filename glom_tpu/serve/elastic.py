@@ -57,6 +57,36 @@ JSONL evidence alone (docs/RESILIENCE.md).
 
 With `ServeConfig.elastic=False` (the default) none of this constructs:
 the static `--engines N` path is byte-for-byte the PR 13 contract.
+
+Schema v10 makes the loop ANTICIPATORY and AUDITABLE (ROADMAP item 4's
+action half, docs/SERVING.md "Anticipatory autoscaling"):
+
+  * With `elastic_anticipatory=True` the policy reads the live load
+    forecast (telemetry/forecast.py ForecastEmitter) and the spawn-lead-
+    time quantile each tick, and a positive PREDICTED DEFICIT — forecast
+    load at `now + lead_time_ms` minus the fleet's usable capacity
+    (measured service rate x `elastic_target_utilization`) — arms
+    scale-out and vetoes scale-in. The signal only fires once both
+    models have MATURED (a scored `forecast_abs_err`, real spawn
+    evidence); until then the semantics are the reactive path
+    bit-for-bit.
+
+  * Every decision that acts stamps a "decision" record: the full
+    evidence bundle (headroom/dwell/breach state, the forecast believed
+    at decision time, lead quantile, measured service rate), the action,
+    and the per-fleet `decision_id` chain it extends. decide() computes
+    the action FROM that bundle via the pure `telemetry/audit.py
+    policy_action`, so `python -m glom_tpu.telemetry audit` can replay
+    the JSONL and demand the stamped action back bit-for-bit.
+
+  * `warm_pool=N` holds N pre-spawned, fully-warmed SPARES outside
+    admission (never registered with the batcher — a spare is not a
+    husk and serves no traffic): scale-out PROMOTES a spare at ~0 spawn
+    cost (stamped "spare_promote" with the owning decision_id),
+    scale-in DEMOTES the drained engine back into the pool instead of
+    releasing it ("spare_demote"), and the pre-spawn latencies
+    ("spare_spawn") bootstrap the lead-time model before the first
+    live scale-out.
 """
 
 from __future__ import annotations
@@ -83,6 +113,9 @@ SCALE_EVENTS = (
     "drain_flush",
     "drain_migrate",
     "drain_release",
+    "spare_spawn",
+    "spare_promote",
+    "spare_demote",
 )
 
 
@@ -117,6 +150,8 @@ class ElasticPolicy:
         dwell_s: float = 2.0,
         cooldown_s: float = 5.0,
         window_s: float = 10.0,
+        anticipatory: bool = False,
+        target_utilization: float = 0.8,
         clock=time.monotonic,
     ):
         if min_engines < 1:
@@ -137,6 +172,10 @@ class ElasticPolicy:
             )
         if window_s <= 0:
             raise ValueError(f"window_s {window_s} must be > 0")
+        if not 0.0 < target_utilization <= 1.0:
+            raise ValueError(
+                f"target_utilization {target_utilization} must be in (0, 1]"
+            )
         self.min_engines = min_engines
         self.max_engines = max_engines
         self.low_water = low_water
@@ -144,6 +183,8 @@ class ElasticPolicy:
         self.dwell_s = dwell_s
         self.cooldown_s = cooldown_s
         self.window_s = window_s
+        self.anticipatory = bool(anticipatory)
+        self.target_utilization = float(target_utilization)
         self._clock = clock
         self._samples: deque = deque()   # (t, worst eligible headroom)
         self._breaches: deque = deque()  # (t, rule)
@@ -151,6 +192,14 @@ class ElasticPolicy:
         self._above_since: Optional[float] = None
         self._last_action_t: Optional[float] = None
         self._last_action: Optional[str] = None
+        # Anticipatory inputs, refreshed by the autoscaler each tick
+        # (telemetry/forecast.py): the latest closed-window load
+        # forecast, the spawn-lead-time quantile, the fleet's measured
+        # ok-engine service rate. All default None = reactive semantics.
+        self._forecast: Optional[dict] = None
+        self._lead_time_ms: Optional[float] = None
+        self._lead_quantile: Optional[float] = None
+        self._service_rate_rps: Optional[float] = None
 
     def _prune(self, now: float) -> None:
         horizon = now - self.window_s
@@ -185,6 +234,30 @@ class ElasticPolicy:
         self._breaches.append((self._clock(), str(rule)))
         self._prune(self._clock())
 
+    def note_forecast(self, rec: Optional[dict]) -> None:
+        """The latest closed-window load forecast record (the fields the
+        evidence bundle stamps: predicted / forecast_abs_err / horizon_s
+        / trend_per_s / t). None clears it."""
+        self._forecast = dict(rec) if rec else None
+
+    def note_lead_time(
+        self, lead_ms: Optional[float], quantile: Optional[float] = None
+    ) -> None:
+        """The spawn-lead-time model's current quantile estimate (None =
+        no spawn evidence yet — the anticipatory signal stays dark)."""
+        self._lead_time_ms = float(lead_ms) if lead_ms is not None else None
+        self._lead_quantile = (
+            float(quantile) if quantile is not None else None
+        )
+
+    def note_service_rate(self, rate_rps: Optional[float]) -> None:
+        """The fleet's measured service rate (sum of ok engines'
+        service_rate_rps from the capacity records) — the capacity side
+        of the anticipated deficit."""
+        self._service_rate_rps = (
+            float(rate_rps) if rate_rps is not None else None
+        )
+
     def active_breaches(self) -> List[str]:
         self._prune(self._clock())
         return sorted({rule for _, rule in self._breaches})
@@ -208,9 +281,65 @@ class ElasticPolicy:
             ],
         }
 
+    def evidence(self, n_engines: int) -> dict:
+        """The full input bundle one decision is judged on — every value
+        ALREADY in its stamped (rounded, JSON-safe) form, because
+        decide() computes the action FROM this dict via the pure
+        `telemetry/audit.py policy_action`: what the audit replays is
+        what the policy saw, bit for bit, by construction."""
+        now = self._clock()
+        self._prune(now)
+        tail = self._samples[-1] if self._samples else None
+        fc = None
+        if self._forecast is not None:
+            fc = {
+                "predicted": self._forecast.get("predicted"),
+                "forecast_abs_err": self._forecast.get("forecast_abs_err"),
+                "horizon_s": self._forecast.get("horizon_s"),
+                "trend_per_s": self._forecast.get("trend_per_s"),
+                "t": self._forecast.get("t"),
+            }
+        return {
+            "n_engines": int(n_engines),
+            "min_engines": self.min_engines,
+            "max_engines": self.max_engines,
+            "breaches": sorted({rule for _, rule in self._breaches}),
+            "headroom": round(tail[1], 4) if tail else None,
+            "low_water": self.low_water,
+            "high_water": self.high_water,
+            "dwell_s": self.dwell_s,
+            "below_held_s": (
+                round(now - self._below_since, 6)
+                if self._below_since is not None else None
+            ),
+            "above_held_s": (
+                round(now - self._above_since, 6)
+                if self._above_since is not None else None
+            ),
+            "anticipatory": self.anticipatory,
+            "target_utilization": self.target_utilization,
+            "forecast": fc,
+            "lead_time_ms": self._lead_time_ms,
+            "lead_quantile": self._lead_quantile,
+            "fleet_service_rate_rps": (
+                round(self._service_rate_rps, 4)
+                if self._service_rate_rps is not None else None
+            ),
+        }
+
     def decide(self, n_engines: int) -> Optional[dict]:
         """The next fleet action at the current signals, or None. Clamped
-        to [min_engines, max_engines]; silent inside the cooldown."""
+        to [min_engines, max_engines]; silent inside the cooldown.
+
+        Returns {"action", "signal", "evidence"}: the action comes from
+        the pure policy function applied to the evidence bundle decide()
+        is about to stamp — reactive semantics are the PR 14 contract
+        verbatim when the anticipatory inputs are absent or unmatured,
+        and the audit CLI replays the same function on the JSONL."""
+        from glom_tpu.telemetry.audit import (
+            anticipated_deficit, policy_action,
+        )
+
         now = self._clock()
         self._prune(now)
         if (
@@ -218,26 +347,32 @@ class ElasticPolicy:
             and now - self._last_action_t < self.cooldown_s
         ):
             return None
-        breaches = self.active_breaches()
-        below = (
-            self._below_since is not None
-            and now - self._below_since >= self.dwell_s
-        )
-        above = (
-            self._above_since is not None
-            and now - self._above_since >= self.dwell_s
-        )
-        if (breaches or below) and n_engines < self.max_engines:
-            rule = breaches[0] if breaches else "headroom"
-            return {"action": "scale_out", "signal": self._signal(now, rule)}
-        if breaches:
-            # Breach precedence: a breaching fleet never scales IN, no
-            # matter how idle its queues look (shed_rate breaches are
-            # exactly the idle-queues-because-we-reject shape).
+        ev = self.evidence(n_engines)
+        action = policy_action(ev)
+        if action is None:
             return None
-        if above and n_engines > self.min_engines:
-            return {"action": "scale_in", "signal": self._signal(now, "headroom")}
-        return None
+        if action == "scale_out":
+            breaches = ev["breaches"]
+            below = (
+                ev["below_held_s"] is not None
+                and ev["below_held_s"] >= self.dwell_s
+            )
+            if breaches:
+                rule = breaches[0]
+            elif below:
+                rule = "headroom"
+            else:
+                rule = "forecast"
+                deficit = anticipated_deficit(ev)
+                if deficit is not None:
+                    ev["anticipated_deficit_rps"] = deficit
+        else:
+            rule = "headroom"
+        return {
+            "action": action,
+            "signal": self._signal(now, rule),
+            "evidence": ev,
+        }
 
     def acted(self, action: str) -> None:
         now = self._clock()
@@ -274,6 +409,10 @@ def resolve_policy(scfg, *, clock=time.monotonic) -> ElasticPolicy:
         dwell_s=scfg.elastic_dwell_s,
         cooldown_s=scfg.elastic_cooldown_s,
         window_s=scfg.elastic_window_s,
+        anticipatory=getattr(scfg, "elastic_anticipatory", False),
+        target_utilization=getattr(
+            scfg, "elastic_target_utilization", 0.8
+        ),
         clock=clock,
     )
 
@@ -306,12 +445,17 @@ class Autoscaler:
         interval_s: float = 0.5,
         spawn_hook=None,
         warm_degraded_iters: Optional[int] = None,
+        forecast=None,
+        warm_pool: int = 0,
+        fleet: str = "fleet0",
         clock=time.monotonic,
     ):
         from glom_tpu.telemetry.aggregate import SLOMonitor
 
         if interval_s <= 0:
             raise ValueError(f"interval_s {interval_s} must be > 0")
+        if warm_pool < 0:
+            raise ValueError(f"warm_pool {warm_pool} must be >= 0")
         self.batcher = batcher
         self.engine_factory = engine_factory
         scfg = getattr(batcher.engine, "scfg", None)
@@ -325,6 +469,14 @@ class Autoscaler:
         self.interval_s = interval_s
         self.spawn_hook = spawn_hook
         self.warm_degraded_iters = warm_degraded_iters
+        # The live forecast glue (telemetry/forecast.py ForecastEmitter,
+        # tapped into the batcher's event stream by the caller): each
+        # tick pulls its latest closed-window load forecast and the
+        # spawn-lead-time quantile into the policy. None = the policy's
+        # anticipatory inputs stay dark (reactive semantics).
+        self.forecast = forecast
+        self.warm_pool = int(warm_pool)
+        self.fleet = str(fleet)
         self._clock = clock
         self.monitor = SLOMonitor(
             dict(rules or {}),
@@ -344,15 +496,26 @@ class Autoscaler:
         self._lock = threading.Lock()
         self._t0 = clock()
         self._decision_seq = 0
+        self._last_decision_id: Optional[int] = None
         self._spawn_attempts = 0
         self.n_scale_outs = 0
         self.n_scale_ins = 0
         self.n_spawn_failures = 0
         self.n_ticks = 0
+        self.n_decisions = 0
+        self.decisions_late = 0
+        self.spawn_lead_violations = 0
         self.n_migrated_sessions = 0
         self.n_invalidated_sessions = 0
         self.migrated_bytes = 0
         self._spawn_ms: List[float] = []
+        # Warm-pool spares: pre-spawned, fully-warmed engines held
+        # OUTSIDE the batcher (never registered — a spare is not a husk
+        # and serves no traffic) until a scale-out promotes one.
+        self._spares: List[object] = []
+        self._spare_spawn_ms: List[float] = []
+        self.n_promotions = 0
+        self.n_demotions = 0
         self._timeline: List[list] = [
             [0.0, batcher.n_active_engines()]
         ]
@@ -361,12 +524,65 @@ class Autoscaler:
 
     def start(self) -> "Autoscaler":
         if self._thread is None or not self._thread.is_alive():
+            self.fill_warm_pool()
             self._stop.clear()
             self._thread = threading.Thread(
                 target=self._run, name="glom-serve-autoscaler", daemon=True
             )
             self._thread.start()
         return self
+
+    def fill_warm_pool(self) -> int:
+        """Pre-spawn spares up to `warm_pool` (factory + FULL warmup,
+        exactly the scale-out build), held outside admission. Runs
+        before the control thread starts — provisioning happens before
+        traffic, and each spare's spawn_ms is REAL lead-time evidence
+        (the "spare_spawn" event feeds ForecastEmitter's lead model),
+        so the anticipatory signal can arm before the first live
+        scale-out. A failed spare spawn is stamped and stops the fill —
+        the fleet runs with the spares it has."""
+        n_built = 0
+        while True:
+            with self._lock:
+                if len(self._spares) >= self.warm_pool:
+                    return n_built
+                n_spares = len(self._spares)
+            t0 = self._clock()
+            try:
+                engine = self.engine_factory()
+                warmup = getattr(engine, "warmup", None)
+                if callable(warmup):
+                    warmup()
+                    if self.warm_degraded_iters is not None:
+                        warmup(iters_override=self.warm_degraded_iters)
+            except BaseException as e:  # noqa: BLE001 — stamped, fill stops
+                self._emit(
+                    {
+                        "event": "spawn_rollback",
+                        "decision_id": None,
+                        "fleet": self.fleet,
+                        "spare": True,
+                        "n_engines": self.batcher.n_active_engines(),
+                        "exception": f"{type(e).__name__}: {e}"[:300],
+                    }
+                )
+                return n_built
+            spawn_ms = round(1e3 * (self._clock() - t0), 3)
+            with self._lock:
+                self._spares.append(engine)
+                self._spare_spawn_ms.append(spawn_ms)
+                n_spares = len(self._spares)
+            n_built += 1
+            self._emit(
+                {
+                    "event": "spare_spawn",
+                    "fleet": self.fleet,
+                    "engine": getattr(engine, "name", None),
+                    "spawn_ms": spawn_ms,
+                    "n_spares": n_spares,
+                    "n_engines": self.batcher.n_active_engines(),
+                }
+            )
 
     def stop(self) -> None:
         self._stop.set()
@@ -418,6 +634,20 @@ class Autoscaler:
         ]
         if eligible:
             self.policy.observe_headroom(min(eligible))
+        # The capacity side of the anticipated deficit: the fleet's
+        # measured ok-engine service rate, refreshed every tick.
+        rates = [
+            c["service_rate_rps"] for c in caps
+            if c.get("state") == "ok"
+            and isinstance(c.get("service_rate_rps"), (int, float))
+        ]
+        self.policy.note_service_rate(sum(rates) if rates else None)
+        if self.forecast is not None:
+            self.policy.note_forecast(self.forecast.latest_forecast())
+            lead_model = self.forecast.lead_model
+            self.policy.note_lead_time(
+                lead_model.lead_time_ms(), lead_model.quantile
+            )
         for b in self.monitor.evaluate():
             # Lower-bound rules (headroom) are the policy's OWN water
             # marks — only upper-bound breaches (p99, shed_rate) feed
@@ -431,15 +661,46 @@ class Autoscaler:
         if decision is None:
             return None
         if decision["action"] == "scale_out":
-            self._scale_out(n, decision["signal"])
+            self._scale_out(n, decision["signal"], decision.get("evidence"))
         else:
-            self._scale_in(n, decision["signal"], caps)
+            self._scale_in(
+                n, decision["signal"], caps, decision.get("evidence")
+            )
         return decision
 
-    def _next_decision(self) -> int:
+    def _mint_decision(
+        self, action: str, evidence: Optional[dict]
+    ) -> int:
+        """Mint the next decision_id and stamp the schema-v10 "decision"
+        record — the evidence bundle, the action the pure policy
+        function derived from it, and the chain link to the previous
+        decision. Every actuation event that follows carries this id."""
         with self._lock:
             self._decision_seq += 1
-            return self._decision_seq
+            decision_id = self._decision_seq
+            prev = self._last_decision_id
+            self._last_decision_id = decision_id
+            self.n_decisions += 1
+            if (
+                action == "scale_out"
+                and isinstance(evidence, dict)
+                and evidence.get("breaches")
+            ):
+                # Scaled AFTER the SLO already broke — the reactive
+                # failure mode the anticipatory signal exists to avoid.
+                self.decisions_late += 1
+        self._emit(
+            {
+                "t": round(self._clock() - self._t0, 3),
+                "fleet": self.fleet,
+                "decision_id": decision_id,
+                "prev_decision_id": prev,
+                "action": action,
+                "evidence": evidence,
+            },
+            kind="decision",
+        )
+        return decision_id
 
     def _note_fleet(self, n: int) -> None:
         with self._lock:
@@ -447,16 +708,26 @@ class Autoscaler:
                 [round(self._clock() - self._t0, 3), n]
             )
 
-    def _scale_out(self, n: int, signal: dict) -> None:
-        decision_id = self._next_decision()
+    def _scale_out(
+        self, n: int, signal: dict, evidence: Optional[dict] = None
+    ) -> None:
+        decision_id = self._mint_decision("scale_out", evidence)
         self._emit(
             {
                 "event": "scale_out_decision",
                 "decision_id": decision_id,
+                "fleet": self.fleet,
                 "n_engines": n,
                 "signal": signal,
             }
         )
+        # A warm spare absorbs the scale-out at ~0 spawn cost: promote
+        # it (register with the batcher) instead of building cold.
+        with self._lock:
+            spare = self._spares.pop(0) if self._spares else None
+        if spare is not None:
+            self._promote_spare(spare, decision_id, n)
+            return
         with self._lock:
             self._spawn_attempts += 1
             attempt = self._spawn_attempts
@@ -485,28 +756,47 @@ class Autoscaler:
                 {
                     "event": "spawn_rollback",
                     "decision_id": decision_id,
+                    "fleet": self.fleet,
                     "n_engines": n,
                     "exception": f"{type(e).__name__}: {e}"[:300],
                 }
             )
             return
         spawn_ms = round(1e3 * (self._clock() - t0), 3)
-        name = self.batcher.add_engine(engine)
+        name = self.batcher.add_engine(
+            engine,
+            detail={"decision_id": decision_id, "fleet": self.fleet},
+        )
+        # Did the spawn land inside the lead the decision believed? A
+        # violation means the anticipatory act-ahead margin was too
+        # short — the audit counts these against the lead-time model.
+        lead_ms = (
+            evidence.get("lead_time_ms")
+            if isinstance(evidence, dict) else None
+        )
+        violation = (
+            isinstance(lead_ms, (int, float)) and spawn_ms > lead_ms
+        )
         with self._lock:
             self.n_scale_outs += 1
             self._spawn_ms.append(spawn_ms)
+            if violation:
+                self.spawn_lead_violations += 1
         self.policy.acted("scale_out")
         self._note_fleet(n + 1)
-        self._emit(
-            {
-                "event": "scale_out",
-                "decision_id": decision_id,
-                "engine": name,
-                "spawn_ms": spawn_ms,
-                "n_engines": n + 1,
-                "signal": signal,
-            }
-        )
+        rec = {
+            "event": "scale_out",
+            "decision_id": decision_id,
+            "fleet": self.fleet,
+            "engine": name,
+            "spawn_ms": spawn_ms,
+            "n_engines": n + 1,
+            "signal": signal,
+        }
+        if violation:
+            rec["lead_violation"] = True
+            rec["lead_time_ms"] = lead_ms
+        self._emit(rec)
         # Admission is OPEN from add_engine's worker start — stamped as
         # its own transition so the chaos chain check can pin the order:
         # decision -> (warmup inside spawn_ms) -> admission.
@@ -514,28 +804,95 @@ class Autoscaler:
             {
                 "event": "admission_open",
                 "decision_id": decision_id,
+                "fleet": self.fleet,
                 "engine": name,
                 "n_engines": n + 1,
             }
         )
 
-    def _scale_in(self, n: int, signal: dict, caps: List[dict]) -> None:
+    def _promote_spare(self, engine, decision_id: int, n: int) -> None:
+        """Register a pre-warmed spare with the batcher — the ~0-cost
+        scale-out path. A demoted spare's old name lives on in the
+        batcher as a drained husk (the evidence of its drain), so a
+        re-promotion takes a fresh suffixed name."""
+        t0 = self._clock()
+        base = getattr(engine, "name", None) or "spare"
+        name = base
+        k = 0
+        while name in getattr(self.batcher, "_engine_state", {}):
+            k += 1
+            name = f"{base}~p{k}"
+        if name != base:
+            try:
+                engine.name = name
+            except AttributeError:
+                pass
+        name = self.batcher.add_engine(
+            engine,
+            name=name,
+            detail={
+                "decision_id": decision_id,
+                "fleet": self.fleet,
+                "spare": True,
+            },
+        )
+        promote_ms = round(1e3 * (self._clock() - t0), 3)
+        with self._lock:
+            self.n_promotions += 1
+            n_spares = len(self._spares)
+        self.policy.acted("scale_out")
+        self._note_fleet(n + 1)
+        self._emit(
+            {
+                "event": "spare_promote",
+                "decision_id": decision_id,
+                "fleet": self.fleet,
+                "engine": name,
+                "promote_ms": promote_ms,
+                "n_spares": n_spares,
+                "n_engines": n + 1,
+            }
+        )
+        self._emit(
+            {
+                "event": "admission_open",
+                "decision_id": decision_id,
+                "fleet": self.fleet,
+                "engine": name,
+                "n_engines": n + 1,
+            }
+        )
+
+    def _scale_in(
+        self,
+        n: int,
+        signal: dict,
+        caps: List[dict],
+        evidence: Optional[dict] = None,
+    ) -> None:
         target = self.policy.pick_drain_target(caps)
         if target is None:
             return
-        decision_id = self._next_decision()
+        decision_id = self._mint_decision("scale_in", evidence)
         self._emit(
             {
                 "event": "scale_in_decision",
                 "decision_id": decision_id,
+                "fleet": self.fleet,
                 "engine": target,
                 "n_engines": n,
                 "signal": signal,
             }
         )
+        # Resolve the engine object BEFORE the drain: husk retention
+        # (husk_max=0) may retire the name from the batcher's registry
+        # inside drain_engine, and a retired husk must still be able to
+        # demote into the warm pool — the spare outlives its husk.
+        engine = self.batcher.engine_by_name(target)
         try:
             stats = self.batcher.drain_engine(
-                target, detail={"decision_id": decision_id}
+                target,
+                detail={"decision_id": decision_id, "fleet": self.fleet},
             )
         except ValueError as e:
             # Raced a death/concurrent drain: the fleet can no longer
@@ -545,15 +902,28 @@ class Autoscaler:
                 {
                     "event": "drain_abort",
                     "decision_id": decision_id,
+                    "fleet": self.fleet,
                     "engine": target,
                     "exception": f"{type(e).__name__}: {e}"[:300],
                 }
             )
             return
-        engine = self.batcher.engine_by_name(target)
-        release = getattr(engine, "release", None)
-        if callable(release):
-            release()
+        # Demote into the warm pool instead of releasing when the pool
+        # is below target: the drained engine keeps its device state and
+        # compiled executables, so the NEXT scale-out promotes it at ~0
+        # cost. Otherwise release as before.
+        demote = False
+        if engine is not None:
+            with self._lock:
+                if len(self._spares) < self.warm_pool:
+                    self._spares.append(engine)
+                    self.n_demotions += 1
+                    demote = True
+                    n_spares = len(self._spares)
+        if not demote:
+            release = getattr(engine, "release", None)
+            if callable(release):
+                release()
         with self._lock:
             self.n_scale_ins += 1
             self.n_migrated_sessions += stats.get("n_migrated", 0)
@@ -565,8 +935,10 @@ class Autoscaler:
             {
                 "event": "drain_release",
                 "decision_id": decision_id,
+                "fleet": self.fleet,
                 "engine": target,
                 "n_engines": n - 1,
+                "demoted": demote,
                 **{
                     k: stats.get(k)
                     for k in (
@@ -576,6 +948,17 @@ class Autoscaler:
                 },
             }
         )
+        if demote:
+            self._emit(
+                {
+                    "event": "spare_demote",
+                    "decision_id": decision_id,
+                    "fleet": self.fleet,
+                    "engine": target,
+                    "n_spares": n_spares,
+                    "n_engines": n - 1,
+                }
+            )
 
     # -- telemetry ---------------------------------------------------------
 
@@ -586,15 +969,21 @@ class Autoscaler:
             # Already-stamped records (the capacity rollup) pass through.
             write_or_observe(self.writer, rec)
             return
-        if kind == "serve":
-            from glom_tpu.serve.events import emit_serve
+        if kind in ("serve", "decision"):
+            stamped = rec
+            if kind == "serve":
+                from glom_tpu.serve.events import emit_serve
 
-            stamped = emit_serve(self.writer, rec)
-            # Scale events join the batcher's tap fan-out: the forecast
-            # emitter's spawn-lead-time model (telemetry/forecast.py)
-            # reads spawn_ms from the same in-process stream `telemetry
-            # watch` would tail — the scale_out record must not exist
-            # only on disk. Taps never kill the control loop.
+                stamped = emit_serve(self.writer, rec)
+            else:
+                stamped = schema.stamp(rec, kind="decision")
+                write_or_observe(self.writer, stamped)
+            # Scale events AND decision records join the batcher's tap
+            # fan-out: the forecast emitter's spawn-lead-time model
+            # (telemetry/forecast.py) reads spawn_ms from the same
+            # in-process stream `telemetry watch` would tail — the
+            # scale_out record must not exist only on disk. Taps never
+            # kill the control loop.
             for tap in list(getattr(self.batcher, "_taps", ())):
                 try:
                     tap(stamped)
@@ -609,11 +998,32 @@ class Autoscaler:
         spawn latency and migration bytes classified as costs)."""
         with self._lock:
             spawn_ms = list(self._spawn_ms)
+            spare_spawn_ms = list(self._spare_spawn_ms)
             rec = {
                 "n_scale_outs": self.n_scale_outs,
                 "n_scale_ins": self.n_scale_ins,
                 "n_spawn_failures": self.n_spawn_failures,
                 "n_ticks": self.n_ticks,
+                # The decision observatory's runtime counters (the audit
+                # recomputes all three from the JSONL independently):
+                # decisions_late = scale-outs decided while a breach was
+                # already live; spawn_lead_violations = spawns slower
+                # than the lead the decision believed. `telemetry
+                # compare` classifies every one a cost.
+                "n_decisions": self.n_decisions,
+                "decisions_late": self.decisions_late,
+                "spawn_lead_violations": self.spawn_lead_violations,
+                # Warm-pool spares (a spare is NOT a husk: it was never
+                # registered with the batcher, serves no traffic, and
+                # husk retention cannot touch it).
+                "warm_pool": self.warm_pool,
+                "n_spares": len(self._spares),
+                "n_promotions": self.n_promotions,
+                "n_demotions": self.n_demotions,
+                "spare_spawn_ms_mean": (
+                    round(sum(spare_spawn_ms) / len(spare_spawn_ms), 3)
+                    if spare_spawn_ms else None
+                ),
                 "n_migrated_sessions": self.n_migrated_sessions,
                 "n_invalidated_sessions": self.n_invalidated_sessions,
                 "migrated_bytes": self.migrated_bytes,

@@ -1,8 +1,8 @@
 """`python -m glom_tpu.telemetry ...` — the telemetry CLI.
 
-Six subcommands sharing one entry point (all pure stdlib — they must run
-in a jax-broken environment, the exact wedged-image scenario they exist
-for):
+Seven subcommands sharing one entry point (all pure stdlib — they must
+run in a jax-broken environment, the exact wedged-image scenario they
+exist for):
 
     python -m glom_tpu.telemetry FILE...            lint JSONL logs against
                                                     the versioned schema
@@ -17,6 +17,9 @@ for):
                                                     into one pod rollup
     python -m glom_tpu.telemetry watch DIR --slo R=T  live SLO monitor,
                                                     stamps slo_breach
+    python -m glom_tpu.telemetry audit FILE...      replay the elastic
+                                                    decision chain: evidence
+                                                    conservation + regret
 
 (`-m ...telemetry.schema` / `-m ...telemetry.compare` work too but trip
 runpy's already-imported warning.)
@@ -46,6 +49,10 @@ if __name__ == "__main__":
         from glom_tpu.telemetry.aggregate import watch_main
 
         sys.exit(watch_main(argv[1:]))
+    if argv and argv[0] == "audit":
+        from glom_tpu.telemetry.audit import main as audit_main
+
+        sys.exit(audit_main(argv[1:]))
     from glom_tpu.telemetry.schema import main
 
     sys.exit(main(argv))

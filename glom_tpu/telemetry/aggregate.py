@@ -237,6 +237,7 @@ def rollup(host_records: "OrderedDict[str, List[dict]]") -> dict:
     failover_timeline: List[dict] = []
     ladder_timeline: List[dict] = []
     barrier_rounds: Dict[str, Dict[str, List[dict]]] = {}
+    decision_fleets: Dict[str, dict] = {}
     for host, recs in host_records.items():
         h = per_host.setdefault(
             host,
@@ -272,6 +273,28 @@ def rollup(host_records: "OrderedDict[str, List[dict]]") -> dict:
                     eng["headroom_min"] = min(
                         float(h), eng.get("headroom_min", float(h))
                     )
+                continue
+            if kind == "decision":
+                # The decision observatory (schema v10): per-fleet
+                # decision counts at pod scope. The full chain/evidence
+                # audit is `python -m glom_tpu.telemetry audit`; the
+                # rollup just surfaces how often each fleet acted and
+                # how often it acted LATE (after a live breach).
+                fleet = str(rec.get("fleet", "fleet0"))
+                d = decision_fleets.setdefault(
+                    fleet,
+                    {"n_decisions": 0, "n_scale_outs": 0,
+                     "n_scale_ins": 0, "decisions_late": 0},
+                )
+                d["n_decisions"] += 1
+                action = rec.get("action")
+                if action == "scale_out":
+                    d["n_scale_outs"] += 1
+                    ev = rec.get("evidence")
+                    if isinstance(ev, dict) and ev.get("breaches"):
+                        d["decisions_late"] += 1
+                elif action == "scale_in":
+                    d["n_scale_ins"] += 1
                 continue
             if kind != "serve":
                 continue
@@ -407,6 +430,7 @@ def rollup(host_records: "OrderedDict[str, List[dict]]") -> dict:
         "per_engine": per_engine,
         "per_bucket": per_bucket,
         "cache": cache,
+        "decisions": decision_fleets or None,
         "timelines": {
             "failover": failover_timeline,
             "ladder": ladder_timeline,

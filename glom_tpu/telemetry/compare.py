@@ -83,6 +83,13 @@ _COST_METRIC_TOKENS = (
     # (lead_time_ms also rides the "ms" unit token; the name token
     # covers the flattened forecast.*.lead_time rows).
     "forecast_abs_err", "lead_time",
+    # Decision-observatory rows (ISSUE 18): REGRET is failure evidence
+    # inside a decision's cover window, decisions_late counts scale-outs
+    # taken only after the SLO already broke, and spawn_lead_violations
+    # counts spawns slower than the lead their decision believed — every
+    # one regresses UP ("violation" also covers the flattened
+    # serve_elastic.spawn_lead_violations row).
+    "regret", "decisions_late", "violation",
 )
 # Metric-name tokens that mark a HIGHER-is-better row regardless of the
 # cost heuristics: headroom is capacity LEFT — a serving change that
@@ -361,6 +368,48 @@ def load_bench_records(lines) -> Tuple[Dict[str, dict], Dict[str, dict]]:
                         "kind": "bench",
                     }
                 )
+            continue
+        if rec.get("kind") == "decision":
+            # Forecast-AT-DECISION rows (ISSUE 18, the PR 17 forecast-row
+            # shape): the error the policy BELIEVED when it acted gates
+            # like the live forecast error — a change that makes the
+            # fleet act on worse-scored predictions regresses UP even if
+            # every window's live score held. Unmatured evidence (null
+            # error) is an honest gap, skipped.
+            evidence = rec.get("evidence")
+            fc = (
+                evidence.get("forecast")
+                if isinstance(evidence, dict) else None
+            )
+            fleet = rec.get("fleet", "fleet0")
+            if isinstance(fc, dict):
+                err = fc.get("forecast_abs_err")
+                if isinstance(err, (int, float)) and not isinstance(
+                    err, bool
+                ):
+                    ingest(
+                        {
+                            "metric": (
+                                f"decision.{fleet}.forecast_abs_err"
+                            ),
+                            "value": float(err),
+                            "unit": "count",
+                            "kind": "bench",
+                        }
+                    )
+            if isinstance(evidence, dict):
+                lead = evidence.get("lead_time_ms")
+                if isinstance(lead, (int, float)) and not isinstance(
+                    lead, bool
+                ):
+                    ingest(
+                        {
+                            "metric": f"decision.{fleet}.lead_time_ms",
+                            "value": float(lead),
+                            "unit": "ms",
+                            "kind": "bench",
+                        }
+                    )
             continue
         ingest(rec)
     return measured, unmeasured

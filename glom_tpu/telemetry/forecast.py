@@ -369,6 +369,7 @@ class ForecastEmitter:
         self._window_start: Optional[float] = None
         self._window_arrivals = 0
         self.n_windows = 0
+        self._last_forecast: Optional[dict] = None
 
     def tap(self, rec: dict) -> None:
         out: List[dict] = []
@@ -380,15 +381,28 @@ class ForecastEmitter:
                 event = rec.get("event")
                 if event == "admit":
                     self._window_arrivals += 1
-                elif event == "scale_out" and isinstance(
+                elif event in ("scale_out", "spare_spawn") and isinstance(
                     rec.get("spawn_ms"), (int, float)
                 ):
+                    # Warm-pool spare pre-spawns are REAL spawn evidence
+                    # (same factory, same warmup) — they bootstrap the
+                    # lead-time model before the first live scale-out,
+                    # which is exactly when the anticipatory policy
+                    # needs a lead to act ahead of.
                     self.lead_model.observe(float(rec["spawn_ms"]))
                     out.append(self.lead_model.record())
             if now - self._window_start >= self.interval_s:
                 out.append(self._close_window(now))
         for r in out:
             self._emit(r)
+
+    def latest_forecast(self) -> Optional[dict]:
+        """The most recent closed-window arrival-rate forecast record
+        (a copy), or None before any window has closed. The autoscaler
+        reads this each tick to stamp the forecast it believed into the
+        decision's evidence bundle."""
+        with self._lock:
+            return dict(self._last_forecast) if self._last_forecast else None
 
     def _close_window(self, now: float) -> dict:
         """Observe the realized window rate, score, and forecast — caller
@@ -402,6 +416,7 @@ class ForecastEmitter:
         self.n_windows += 1
         rec = self.forecaster.forecast(t_rel)
         rec["observed_rate_rps"] = round(rate, 4)
+        self._last_forecast = rec
         return rec
 
     def close(self) -> None:

@@ -67,6 +67,14 @@ _TID_PHASES = 96
 _TID_FORECAST = 97
 _TID_WORKLOAD = 98
 _TID_BARRIER_BASE = 100
+# Decision-observatory tracks (ISSUE 18): one track PER FLEET of
+# "decision" instants (schema v10, serve/elastic.py), flow-arrowed to
+# the scale/spare events each decision_id actuated — a decision reads
+# as an arrow from the instant the policy believed its evidence to the
+# spawn/drain/promotion that answered it, beside fleet:n_engines and
+# the arrival-rate tracks. Allocated past the barrier range so a pod
+# chaos run's host tracks never collide with the fleet tracks.
+_TID_DECISION_BASE = 1000
 _ARRIVAL_WINDOW_S = 1.0  # the arrival-rate counter's trailing window
 
 # The elastic-serving transition vocabulary (serve/elastic.SCALE_EVENTS —
@@ -82,6 +90,9 @@ _SCALE_EVENTS = (
     "drain_flush",
     "drain_migrate",
     "drain_release",
+    "spare_spawn",
+    "spare_promote",
+    "spare_demote",
 )
 
 
@@ -128,10 +139,36 @@ def to_trace_events(records: Iterable[dict]) -> List[dict]:
         arrows crossing the host tracks.
     """
     raw: List[dict] = []
-    flow_seen: dict = {}  # barrier flow id -> "open"
+    flow_seen: dict = {}  # barrier/decision flow id -> "open"
     trace_flows: dict = {}  # trace_id -> [(ts, is_leaf), ...]
     barrier_tracks: dict = {}  # tid -> track label
+    decision_tracks: dict = {}  # fleet -> tid
     arrival_window: List[float] = []  # trailing arrival ts (seconds)
+
+    def decision_flow(rec: dict, ts: float, tid: int) -> None:
+        # Chain every record carrying a decision_id on one flow id per
+        # (fleet, decision): "s" at the first sighting (the decision
+        # instant, when the stream carries it), "t" per actuation — the
+        # barrier-flow pattern, since the chain's length isn't known
+        # until the stream ends.
+        did = rec.get("decision_id")
+        if not isinstance(did, int) or isinstance(did, bool):
+            return
+        fleet = rec.get("fleet")
+        fleet = fleet if isinstance(fleet, str) and fleet else "fleet0"
+        fid = f"decision:{fleet}:{did}"
+        raw.append(
+            {
+                "name": fid,
+                "cat": "decision",
+                "ph": "s" if fid not in flow_seen else "t",
+                "id": fid,
+                "pid": _PID,
+                "tid": tid,
+                "ts": ts,
+            }
+        )
+        flow_seen[fid] = "open"
     for i, rec in enumerate(records):
         kind = rec.get("kind", schema.infer_kind(rec))
         fallback = i * 1e-3  # 1ms spacing keeps clockless records ordered
@@ -284,6 +321,30 @@ def to_trace_events(records: Iterable[dict]) -> List[dict]:
                         "args": {"n_engines": float(n)},
                     }
                 )
+            decision_flow(rec, ts, _TID_EVENTS)
+        elif kind == "decision":
+            # One instants track PER FLEET (schema v10): the decision,
+            # with its full evidence bundle in args, starts the flow its
+            # actuation events extend.
+            fleet = rec.get("fleet")
+            fleet = (
+                fleet if isinstance(fleet, str) and fleet else "fleet0"
+            )
+            tid = decision_tracks.setdefault(
+                fleet, _TID_DECISION_BASE + len(decision_tracks)
+            )
+            raw.append(
+                {
+                    "name": f"decision:{rec.get('action', '?')}",
+                    "ph": "i",
+                    "s": "t",
+                    "pid": _PID,
+                    "tid": tid,
+                    "ts": ts,
+                    "args": rec,
+                }
+            )
+            decision_flow(rec, ts, tid)
         elif kind == "forecast":
             # Forecast evidence (schema v9, telemetry/forecast.py): each
             # window samples a counter track per metric beside the fleet
@@ -482,6 +543,17 @@ def to_trace_events(records: Iterable[dict]) -> List[dict]:
                     "args": {"name": label},
                 }
             )
+    # Name the per-fleet decision tracks (metadata events; ts-less).
+    for fleet, tid in sorted(decision_tracks.items()):
+        raw.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": _PID,
+                "tid": tid,
+                "args": {"name": f"decisions {fleet}"},
+            }
+        )
     # Name the per-host barrier tracks (metadata events; ts-less).
     for tid, label in sorted(barrier_tracks.items()):
         raw.append(

@@ -85,7 +85,21 @@ from typing import Iterable, List, Optional, Tuple
 # serve event "engine_husk_retired" folds a pruned drained-husk's
 # counters into the evidence stream so summary conservation still
 # reconciles after retention trims the engines nest.
-SCHEMA_VERSION = 9
+# v10 is the decision observatory (serve/elastic.py, telemetry/audit.py,
+# docs/OBSERVABILITY.md "Decision observatory"): the new "decision" kind
+# is one autoscaling decision that ACTED — `action` ("scale_out" |
+# "scale_in"), `decision_id` extending the per-fleet chain
+# (`prev_decision_id` links backwards; `fleet` labels the chain), and
+# the `evidence` KEY must be PRESENT on every record: the full input
+# bundle (headroom/dwell/breach state, the forecast window believed at
+# decision time with its forecast_abs_err, the spawn-lead-time quantile,
+# the measured fleet service rate) that the pure policy function
+# (telemetry/audit.py policy_action) must replay to the stamped action
+# bit-for-bit — `python -m glom_tpu.telemetry audit` enforces it. New
+# serve events "spare_spawn" / "spare_promote" / "spare_demote" stamp
+# the warm-pool spare lifecycle (pre-spawned engines held outside
+# admission), each promotion/demotion carrying its owning decision_id.
+SCHEMA_VERSION = 10
 
 _NUM = (int, float)
 _STR = (str,)
@@ -176,6 +190,14 @@ KINDS = {
     # nothing matured yet; absent = the emitter never scored itself —
     # enforced by validate_record below).
     "forecast": {"metric": _STR, "horizon_s": _NUM},
+    # One autoscaling decision that acted (serve/elastic.py,
+    # telemetry/audit.py, docs/OBSERVABILITY.md "Decision observatory"):
+    # `action` is "scale_out" | "scale_in", `decision_id` extends the
+    # per-fleet chain (prev_decision_id / fleet / t ride per record),
+    # and the `evidence` key — the full input bundle the pure policy
+    # function replays bit-for-bit — must be present on every v10
+    # record (enforced by validate_record below).
+    "decision": {"action": _STR, "decision_id": _NUM},
 }
 
 # Serve events that are REQUEST-scoped and must carry trace context on
@@ -303,6 +325,21 @@ def validate_record(rec: object) -> List[str]:
             "forecast_abs_err key — predicted-vs-realized error must be "
             "stamped on every window (null = not matured; absent = "
             "unscored; see telemetry/forecast.py)"
+        )
+    if (
+        kind == "decision"
+        and isinstance(v, int)
+        and v >= 10
+        and "evidence" not in rec
+    ):
+        # v10's decision-provenance contract (the same presence pattern):
+        # a decision without its inputs on the record can never be
+        # audited — `telemetry audit` replays the evidence through the
+        # pure policy function and demands the stamped action back.
+        errs.append(
+            f"decision.{rec.get('action')} record (v{v}) carries no "
+            "evidence key — the input bundle must be stamped on every "
+            "decision (see telemetry/audit.py)"
         )
     try:
         json.dumps(rec)

@@ -345,6 +345,25 @@ class ServeConfig:
     # event, so conservation still reconciles after the trim.
     husk_max: Optional[int] = None
     husk_max_age_s: Optional[float] = None
+    # Anticipatory autoscaling (schema v10, docs/SERVING.md "Anticipatory
+    # autoscaling"): elastic_anticipatory=True lets the policy act on the
+    # forecast load at `now + spawn_lead_time` instead of the already-
+    # breached present — a positive predicted deficit over the fleet's
+    # usable capacity (measured service rate x elastic_target_utilization)
+    # arms scale-out and vetoes scale-in. The anticipatory signal only
+    # fires once BOTH models have matured (a scored forecast_abs_err and
+    # spawn-lead evidence); until then the policy is the reactive PR 14
+    # semantics bit-for-bit. Every decision stamps its evidence bundle
+    # (`python -m glom_tpu.telemetry audit` replays it).
+    elastic_anticipatory: bool = False
+    elastic_target_utilization: float = 0.8
+    # Warm-pool spares: N pre-spawned, fully-warmed engine replicas held
+    # OUTSIDE admission (never registered with the batcher, so a spare is
+    # not a husk and serves no traffic). Scale-out promotes a spare at
+    # ~0 spawn cost; scale-in demotes the drained engine back into the
+    # pool instead of releasing its devices. Spare spawn latencies feed
+    # the spawn-lead-time model before the first live scale-out.
+    warm_pool: int = 0
 
     def __post_init__(self):
         if not self.buckets:
@@ -552,6 +571,13 @@ class ServeConfig:
             raise ValueError(
                 f"husk_max_age_s {self.husk_max_age_s} must be >= 0 or None"
             )
+        if not 0.0 < self.elastic_target_utilization <= 1.0:
+            raise ValueError(
+                f"elastic_target_utilization "
+                f"{self.elastic_target_utilization} must be in (0, 1]"
+            )
+        if self.warm_pool < 0:
+            raise ValueError(f"warm_pool {self.warm_pool} must be >= 0")
 
 
 @dataclasses.dataclass(frozen=True)

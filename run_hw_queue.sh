@@ -386,6 +386,25 @@ print(f"OK: {len(fc)} forecast records ({len(scored)} scored, last "
       f"{lag[-1]['value'] if lag else '?'}ms, tickets conserved")
 EOF
 
+# 9n. Decision-observatory gate (PR 18, docs/SERVING.md "Anticipatory
+#     autoscaling" + docs/OBSERVABILITY.md schema v10): the flash-crowd
+#     anticipatory-vs-reactive A/B on real hardware — a crowd past one
+#     engine's service rate drives the SAME replayed records through the
+#     PR 14 reactive baseline and the forecast + warm-pool fleet. The
+#     bench ASSERTS the anticipatory arm failed no more tickets AND
+#     landed a strictly lower p99; both arms' decision chains must then
+#     reconstruct from the JSONL alone under `telemetry audit --strict`
+#     (evidence conservation bit-for-bit, chain integrity, regret
+#     scored). On TPU the spare's spawn_ms prices a REAL precompiled
+#     device-group promote vs a cold spawn. Rows join the 11b serve
+#     baseline so regret/late-decision/lead-violation growth gates.
+step elastic_ab 2400 python -u bench_serve.py --scenario flash-crowd \
+    --scenario-duration 12 --scenario-crowd-rps 400 --elastic-ab \
+    --elastic-ab-out results/hw_queue/elastic_ab
+step elastic_audit 120 python -m glom_tpu.telemetry audit --strict \
+    results/hw_queue/elastic_ab_reactive.jsonl \
+    results/hw_queue/elastic_ab_anticipatory.jsonl
+
 # 10. Schema lint: every JSON row this queue produced must validate
 #     against the versioned event schema (glom_tpu/telemetry/schema.py).
 #     Shell noise in the logs is skipped; --allow-unstamped because the
@@ -422,6 +441,7 @@ grep -ah '^{' results/hw_queue/bench_serve.log \
     results/hw_queue/phase_ab.log \
     results/hw_queue/ramp_serve.log \
     results/hw_queue/workload_serve.log \
+    results/hw_queue/elastic_ab.log \
     > results/hw_queue/serve_candidate.jsonl 2>/dev/null || true
 if [ -f results/serve_baseline.jsonl ]; then
     step serve_compare 300 python -m glom_tpu.telemetry compare \

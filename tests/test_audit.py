@@ -1,0 +1,339 @@
+"""Decision-chain audit (glom_tpu/telemetry/audit.py, ISSUE 18).
+
+The tier-1 locks:
+
+  * policy_action IS the PR 14 reactive policy on a stamped evidence
+    bundle — breach precedence, dwell hysteresis, min/max clamps — and
+    the anticipatory extension adds exactly one signal: a positive
+    anticipated_deficit arms scale-out and vetoes scale-in;
+  * anticipated_deficit's maturity gate: any missing/unmatured input
+    (null predicted, null forecast_abs_err, null lead time, no measured
+    service rate) pins the deficit to None — reactive semantics;
+  * audit_records reconstructs the per-fleet decision chain, demands
+    EVIDENCE CONSERVATION (stamped inputs replay to the stamped action
+    bit-for-bit), flags unchained actuations, and scores per-decision
+    regret from failure evidence inside each cover window;
+  * the CLI exits 0 on clean evidence, 1 on errors (and on warnings
+    under --strict).
+
+Pure stdlib — no jax, no numpy, no clocks.
+"""
+
+import json
+
+import pytest
+
+from glom_tpu.telemetry.audit import (
+    anticipated_deficit,
+    audit_records,
+    main as audit_main,
+    policy_action,
+)
+
+
+def _evidence(**kw):
+    ev = {
+        "n_engines": 1,
+        "min_engines": 1,
+        "max_engines": 4,
+        "breaches": [],
+        "headroom": 0.5,
+        "low_water": 0.2,
+        "high_water": 0.7,
+        "dwell_s": 1.0,
+        "below_held_s": None,
+        "above_held_s": None,
+        "anticipatory": False,
+        "target_utilization": 0.8,
+        "forecast": None,
+        "lead_time_ms": None,
+        "lead_quantile": None,
+        "fleet_service_rate_rps": None,
+    }
+    ev.update(kw)
+    return ev
+
+
+def _matured(**kw):
+    """Fully matured anticipatory evidence: predicted 50 rps against a
+    10 rps fleet at 0.8 target — deficit decisively positive."""
+    ev = _evidence(
+        anticipatory=True,
+        forecast={
+            "predicted": 50.0,
+            "forecast_abs_err": 1.0,
+            "horizon_s": 0.5,
+            "trend_per_s": 10.0,
+            "t": 1.0,
+        },
+        lead_time_ms=800.0,
+        lead_quantile=0.9,
+        fleet_service_rate_rps=10.0,
+    )
+    ev.update(kw)
+    return ev
+
+
+class TestPolicyAction:
+    def test_reactive_quiet_fleet_holds(self):
+        assert policy_action(_evidence()) is None
+
+    def test_breach_forces_scale_out_and_vetoes_scale_in(self):
+        assert policy_action(_evidence(breaches=["p99_ms"])) == "scale_out"
+        # At the ceiling the breach still VETOES scale-in (None, not in).
+        assert policy_action(
+            _evidence(breaches=["p99_ms"], n_engines=4, above_held_s=5.0)
+        ) is None
+
+    def test_dwell_gates_watermarks(self):
+        assert policy_action(_evidence(below_held_s=0.5)) is None
+        assert policy_action(_evidence(below_held_s=1.0)) == "scale_out"
+        assert policy_action(
+            _evidence(n_engines=2, above_held_s=0.5)
+        ) is None
+        assert policy_action(
+            _evidence(n_engines=2, above_held_s=1.5)
+        ) == "scale_in"
+
+    def test_min_max_clamps(self):
+        assert policy_action(
+            _evidence(n_engines=4, below_held_s=9.0)
+        ) is None
+        assert policy_action(
+            _evidence(n_engines=1, above_held_s=9.0)
+        ) is None
+
+    def test_anticipated_deficit_arms_scale_out(self):
+        assert policy_action(_matured()) == "scale_out"
+
+    def test_anticipated_deficit_vetoes_scale_in(self):
+        assert policy_action(
+            _matured(n_engines=4, above_held_s=9.0)
+        ) is None
+
+    def test_unmatured_forecast_is_reactive_bit_for_bit(self):
+        """The satellite pin: every maturity gate, knocked out one at a
+        time, must reproduce the REACTIVE action on otherwise identical
+        evidence — an unproven forecast never spends hardware."""
+        reactive = _evidence()
+        degradations = (
+            _matured(forecast=None),
+            _matured(forecast={"predicted": None, "forecast_abs_err": 1.0}),
+            _matured(forecast={"predicted": 50.0,
+                               "forecast_abs_err": None}),
+            _matured(lead_time_ms=None),
+            _matured(fleet_service_rate_rps=None),
+            _matured(fleet_service_rate_rps=0.0),
+        )
+        for ev in degradations:
+            assert anticipated_deficit(ev) is None, ev
+            assert policy_action(ev) == policy_action(reactive), ev
+
+    def test_degenerate_pinned_fit_never_scales_out(self):
+        """A degenerate fit stamps predicted null (+ reason) — the
+        deficit pins None and the quiet fleet holds."""
+        ev = _matured(forecast={
+            "predicted": None,
+            "degenerate": "insufficient-samples",
+            "forecast_abs_err": 1.0,
+        })
+        assert anticipated_deficit(ev) is None
+        assert policy_action(ev) is None
+
+
+class TestAnticipatedDeficit:
+    def test_trend_extrapolates_past_horizon(self):
+        # lead 800ms, horizon 500ms: 0.3s of extra trend at 10 rps/s.
+        ev = _matured()
+        assert anticipated_deficit(ev) == pytest.approx(
+            50.0 + 10.0 * 0.3 - 10.0 * 0.8
+        )
+
+    def test_lead_shorter_than_horizon_keeps_forecast(self):
+        ev = _matured(lead_time_ms=100.0)  # 0.1s < horizon 0.5s
+        assert anticipated_deficit(ev) == pytest.approx(50.0 - 8.0)
+
+    def test_capacity_surplus_goes_negative(self):
+        ev = _matured(
+            forecast={"predicted": 2.0, "forecast_abs_err": 0.5,
+                      "horizon_s": 0.5, "trend_per_s": 0.0},
+            fleet_service_rate_rps=10.0,
+        )
+        d = anticipated_deficit(ev)
+        assert d is not None and d < 0
+        assert policy_action(ev) is None
+
+
+def _decision(did, action, evidence, *, prev=None, fleet="fleet0", t=0.0):
+    return {
+        "kind": "decision", "schema_version": 10, "t": t, "fleet": fleet,
+        "decision_id": did, "prev_decision_id": prev, "action": action,
+        "evidence": evidence,
+    }
+
+
+def _serve(event, did, *, fleet="fleet0", t=0.0, **kw):
+    rec = {"kind": "serve", "event": event, "fleet": fleet, "t": t}
+    if did is not None:
+        rec["decision_id"] = did
+    rec.update(kw)
+    return rec
+
+
+def _chain():
+    """One clean scale-out -> scale-in run."""
+    out_ev = _evidence(breaches=["p99_ms"])
+    in_ev = _evidence(n_engines=2, above_held_s=5.0)
+    return [
+        _decision(1, "scale_out", out_ev, t=1.0),
+        _serve("scale_out_decision", 1, t=1.0),
+        _serve("scale_out", 1, t=1.2, spawn_ms=150.0),
+        _serve("admission_open", 1, t=1.2),
+        _decision(2, "scale_in", in_ev, prev=1, t=5.0),
+        _serve("scale_in_decision", 2, t=5.0),
+        _serve("drain_release", 2, t=5.3),
+    ]
+
+
+class TestAuditRecords:
+    def test_clean_chain_conserves(self):
+        rep = audit_records(_chain())
+        assert rep["errors"] == [] and rep["warnings"] == []
+        assert rep["n_decisions"] == 2 and rep["n_conserved"] == 2
+        assert rep["fleets"] == ["fleet0"]
+        # Scaled out WITH a live breach: late by definition.
+        assert rep["decisions_late"] == 1
+        assert rep["spawn_lead_violations"] == 0
+
+    def test_corrupted_evidence_breaks_conservation(self):
+        recs = _chain()
+        recs[0] = _decision(1, "scale_out", _evidence(), t=1.0)  # quiet!
+        rep = audit_records(recs)
+        assert any("replays to" in e for e in rep["errors"])
+        assert rep["n_conserved"] == 1
+
+    def test_chain_gap_and_bad_prev_flagged(self):
+        recs = [
+            _decision(1, "scale_out", _evidence(breaches=["x"]), t=1.0),
+            _serve("scale_out", 1, t=1.1, spawn_ms=10.0),
+            _decision(3, "scale_out", _evidence(breaches=["x"]),
+                      prev=2, t=2.0),
+            _serve("scale_out", 3, t=2.1, spawn_ms=10.0),
+        ]
+        rep = audit_records(recs)
+        assert any("chain gap" in e for e in rep["errors"])
+        assert any("prev_decision_id" in e for e in rep["errors"])
+
+    def test_unchained_actuation_is_an_error(self):
+        rep = audit_records([_serve("scale_out", None, t=1.0)])
+        assert any("no decision_id" in e for e in rep["errors"])
+
+    def test_orphan_decision_warns_only(self):
+        rep = audit_records(
+            [_decision(1, "scale_out", _evidence(breaches=["x"]), t=1.0)]
+        )
+        assert rep["errors"] == []
+        assert any("actuated no serve" in w for w in rep["warnings"])
+
+    def test_wrong_family_chaining_flagged(self):
+        recs = [
+            _decision(1, "scale_out", _evidence(breaches=["x"]), t=1.0),
+            _serve("drain_release", 1, t=1.5),
+        ]
+        rep = audit_records(recs)
+        assert any("not scale_out" not in e and "scale_out" in e
+                   for e in rep["errors"])
+
+    def test_fleets_audit_independently(self):
+        recs = []
+        for fleet in ("reactive", "anticipatory"):
+            recs += [
+                _decision(1, "scale_out", _evidence(breaches=["x"]),
+                          fleet=fleet, t=1.0),
+                _serve("scale_out", 1, fleet=fleet, t=1.1, spawn_ms=9.0),
+            ]
+        rep = audit_records(recs)
+        assert rep["errors"] == []
+        assert rep["fleets"] == ["anticipatory", "reactive"]
+        assert rep["n_decisions"] == 2
+
+    def test_regret_counts_failures_inside_cover_window(self):
+        ev = _matured()  # lead 800ms -> cover 0.8s
+        recs = [
+            _decision(1, "scale_out", ev, t=1.0),
+            _serve("scale_out", 1, t=1.1, spawn_ms=100.0),
+            _serve("shed", None, t=1.5),            # inside cover
+            _serve("shed", None, t=3.0),            # outside
+            {"kind": "slo_breach", "t": 1.7},       # inside
+            _serve("settle", None, t=1.6, outcome="failed"),  # inside
+        ]
+        # The unchained sheds are failure evidence, not actuations.
+        for r in recs:
+            r.pop("decision_id", None) if r.get("event") == "shed" else None
+        rep = audit_records(recs)
+        assert rep["errors"] == []
+        assert rep["regret_total"] == 3
+        assert rep["n_failure_signals"] == 4
+        (pd,) = rep["regret_per_decision"]
+        assert pd["regret"] == 3 and pd["cover_s"] == pytest.approx(0.8)
+        assert pd["late"] is False
+
+    def test_spawn_lead_violation_counted(self):
+        ev = _matured(lead_time_ms=50.0)
+        recs = [
+            _decision(1, "scale_out", ev, t=1.0),
+            _serve("scale_out", 1, t=1.2, spawn_ms=200.0),
+        ]
+        rep = audit_records(recs)
+        assert rep["spawn_lead_violations"] == 1
+
+    def test_duplicate_decision_id_is_an_error(self):
+        recs = [
+            _decision(1, "scale_out", _evidence(breaches=["x"]), t=1.0),
+            _decision(1, "scale_out", _evidence(breaches=["x"]), t=2.0),
+        ]
+        rep = audit_records(recs)
+        assert any("duplicate" in e for e in rep["errors"])
+
+
+class TestAuditCLI:
+    def _write(self, tmp_path, name, records):
+        p = tmp_path / name
+        p.write_text("".join(json.dumps(r) + "\n" for r in records))
+        return str(p)
+
+    def test_clean_stream_exits_zero(self, tmp_path, capsys):
+        path = self._write(tmp_path, "a.jsonl", _chain())
+        assert audit_main([path]) == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        rep = json.loads(out[-1])
+        assert rep["ok"] is True and rep["n_decisions"] == 2
+        assert rep["kind"] == "summary"
+
+    def test_broken_chain_exits_one(self, tmp_path):
+        recs = [_serve("scale_out", None, t=1.0)]
+        path = self._write(tmp_path, "b.jsonl", recs)
+        assert audit_main([path]) == 1
+
+    def test_strict_fails_warnings(self, tmp_path):
+        recs = [_decision(1, "scale_out", _evidence(breaches=["x"]),
+                          t=1.0)]
+        path = self._write(tmp_path, "c.jsonl", recs)
+        assert audit_main([path]) == 0
+        assert audit_main([path, "--strict"]) == 1
+
+    def test_baseline_delta_emitted(self, tmp_path, capsys):
+        ev = _matured(lead_time_ms=2000.0)
+        loud = [
+            _decision(1, "scale_out", ev, t=1.0),
+            _serve("scale_out", 1, t=1.1, spawn_ms=100.0),
+            _serve("shed", None, t=1.5),
+        ]
+        quiet = _chain()
+        a = self._write(tmp_path, "anticipatory.jsonl", quiet)
+        b = self._write(tmp_path, "reactive.jsonl", loud)
+        assert audit_main([a, "--baseline", b]) == 0
+        lines = capsys.readouterr().out.strip().splitlines()
+        delta = json.loads(lines[-1])
+        assert delta["audit"] == "baseline-delta"
+        assert delta["regret_delta"] == 0 - 1

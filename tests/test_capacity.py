@@ -437,7 +437,19 @@ class TestPhaseSplit:
         sink = Sink()
         with DynamicBatcher(engines=[bad, good], max_batch=4,
                             max_delay_ms=10.0, writer=sink) as b:
-            ts = [b.submit(IMG) for _ in range(3)]
+            # PACED submissions until "bad" has demonstrably taken (and
+            # failed) a batch — an all-at-once burst let one pickup race
+            # decide whether the failover path ran at all (the
+            # test_serve.py kill-path fix, same flake).
+            ts = [b.submit(IMG)]
+            deadline = time.monotonic() + 10.0
+            while not any(
+                r.get("event") == "engine_failover" for r in sink.records
+            ):
+                assert time.monotonic() < deadline, "bad never dispatched"
+                time.sleep(0.02)
+                ts.append(b.submit(IMG))
+            ts += [b.submit(IMG) for _ in range(2)]
             for t in ts:
                 t.result(timeout=10.0)
         recs = sink.records

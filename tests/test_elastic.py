@@ -785,3 +785,378 @@ class TestStaticPathUnchanged:
         b, _ = _batcher(1)
         (c,) = b.capacity_records()
         assert c["state"] == "ok" and c["alive"] is True
+
+
+# ---------------------------------------------------------------------------
+# the decision observatory (ISSUE 18): evidence-stamped decisions
+# ---------------------------------------------------------------------------
+
+
+class EvidencedPolicy(ElasticPolicy):
+    """Actuator-test policy whose scripted actions carry a REAL evidence
+    bundle that replays to the action through the pure policy function —
+    what audit_records demands of every stamped decision."""
+
+    def __init__(self, actions):
+        super().__init__(min_engines=1, max_engines=8)
+        self._actions = list(actions)
+
+    def decide(self, n_engines):
+        if not self._actions:
+            return None
+        action = self._actions.pop(0)
+        ev = self.evidence(n_engines)
+        if action == "scale_out":
+            ev["breaches"] = ["p99_ms"]
+        else:
+            ev["above_held_s"] = ev["dwell_s"] + 1.0
+        return {
+            "action": action,
+            "signal": {"rule": "test"},
+            "evidence": ev,
+        }
+
+
+class TestAnticipatoryPolicy:
+    def _anticipatory(self, clock, **kw):
+        kw.setdefault("anticipatory", True)
+        kw.setdefault("target_utilization", 0.8)
+        kw.setdefault("low_water", 0.2)
+        kw.setdefault("high_water", 0.7)
+        kw.setdefault("dwell_s", 1.0)
+        kw.setdefault("cooldown_s", 0.0)
+        return _policy(clock, **kw)
+
+    def _mature(self, p, predicted=50.0):
+        p.note_forecast({
+            "predicted": predicted, "forecast_abs_err": 1.0,
+            "horizon_s": 0.5, "trend_per_s": 0.0, "t": 1.0,
+        })
+        p.note_lead_time(800.0, 0.9)
+        p.note_service_rate(10.0)
+
+    def test_matured_deficit_arms_scale_out(self):
+        """Predicted load over capacity scales out with a QUIET headroom
+        signal — the act-ahead path — and the decision carries the full
+        evidence bundle, deficit stamped, replaying bit-for-bit."""
+        from glom_tpu.telemetry.audit import policy_action
+
+        clk = FakeClock()
+        p = self._anticipatory(clk)
+        self._mature(p)
+        p.observe_headroom(0.5)  # between the water marks: quiet
+        d = p.decide(1)
+        assert d is not None and d["action"] == "scale_out"
+        assert d["signal"]["rule"] == "forecast"
+        ev = d["evidence"]
+        assert ev["anticipated_deficit_rps"] > 0
+        assert ev["forecast"]["predicted"] == 50.0
+        assert ev["lead_time_ms"] == 800.0 and ev["lead_quantile"] == 0.9
+        assert ev["fleet_service_rate_rps"] == 10.0
+        assert policy_action(ev) == "scale_out"
+
+    def test_matured_deficit_vetoes_scale_in(self):
+        clk = FakeClock()
+        p = self._anticipatory(clk)
+        self._mature(p)
+        p.observe_headroom(0.9)
+        clk.advance(2.0)  # above-water dwell satisfied...
+        p.observe_headroom(0.9)
+        # At the ceiling (scale-out clamped) the predicted pressure
+        # still VETOES the scale-in the held-high headroom earned.
+        assert p.decide(p.max_engines) is None
+
+    def test_unmatured_forecast_is_reactive_bit_for_bit(self):
+        """The satellite pin: an anticipatory policy whose forecast has
+        never matured (forecast_abs_err null) decides EXACTLY like the
+        PR 14 reactive policy on an identical signal stream."""
+        clk_a, clk_r = FakeClock(), FakeClock()
+        p_a = self._anticipatory(clk_a)
+        p_r = _policy(clk_r, low_water=0.2, high_water=0.7,
+                      dwell_s=1.0, cooldown_s=0.0)
+        p_a.note_forecast({
+            "predicted": 50.0, "forecast_abs_err": None,
+            "horizon_s": 0.5, "trend_per_s": 0.0, "t": 1.0,
+        })
+        p_a.note_lead_time(800.0, 0.9)
+        p_a.note_service_rate(10.0)
+        script = [
+            (0.5, 0.1, 1), (0.6, 0.1, 1), (0.4, 0.5, 1),  # below dwell
+            (0.1, 0.5, 1), (0.1, 0.6, 1),                  # held low
+            (0.9, 0.5, 2), (0.9, 1.2, 2),                  # held high
+        ]
+        for h, dt, n in script:
+            for clk, p in ((clk_a, p_a), (clk_r, p_r)):
+                clk.advance(dt)
+                p.observe_headroom(h)
+            d_a, d_r = p_a.decide(n), p_r.decide(n)
+            assert (d_a is None) == (d_r is None)
+            if d_a is not None:
+                assert d_a["action"] == d_r["action"]
+                # The anticipatory inputs ride the bundle (null deficit)
+                # even when the decision came from the reactive rules.
+                assert "anticipated_deficit_rps" not in d_a["evidence"]
+
+    def test_degenerate_pinned_fit_never_scales_out(self):
+        """A degenerate fit (predicted null + reason) and a pinned lead
+        model both gate to reactive: the quiet fleet holds."""
+        clk = FakeClock()
+        p = self._anticipatory(clk)
+        p.note_forecast({
+            "predicted": None, "degenerate": "insufficient-samples",
+            "forecast_abs_err": 2.0, "horizon_s": 0.5,
+            "trend_per_s": 0.0, "t": 1.0,
+        })
+        p.note_lead_time(800.0, 0.9)
+        p.note_service_rate(10.0)
+        p.observe_headroom(0.5)
+        assert p.decide(1) is None
+        # Matured forecast but NO lead evidence: still reactive.
+        p2 = self._anticipatory(clk)
+        self._mature(p2)
+        p2.note_lead_time(None)
+        p2.observe_headroom(0.5)
+        assert p2.decide(1) is None
+
+    def test_resolve_policy_wires_anticipatory_knobs(self):
+        scfg = ServeConfig(
+            elastic=True, elastic_anticipatory=True,
+            elastic_target_utilization=0.6,
+        )
+        p = resolve_policy(scfg)
+        assert p.anticipatory is True
+        assert p.target_utilization == 0.6
+
+
+class TestDecisionRecords:
+    def test_decision_chain_audits_clean(self):
+        """The tentpole end-to-end: a scale-out then a scale-in through
+        the real actuator stamp schema-v10 decision records (contiguous
+        ids, prev link, evidence bundles) whose JSONL ALONE passes
+        audit_records — conservation, coverage, chain."""
+        from glom_tpu.telemetry.audit import audit_records
+
+        sink = Sink()
+        b, _ = _batcher(1, writer=sink)
+        with b:
+            sc = Autoscaler(
+                b, lambda: FakeEngine(name="engine1"), writer=sink,
+                policy=EvidencedPolicy(["scale_out", "scale_in"]),
+            )
+            assert sc.tick() is not None
+            assert sc.tick() is not None
+            assert b.n_active_engines() == 1
+        decisions = [r for r in sink.records if r.get("kind") == "decision"]
+        assert [d["decision_id"] for d in decisions] == [1, 2]
+        assert [d["prev_decision_id"] for d in decisions] == [None, 1]
+        assert [d["action"] for d in decisions] == ["scale_out", "scale_in"]
+        assert decisions[0]["fleet"] == "fleet0"
+        for d in decisions:
+            assert schema.validate_record(d) == []
+        rep = audit_records(sink.records)
+        assert rep["errors"] == [], rep["errors"]
+        assert rep["n_decisions"] == 2 and rep["n_conserved"] == 2
+        # The scripted breach makes the scale-out late by definition.
+        assert rep["decisions_late"] == 1
+        el = sc.record()
+        assert el["n_decisions"] == 2 and el["decisions_late"] == 1
+        assert el["spawn_lead_violations"] == 0
+
+    def test_every_actuation_carries_the_decision_id(self):
+        sink = Sink()
+        b, _ = _batcher(1, writer=sink)
+        with b:
+            sc = Autoscaler(
+                b, lambda: FakeEngine(name="engine1"), writer=sink,
+                policy=EvidencedPolicy(["scale_out", "scale_in"]),
+            )
+            sc.tick()
+            sc.tick()
+        chain = sink.events(
+            "scale_out_decision", "scale_out", "admission_open",
+            "engine_add", "scale_in_decision", "drain_begin",
+            "drain_flush", "drain_migrate", "drain_release",
+        )
+        assert len(chain) >= 8
+        for r in chain:
+            assert isinstance(r.get("decision_id"), int), r
+        out_ids = {r["decision_id"] for r in chain
+                   if r["event"] in ("scale_out", "admission_open")}
+        in_ids = {r["decision_id"] for r in chain
+                  if r["event"] == "drain_release"}
+        assert out_ids == {1} and in_ids == {2}
+
+    def test_decision_records_fan_to_taps(self):
+        """Decision records join the batcher's in-process tap stream —
+        the same fan-out the forecaster and `telemetry watch` ride."""
+        sink = Sink()
+        tapped = []
+        b, _ = _batcher(1, writer=sink)
+        b.add_event_tap(tapped.append)
+        with b:
+            sc = Autoscaler(
+                b, lambda: FakeEngine(name="engine1"), writer=sink,
+                policy=EvidencedPolicy(["scale_out"]),
+            )
+            sc.tick()
+        assert any(r.get("kind") == "decision" for r in tapped)
+
+    def test_scripted_policy_without_evidence_still_works(self):
+        """Back-compat: a decide() that returns no evidence key (the PR
+        14 shape) actuates normally — the decision record just stamps
+        evidence null."""
+        sink = Sink()
+        b, _ = _batcher(1, writer=sink)
+        with b:
+            sc = Autoscaler(
+                b, lambda: FakeEngine(name="engine1"), writer=sink,
+                policy=ScriptedPolicy(["scale_out"]),
+            )
+            assert sc.tick() is not None
+            assert b.n_active_engines() == 2
+        (d,) = [r for r in sink.records if r.get("kind") == "decision"]
+        assert d["evidence"] is None and d["action"] == "scale_out"
+
+
+# ---------------------------------------------------------------------------
+# warm-pool spares (ISSUE 18 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestWarmPool:
+    def test_fill_then_promote_on_scale_out(self):
+        """fill_warm_pool pre-spawns + warms the spare OUTSIDE admission
+        (spare_spawn stamped, fleet unchanged); the scale-out PROMOTES
+        it — add_engine with the owning decision_id, no cold spawn."""
+        sink = Sink()
+        b, _ = _batcher(1, writer=sink)
+        built = []
+
+        def factory():
+            e = FakeEngine(name=f"engine{1 + len(built)}")
+            built.append(e)
+            return e
+
+        with b:
+            sc = Autoscaler(
+                b, factory, writer=sink, warm_pool=1,
+                policy=EvidencedPolicy(["scale_out"]),
+            )
+            assert sc.fill_warm_pool() == 1
+            (spare,) = built
+            assert spare.warmed
+            assert b.n_active_engines() == 1  # spare NOT admitted
+            (ss,) = sink.events("spare_spawn")
+            assert ss["engine"] == "engine1" and ss["n_spares"] == 1
+            assert isinstance(ss["spawn_ms"], float)
+            assert sc.tick() is not None
+            assert b.n_active_engines() == 2
+            assert len(built) == 1  # no cold spawn: the spare absorbed it
+            (pr,) = sink.events("spare_promote")
+            assert pr["engine"] == "engine1" and pr["decision_id"] == 1
+            adds = sink.events("engine_add")
+            assert adds and adds[-1]["decision_id"] == 1
+            assert adds[-1]["spare"] is True
+            el = sc.record()
+            assert el["n_promotions"] == 1 and el["n_spares"] == 0
+            assert el["n_scale_outs"] == 0  # promotion, not cold spawn
+
+    def test_scale_in_demotes_back_to_pool(self):
+        """A drained engine re-pools (NO release) while the pool is
+        below target; the next scale-out re-promotes it under a fresh
+        suffixed name (its old name is a retained husk)."""
+        sink = Sink()
+        b, _ = _batcher(2, writer=sink)
+        with b:
+            sc = Autoscaler(
+                b, lambda: FakeEngine(name="engine9"), writer=sink,
+                warm_pool=1,
+                policy=EvidencedPolicy(["scale_in", "scale_out"]),
+            )
+            # Pool intentionally NOT pre-filled: the demotion fills it.
+            assert sc.tick() is not None
+            assert b.n_active_engines() == 1
+            (dr,) = sink.events("drain_release")
+            assert dr["demoted"] is True
+            (dm,) = sink.events("spare_demote")
+            assert dm["engine"] == dr["engine"] and dm["n_spares"] == 1
+            demoted = b.engine_by_name(dr["engine"])
+            assert demoted is not None and not demoted.released
+            assert sc.record()["n_demotions"] == 1
+            # Re-promotion: the husk holds the old name, so the spare
+            # re-registers under a suffixed one.
+            assert sc.tick() is not None
+            assert b.n_active_engines() == 2
+            (pr,) = sink.events("spare_promote")
+            assert pr["engine"] == f"{dr['engine']}~p1"
+        rep_errors = __import__(
+            "glom_tpu.telemetry.audit", fromlist=["audit_records"]
+        ).audit_records(sink.records)["errors"]
+        assert rep_errors == [], rep_errors
+
+    def test_spare_is_not_a_husk(self):
+        """Husk retention (husk_max=0: retire every husk instantly)
+        composes with the warm pool: the demoted spare leaves the
+        batcher's engines nest entirely (husk retired) yet stays warm in
+        the pool — and a spare never appears in the nest before its
+        promotion."""
+        import dataclasses as _dc
+
+        sink = Sink()
+        engines = [FakeEngine(name=f"engine{i}") for i in range(2)]
+        for e in engines:
+            e.warmup()
+            e.scfg = _dc.replace(e.scfg, husk_max=0)
+        b = DynamicBatcher(engines=engines, writer=sink)
+        built = []
+
+        def factory():
+            # Exhausts after two spares: the fill stops loudly at 2,
+            # leaving one pool slot for the demotion to land in.
+            if len(built) >= 2:
+                raise RuntimeError("device pool exhausted")
+            e = FakeEngine(name=f"engine{5 + len(built)}")
+            built.append(e)
+            return e
+
+        with b:
+            sc = Autoscaler(
+                b, factory, writer=sink,
+                warm_pool=3,
+                policy=EvidencedPolicy(["scale_in"]),
+            )
+            assert sc.fill_warm_pool() == 2
+            s = b.summary_record()
+            # Spares never enter the engines nest (not husks, not fleet).
+            assert set(s["engines"]) == {"engine0", "engine1"}
+            assert sc.tick() is not None
+            s = b.summary_record()
+            drained = sink.events("drain_release")[0]["engine"]
+            assert drained not in s["engines"]  # husk retired (max=0)
+            assert s["husks_retired"]["n"] == 1
+            el = sc.record()
+            # ...but the engine itself lives on as a warm spare.
+            assert el["n_spares"] == 3 and el["n_demotions"] == 1
+
+    def test_spawn_failure_during_fill_stops_loudly(self):
+        sink = Sink()
+        b, _ = _batcher(1, writer=sink)
+
+        def factory():
+            raise RuntimeError("device pool exhausted")
+
+        with b:
+            sc = Autoscaler(
+                b, factory, writer=sink, warm_pool=2,
+                policy=ScriptedPolicy([]),
+            )
+            assert sc.fill_warm_pool() == 0
+        (rb,) = sink.events("spawn_rollback")
+        assert rb["spare"] is True and rb["decision_id"] is None
+        assert "device pool exhausted" in rb["exception"]
+
+    def test_warm_pool_validation(self):
+        b, _ = _batcher(1)
+        with pytest.raises(ValueError, match="warm_pool"):
+            Autoscaler(b, lambda: FakeEngine(), warm_pool=-1,
+                       policy=ScriptedPolicy([]))

@@ -300,3 +300,38 @@ class TestForecastEmitter:
     def test_validation(self):
         with pytest.raises(ValueError):
             ForecastEmitter(lambda r: None, interval_s=0.0)
+
+    def test_latest_forecast_accessor_tracks_closed_windows(self):
+        """The autoscaler's pull side (ISSUE 18): latest_forecast() is
+        None before any window closes, then a COPY of the most recent
+        closed-window record — mutating the copy never corrupts the
+        emitter's own state."""
+        clk = FakeClock()
+        out = []
+        em = ForecastEmitter(out.append, interval_s=0.5, clock=clk)
+        assert em.latest_forecast() is None
+        em.tap(_admit(0))
+        clk.advance(0.5)
+        em.tap(_admit(1))  # closes the first window
+        fc = em.latest_forecast()
+        assert fc is not None and "forecast_abs_err" in fc
+        assert fc["observed_rate_rps"] == out[-1]["observed_rate_rps"]
+        fc["predicted"] = 1e9
+        assert em.latest_forecast()["predicted"] != 1e9
+
+    def test_spare_spawn_feeds_lead_model(self):
+        """Warm-pool pre-spawns are REAL lead evidence: a spare_spawn's
+        spawn_ms lands in the lead model exactly like a cold scale_out's
+        — the anticipatory signal can arm before any live spawn."""
+        clk = FakeClock()
+        out = []
+        em = ForecastEmitter(out.append, interval_s=10.0, clock=clk)
+        em.tap({"kind": "serve", "event": "spare_spawn", "spawn_ms": 120.0})
+        assert em.lead_model.lead_time_ms() == 120.0
+        leads = [r for r in out if r.get("metric") == "spawn_lead_time"]
+        assert len(leads) == 1
+        # A promotion is NOT a spawn: promote_ms must never contaminate
+        # the cold-spawn lead distribution.
+        em.tap({"kind": "serve", "event": "spare_promote",
+                "promote_ms": 0.4})
+        assert em.lead_model.lead_time_ms() == 120.0
