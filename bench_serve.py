@@ -1790,6 +1790,256 @@ def run_elastic_ab(cfg, scfg, label: str, records, *, source: str,
     return arms
 
 
+def run_qos_ab(cfg, scfg, label: str, records, *, source: str,
+               time_scale: float = 1.0, out_prefix: str = "qos_ab",
+               max_engines: int = 2, gate: bool = False) -> dict:
+    """Classless-vs-QoS serving A/B over ONE mixed-class flash-crowd
+    artifact (docs/SERVING.md "SLO classes"): the same records drive two
+    independent elastic fleets —
+
+      * classless — one shared FIFO queue (the PR 18 baseline); every
+                    submit still CARRIES its recorded slo_class label,
+                    so per-class latency attributes on both sides; and
+      * qos       — three declared SLO classes (premium/standard/batch,
+                    8/2/1 weights, per-class lanes partitioning the SAME
+                    total queue depth) through the deficit-weighted-fair
+                    scheduler, class-aware shed, and class-scoped
+                    monitor rules,
+
+    each writing its decision chain to its own JSONL ({out_prefix}_
+    {arm}.jsonl) and audited STRICTLY (errors AND warnings fail — the
+    acceptance bar). Emits per-(arm, class) p99 / served-fraction /
+    shed rows plus the premium-p99 delta. Both arms must conserve
+    tickets EXACTLY per class. With gate=True the run additionally
+    ASSERTS premium p99 strictly below the classless baseline and the
+    batch served fraction at or above the starvation floor.
+    """
+    import dataclasses
+
+    from glom_tpu.serve import workload as wl
+    from glom_tpu.serve.batcher import DynamicBatcher
+    from glom_tpu.serve.elastic import Autoscaler, resolve_policy
+    from glom_tpu.serve.engine import InferenceEngine
+    from glom_tpu.serve.events import stamp_serve
+    from glom_tpu.serve.qos import class_slo_rules, resolve_slo_classes
+    from glom_tpu.telemetry.audit import audit_records, load_records
+    from glom_tpu.telemetry.sinks import emit
+    from glom_tpu.utils.metrics import MetricsWriter
+
+    scfg_base = dataclasses.replace(
+        scfg,
+        elastic=True, min_engines=1, max_engines=max_engines,
+        elastic_low_water=0.5, elastic_high_water=0.8,
+        elastic_dwell_s=0.1, elastic_cooldown_s=0.5,
+        elastic_window_s=2.0, elastic_interval_s=0.05,
+        elastic_p99_ms=100.0,
+    )
+    # The QoS arm's lanes PARTITION the classless arm's queue depth —
+    # identical total admission capacity, so the A/B isolates the
+    # scheduler, not a bigger buffer.
+    qd = scfg_base.queue_depth
+    floor = 0.1
+    qos_classes = (
+        f"premium:weight=8,p99_ms={scfg_base.elastic_p99_ms},"
+        f"queue_depth={max(1, qd // 2)}",
+        f"standard:weight=2,queue_depth={max(1, qd // 4)}",
+        f"batch:weight=1,queue_depth={max(1, qd - qd // 2 - qd // 4)}",
+    )
+    n_total = len(records)
+    qtile = lambda xs, f: sorted(xs)[min(len(xs) - 1, int(f * len(xs)))]
+
+    def _arm(arm: str, *, classed: bool) -> dict:
+        scfg_arm = (
+            dataclasses.replace(
+                scfg_base,
+                slo_classes=qos_classes,
+                slo_starvation_floor=floor,
+            )
+            if classed else scfg_base
+        )
+        path = f"{out_prefix}_{arm}.jsonl"
+        writer = MetricsWriter(path, echo=False)
+        engines = _make_engines(cfg, scfg_arm, 1)
+        params = engines[0].params
+        for eng in engines:
+            eng.warmup()
+        seq = [len(engines)]
+
+        def factory():
+            i = seq[0]
+            eng = InferenceEngine(
+                cfg, scfg_arm, params=params, name=f"engine{i}"
+            )
+            seq[0] += 1
+            return eng
+
+        rules = {"p99_ms": scfg_arm.elastic_p99_ms}
+        if classed:
+            rules.update(class_slo_rules(resolve_slo_classes(scfg_arm)))
+        lat_by_class: dict = {}
+        with DynamicBatcher(engines=engines, writer=writer) as batcher:
+            batcher.enable_admission_events()
+            scaler = Autoscaler(
+                batcher, factory, policy=resolve_policy(scfg_arm),
+                rules=rules,
+                writer=writer,
+                interval_s=scfg_arm.elastic_interval_s,
+                fleet=arm,
+            ).start()
+            try:
+                tickets = []
+
+                def offer(rec, i):
+                    # HARD traffic, the same 100x lever as the elastic
+                    # A/B: the crowd must queue or the scheduler has
+                    # nothing to arbitrate. A ShedError propagates to
+                    # replay(), which counts it and drives on — the
+                    # batcher already attributed it to the class.
+                    img = 100.0 * wl.synth_input(rec, i)
+                    cls = rec.get("slo_class")
+                    tickets.append(
+                        (cls, batcher.submit(
+                            img,
+                            session_id=rec.get("session"),
+                            slo_class=cls,
+                        ))
+                    )
+
+                wl.replay(records, offer, time_scale=time_scale)
+                for cls, t in tickets:
+                    try:
+                        _, _, latency_s = t.result(timeout=600.0)
+                        lat_by_class.setdefault(cls, []).append(
+                            1e3 * latency_s
+                        )
+                    except Exception:  # noqa: BLE001 — summary counts it
+                        pass
+            finally:
+                scaler.stop()
+            summary = batcher.summary_record()
+            writer.write(stamp_serve(dict(summary)))
+        writer.close()
+        audit = audit_records(load_records(path))
+        # The acceptance bar is `telemetry audit --strict`: structural
+        # errors AND warnings (un-actuated decisions) both fail.
+        assert not audit["errors"] and not audit["warnings"], (
+            f"{arm} arm failed its strict audit: "
+            f"{(audit['errors'] + audit['warnings'])[:3]}"
+        )
+        classes = summary.get("classes") or {}
+        for cls, cnt in classes.items():
+            # EXACT per-class ticket conservation — every admitted
+            # request settles under the class it was admitted with,
+            # across sheds, failover, and continuations.
+            assert (
+                cnt["n_served"] + cnt["n_shed"] + cnt["n_failed"]
+                == cnt["n_requests"]
+            ), f"{arm} arm class {cls!r} tickets NOT conserved: {cnt}"
+        assert (
+            sum(c["n_requests"] for c in classes.values())
+            == summary["n_requests"] == n_total
+        ), (
+            f"{arm} arm class rows do not cover the offered load: "
+            f"{classes} vs {n_total}"
+        )
+        return {
+            "arm": arm,
+            "path": path,
+            "p99_ms": {
+                cls: round(qtile(ls, 0.99), 3)
+                for cls, ls in sorted(lat_by_class.items())
+                if ls
+            },
+            "classes": classes,
+            "regret": audit["regret_total"],
+            "regret_weighted": audit["regret_weighted"],
+            "n_decisions": audit["n_decisions"],
+        }
+
+    arms = {
+        "classless": _arm("classless", classed=False),
+        "qos": _arm("qos", classed=True),
+    }
+    emit(
+        {
+            "event": "qos_ab_summary",
+            "config": label,
+            "source": source,
+            "n_requests": n_total,
+            "starvation_floor": floor,
+            "arms": arms,
+        },
+        kind="serve",
+    )
+    for arm, r in arms.items():
+        for cls, p99 in r["p99_ms"].items():
+            emit(
+                {
+                    "metric": f"serve_qos_ab_p99 ({cls}, {arm}, "
+                              f"{source}, {label})",
+                    "value": p99,
+                    "unit": "ms",
+                }
+            )
+        for cls, cnt in sorted(r["classes"].items()):
+            if cnt.get("served_fraction") is not None:
+                emit(
+                    {
+                        "metric": "serve_qos_ab_served_fraction "
+                                  f"({cls}, {arm}, {source}, {label})",
+                        "value": cnt["served_fraction"],
+                        "unit": "fraction",
+                    }
+                )
+            emit(
+                {
+                    "metric": f"serve_qos_ab_shed ({cls}, {arm}, "
+                              f"{source}, {label})",
+                    "value": cnt["n_shed"],
+                    "unit": "count",
+                }
+            )
+        emit(
+            {
+                "metric": f"serve_qos_ab_regret_weighted ({arm}, "
+                          f"{source}, {label})",
+                "value": r["regret_weighted"],
+                "unit": "count",
+                "n_decisions": r["n_decisions"],
+                "log": r["path"],
+            }
+        )
+    base, qos = arms["classless"], arms["qos"]
+    prem_base = base["p99_ms"].get("premium")
+    prem_qos = qos["p99_ms"].get("premium")
+    if prem_base is not None and prem_qos is not None:
+        emit(
+            {
+                "metric": f"serve_qos_ab_premium_p99_delta ({source}, "
+                          f"{label})",
+                "value": round(prem_qos - prem_base, 3),
+                "unit": "ms",
+            }
+        )
+    if gate:
+        assert prem_base is not None and prem_qos is not None, (
+            "qos A/B gate needs premium latencies on both arms: "
+            f"classless={prem_base}, qos={prem_qos}"
+        )
+        assert prem_qos < prem_base, (
+            "QoS arm did not beat the classless premium p99: "
+            f"{prem_qos} vs {prem_base}"
+        )
+        batch_served = (qos["classes"].get("batch") or {}).get(
+            "served_fraction"
+        )
+        assert batch_served is not None and batch_served >= floor, (
+            "QoS arm starved the batch class below its floor: "
+            f"served_fraction={batch_served} < {floor}"
+        )
+    return arms
+
+
 def run_trace_ab(cfg, scfg, label: str, *, n_requests: int,
                  n_engines: int = 1, repeats: int = 3) -> dict:
     """Request-tracing overhead A/B (docs/OBSERVABILITY.md, Request
@@ -2112,6 +2362,23 @@ def main(argv=None) -> int:
                     metavar="PREFIX",
                     help="per-arm decision-log path prefix "
                     "(PREFIX_reactive.jsonl / PREFIX_anticipatory.jsonl)")
+    ap.add_argument("--class-mix", default=None, metavar="SPEC",
+                    help="scenario only: deal each arrival an SLO class "
+                    "by seeded fraction, e.g. "
+                    "'premium=0.2,standard=0.3,batch=0.5' "
+                    "(docs/SERVING.md 'SLO classes')")
+    ap.add_argument("--qos-ab", action="store_true",
+                    help="with --replay/--scenario: drive the SAME "
+                    "records through a classless (shared FIFO) and a "
+                    "QoS (premium/standard/batch weighted-fair) fleet, "
+                    "audit each arm's decision log STRICTLY, and emit "
+                    "per-class p99 / served-fraction rows; flash-crowd "
+                    "runs GATE on premium p99 beating the classless "
+                    "baseline with batch held at the starvation floor")
+    ap.add_argument("--qos-ab-out", default="qos_ab",
+                    metavar="PREFIX",
+                    help="per-arm decision-log path prefix "
+                    "(PREFIX_classless.jsonl / PREFIX_qos.jsonl)")
     ap.add_argument("--workload-out", default=None, metavar="FILE",
                     help="replay/scenario: re-record THIS run's offered "
                     "traffic as a workload artifact (closes the "
@@ -2233,6 +2500,10 @@ def main(argv=None) -> int:
                     ap.error("--scenario-crowd-rps only applies to "
                              "--scenario flash-crowd")
                 scen_kw["crowd_rps"] = args.scenario_crowd_rps
+            if args.class_mix is not None:
+                from glom_tpu.serve.workload import parse_class_mix
+
+                scen_kw["class_mix"] = parse_class_mix(args.class_mix)
             records = generate(
                 args.scenario, args.scenario_duration,
                 seed=args.scenario_seed,
@@ -2248,6 +2519,21 @@ def main(argv=None) -> int:
                 out_prefix=args.elastic_ab_out,
                 # The acceptance gate rides the flash-crowd scenario:
                 # the crowd is exactly the shape anticipation must beat.
+                gate="flash-crowd" in source,
+            )
+            return 0
+        if args.qos_ab:
+            if not any(rec.get("slo_class") for rec in records):
+                ap.error("--qos-ab needs classed arrivals: record the "
+                         "workload with classes or pass --class-mix "
+                         "(e.g. 'premium=0.2,standard=0.3,batch=0.5')")
+            run_qos_ab(
+                cfg, scfg, label, records,
+                source=source,
+                time_scale=args.time_scale,
+                out_prefix=args.qos_ab_out,
+                # Same shape as the elastic gate: the flash crowd is
+                # exactly the contention QoS must arbitrate.
                 gate="flash-crowd" in source,
             )
             return 0

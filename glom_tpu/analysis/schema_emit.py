@@ -64,6 +64,12 @@ _FALLBACK_TRACE_EVENTS = (
 )
 _TRACE_KEYS = ("trace_id", "trace_ids")
 
+# Serve events that are TENANT-scoped and must stamp the SLO class on
+# every v11 record (null = classless is fine, absent is not — the same
+# presence discipline as the trace keys).
+_FALLBACK_CLASS_EVENTS = ("admit", "shed", "settle", "resolve")
+_CLASS_KEY = "slo_class"
+
 
 @lru_cache(maxsize=1)
 def _load_trace_events() -> tuple:
@@ -73,6 +79,16 @@ def _load_trace_events() -> tuple:
         return tuple(TRACE_REQUIRED_EVENTS)
     except Exception:
         return _FALLBACK_TRACE_EVENTS
+
+
+@lru_cache(maxsize=1)
+def _load_class_events() -> tuple:
+    try:
+        from glom_tpu.telemetry.schema import CLASS_REQUIRED_EVENTS
+
+        return tuple(CLASS_REQUIRED_EVENTS)
+    except Exception:
+        return _FALLBACK_CLASS_EVENTS
 
 
 def _load_kinds(ctx: Context) -> Set[str]:
@@ -203,6 +219,26 @@ class SchemaEmit(Checker):
                         "it (telemetry/tracectx.py; null = explicitly "
                         "untraced is fine, absent is not)",
                         "trace-context",
+                    )
+                if (
+                    kind_value in (None, "serve")
+                    and isinstance(ev, ast.Constant)
+                    and ev.value in _load_class_events()
+                    and not any(k is None for k in record.keys)  # **splat
+                    and not self._has_key(record, _CLASS_KEY)
+                ):
+                    # The schema-v11 QoS contract, same discipline as the
+                    # trace keys: a tenant-scoped serve event literal that
+                    # stamps no slo_class (nor merges one via **splat)
+                    # writes records no per-class rollup, weighted-regret
+                    # audit, or class-scoped SLO rule can ever attribute.
+                    add(
+                        ev,
+                        f"serve event {ev.value!r} record stamps no "
+                        f"{_CLASS_KEY} — schema v11 requires tenant-scoped "
+                        "serve records to carry it (serve/qos.py; null = "
+                        "classless is fine, absent is not)",
+                        "class-context",
                     )
                 if self._has_key(record, "error"):
                     value = self._value_of(record, "value")

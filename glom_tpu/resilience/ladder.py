@@ -45,6 +45,34 @@ RUNGS = ("normal", "capped_iters", "bucket_cap", "shed")
 NORMAL, CAPPED_ITERS, BUCKET_CAP, SHED = range(4)
 
 
+def class_rungs(shed_position: int, n_classes: int) -> tuple:
+    """(degrade_rung, shed_rung) for the SLO class at `shed_position` of
+    `n_classes` in the shed order (glom_tpu/serve/qos.py; 0 = first to
+    shed). The ladder itself stays ONE shared pressure signal — classes
+    differ in WHICH rung starts costing them:
+
+      * the FIRST class in the shed order (the batch end) sheds a rung
+        EARLY (bucket_cap instead of shed): under pressure the fleet
+        drops its cheapest tenant before anything else degrades hard;
+      * the LAST class (the premium end) HOLDS its full route until
+        bucket_cap (one rung past everyone else's capped_iters) and
+        sheds only at the ladder's own floor;
+      * everything between degrades at capped_iters and sheds at shed —
+        the classless semantics, unchanged.
+
+    One class (or a classless config) degrades/sheds exactly like PR 18:
+    (capped_iters, shed)."""
+    if not 0 <= shed_position < n_classes:
+        raise ValueError(
+            f"shed_position {shed_position} outside 0..{n_classes - 1}"
+        )
+    if n_classes <= 1:
+        return (CAPPED_ITERS, SHED)
+    degrade = BUCKET_CAP if shed_position == n_classes - 1 else CAPPED_ITERS
+    shed = BUCKET_CAP if shed_position == 0 else SHED
+    return (degrade, shed)
+
+
 class DegradationLadder:
     """Pressure/flap-driven serving mode, one reversible rung at a time."""
 

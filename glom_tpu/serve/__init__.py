@@ -27,6 +27,11 @@ between that checkpoint and traffic (docs/SERVING.md). Layers:
                  that spawns fully-warmed replicas at runtime and
                  gracefully drains them back out (capacity follows load
                  — docs/SERVING.md "Elastic serving")
+    qos        — SLOClass / QosSpec / ClassQueues: named SLO classes
+                 with deficit-weighted-fair admission (strict priority
+                 bounded by a starvation floor), class-aware
+                 degradation/shed, and per-class telemetry
+                 (docs/SERVING.md "SLO classes")
     early_exit — glom_forward_auto / glom_forward_tiered: lax.while_loop
                  over column updates with the consensus-agreement delta
                  as the stopping witness (iters="auto"; the tiered form
@@ -53,6 +58,12 @@ _EXPORTS = {
     "ElasticPolicy": "elastic",
     "SCALE_EVENTS": "elastic",
     "resolve_policy": "elastic",
+    "ClassQueues": "qos",
+    "QosSpec": "qos",
+    "SLOClass": "qos",
+    "class_slo_rules": "qos",
+    "parse_slo_class": "qos",
+    "resolve_slo_classes": "qos",
     "ColumnCache": "column_cache",
     "PageHit": "column_cache",
     "column_state_bytes": "column_cache",
@@ -74,7 +85,7 @@ _EXPORTS = {
     "stamp_serve": "events",
 }
 _SUBMODULES = ("batcher", "cli", "column_cache", "early_exit", "elastic",
-               "engine", "events", "paged_columns")
+               "engine", "events", "paged_columns", "qos", "workload")
 
 __all__ = sorted([*_EXPORTS, *_SUBMODULES])
 

@@ -158,10 +158,16 @@ class Ticket:
     resolve, `hops` and `dispatch_ms` carry the served totals the trace
     tree's conservation check reconciles against."""
 
-    def __init__(self, request_id, trace_id=None, span_id=None):
+    def __init__(self, request_id, trace_id=None, span_id=None,
+                 slo_class=None):
         self.request_id = request_id
         self.trace_id = trace_id
         self.span_id = span_id
+        # The request's SLO class (glom_tpu/serve/qos.py; None =
+        # unclassed / classless config): stamped on every record this
+        # request leaves — admit, shed, settle, resolve — so per-tenant
+        # conservation reconciles from the stream alone (schema v11).
+        self.slo_class = slo_class
         self.hops: Optional[int] = None
         self.dispatch_ms: Optional[float] = None
         self._done = threading.Event()
@@ -242,6 +248,7 @@ class _Item:
         "img", "ticket", "session", "levels", "executed", "hops",
         "redispatches", "warm_src", "parent_span", "dispatch_ms",
         "n_patches", "pages", "patches", "t_enq", "phase_ms",
+        "slo_class",
     )
 
     def __init__(
@@ -272,6 +279,10 @@ class _Item:
         # in hop order) — the resolve leaf's phase_ms_total, conserved
         # bit-exactly by `telemetry trace` (tracectx.PHASE_KEYS).
         self.phase_ms: dict = {}
+        # The ticket's SLO class, mirrored on the item so the class
+        # scheduler routes requeues/continuations without touching the
+        # ticket (glom_tpu/serve/qos.py; None = classless).
+        self.slo_class = ticket.slo_class
 
 
 def _backend_down() -> bool:
@@ -476,7 +487,22 @@ class DynamicBatcher:
                 self._ladders[name] = None
         self.ladder = self._ladders[self._ename(self.engines[0], 0)]
         self._clock = clock
-        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        # Multi-tenant QoS (glom_tpu/serve/qos.py, docs/SERVING.md "SLO
+        # classes"): a ServeConfig that declares slo_classes swaps the
+        # shared FIFO for the deficit-weighted-fair class scheduler —
+        # per-class BOUNDED lanes behind the same queue.Queue facade
+        # (get/get_nowait/put_nowait/qsize/empty/maxsize), so every
+        # gather/requeue/drain path below reads one queue either way. A
+        # classless config keeps the plain queue.Queue byte-for-byte
+        # (the bit-parity pin, tests/test_qos.py).
+        self._qos = None
+        if scfg is not None and getattr(scfg, "slo_classes", None):
+            from glom_tpu.serve.qos import ClassQueues, resolve_slo_classes
+
+            self._qos = resolve_slo_classes(scfg)
+            self._q = ClassQueues(self._qos, default_depth=depth)
+        else:
+            self._q: queue.Queue = queue.Queue(maxsize=depth)
         # SESSION-AFFINITY queues (pages mode): one per engine. A stream
         # whose pages live in engine E's pool routes to E's queue — its
         # worker drains it ahead of the shared queue, so the warm path
@@ -527,6 +553,13 @@ class DynamicBatcher:
         self.n_affinity = 0   # requests routed by session affinity
         self.n_page_warm = 0  # rows warm-started from pool pages
         self.n_incremental = 0  # rows served on the incremental route
+        # Per-SLO-class conservation counters (ISSUE 19: the aggregate
+        # n_shed told one story for every tenant): lazily keyed by the
+        # class names actually seen, each holding the same
+        # served/shed/failed/degraded ledger, so conservation reconciles
+        # PER TENANT (n_served + n_shed + n_failed == n_requests within
+        # every class). Guarded by _counter_lock like its siblings.
+        self._class_counts: dict = {}
         # Pad-tax rollup (ISSUE 11 satellite): per-dispatch pad_fraction
         # was stamped since PR 4 but never aggregated — the summary now
         # carries the mean plus the BYTES the padding wasted (pad token
@@ -697,6 +730,7 @@ class DynamicBatcher:
             for item in got:
                 with self._counter_lock:
                     self.n_failed += 1
+                    self._bump_class_locked(item.ticket.slo_class, "n_failed")
                 item.ticket._fail(ShedError("batcher stopped"))
 
     def __enter__(self) -> "DynamicBatcher":
@@ -799,8 +833,24 @@ class DynamicBatcher:
                     if ticket._latency_s is not None else None
                 ),
                 "trace_id": ticket.trace_id,
+                "slo_class": ticket.slo_class,
             }
         )
+
+    def _bump_class_locked(self, slo_class, key: str, n: int = 1) -> None:
+        """Advance one per-class conservation counter. Caller HOLDS
+        _counter_lock (the sites all sit inside existing counter-lock
+        blocks; taking it here would deadlock — threading.Lock is not
+        reentrant). Unclassed requests (None) stay aggregate-only."""
+        if slo_class is None:
+            return
+        c = self._class_counts.get(slo_class)
+        if c is None:
+            c = self._class_counts[slo_class] = {
+                "n_requests": 0, "n_served": 0, "n_shed": 0,
+                "n_failed": 0, "n_degraded": 0,
+            }
+        c[key] += n
 
     def attach_elastic(self, scaler) -> None:
         """Attach the Autoscaler whose rollup summary_record() nests
@@ -809,7 +859,7 @@ class DynamicBatcher:
         with self._counter_lock:
             self._elastic = scaler
 
-    def submit(self, img, session_id=None) -> Ticket:
+    def submit(self, img, session_id=None, slo_class=None) -> Ticket:
         """Enqueue one [c, H, W] request. Sheds immediately (raises) when
         the queue is full, the backend is down, every engine is dead, or
         every live engine's degradation ladder is on its shed rung —
@@ -823,11 +873,26 @@ class DynamicBatcher:
         column state when one is resident (serve/column_cache.py), and
         on resolve the converged columns are written back under the key
         for the stream's next frame. None (the default) is the
-        stateless cold path, bit-for-bit the pre-streaming contract."""
+        stateless cold path, bit-for-bit the pre-streaming contract.
+
+        `slo_class` names the request's SLO class (glom_tpu/serve/qos.py,
+        docs/SERVING.md "SLO classes"): under a ServeConfig declaring
+        slo_classes it routes admission through the class's bounded lane
+        and the weighted-fair pick (None takes the default class; an
+        UNDECLARED name raises ValueError before any counter moves). A
+        classless config stamps the label on the request's records as
+        pure observability — scheduling stays byte-for-byte FIFO."""
+        if self._qos is not None:
+            # Resolve BEFORE any counter or event: an unknown class is a
+            # caller bug, not traffic — it must not dent conservation.
+            slo_class = self._qos.resolve(slo_class)
+        elif slo_class is not None:
+            slo_class = str(slo_class)
         with self._counter_lock:
             self._seq += 1
             rid = self._seq
             self.n_requests += 1
+            self._bump_class_locked(slo_class, "n_requests")
         # Mint the request's trace context HERE, at admission: trace_id
         # names the causal tree, span_id is the submit root every
         # first-hop record parents to (telemetry/tracectx.py). Tracing
@@ -837,9 +902,10 @@ class DynamicBatcher:
                 rid,
                 trace_id=tracectx.new_trace_id(),
                 span_id=tracectx.new_span_id(),
+                slo_class=slo_class,
             )
         else:
-            ticket = Ticket(rid)
+            ticket = Ticket(rid, slo_class=slo_class)
         if self._admit_events:
             # The workload observatory's arrival record: emitted BEFORE
             # the shed checks — a shed request was offered traffic, and
@@ -855,6 +921,7 @@ class DynamicBatcher:
                     "shape": [int(d) for d in np.shape(img)],
                     "session": session_id,
                     "trace_id": ticket.trace_id,
+                    "slo_class": slo_class,
                 }
             )
         with span("serve_enqueue", aggregator=self.spans):
@@ -887,13 +954,24 @@ class DynamicBatcher:
             if live_ladders:
                 from glom_tpu.resilience.ladder import SHED
 
-                if min(l.rung() for l in live_ladders) >= SHED:
+                # Class-aware shed gate (glom_tpu/serve/qos.py): the
+                # first class in the shed order sheds a rung EARLY, the
+                # premium end holds until the ladder's own floor — load
+                # drops tenant-by-tenant. Classless keeps the SHED gate.
+                shed_gate = SHED
+                if self._qos is not None:
+                    shed_gate = self._qos.shed_rung(slo_class)
+                if min(l.rung() for l in live_ladders) >= shed_gate:
                     detail = dict(self._pressure(), trace_id=ticket.trace_id)
                     self._shed(ticket, "ladder-shed", **detail)
+                    cls_note = (
+                        f" for class {slo_class!r}"
+                        if self._qos is not None else ""
+                    )
                     raise LadderShedError(
-                        "degradation ladder at its shed rung on every "
-                        "live engine (every cheaper serving mode "
-                        "exhausted); retry later",
+                        f"degradation ladder at its shed rung{cls_note} "
+                        "on every live engine (every cheaper serving "
+                        "mode exhausted); retry later",
                         **detail,
                     )
             img = np.asarray(img, np.float32)
@@ -1012,6 +1090,13 @@ class DynamicBatcher:
             "queue_capacity": self._q.maxsize,
             "continuations_queued": self._cont_q.qsize(),
         }
+        if self._qos is not None:
+            # Per-class lane pressure (glom_tpu/serve/qos.py): which
+            # tenant's lane is actually full — the aggregate depth alone
+            # reads one story for every class.
+            detail["class_depth"] = {
+                n: f["depth"] for n, f in self._q.class_fill().items()
+            }
         ladder = self._ladders.get(
             engine_name or self._ename(self.engines[0], 0)
         )
@@ -1022,7 +1107,9 @@ class DynamicBatcher:
     def _shed(self, ticket: Ticket, reason: str, **detail) -> None:
         with self._counter_lock:
             self.n_shed += 1
+            self._bump_class_locked(ticket.slo_class, "n_shed")
         detail.setdefault("trace_id", ticket.trace_id)
+        detail.setdefault("slo_class", ticket.slo_class)
         exc_type = {
             "backend-down": BackendDownError,
             "ladder-shed": LadderShedError,
@@ -1723,8 +1810,32 @@ class DynamicBatcher:
         )
         return cfg.levels * cfg.dim * itemsize
 
+    def _degrade_gate(self, batch) -> int:
+        """The ladder rung at which THIS batch's route degrades: the
+        most protected class present wins (qos.py class_rungs) — one
+        premium row holds the whole dispatch at its full route until
+        the ladder reaches premium's own degrade rung, while a
+        pure-batch dispatch degrades at the classless rung. Classless
+        configs: capped_iters, the pre-QoS semantics unchanged."""
+        from glom_tpu.resilience.ladder import CAPPED_ITERS
+
+        if self._qos is None:
+            return CAPPED_ITERS
+        return max(self._qos.degrade_rung(it.slo_class) for it in batch)
+
+    @staticmethod
+    def _class_rows(items) -> Optional[dict]:
+        """{slo_class: n_rows} over classed items — None when nothing
+        is classed, so classless records stay byte-identical."""
+        rows: dict = {}
+        for it in items:
+            if it.slo_class is not None:
+                rows[it.slo_class] = rows.get(it.slo_class, 0) + 1
+        return rows or None
+
     def _note_dispatch(self, engine_name: str, rec: dict, resolved: List[dict],
-                       n_served: int, n_degraded: int, n_continued: int) -> None:
+                       n_served: int, n_degraded: int, n_continued: int,
+                       class_served: Optional[dict] = None) -> None:
         """Per-engine + global bookkeeping for one successful dispatch,
         under BOTH locks in the documented order — the per-engine
         dispatch count and the conservation counters must be mutually
@@ -1736,6 +1847,13 @@ class DynamicBatcher:
             with self._counter_lock:
                 self.n_served += n_served
                 self.n_degraded += n_degraded
+                if class_served:
+                    # A degraded dispatch degrades EVERY row it resolves,
+                    # so per-class degraded rides the same row counts.
+                    for cls, k in class_served.items():
+                        self._bump_class_locked(cls, "n_served", k)
+                        if n_degraded:
+                            self._bump_class_locked(cls, "n_degraded", k)
                 self.n_continued += n_continued
                 self.n_page_warm += rec.get("n_page_warm") or 0
                 self.n_incremental += rec.get("n_incremental") or 0
@@ -1812,6 +1930,7 @@ class DynamicBatcher:
             if item.redispatches > self.max_redispatch:
                 with self._counter_lock:
                     self.n_failed += 1
+                    self._bump_class_locked(item.ticket.slo_class, "n_failed")
                 item.ticket._fail(
                     ShedError(
                         "redispatch budget exhausted "
@@ -1836,6 +1955,7 @@ class DynamicBatcher:
             except queue.Full:
                 with self._counter_lock:
                     self.n_failed += 1
+                    self._bump_class_locked(item.ticket.slo_class, "n_failed")
                 item.ticket._fail(
                     QueueFullError("requeue after engine failure: full")
                 )
@@ -1866,6 +1986,7 @@ class DynamicBatcher:
             except queue.Full:
                 with self._counter_lock:
                     self.n_failed += 1
+                    self._bump_class_locked(item.ticket.slo_class, "n_failed")
                 item.ticket._fail(
                     QueueFullError("affinity drain after engine death: full")
                 )
@@ -2083,6 +2204,8 @@ class DynamicBatcher:
             return
         with self._counter_lock:
             self.n_failed += len(batch)
+            for req in batch:
+                self._bump_class_locked(req.ticket.slo_class, "n_failed")
         for req in batch:
             req.ticket._fail(e)
         self._emit(
@@ -2118,11 +2241,11 @@ class DynamicBatcher:
         rung_name = None
         ladder = self._ladders.get(engine_name)
         if ladder is not None:
-            from glom_tpu.resilience.ladder import CAPPED_ITERS, RUNGS
+            from glom_tpu.resilience.ladder import RUNGS
 
             rung = ladder.rung()
             rung_name = RUNGS[rung]
-            if rung >= CAPPED_ITERS:
+            if rung >= self._degrade_gate(batch):
                 iters_override = ladder.degraded_iters
         scfg = getattr(engine, "scfg", None)
         budget = getattr(engine, "auto_budget", None)
@@ -2466,6 +2589,9 @@ class DynamicBatcher:
             rec["rung"] = rung_name
         if iters_override is not None:
             rec["iters_override"] = iters_override
+        cls_rows = self._class_rows(batch)
+        if cls_rows is not None:
+            rec["classes"] = cls_rows
         # The dispatch log is read by summary_record() from the CALLER's
         # thread while this worker appends — glom-lint's lockset checker
         # flagged the bare append (iteration during append is a crash, not
@@ -2476,6 +2602,7 @@ class DynamicBatcher:
             n_served=n_resolved,
             n_degraded=n_resolved if iters_override is not None else 0,
             n_continued=len(stragglers),
+            class_served=self._class_rows([t[0] for t in to_resolve]),
         )
         # Tickets resolve AFTER the counters: the instant result() returns
         # a caller may read summary_record(), and its conservation
@@ -2508,6 +2635,7 @@ class DynamicBatcher:
                         "hops": it.hops,
                         "redispatches": it.redispatches,
                         "latency_ms": round(1e3 * it.ticket._latency_s, 3),
+                        "slo_class": it.ticket.slo_class,
                         "trace_id": it.ticket.trace_id,
                         "span_id": tracectx.new_span_id(),
                         "parent_span": dspan,
@@ -2542,11 +2670,11 @@ class DynamicBatcher:
         rung_name = None
         ladder = self._ladders.get(engine_name)
         if ladder is not None:
-            from glom_tpu.resilience.ladder import CAPPED_ITERS, RUNGS
+            from glom_tpu.resilience.ladder import RUNGS
 
             rung = ladder.rung()
             rung_name = RUNGS[rung]
-            if rung >= CAPPED_ITERS:
+            if rung >= self._degrade_gate(batch):
                 iters_override = ladder.degraded_iters
         scfg = getattr(engine, "scfg", None)
         budget = getattr(engine, "auto_budget", None)
@@ -2773,11 +2901,15 @@ class DynamicBatcher:
             rec["rung"] = rung_name
         if iters_override is not None:
             rec["iters_override"] = iters_override
+        cls_rows = self._class_rows(batch)
+        if cls_rows is not None:
+            rec["classes"] = cls_rows
         self._note_dispatch(
             engine_name, rec, resolved,
             n_served=n_resolved,
             n_degraded=n_resolved if iters_override is not None else 0,
             n_continued=len(stragglers),
+            class_served=self._class_rows([t[0] for t in to_resolve]),
         )
         for it, row_levels, iters in to_resolve:
             it.ticket._resolve(
@@ -2799,6 +2931,7 @@ class DynamicBatcher:
                         "hops": it.hops,
                         "redispatches": it.redispatches,
                         "latency_ms": round(1e3 * it.ticket._latency_s, 3),
+                        "slo_class": it.ticket.slo_class,
                         "trace_id": it.ticket.trace_id,
                         "span_id": tracectx.new_span_id(),
                         "parent_span": dspan,
@@ -2925,24 +3058,32 @@ class DynamicBatcher:
                 else "ok" if alive
                 else "dead"
             )
-            out.append(
-                schema.stamp(
-                    {
-                        "engine": name,
-                        "alive": alive,
-                        "state": state,
-                        "headroom": headroom,
-                        "utilization": utilization,
-                        "service_rate_rps": service_rate,
-                        "queue_fill": queue_fill,
-                        "continuation_fill": cont_fill,
-                        "affinity_fill": aff_fill,
-                        "pool_fill": pool_fill,
-                        "n_dispatches": len(own),
-                    },
-                    kind="capacity",
-                )
-            )
+            cap_rec = {
+                "engine": name,
+                "alive": alive,
+                "state": state,
+                "headroom": headroom,
+                "utilization": utilization,
+                "service_rate_rps": service_rate,
+                "queue_fill": queue_fill,
+                "continuation_fill": cont_fill,
+                "affinity_fill": aff_fill,
+                "pool_fill": pool_fill,
+                "n_dispatches": len(own),
+            }
+            if self._qos is not None:
+                # Per-class LANE fill (qos.py ClassQueues): the elastic
+                # loop needs to see WHICH tenant's lane is saturating —
+                # aggregate queue_fill hides a full premium lane behind
+                # an empty batch lane. Classless records keep the exact
+                # pre-QoS shape (no key).
+                cap_rec["class_fill"] = {
+                    cn: round(
+                        min(1.0, f["depth"] / max(1, f["capacity"])), 4
+                    )
+                    for cn, f in self._q.class_fill().items()
+                }
+            out.append(schema.stamp(cap_rec, kind="capacity"))
         return out
 
     def summary_record(self) -> dict:
@@ -2992,6 +3133,9 @@ class DynamicBatcher:
                 pad_bytes_wasted = self._pad_bytes_wasted
                 levels0_h2d_bytes = self._levels0_h2d_bytes
                 phase_sums = dict(self._phase_sums)
+                class_counts = {
+                    c: dict(v) for c, v in self._class_counts.items()
+                }
             husks_retired = dict(self._husks_retired)
         rec = {
             "event": "summary",
@@ -3033,6 +3177,24 @@ class DynamicBatcher:
             ) if n_served else None,
             "engines": engines,
         }
+        if class_counts or self._qos is not None:
+            # Per-tenant conservation (ISSUE 19): each class's counters
+            # must reconcile on their own — n_served + n_shed + n_failed
+            # == n_requests PER CLASS, not just in aggregate. Classless
+            # runs add no key (bit-parity with the pre-QoS summary).
+            classes = {}
+            for cls in sorted(class_counts):
+                cnt = dict(class_counts[cls])
+                cnt["served_fraction"] = (
+                    round(cnt["n_served"] / cnt["n_requests"], 4)
+                    if cnt["n_requests"] else None
+                )
+                classes[cls] = cnt
+            rec["classes"] = classes
+            if self._qos is not None:
+                # The admission scheduler's own evidence: pick counts,
+                # floor preemptions, per-lane rejections.
+                rec["class_scheduler"] = self._q.record()
         if husks_retired.get("n"):
             # Retention trimmed the engines nest: the folded counters
             # keep the books whole (global dispatch totals == the nest's

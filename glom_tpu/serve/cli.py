@@ -261,6 +261,33 @@ def build_parser() -> argparse.ArgumentParser:
         "with its owning decision_id",
     )
     p.add_argument(
+        "--slo-class", action="append", default=None, metavar="SPEC",
+        dest="slo_class",
+        help="declare one SLO class (repeatable): "
+        "'name:weight=W,p99_ms=MS,shed_rate=R,queue_depth=N' — e.g. "
+        "--slo-class premium:weight=8,p99_ms=150 --slo-class batch:"
+        "weight=1. Declaring classes arms the weighted-fair admission "
+        "scheduler, class-aware degradation/shed, and per-class "
+        "telemetry (serve/qos.py, docs/SERVING.md 'SLO classes')",
+    )
+    p.add_argument(
+        "--slo-default-class", default=None, metavar="NAME",
+        help="class for unclassed submits (default: 'standard' when "
+        "declared, else the highest-weight class)",
+    )
+    p.add_argument(
+        "--slo-shed-order", default=None, metavar="C1,C2,...",
+        help="override the shed order (first = first to shed/degrade; "
+        "must be a permutation of the declared classes; default: "
+        "ascending weight)",
+    )
+    p.add_argument(
+        "--slo-starvation-floor", type=float, default=None, metavar="F",
+        help="guaranteed served fraction per non-top class under strict "
+        "priority (default 0.05): each backlogged lower class banks F "
+        "credit per pick and preempts at a whole owed pick",
+    )
+    p.add_argument(
         "--husk-max", type=int, default=None, metavar="N",
         help="elastic: retain at most N drained-engine evidence husks "
         "in the summary (oldest retire into a stamped "
@@ -424,6 +451,16 @@ def main(argv=None) -> int:
             overrides[field] = v
     if args.elastic_anticipatory:
         overrides["elastic_anticipatory"] = True
+    if args.slo_class:
+        overrides["slo_classes"] = tuple(args.slo_class)
+    if args.slo_default_class is not None:
+        overrides["slo_default_class"] = args.slo_default_class
+    if args.slo_shed_order is not None:
+        overrides["slo_shed_order"] = tuple(
+            c.strip() for c in args.slo_shed_order.split(",") if c.strip()
+        )
+    if args.slo_starvation_floor is not None:
+        overrides["slo_starvation_floor"] = args.slo_starvation_floor
     if overrides:
         scfg = dataclasses.replace(scfg, **overrides)
     if args.engines < 1:
@@ -613,6 +650,19 @@ def main(argv=None) -> int:
                     rules["p99_ms"] = scfg.elastic_p99_ms
                 if scfg.elastic_shed_rate is not None:
                     rules["shed_rate"] = scfg.elastic_shed_rate
+                if scfg.slo_classes:
+                    # Each class's declared targets become class-scoped
+                    # monitor rules ("p99_ms[premium]"); low-class
+                    # breaches are recorded but non-binding — the
+                    # policy's low_classes filter (serve/qos.py).
+                    from glom_tpu.serve.qos import (
+                        class_slo_rules,
+                        resolve_slo_classes,
+                    )
+
+                    spec = resolve_slo_classes(scfg)
+                    if spec is not None:
+                        rules.update(class_slo_rules(spec))
                 scaler = Autoscaler(
                     batcher, engine_factory,
                     policy=resolve_policy(scfg),
@@ -634,6 +684,9 @@ def main(argv=None) -> int:
                             (rid, batcher.submit(
                                 wl.synth_input(rec, i),
                                 session_id=rec.get("session"),
+                                # v11: re-offer the recorded tenant class
+                                # (null = classless, exactly as captured).
+                                slo_class=rec.get("slo_class"),
                             ))
                         )
                     except ShedError as e:

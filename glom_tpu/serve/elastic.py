@@ -152,6 +152,8 @@ class ElasticPolicy:
         window_s: float = 10.0,
         anticipatory: bool = False,
         target_utilization: float = 0.8,
+        low_classes=frozenset(),
+        class_weights: Optional[Dict[str, float]] = None,
         clock=time.monotonic,
     ):
         if min_engines < 1:
@@ -185,6 +187,17 @@ class ElasticPolicy:
         self.window_s = window_s
         self.anticipatory = bool(anticipatory)
         self.target_utilization = float(target_utilization)
+        # QoS (glom_tpu/serve/qos.py): breaches of rules scoped to a
+        # LOW class (e.g. "p99_ms[batch]") are recorded but NON-BINDING
+        # — they neither force scale-out nor veto an earned scale-in.
+        # Cheap-tenant pressure alone never spends hardware; the weights
+        # ride the evidence bundle so the audit can score class-weighted
+        # regret. Empty/None = classless semantics bit-for-bit.
+        self.low_classes = frozenset(str(c) for c in (low_classes or ()))
+        self.class_weights = (
+            {str(k): float(v) for k, v in class_weights.items()}
+            if class_weights else None
+        )
         self._clock = clock
         self._samples: deque = deque()   # (t, worst eligible headroom)
         self._breaches: deque = deque()  # (t, rule)
@@ -299,7 +312,7 @@ class ElasticPolicy:
                 "trend_per_s": self._forecast.get("trend_per_s"),
                 "t": self._forecast.get("t"),
             }
-        return {
+        ev = {
             "n_engines": int(n_engines),
             "min_engines": self.min_engines,
             "max_engines": self.max_engines,
@@ -326,6 +339,18 @@ class ElasticPolicy:
                 if self._service_rate_rps is not None else None
             ),
         }
+        if self.low_classes:
+            # Stamped ONLY when SLO classes are declared: a classless
+            # fleet's evidence bundle stays byte-identical to v10. The
+            # pure policy function reads "low_classes" to drop
+            # non-binding breaches; "class_weights" is audit-side
+            # evidence for the weighted regret score.
+            ev["low_classes"] = sorted(self.low_classes)
+            if self.class_weights is not None:
+                ev["class_weights"] = dict(
+                    sorted(self.class_weights.items())
+                )
+        return ev
 
     def decide(self, n_engines: int) -> Optional[dict]:
         """The next fleet action at the current signals, or None. Clamped
@@ -337,7 +362,7 @@ class ElasticPolicy:
         verbatim when the anticipatory inputs are absent or unmatured,
         and the audit CLI replays the same function on the JSONL."""
         from glom_tpu.telemetry.audit import (
-            anticipated_deficit, policy_action,
+            anticipated_deficit, binding_breaches, policy_action,
         )
 
         now = self._clock()
@@ -352,7 +377,9 @@ class ElasticPolicy:
         if action is None:
             return None
         if action == "scale_out":
-            breaches = ev["breaches"]
+            # The trigger rule names a BINDING breach: a low-class
+            # breach cannot be the reason a decision spent hardware.
+            breaches = binding_breaches(ev)
             below = (
                 ev["below_held_s"] is not None
                 and ev["below_held_s"] >= self.dwell_s
@@ -400,7 +427,19 @@ class ElasticPolicy:
 
 
 def resolve_policy(scfg, *, clock=time.monotonic) -> ElasticPolicy:
-    """The one ServeConfig -> policy resolution (the ladder pattern)."""
+    """The one ServeConfig -> policy resolution (the ladder pattern).
+    Declared SLO classes arm the QoS extension: the first class in the
+    shed order becomes non-binding for elastic decisions and the class
+    weights ride every evidence bundle."""
+    low_classes: frozenset = frozenset()
+    class_weights = None
+    if getattr(scfg, "slo_classes", None):
+        from glom_tpu.serve.qos import resolve_slo_classes
+
+        spec = resolve_slo_classes(scfg)
+        if spec is not None:
+            low_classes = spec.low_classes()
+            class_weights = spec.weights()
     return ElasticPolicy(
         min_engines=scfg.min_engines,
         max_engines=scfg.max_engines,
@@ -413,6 +452,8 @@ def resolve_policy(scfg, *, clock=time.monotonic) -> ElasticPolicy:
         target_utilization=getattr(
             scfg, "elastic_target_utilization", 0.8
         ),
+        low_classes=low_classes,
+        class_weights=class_weights,
         clock=clock,
     )
 
@@ -675,6 +716,8 @@ class Autoscaler:
         record — the evidence bundle, the action the pure policy
         function derived from it, and the chain link to the previous
         decision. Every actuation event that follows carries this id."""
+        from glom_tpu.telemetry.audit import binding_breaches
+
         with self._lock:
             self._decision_seq += 1
             decision_id = self._decision_seq
@@ -684,7 +727,7 @@ class Autoscaler:
             if (
                 action == "scale_out"
                 and isinstance(evidence, dict)
-                and evidence.get("breaches")
+                and binding_breaches(evidence)
             ):
                 # Scaled AFTER the SLO already broke — the reactive
                 # failure mode the anticipatory signal exists to avoid.

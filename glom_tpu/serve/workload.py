@@ -93,6 +93,7 @@ class WorkloadRecorder:
                     "signature": rec.get("signature"),
                     "shape": rec.get("shape"),
                     "session": rec.get("session"),
+                    "slo_class": rec.get("slo_class"),
                     "outcome": "unresolved",
                 }
             elif event == "shed":
@@ -133,6 +134,10 @@ class WorkloadRecorder:
                     "seed": i,
                     "session": e["session"],
                     "shape": e["shape"],
+                    # v11: the class key is PRESENT on every workload
+                    # record (null = classless) so a replay re-offers
+                    # each request under ITS tenant.
+                    "slo_class": e.get("slo_class"),
                 }
                 if e.get("reason") is not None:
                     rec["reason"] = e["reason"]
@@ -310,6 +315,58 @@ def replay(
 # -- scenario generators (pure stdlib) -------------------------------------
 
 
+def parse_class_mix(spec) -> Optional[dict]:
+    """'premium=0.2,batch=0.5' -> {"premium": 0.2, "batch": 0.5}: the
+    --class-mix knob. Fractions are per-class probabilities; they must
+    sum to <= 1 and the remainder is UNCLASSED traffic (slo_class null
+    — the server's default class catches it). None/empty spec = a
+    classless scenario, byte-identical to the pre-v11 generators."""
+    if spec is None or (isinstance(spec, str) and not spec.strip()):
+        return None
+    if isinstance(spec, dict):
+        mix = {str(k): float(v) for k, v in spec.items()}
+    else:
+        mix = {}
+        for part in str(spec).split(","):
+            name, eq, val = part.partition("=")
+            name = name.strip()
+            if not name or not eq:
+                raise ValueError(
+                    f"class mix entry {part!r}: expected NAME=FRACTION"
+                )
+            try:
+                mix[name] = mix.get(name, 0.0) + float(val)
+            except ValueError:
+                raise ValueError(
+                    f"class mix entry {part!r}: fraction {val!r} is not "
+                    "a number"
+                ) from None
+    for name, f in mix.items():
+        if not 0.0 <= f <= 1.0:
+            raise ValueError(
+                f"class mix {name}={f}: fraction must be in [0, 1]"
+            )
+    if sum(mix.values()) > 1.0 + 1e-9:
+        raise ValueError(
+            f"class mix fractions sum to {sum(mix.values()):.4f} > 1"
+        )
+    return mix
+
+
+def _deal_class(class_mix: Optional[dict], rng: random.Random):
+    """One deterministic class draw (sorted names, cumulative walk) —
+    None both for classless scenarios and for the unclassed remainder."""
+    if not class_mix:
+        return None
+    u = rng.random()
+    acc = 0.0
+    for name in sorted(class_mix):
+        acc += class_mix[name]
+        if u < acc:
+            return name
+    return None
+
+
 def _signature_for(
     shape: Tuple[int, ...],
     session: Optional[str],
@@ -363,11 +420,14 @@ def _materialize(
     patch_size: Optional[int],
     page_tokens: Optional[int],
     keep: Callable[[float, Optional[str]], bool] = lambda t, s: True,
+    class_mix: Optional[dict] = None,
 ) -> List[dict]:
     """Arrival times -> stamped "workload" records: sessions dealt
     round-robin (the serve CLI's stream convention), shapes drawn per
-    request (mixed-resolution ragged traffic needs more than one), and
-    a keep() predicate for scenarios that silence part of the traffic."""
+    request (mixed-resolution ragged traffic needs more than one),
+    SLO classes dealt per the --class-mix fractions (parse_class_mix;
+    the unclassed remainder stays null), and a keep() predicate for
+    scenarios that silence part of the traffic."""
     out: List[dict] = []
     i = 0
     for t in ts:
@@ -375,6 +435,7 @@ def _materialize(
         shape = shapes[rng.randrange(len(shapes))] if len(shapes) > 1 else (
             shapes[0]
         )
+        slo_class = _deal_class(class_mix, rng)
         i += 1
         if not keep(t, session):
             continue
@@ -390,6 +451,7 @@ def _materialize(
                     "seed": len(out),
                     "session": session,
                     "shape": list(shape),
+                    "slo_class": slo_class,
                 },
                 kind="workload",
             )
@@ -409,6 +471,7 @@ def gen_diurnal(
     mode: str = "bucket",
     patch_size: Optional[int] = None,
     page_tokens: Optional[int] = None,
+    class_mix: Optional[dict] = None,
 ) -> List[dict]:
     """The daily curve, compressed: arrival rate swings sinusoidally
     base -> peak -> base over period_s (default: the whole duration is
@@ -427,6 +490,7 @@ def gen_diurnal(
     return _materialize(
         ts, streams=streams, shapes=shapes, mode=mode, rng=rng,
         patch_size=patch_size, page_tokens=page_tokens,
+        class_mix=parse_class_mix(class_mix),
     )
 
 
@@ -443,6 +507,7 @@ def gen_flash_crowd(
     mode: str = "bucket",
     patch_size: Optional[int] = None,
     page_tokens: Optional[int] = None,
+    class_mix: Optional[dict] = None,
 ) -> List[dict]:
     """The step the autoscaler dreads: steady base load, then a crowd
     arrives all at once for crowd_s seconds (default: the middle third
@@ -461,6 +526,7 @@ def gen_flash_crowd(
     return _materialize(
         ts, streams=streams, shapes=shapes, mode=mode, rng=rng,
         patch_size=patch_size, page_tokens=page_tokens,
+        class_mix=parse_class_mix(class_mix),
     )
 
 
@@ -476,6 +542,7 @@ def gen_rolling_outage(
     mode: str = "bucket",
     patch_size: Optional[int] = None,
     page_tokens: Optional[int] = None,
+    class_mix: Optional[dict] = None,
 ) -> List[dict]:
     """A partial outage ROLLS across the stream population: each session
     group goes dark for its own slice of the outage window (group k
@@ -501,6 +568,7 @@ def gen_rolling_outage(
     return _materialize(
         ts, streams=streams, shapes=shapes, mode=mode, rng=rng,
         patch_size=patch_size, page_tokens=page_tokens, keep=keep,
+        class_mix=parse_class_mix(class_mix),
     )
 
 

@@ -234,6 +234,8 @@ def rollup(host_records: "OrderedDict[str, List[dict]]") -> dict:
     n_resolved = n_shed = n_responses = n_failed_responses = 0
     cache_totals: Dict[str, int] = {}
     seen_cache = False
+    class_totals: Dict[str, Dict[str, int]] = {}
+    class_ms: Dict[str, List[float]] = {}
     failover_timeline: List[dict] = []
     ladder_timeline: List[dict] = []
     barrier_rounds: Dict[str, Dict[str, List[dict]]] = {}
@@ -326,6 +328,9 @@ def rollup(host_records: "OrderedDict[str, List[dict]]") -> dict:
                 ms = rec.get("latency_ms")
                 if isinstance(ms, (int, float)):
                     request_ms.append(float(ms))
+                    cls = rec.get("slo_class")
+                    if isinstance(cls, str):
+                        class_ms.setdefault(cls, []).append(float(ms))
                 it = rec.get("iters_total")
                 if isinstance(it, (int, float)):
                     iters_hist[str(int(it))] = (
@@ -375,6 +380,20 @@ def rollup(host_records: "OrderedDict[str, List[dict]]") -> dict:
                     v = cc.get(k)
                     if isinstance(v, int):
                         cache_totals[k] = cache_totals.get(k, 0) + v
+            classes = last_summary.get("classes")
+            if isinstance(classes, dict):
+                # Per-SLO-class pod rollup (schema v11, serve/qos.py):
+                # each host's summary carries its per-tenant
+                # conservation counters — sum them across the pod.
+                for cls, cnt in classes.items():
+                    if not isinstance(cnt, dict):
+                        continue
+                    tot = class_totals.setdefault(str(cls), {})
+                    for k in ("n_requests", "n_served", "n_shed",
+                              "n_failed", "n_degraded"):
+                        v = cnt.get(k)
+                        if isinstance(v, int):
+                            tot[k] = tot.get(k, 0) + v
     for h in per_host.values():
         h["dispatch_latency_ms"] = _pcts(h.pop("dispatch_ms"))
     for eng in per_engine.values():
@@ -391,6 +410,17 @@ def rollup(host_records: "OrderedDict[str, List[dict]]") -> dict:
     served_or_shed = max(n_resolved, n_ok_responses) + n_shed
     if not request_ms:
         request_ms = response_ms
+    per_class = None
+    if class_totals or class_ms:
+        per_class = {}
+        for cls in sorted(set(class_totals) | set(class_ms)):
+            tot = dict(class_totals.get(cls, {}))
+            req = tot.get("n_requests", 0)
+            tot["served_fraction"] = (
+                round(tot.get("n_served", 0) / req, 4) if req else None
+            )
+            tot["latency_ms"] = _pcts(class_ms.get(cls, []))
+            per_class[cls] = tot
     cache = None
     if seen_cache:
         looked = cache_totals.get("n_hits", 0) + cache_totals.get(
@@ -429,6 +459,7 @@ def rollup(host_records: "OrderedDict[str, List[dict]]") -> dict:
         "per_host": per_host,
         "per_engine": per_engine,
         "per_bucket": per_bucket,
+        "per_class": per_class,
         "cache": cache,
         "decisions": decision_fleets or None,
         "timelines": {
@@ -496,16 +527,47 @@ SLO_RULES = {
 # Rules where LESS is the emergency: observed < threshold breaches.
 SLO_LOWER_BOUND_RULES = frozenset({"headroom"})
 
+# Rules that accept an SLO-class scope — "p99_ms[premium]=40" windows
+# ONLY premium's requests (schema v11, serve/qos.py). Per-request rules
+# only: headroom and forecast_abs_err are fleet-level signals with no
+# per-tenant meaning.
+CLASS_SCOPED_RULES = frozenset(
+    {"p50_ms", "p95_ms", "p99_ms", "mean_ms", "shed_rate",
+     "failure_rate", "mean_iters"}
+)
+
+
+def split_slo_rule(name: str) -> Tuple[str, Optional[str]]:
+    """'p99_ms[premium]' -> ('p99_ms', 'premium'); unscoped names ->
+    (name, None). Loud on a malformed scope — '[' with no closing
+    bracket or an empty class is a typo, not a rule."""
+    base, sep, rest = name.partition("[")
+    if not sep:
+        return name, None
+    if not rest.endswith("]") or not rest[:-1].strip():
+        raise ValueError(
+            f"SLO rule {name!r}: class scope must be RULE[CLASS]"
+        )
+    return base, rest[:-1].strip()
+
 
 def parse_slo(spec: str) -> Tuple[str, float]:
-    """'p99_ms=50' -> ('p99_ms', 50.0); unknown rules fail loudly with
-    the full vocabulary (a typo'd SLO that silently never fires is worse
-    than none)."""
+    """'p99_ms=50' -> ('p99_ms', 50.0); 'p99_ms[premium]=40' keeps the
+    composite name as the rule key (the monitor windows that class
+    alone). Unknown rules fail loudly with the full vocabulary (a
+    typo'd SLO that silently never fires is worse than none)."""
     name, sep, value = spec.partition("=")
-    if not sep or name not in SLO_RULES:
+    base, cls = split_slo_rule(name) if sep else (name, None)
+    if not sep or base not in SLO_RULES:
         raise ValueError(
             f"--slo {spec!r}: expected RULE=THRESHOLD with RULE one of "
-            f"{sorted(SLO_RULES)}"
+            f"{sorted(SLO_RULES)} (optionally RULE[CLASS]=THRESHOLD for "
+            f"{sorted(CLASS_SCOPED_RULES)})"
+        )
+    if cls is not None and base not in CLASS_SCOPED_RULES:
+        raise ValueError(
+            f"--slo {spec!r}: rule {base!r} is fleet-level and takes no "
+            f"class scope; class-scoped rules: {sorted(CLASS_SCOPED_RULES)}"
         )
     try:
         return name, float(value)
@@ -534,10 +596,21 @@ class SLOMonitor:
         writer=None,
         clock=time.monotonic,
     ):
-        unknown = sorted(set(rules) - set(SLO_RULES))
+        unknown = []
+        for name in rules:
+            try:
+                base, cls = split_slo_rule(name)
+            except ValueError:
+                unknown.append(name)
+                continue
+            if base not in SLO_RULES or (
+                cls is not None and base not in CLASS_SCOPED_RULES
+            ):
+                unknown.append(name)
         if unknown:
-            raise ValueError(f"unknown SLO rules {unknown}; valid: "
-                             f"{sorted(SLO_RULES)}")
+            raise ValueError(f"unknown SLO rules {sorted(unknown)}; valid: "
+                             f"{sorted(SLO_RULES)} (class-scoped: "
+                             f"{sorted(CLASS_SCOPED_RULES)})")
         if window_s is not None and window_s <= 0:
             raise ValueError(f"window_s {window_s} must be > 0 or None")
         if min_samples < 1:
@@ -553,6 +626,17 @@ class SLOMonitor:
         self._headroom: deque = deque()  # (t, headroom)
         self._forecast_err: deque = deque()  # (t, forecast_abs_err)
         self._latency_traces: set = set()
+        # Per-SLO-class windows (schema v11, serve/qos.py), fed from
+        # class-stamped resolve/settle/shed records. Outcome entries are
+        # MUTABLE [t, rid, outcome] triples indexed by request_id: a
+        # shed's settle-"failed" fires BEFORE its "shed" leaf (the
+        # ticket fails first), so the later, richer terminal reclassifies
+        # the same entry instead of double-counting the request.
+        self._class_latency: Dict[str, deque] = {}   # (t, ms, rid)
+        self._class_lat_rids: Dict[str, set] = {}
+        self._class_iters: Dict[str, deque] = {}     # (t, iters_total)
+        self._class_events: Dict[str, deque] = {}    # [t, rid, outcome]
+        self._class_rid: Dict[str, dict] = {}        # rid -> entry
         self.n_breaches = 0
 
     def observe(self, rec: dict) -> None:
@@ -612,7 +696,58 @@ class SLOMonitor:
                 self._outcomes.append((now, "ok" if ok else "failed"))
         elif event == "shed":
             self._outcomes.append((now, "shed"))
+        # Per-class windows (schema v11): class-stamped resolve/settle/
+        # shed records feed the class-scoped rules. A request's terminal
+        # counts ONCE per class window (request_id-deduped), with the
+        # richer "shed" leaf reclassifying its preceding settle-"failed".
+        cls = rec.get("slo_class")
+        if isinstance(cls, str):
+            rid = rec.get("request_id")
+            if event == "resolve":
+                self._class_terminal(cls, rid, "resolved", now)
+                self._class_lat(cls, rid, rec.get("latency_ms"), now)
+                it = rec.get("iters_total")
+                if isinstance(it, (int, float)) and not isinstance(it, bool):
+                    self._class_iters.setdefault(cls, deque()).append(
+                        (now, float(it))
+                    )
+            elif event == "settle":
+                outcome = rec.get("outcome")
+                if outcome == "served":
+                    self._class_terminal(cls, rid, "resolved", now)
+                    self._class_lat(cls, rid, rec.get("latency_ms"), now)
+                elif outcome == "failed":
+                    self._class_terminal(cls, rid, "failed", now)
+            elif event == "shed":
+                self._class_terminal(cls, rid, "shed", now)
         self._prune(now)
+
+    def _class_terminal(
+        self, cls: str, rid, outcome: str, now: float
+    ) -> None:
+        by_rid = self._class_rid.setdefault(cls, {})
+        entry = by_rid.get(rid) if rid is not None else None
+        if entry is None:
+            entry = [now, rid, outcome]
+            self._class_events.setdefault(cls, deque()).append(entry)
+            if rid is not None:
+                by_rid[rid] = entry
+        elif outcome == "shed":
+            # The shed leaf arrives AFTER its settle-"failed" (the
+            # ticket fails first) — same request, richer terminal.
+            entry[2] = "shed"
+
+    def _class_lat(self, cls: str, rid, ms, now: float) -> None:
+        if not isinstance(ms, (int, float)) or isinstance(ms, bool):
+            return
+        rids = self._class_lat_rids.setdefault(cls, set())
+        if rid is not None and rid in rids:
+            return  # resolve + settle double-emission: count once
+        self._class_latency.setdefault(cls, deque()).append(
+            (now, float(ms), rid)
+        )
+        if rid is not None:
+            rids.add(rid)
 
     def _prune(self, now: float) -> None:
         if self.window_s is None:
@@ -627,6 +762,21 @@ class SLOMonitor:
         for q in (
             self._iters, self._outcomes, self._headroom, self._forecast_err
         ):
+            while q and q[0][0] < horizon:
+                q.popleft()
+        for cls, q in self._class_latency.items():
+            rids = self._class_lat_rids.get(cls, set())
+            while q and q[0][0] < horizon:
+                _, _, rid = q.popleft()
+                if rid is not None:
+                    rids.discard(rid)
+        for cls, q in self._class_events.items():
+            by_rid = self._class_rid.get(cls, {})
+            while q and q[0][0] < horizon:
+                e = q.popleft()
+                if e[1] is not None:
+                    by_rid.pop(e[1], None)
+        for q in self._class_iters.values():
             while q and q[0][0] < horizon:
                 q.popleft()
 
@@ -651,6 +801,10 @@ class SLOMonitor:
         resolved = max(outcomes.count("resolved"), outcomes.count("ok"))
         out: Dict[str, Optional[float]] = {}
         for rule in self.rules:
+            base, cls = split_slo_rule(rule)
+            if cls is not None:
+                out[rule] = self._class_observed(base, cls)
+                continue
             if rule in ("p50_ms", "p95_ms", "p99_ms", "mean_ms"):
                 if len(lat) < self.min_samples:
                     out[rule] = None
@@ -685,6 +839,37 @@ class SLOMonitor:
                 )
         return out
 
+    def _class_observed(self, base: str, cls: str) -> Optional[float]:
+        """One class-scoped rule's windowed value from that class's own
+        windows (None = not enough of THAT class's samples — another
+        tenant's traffic can never arm or mask a class rule)."""
+        if base in ("p50_ms", "p95_ms", "p99_ms", "mean_ms"):
+            lat = [v for _, v, _ in self._class_latency.get(cls, ())]
+            if len(lat) < self.min_samples:
+                return None
+            if base == "mean_ms":
+                return sum(lat) / len(lat)
+            q = {"p50_ms": 0.5, "p95_ms": 0.95, "p99_ms": 0.99}[base]
+            return percentile(lat, q)
+        outcomes = [e[2] for e in self._class_events.get(cls, ())]
+        if base == "shed_rate":
+            sheds = outcomes.count("shed")
+            total = sheds + outcomes.count("resolved")
+            return sheds / total if total >= self.min_samples else None
+        if base == "failure_rate":
+            total = len(outcomes)
+            return (
+                outcomes.count("failed") / total
+                if total >= self.min_samples else None
+            )
+        if base == "mean_iters":
+            vals = [v for _, v in self._class_iters.get(cls, ())]
+            return (
+                sum(vals) / len(vals)
+                if len(vals) >= self.min_samples else None
+            )
+        return None
+
     def evaluate(self) -> List[dict]:
         """One stamped "slo_breach" record per rule whose windowed value
         exceeds its threshold, delivered writer-else-flight (the flight
@@ -713,6 +898,17 @@ class SLOMonitor:
                     continue
             elif observed <= threshold:
                 continue
+            base, cls = split_slo_rule(rule)
+            if cls is not None:
+                ns = (
+                    len(self._class_events.get(cls, ()))
+                    if base in ("shed_rate", "failure_rate")
+                    else len(self._class_iters.get(cls, ()))
+                    if base == "mean_iters"
+                    else len(self._class_latency.get(cls, ()))
+                )
+            else:
+                ns = n_samples.get(rule, len(self._latency))
             rec = schema.stamp(
                 {
                     "rule": rule,
@@ -723,11 +919,16 @@ class SLOMonitor:
                         else "upper"
                     ),
                     "window_s": self.window_s,
-                    "n_samples": n_samples.get(rule, len(self._latency)),
+                    "n_samples": ns,
                     "wall_time_s": round(time.time(), 3),
                 },
                 kind="slo_breach",
             )
+            if cls is not None:
+                # The breach names its tenant — the elastic policy
+                # reads this to decide whether the breach is BINDING
+                # (serve/elastic.py low_classes).
+                rec["slo_class"] = cls
             for k, v in backend_record().items():
                 rec.setdefault(k, v)
             write_or_observe(self.writer, rec)

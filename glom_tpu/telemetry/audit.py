@@ -53,6 +53,33 @@ def _num(v: object) -> bool:
 # these on the evidence bundle it stamps — keep them dependency-free).
 # ---------------------------------------------------------------------------
 
+def rule_class(rule: str) -> Optional[str]:
+    """The SLO class a composite rule name is scoped to, or None for a
+    fleet-level rule: "p99_ms[premium]" -> "premium", "p99_ms" -> None.
+    Mirrors telemetry/aggregate.split_slo_rule, re-derived here so the
+    audit stays import-light (the stdlib-only contract); a malformed
+    scope is treated as fleet-level rather than raising — the audit
+    reads hostile JSONL."""
+    base, bracket, rest = str(rule).partition("[")
+    if not bracket or not rest.endswith("]") or not base:
+        return None
+    cls = rest[:-1]
+    return cls if cls else None
+
+
+def binding_breaches(evidence: dict) -> list:
+    """The breaches that BIND the policy: every stamped breach whose
+    rule is not scoped to one of the evidence's `low_classes`. A bundle
+    without `low_classes` (classless, or pre-v11) binds on everything —
+    the PR 18 semantics bit-for-bit."""
+    breaches = evidence.get("breaches") or []
+    low = evidence.get("low_classes")
+    if not low:
+        return list(breaches)
+    low_set = {str(c) for c in low}
+    return [r for r in breaches if rule_class(r) not in low_set]
+
+
 def anticipated_deficit(evidence: dict) -> Optional[float]:
     """Predicted load excess (rps) at `now + lead_time_ms` over the
     fleet's usable capacity, or None when the anticipatory inputs are
@@ -102,11 +129,16 @@ def policy_action(evidence: dict) -> Optional[str]:
     clamps) are the PR 14 contract verbatim; the anticipatory extension
     adds exactly one signal — a positive `anticipated_deficit` arms
     scale-out AND vetoes scale-in (predicted pressure is treated like a
-    live breach), and a None deficit changes nothing."""
+    live breach), and a None deficit changes nothing.
+
+    The QoS extension (evidence key `low_classes`, stamped only when
+    SLO classes are declared): breaches scoped to a low class are
+    NON-BINDING — they neither force scale-out nor veto an earned
+    scale-in. Batch-tenant pressure alone never moves the fleet."""
     n = evidence.get("n_engines")
     if not _num(n):
         return None
-    breaches = evidence.get("breaches") or []
+    breaches = binding_breaches(evidence)
     dwell_s = evidence.get("dwell_s")
     dwell_s = float(dwell_s) if _num(dwell_s) else 0.0
     held = evidence.get("below_held_s")
@@ -177,6 +209,19 @@ ACTUATION_EVENTS = (
 _FAILED_OUTCOMES = ("failed", "shed")
 
 
+def _failure_class(rec: dict) -> Optional[str]:
+    """The SLO class one failure record charges: the v11 `slo_class`
+    stamp on sheds/settles/breaches, falling back to the breach rule's
+    scope. None = classless (weight 1.0)."""
+    cls = rec.get("slo_class")
+    if isinstance(cls, str) and cls:
+        return cls
+    rule = rec.get("rule")
+    if isinstance(rule, str):
+        return rule_class(rule)
+    return None
+
+
 def _ts(rec: dict) -> Optional[float]:
     """The record's run-relative timestamp: `wall_time` (MetricsWriter's
     one clock per stream) first, the record's own `t` otherwise."""
@@ -203,7 +248,10 @@ def audit_records(
     flags suspicious-but-survivable shapes (--strict fails them too)."""
     decisions: Dict[Tuple[str, int], dict] = {}
     chain_events: List[dict] = []
-    failures: List[float] = []
+    # (t, slo_class-or-None): v11 failure evidence carries the tenant
+    # class, so regret can be scored class-weighted. Classless records
+    # land with None and weight 1.0 — the raw count is unchanged.
+    failures: List[Tuple[float, Optional[str]]] = []
     errors: List[str] = []
     warnings: List[str] = []
     n_records = 0
@@ -240,12 +288,12 @@ def audit_records(
             ):
                 t = _ts(rec)
                 if t is not None:
-                    failures.append(t)
+                    failures.append((t, _failure_class(rec)))
         elif kind == "slo_breach":
             t = _ts(rec)
             if t is not None:
-                failures.append(t)
-    failures.sort()
+                failures.append((t, _failure_class(rec)))
+    failures.sort(key=lambda f: f[0])
 
     # -- chain: per fleet, contiguous ids, each linking its predecessor --
     fleets = sorted({f for f, _ in decisions})
@@ -333,6 +381,7 @@ def audit_records(
                 key = (_fleet(rec), rec.get("decision_id"))
                 spawn_ms_by_decision[key] = float(ms)
     regret_total = 0
+    regret_weighted_total = 0.0
     decisions_late = 0
     lead_violations = 0
     per_decision: List[dict] = []
@@ -340,9 +389,12 @@ def audit_records(
         if rec.get("action") != "scale_out":
             continue
         evidence = rec.get("evidence") or {}
-        if evidence.get("breaches"):
+        late = bool(binding_breaches(evidence))
+        if late:
             # Scaled AFTER the SLO already broke: the reactive failure
-            # mode the anticipatory policy exists to avoid.
+            # mode the anticipatory policy exists to avoid. A breach
+            # scoped to a low class is not "late" — it could not have
+            # driven the decision.
             decisions_late += 1
         lead_ms = evidence.get("lead_time_ms")
         spawn_ms = spawn_ms_by_decision.get(key)
@@ -355,20 +407,36 @@ def audit_records(
         else:
             cover_s = default_cover_s
         t = _ts(rec)
-        regret = (
-            sum(1 for ft in failures if t <= ft <= t + cover_s)
-            if t is not None
-            else None
-        )
+        if t is not None:
+            covered = [
+                cls for ft, cls in failures if t <= ft <= t + cover_s
+            ]
+            regret = len(covered)
+            # Class-weighted regret: each covered failure charges its
+            # class's stamped weight (the decision's own evidence — the
+            # audit invents nothing), classless failures charge 1.0.
+            weights = evidence.get("class_weights") or {}
+            regret_weighted = round(
+                sum(
+                    float(weights.get(cls, 1.0)) if cls else 1.0
+                    for cls in covered
+                ),
+                6,
+            )
+        else:
+            regret = None
+            regret_weighted = None
         if regret is not None:
             regret_total += regret
+            regret_weighted_total += regret_weighted
         per_decision.append(
             {
                 "fleet": key[0],
                 "decision_id": key[1],
                 "regret": regret,
+                "regret_weighted": regret_weighted,
                 "cover_s": round(cover_s, 6),
-                "late": bool(evidence.get("breaches")),
+                "late": late,
             }
         )
 
@@ -380,6 +448,7 @@ def audit_records(
         "n_chain_events": len(chain_events),
         "n_failure_signals": len(failures),
         "regret_total": regret_total,
+        "regret_weighted": round(regret_weighted_total, 6),
         "regret_per_decision": per_decision,
         "decisions_late": decisions_late,
         "spawn_lead_violations": lead_violations,
@@ -423,8 +492,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
 
     rc = 0
-    totals = {"regret_total": 0, "decisions_late": 0,
-              "spawn_lead_violations": 0, "n_decisions": 0}
+    totals = {"regret_total": 0, "regret_weighted": 0.0,
+              "decisions_late": 0, "spawn_lead_violations": 0,
+              "n_decisions": 0}
     for path in args.paths:
         report = audit_records(
             load_records(path), default_cover_s=args.default_cover_s
@@ -445,7 +515,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 for k in (
                     "n_records", "fleets", "n_decisions", "n_conserved",
                     "n_chain_events", "n_failure_signals", "regret_total",
-                    "decisions_late", "spawn_lead_violations",
+                    "regret_weighted", "decisions_late",
+                    "spawn_lead_violations",
                 )
             },
             "n_errors": len(report["errors"]),
@@ -464,6 +535,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             "regret_total": totals["regret_total"],
             # Negative = the audited streams beat the counterfactual.
             "regret_delta": totals["regret_total"] - base["regret_total"],
+            "regret_weighted_delta": round(
+                totals["regret_weighted"] - base["regret_weighted"], 6
+            ),
             "decisions_late_delta": (
                 totals["decisions_late"] - base["decisions_late"]
             ),

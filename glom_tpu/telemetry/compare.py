@@ -90,11 +90,19 @@ _COST_METRIC_TOKENS = (
     # one regresses UP ("violation" also covers the flattened
     # serve_elastic.spawn_lead_violations row).
     "regret", "decisions_late", "violation",
+    # Per-class QoS rows (ISSUE 19): a tenant's failed/degraded/shed
+    # counts regress UP wherever they surface ("shed" already rides the
+    # list; "failed" covers serve_class.*.n_failed, "degraded" the
+    # per-class degrade counters — a change that degrades premium more
+    # is a regression even when totals hold).
+    "failed", "degraded",
 )
 # Metric-name tokens that mark a HIGHER-is-better row regardless of the
 # cost heuristics: headroom is capacity LEFT — a serving change that
 # erodes it regresses DOWN, exactly opposite to the occupancy costs.
-_BENEFIT_METRIC_TOKENS = ("headroom",)
+# served_fraction is the starvation-floor contract made a gate: the
+# batch tenant's served share dropping IS the regression (ISSUE 19).
+_BENEFIT_METRIC_TOKENS = ("headroom", "served_fraction")
 
 
 def lower_is_better(metric: str, unit: str) -> bool:
@@ -266,6 +274,51 @@ def flatten_engine_metrics(rec: dict) -> List[dict]:
                     "kind": "bench",
                 }
             )
+    # The per-class QoS nest (ISSUE 19): each SLO class's counters gate
+    # as serve_class.<class>.* rows — premium sheds/fails/degrades are
+    # COSTS (the failure-ish metric tokens), each class's
+    # served_fraction a BENEFIT (the starvation floor made a gate: the
+    # batch tenant's served share dropping below the floor regresses
+    # even while fleet totals hold).
+    classes = rec.get("classes")
+    if isinstance(classes, dict):
+        for cls in sorted(classes):
+            st = classes[cls]
+            if not isinstance(st, dict):
+                continue
+            for key in sorted(st):
+                v = st[key]
+                if not isinstance(v, (int, float)) or isinstance(v, bool):
+                    continue
+                unit = "fraction" if "fraction" in key else "count"
+                rows.append(
+                    {
+                        "metric": f"serve_class.{cls}.{key}{suffix}",
+                        "value": float(v),
+                        "unit": unit,
+                        "kind": "bench",
+                    }
+                )
+    # Per-lane admission rejections from the class scheduler's record: a
+    # full premium lane is shed-at-the-door evidence ("rejects" token —
+    # regresses UP). Scheduler pick counters are workload, not quality —
+    # they never gate.
+    sched = rec.get("class_scheduler")
+    if isinstance(sched, dict) and isinstance(sched.get("lane_full"), dict):
+        lane_full = sched["lane_full"]
+        for cls in sorted(lane_full):
+            v = lane_full[cls]
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                rows.append(
+                    {
+                        "metric": (
+                            f"serve_class.{cls}.lane_full_rejects{suffix}"
+                        ),
+                        "value": float(v),
+                        "unit": "count",
+                        "kind": "bench",
+                    }
+                )
     return rows
 
 

@@ -368,6 +368,10 @@ class ForecastEmitter:
         self._t0: Optional[float] = None
         self._window_start: Optional[float] = None
         self._window_arrivals = 0
+        # Per-SLO-class arrivals inside the open window (v11): the admit
+        # events' slo_class stamps, counted only when classed — a
+        # classless stream keeps its forecast records byte-identical.
+        self._window_by_class: dict = {}
         self.n_windows = 0
         self._last_forecast: Optional[dict] = None
 
@@ -381,6 +385,11 @@ class ForecastEmitter:
                 event = rec.get("event")
                 if event == "admit":
                     self._window_arrivals += 1
+                    cls = rec.get("slo_class")
+                    if isinstance(cls, str) and cls:
+                        self._window_by_class[cls] = (
+                            self._window_by_class.get(cls, 0) + 1
+                        )
                 elif event in ("scale_out", "spare_spawn") and isinstance(
                     rec.get("spawn_ms"), (int, float)
                 ):
@@ -409,13 +418,21 @@ class ForecastEmitter:
         holds the lock."""
         span = max(now - self._window_start, 1e-9)
         rate = self._window_arrivals / span
+        by_class = self._window_by_class
         t_rel = now - self._t0
         self.forecaster.observe(t_rel, rate)
         self._window_arrivals = 0
+        self._window_by_class = {}
         self._window_start = now
         self.n_windows += 1
         rec = self.forecaster.forecast(t_rel)
         rec["observed_rate_rps"] = round(rate, 4)
+        if by_class:
+            # Tenant mix of the closed window (v11): per-class arrival
+            # counts, stamped only when any admit carried a class.
+            rec["by_class"] = {
+                cls: by_class[cls] for cls in sorted(by_class)
+            }
         self._last_forecast = rec
         return rec
 

@@ -144,6 +144,7 @@ def to_trace_events(records: Iterable[dict]) -> List[dict]:
     barrier_tracks: dict = {}  # tid -> track label
     decision_tracks: dict = {}  # fleet -> tid
     arrival_window: List[float] = []  # trailing arrival ts (seconds)
+    class_arrivals: dict = {}  # slo_class -> trailing arrival ts (v11)
 
     def decision_flow(rec: dict, ts: float, tid: int) -> None:
         # Chain every record carrying a decision_id on one flow id per
@@ -401,6 +402,31 @@ def to_trace_events(records: Iterable[dict]) -> List[dict]:
                     },
                 }
             )
+            # Per-SLO-class arrival rate (schema v11, serve/qos.py): a
+            # classed record ALSO advances its tenant's own counter on
+            # the same track — the flash-crowd mix reads as stacked
+            # curves. Classless streams (slo_class null/absent) never
+            # emit these, keeping their traces byte-identical.
+            cls = rec.get("slo_class")
+            if isinstance(cls, str) and cls:
+                win = class_arrivals.setdefault(cls, [])
+                win.append(ts)
+                while win and win[0] < cutoff:
+                    win.pop(0)
+                raw.append(
+                    {
+                        "name": f"workload:arrival_rps[{cls}]",
+                        "ph": "C",
+                        "pid": _PID,
+                        "tid": _TID_WORKLOAD,
+                        "ts": ts,
+                        "args": {
+                            "arrival_rps": round(
+                                len(win) / _ARRIVAL_WINDOW_S, 3
+                            )
+                        },
+                    }
+                )
         else:
             label = {
                 "train_step": f"step {rec.get('step', '?')}",

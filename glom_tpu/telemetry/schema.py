@@ -99,7 +99,16 @@ from typing import Iterable, List, Optional, Tuple
 # serve events "spare_spawn" / "spare_promote" / "spare_demote" stamp
 # the warm-pool spare lifecycle (pre-spawned engines held outside
 # admission), each promotion/demotion carrying its owning decision_id.
-SCHEMA_VERSION = 10
+# v11 is multi-tenant QoS (serve/qos.py, docs/SERVING.md "SLO classes"):
+# REQUEST-scoped serve events ("admit" / "shed" / "settle" / "resolve")
+# and "workload" records must carry the `slo_class` KEY (null = a
+# classless config — fine; ABSENT = an emit site that never threaded
+# the class, a lint failure — the v6 trace-key presence precedent).
+# The serve "summary" grows per-class `classes` + `class_scheduler`
+# nests, "capacity" records a per-class `class_fill`, and decision
+# evidence stamps `low_classes` / `class_weights` so `telemetry audit`
+# can replay class-aware policy and score class-weighted regret.
+SCHEMA_VERSION = 11
 
 _NUM = (int, float)
 _STR = (str,)
@@ -216,6 +225,18 @@ TRACE_REQUIRED_EVENTS = (
 )
 _TRACE_KEYS = ("trace_id", "trace_ids")
 
+# Serve events that are scoped to ONE request and must carry the SLO
+# class key on schema-v11 records (serve/qos.py; null = classless config,
+# absent = the emit site never threaded the class — the same
+# present-but-nullable contract as the v6 trace keys above).
+CLASS_REQUIRED_EVENTS = (
+    "admit",
+    "shed",
+    "settle",
+    "resolve",
+)
+_CLASS_KEY = "slo_class"
+
 WATCHDOG_STATES = ("unknown", "up", "down", "flapping")
 
 
@@ -307,6 +328,28 @@ def validate_record(rec: object) -> List[str]:
             f"serve.{rec.get('event')} record (v{v}) carries no trace "
             f"context key ({'/'.join(_TRACE_KEYS)}) — see "
             "telemetry/tracectx.py"
+        )
+    if (
+        isinstance(v, int)
+        and v >= 11
+        and (
+            (kind == "serve" and rec.get("event") in CLASS_REQUIRED_EVENTS)
+            or kind == "workload"
+        )
+        and _CLASS_KEY not in rec
+    ):
+        # v11's multi-tenant contract (the v6 trace-key pattern):
+        # request-scoped serve events and workload records must carry
+        # the slo_class KEY — null on a classless config, but never
+        # silently absent, so per-tenant conservation can always be
+        # reconciled (see serve/qos.py).
+        what = (
+            f"serve.{rec.get('event')}" if kind == "serve" else "workload"
+        )
+        errs.append(
+            f"{what} record (v{v}) carries no {_CLASS_KEY} key — the SLO "
+            "class must be stamped on every request-scoped record (null = "
+            "classless; see glom_tpu/serve/qos.py)"
         )
     if (
         kind == "forecast"

@@ -364,6 +364,24 @@ class ServeConfig:
     # pool instead of releasing its devices. Spare spawn latencies feed
     # the spawn-lead-time model before the first live scale-out.
     warm_pool: int = 0
+    # Multi-tenant QoS (glom_tpu/serve/qos.py, docs/SERVING.md "SLO
+    # classes"): named SLO classes — e.g. ("premium:weight=8,p99_ms=150",
+    # "standard:weight=3", "batch:weight=1,shed_rate=0.5") — turn the
+    # batcher's shared FIFO into a deficit-weighted-fair class scheduler
+    # with PER-CLASS bounded lanes (batch backpressure can never fill
+    # premium's lane), class-aware ladder gates (the first class in the
+    # shed order degrades and sheds a rung early), class-scoped SLO rules
+    # ("p99_ms[premium]=X"), and per-class decision evidence the audit
+    # weighs. None (the default) keeps the classless batcher and policy
+    # byte-for-byte. slo_default_class labels unclassed submits (default:
+    # "standard" when declared, else the highest-weight class);
+    # slo_shed_order overrides the ascending-weight default; the
+    # starvation floor is each lower class's guaranteed pick share under
+    # strict-priority contention.
+    slo_classes: Optional[Tuple[str, ...]] = None
+    slo_default_class: Optional[str] = None
+    slo_shed_order: Optional[Tuple[str, ...]] = None
+    slo_starvation_floor: float = 0.05
 
     def __post_init__(self):
         if not self.buckets:
@@ -578,6 +596,26 @@ class ServeConfig:
             )
         if self.warm_pool < 0:
             raise ValueError(f"warm_pool {self.warm_pool} must be >= 0")
+        if not 0.0 <= self.slo_starvation_floor < 1.0:
+            raise ValueError(
+                f"slo_starvation_floor {self.slo_starvation_floor} must "
+                "be in [0, 1)"
+            )
+        if self.slo_classes is not None or self.slo_shed_order is not None:
+            # The one class-table resolution (glom_tpu/serve/qos.py,
+            # stdlib-only — no jax rides this import): a typo'd class
+            # spec, duplicate name, unknown default/shed-order entry, or
+            # unsatisfiable starvation floor fails HERE, at config
+            # construction, not mid-traffic. A shed order without
+            # declared classes is equally a config bug.
+            if not self.slo_classes:
+                raise ValueError(
+                    "slo_shed_order needs slo_classes: there are no "
+                    "declared classes to order"
+                )
+            from glom_tpu.serve.qos import resolve_slo_classes
+
+            resolve_slo_classes(self)
 
 
 @dataclasses.dataclass(frozen=True)

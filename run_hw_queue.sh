@@ -405,6 +405,25 @@ step elastic_audit 120 python -m glom_tpu.telemetry audit --strict \
     results/hw_queue/elastic_ab_reactive.jsonl \
     results/hw_queue/elastic_ab_anticipatory.jsonl
 
+# 9o. Multi-tenant QoS gate (ISSUE 19, docs/SERVING.md "SLO classes" +
+#     docs/OBSERVABILITY.md schema v11): the same flash crowd, dealt a
+#     seeded premium/standard/batch mix, drives a classless shared-FIFO
+#     fleet and the deficit-weighted-fair QoS fleet whose lanes
+#     PARTITION the same queue depth. The bench ASSERTS premium p99
+#     strictly below the classless baseline, batch held at or above the
+#     starvation floor, EXACT per-class ticket conservation on both
+#     arms, and both decision chains passing `telemetry audit --strict`
+#     (weighted regret scored from the stamped class_weights). Rows
+#     join the 11b serve baseline so per-class p99 / served-fraction /
+#     shed growth gates.
+step qos_ab 2400 python -u bench_serve.py --scenario flash-crowd \
+    --scenario-duration 12 --scenario-crowd-rps 400 \
+    --class-mix 'premium=0.2,standard=0.3,batch=0.5' --qos-ab \
+    --qos-ab-out results/hw_queue/qos_ab
+step qos_audit 120 python -m glom_tpu.telemetry audit --strict \
+    results/hw_queue/qos_ab_classless.jsonl \
+    results/hw_queue/qos_ab_qos.jsonl
+
 # 10. Schema lint: every JSON row this queue produced must validate
 #     against the versioned event schema (glom_tpu/telemetry/schema.py).
 #     Shell noise in the logs is skipped; --allow-unstamped because the
@@ -442,6 +461,7 @@ grep -ah '^{' results/hw_queue/bench_serve.log \
     results/hw_queue/ramp_serve.log \
     results/hw_queue/workload_serve.log \
     results/hw_queue/elastic_ab.log \
+    results/hw_queue/qos_ab.log \
     > results/hw_queue/serve_candidate.jsonl 2>/dev/null || true
 if [ -f results/serve_baseline.jsonl ]; then
     step serve_compare 300 python -m glom_tpu.telemetry compare \

@@ -1,0 +1,52 @@
+"""schema-emit class-context regression fixture: one serve emit site that
+forgot the v11 SLO-class stamp, next to its correctly-stamped twins.
+
+tests/test_analysis.py runs glom-lint's schema-emit checker over this
+file and asserts the bare `admit` emit in `bad_admit_emit` is flagged
+(key "class-context", file:line) while the three good shapes — an
+explicit slo_class (even null: classless lints), a `**detail` splat that
+may carry the class, and a non-tenant-scoped event — stay clean. NOT
+importable production code: it exists to be linted.
+"""
+
+
+def emit_serve(writer, rec, kind="serve"):  # the emitter family's shape
+    return rec
+
+
+def bad_admit_emit(writer, ticket):
+    # BUG: a tenant-scoped serve event with no slo_class key — no
+    # per-class rollup, class-scoped SLO rule, or weighted-regret audit
+    # can ever attribute the records this site writes, and the runtime
+    # linter rejects every one of them at v11.
+    emit_serve(
+        writer,
+        {
+            "event": "admit",
+            "request_id": ticket.request_id,
+            "trace_id": ticket.trace_id,
+        },
+    )
+
+
+def good_classed_emit(writer, ticket):
+    emit_serve(
+        writer,
+        {
+            "event": "settle",
+            "outcome": "served",
+            "trace_id": ticket.trace_id,
+            "slo_class": ticket.slo_class,  # null when classless — fine
+        },
+    )
+
+
+def good_splat_emit(writer, detail):
+    # A **splat may carry the class (the batcher's shed-detail pattern);
+    # the static rule defers to the runtime linter here.
+    emit_serve(writer, {"event": "shed", "reason": "queue-full", **detail})
+
+
+def good_unscoped_emit(writer):
+    # Not a tenant-scoped event: no slo_class required.
+    emit_serve(writer, {"event": "ladder", "rung": "capped_iters"})

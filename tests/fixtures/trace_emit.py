@@ -31,6 +31,7 @@ def good_singular_emit(writer, ticket):
             "event": "resolve",
             "iters_total": 6,
             "trace_id": ticket.trace_id,  # null when untraced — still fine
+            "slo_class": ticket.slo_class,  # v11: null when classless
         },
     )
 

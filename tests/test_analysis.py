@@ -513,7 +513,8 @@ class TestSchemaEmit:
     def test_trace_context_rule_accepts_null_and_splat(self, tmp_path):
         src = (
             "from glom_tpu.serve.events import emit_serve\n"
-            "emit_serve(w, {'event': 'resolve', 'trace_id': None})\n"
+            "emit_serve(w, {'event': 'resolve', 'trace_id': None,\n"
+            "               'slo_class': None})\n"
             "emit_serve(w, {'event': 'shed', **fields})\n"
             "emit_serve(w, {'event': 'warmup', 'bucket': 4})\n"
         )
@@ -541,6 +542,38 @@ class TestSchemaEmit:
         assert fs[0].symbol == "bad_dispatch_emit"
         src_lines = (FIXTURES / "trace_emit.py").read_text().splitlines()
         assert "dispatch" in src_lines[fs[0].line - 1]
+
+    def test_tenant_scoped_event_without_class_flagged(self, tmp_path):
+        src = (
+            "from glom_tpu.serve.events import emit_serve\n"
+            "emit_serve(w, {'event': 'admit', 'request_id': rid})\n"
+        )
+        fs = by_checker(lint(tmp_path, src), "schema-emit")
+        assert len(fs) == 1 and fs[0].key == "class-context"
+        assert "slo_class" in fs[0].message
+
+    def test_class_context_rule_accepts_null_and_splat(self, tmp_path):
+        src = (
+            "from glom_tpu.serve.events import emit_serve\n"
+            "emit_serve(w, {'event': 'admit', 'slo_class': None})\n"
+            "emit_serve(w, {'event': 'settle', **fields})\n"
+            "emit_serve(w, {'event': 'ladder', 'rung': 'shed'})\n"
+        )
+        assert by_checker(lint(tmp_path, src), "schema-emit") == []
+
+    def test_class_emit_fixture_pair(self):
+        """The seeded acceptance pair (tests/fixtures/class_emit.py): the
+        class-less admit emit flagged, the three good shapes clean."""
+        from glom_tpu.analysis import run
+
+        fs = by_checker(
+            run([str(FIXTURES / "class_emit.py")]), "schema-emit"
+        )
+        assert len(fs) == 1, fs
+        assert fs[0].key == "class-context"
+        assert fs[0].symbol == "bad_admit_emit"
+        src_lines = (FIXTURES / "class_emit.py").read_text().splitlines()
+        assert "admit" in src_lines[fs[0].line - 1]
 
     def test_dead_zero_unmeasured_flagged(self, tmp_path):
         src = (

@@ -46,7 +46,7 @@ class TestSchemaV2Kinds:
             {"metric": "m", "value": None, "error": "backend-init-unavailable"},
             kind="error",
         )
-        assert span["schema_version"] == schema.SCHEMA_VERSION == 10
+        assert span["schema_version"] == schema.SCHEMA_VERSION == 11
         assert schema.validate_record(span) == []
         assert schema.validate_record(err) == []
         # missing required fields are rejected

@@ -1,0 +1,881 @@
+"""Multi-tenant QoS (glom_tpu/serve/qos.py, ISSUE 19, docs/SERVING.md
+"SLO classes").
+
+The tier-1 locks:
+
+  * the starvation floor is a hard arithmetic bound, not a hint: under
+    sustained all-class overload a backlogged class's pick share is
+    >= slo_starvation_floor, and premium takes the remainder;
+  * per-class lanes are per-class BACKPRESSURE — a batch flood sheds
+    batch (and only batch) while premium admission stays open;
+  * EXACT per-class ticket conservation (served + shed + failed ==
+    requests, per class) across failover and two-tier continuations;
+  * a classless config is the PR 18 scheduler byte-for-byte: plain
+    queue.Queue, no classes/class_scheduler summary nests, the same
+    shed message — the bit-parity pin;
+  * low-class SLO breaches are NON-BINDING for the elastic policy
+    (audit.binding_breaches) and regret is priced per class weight
+    (regret_weighted) — both replayed from the stamped evidence alone.
+"""
+
+import queue
+import types
+
+import numpy as np
+import pytest
+
+from glom_tpu.resilience.ladder import (
+    BUCKET_CAP,
+    CAPPED_ITERS,
+    SHED,
+    class_rungs,
+)
+from glom_tpu.serve.batcher import DynamicBatcher, QueueFullError
+from glom_tpu.serve.qos import (
+    ClassQueues,
+    class_slo_rules,
+    parse_slo_class,
+    resolve_slo_classes,
+)
+from glom_tpu.telemetry import schema
+from glom_tpu.telemetry.aggregate import SLOMonitor, parse_slo, split_slo_rule
+from glom_tpu.telemetry.audit import (
+    audit_records,
+    binding_breaches,
+    policy_action,
+    rule_class,
+)
+from glom_tpu.utils.config import ServeConfig
+
+CLASSES = ("premium:weight=8,p99_ms=150", "standard:weight=2",
+           "batch:weight=1,shed_rate=0.5")
+
+
+def _scfg(**kw):
+    kw.setdefault("slo_classes", CLASSES)
+    kw.setdefault("queue_depth", 8)
+    return ServeConfig(buckets=(1, 2, 4), max_batch=4, max_delay_ms=5.0, **kw)
+
+
+# ---------------------------------------------------------------------------
+# spec parsing / validation
+# ---------------------------------------------------------------------------
+
+
+class TestSpecParsing:
+    def test_full_spec_roundtrip(self):
+        c = parse_slo_class(
+            "premium:weight=8,p99_ms=150,shed_rate=0.1,queue_depth=4"
+        )
+        assert c.name == "premium" and c.weight == 8.0
+        assert c.p99_ms == 150.0 and c.shed_rate == 0.1
+        assert c.queue_depth == 4
+
+    def test_bare_name_defaults(self):
+        c = parse_slo_class("batch")
+        assert c.weight == 1.0 and c.p99_ms is None
+        assert c.queue_depth is None
+
+    @pytest.mark.parametrize("spec", [
+        "", ":weight=1", "p:weight=0", "p:weight=-1", "p:bogus=3",
+        "p:weight", "p:p99_ms=0", "p:shed_rate=1.5", "p:queue_depth=0",
+        "p:queue_depth=1.5", "p:weight=abc",
+    ])
+    def test_malformed_specs_are_loud(self, spec):
+        with pytest.raises(ValueError):
+            parse_slo_class(spec)
+
+    def test_priority_is_descending_weight_declaration_ties(self):
+        spec = resolve_slo_classes(_scfg(
+            slo_classes=("a:weight=2", "b:weight=8", "c:weight=2")
+        ))
+        assert spec.names == ("b", "a", "c")  # ties keep declaration order
+
+    def test_default_shed_order_is_reversed_priority(self):
+        spec = resolve_slo_classes(_scfg())
+        assert spec.names == ("premium", "standard", "batch")
+        assert spec.shed_order == ("batch", "standard", "premium")
+
+    def test_explicit_shed_order_must_be_permutation(self):
+        spec = resolve_slo_classes(_scfg(
+            slo_shed_order=("standard", "batch", "premium")
+        ))
+        assert spec.shed_order == ("standard", "batch", "premium")
+        with pytest.raises(ValueError, match="permutation"):
+            resolve_slo_classes(_scfg(slo_shed_order=("batch", "premium")))
+
+    def test_default_class_prefers_standard_then_top(self):
+        assert resolve_slo_classes(_scfg()).default_class == "standard"
+        spec = resolve_slo_classes(_scfg(slo_classes=("p:weight=8", "b")))
+        assert spec.default_class == "p"
+        with pytest.raises(ValueError, match="not a declared class"):
+            resolve_slo_classes(_scfg(slo_default_class="gold"))
+
+    def test_duplicate_names_rejected(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            resolve_slo_classes(_scfg(slo_classes=("a", "a:weight=2")))
+
+    def test_floor_must_leave_top_class_capacity(self):
+        with pytest.raises(ValueError, match="floor"):
+            resolve_slo_classes(_scfg(slo_starvation_floor=0.5))
+
+    def test_resolve_takes_default_and_rejects_undeclared(self):
+        spec = resolve_slo_classes(_scfg())
+        assert spec.resolve(None) == "standard"
+        assert spec.resolve("batch") == "batch"
+        with pytest.raises(ValueError, match="not declared"):
+            spec.resolve("gold")
+
+    def test_class_slo_rules_vocabulary(self):
+        rules = class_slo_rules(resolve_slo_classes(_scfg()))
+        assert rules == {"p99_ms[premium]": 150.0, "shed_rate[batch]": 0.5}
+        # Every generated rule parses in the monitor's vocabulary.
+        for name, thresh in rules.items():
+            assert parse_slo(f"{name}={thresh}") == (name, thresh)
+
+    def test_low_classes_is_shed_order_head(self):
+        spec = resolve_slo_classes(_scfg())
+        assert spec.low_classes() == frozenset({"batch"})
+        solo = resolve_slo_classes(_scfg(slo_classes=("only",)))
+        assert solo.low_classes() == frozenset()
+
+    def test_classless_config_resolves_none(self):
+        assert resolve_slo_classes(_scfg(slo_classes=None)) is None
+
+
+class TestClassRungs:
+    def test_classless_and_solo_keep_pr18_gates(self):
+        assert class_rungs(0, 1) == (CAPPED_ITERS, SHED)
+
+    def test_shed_order_positions_select_gates(self):
+        # batch (position 0): sheds a rung EARLY, degrades normally.
+        assert class_rungs(0, 3) == (CAPPED_ITERS, BUCKET_CAP)
+        # standard (middle): the classless semantics.
+        assert class_rungs(1, 3) == (CAPPED_ITERS, SHED)
+        # premium (last): holds the full route one rung longer.
+        assert class_rungs(2, 3) == (BUCKET_CAP, SHED)
+
+    def test_spec_gates_follow_shed_positions(self):
+        spec = resolve_slo_classes(_scfg())
+        assert spec.shed_rung("batch") < spec.shed_rung("premium")
+        assert spec.degrade_rung("premium") > spec.degrade_rung("batch")
+
+    def test_position_bounds_are_loud(self):
+        with pytest.raises(ValueError):
+            class_rungs(3, 3)
+
+
+# ---------------------------------------------------------------------------
+# the weighted-fair lane
+# ---------------------------------------------------------------------------
+
+
+def _item(cls):
+    return types.SimpleNamespace(slo_class=cls)
+
+
+def _queues(floor=0.1, depth=64, classes=CLASSES):
+    spec = resolve_slo_classes(
+        _scfg(slo_classes=classes, slo_starvation_floor=floor)
+    )
+    return ClassQueues(spec, default_depth=depth)
+
+
+class TestClassQueues:
+    def test_strict_priority_when_no_credit_owed(self):
+        q = _queues()
+        for cls in ("batch", "standard", "premium"):
+            q.put_nowait(_item(cls))
+        assert [q.get_nowait().slo_class for _ in range(3)] == [
+            "premium", "standard", "batch",
+        ]
+
+    def test_starvation_floor_is_a_hard_share_bound(self):
+        """Sustained premium+batch overload: batch's pick share lands
+        within one credit of floor * n_picks — never starved below it,
+        never above premium's strict preference."""
+        floor, n = 0.1, 400
+        q = _queues(floor=floor, depth=2 * n)
+        for _ in range(n):
+            q.put_nowait(_item("premium"))
+            q.put_nowait(_item("batch"))
+        picks = [q.get_nowait().slo_class for _ in range(n)]
+        batch = picks.count("batch")
+        assert batch >= int(floor * n) - 1, picks[:40]
+        assert batch <= int(floor * n) + 2, picks[:40]
+        rec = q.record()
+        assert rec["n_picks"] == n
+        assert rec["n_floor_picks"] == batch  # every batch pick was owed
+        assert rec["picks"]["premium"] == n - batch
+        assert rec["starvation_floor"] == floor
+
+    def test_lowest_class_preempts_first_when_both_owed(self):
+        q = _queues(floor=0.25)
+        for _ in range(8):
+            q.put_nowait(_item("premium"))
+            q.put_nowait(_item("standard"))
+            q.put_nowait(_item("batch"))
+        picks = [q.get_nowait().slo_class for _ in range(8)]
+        # Both lower lanes bank 0.25/pick; at pick 5 both are owed —
+        # the LOWEST priority class takes the slot first.
+        assert "batch" in picks and "standard" in picks
+        assert picks.index("batch") < picks.index("standard")
+
+    def test_idle_class_banks_no_credit(self):
+        """Credit accrues only while BACKLOGGED: a class that idled
+        through premium's burst starts from zero when its traffic
+        arrives — no stored-up monopoly."""
+        q = _queues(floor=0.2)
+        for _ in range(50):
+            q.put_nowait(_item("premium"))
+        for _ in range(50):
+            q.get_nowait()
+        q.put_nowait(_item("premium"))
+        q.put_nowait(_item("batch"))
+        assert q.get_nowait().slo_class == "premium"
+
+    def test_credit_is_capped(self):
+        """A long-backlogged class is owed at most _CREDIT_CAP whole
+        picks: after 100 bypasses batch takes 2 consecutive slots, not
+        10."""
+        q = _queues(floor=0.1, depth=256)
+        for _ in range(100):
+            q.put_nowait(_item("premium"))
+        q.put_nowait(_item("batch"))
+        burn = []
+        for _ in range(40):
+            burn.append(q.get_nowait().slo_class)
+        # batch was picked exactly when owed — the cap keeps its share
+        # near the floor even with maximal banked credit.
+        assert 1 <= burn.count("batch") <= 3
+
+    def test_lane_full_sheds_only_that_class(self):
+        q = _queues(classes=("p:weight=8,queue_depth=2",
+                             "b:weight=1,queue_depth=2"))
+        q.put_nowait(_item("b"))
+        q.put_nowait(_item("b"))
+        with pytest.raises(queue.Full):
+            q.put_nowait(_item("b"))
+        q.put_nowait(_item("p"))  # premium admission unaffected
+        assert q.record()["lane_full"] == {"b": 1}
+        assert q.class_fill()["b"] == {"depth": 2, "capacity": 2}
+
+    def test_queue_facade_shapes(self):
+        q = _queues(classes=("p:queue_depth=2", "b:queue_depth=3"))
+        assert q.maxsize == 5 and q.empty() and q.qsize() == 0
+        q.put_nowait(_item("p"))
+        assert q.qsize() == 1 and not q.empty()
+        with pytest.raises(queue.Empty):
+            _queues().get_nowait()
+        with pytest.raises(queue.Empty):
+            _queues().get(timeout=0.01)
+
+    def test_unknown_class_requeue_routes_to_default(self):
+        # A requeue of a pre-reconfiguration item must not strand.
+        q = _queues()
+        q.put_nowait(_item("gone"))
+        assert q.qsize() == 1
+        assert q.get_nowait().slo_class == "gone"
+
+
+# ---------------------------------------------------------------------------
+# the batcher under classes (host-side fake engine, no device)
+# ---------------------------------------------------------------------------
+
+
+from glom_tpu.serve.engine import ServeResult  # noqa: E402  (needs jax)
+
+IMG = np.zeros((3, 8, 8), np.float32)
+
+
+class FakeEngine:
+    def __init__(self, scfg, fail=None, name="fake0"):
+        self.scfg = scfg
+        self.fail = fail
+        self.name = name
+        self.calls = []
+
+    def pick_bucket(self, n):
+        for b in self.scfg.buckets:
+            if n <= b:
+                return b
+        raise ValueError(n)
+
+    def infer(self, imgs, n_valid=None, **kw):
+        if self.fail is not None:
+            raise self.fail
+        b = imgs.shape[0]
+        self.calls.append((b, n_valid))
+        return ServeResult(
+            levels=np.zeros((b, 16, 3, 16), np.float32),
+            iters_run=6, latency_s=0.0, bucket=b, compiled=False,
+        )
+
+
+class TieredFakeEngine:
+    """First (cold) dispatch leaves the last valid row unconverged; the
+    warm continuation converges it — the two-tier conservation probe."""
+
+    def __init__(self, scfg, name="fake0"):
+        self.scfg = scfg
+        self.iters_key = "auto"
+        self.auto_budget = 12
+        self.name = name
+        self.calls = []
+
+    def pick_bucket(self, n):
+        for b in self.scfg.buckets:
+            if n <= b:
+                return b
+        raise ValueError(n)
+
+    def infer(self, imgs, n_valid=None, levels0=None, auto_budget=None,
+              **kw):
+        b = imgs.shape[0]
+        warm = levels0 is not None
+        self.calls.append({"bucket": b, "warm": warm})
+        conv = np.ones((b,), bool)
+        if not warm:
+            conv[max(0, n_valid - 1):n_valid] = False
+        iters = 4 if not warm else (auto_budget or 8)
+        return ServeResult(
+            levels=np.zeros((b, 16, 3, 16), np.float32),
+            iters_run=iters, latency_s=0.0, bucket=b, compiled=False,
+            row_converged=conv, row_iters=np.full((b,), iters, np.int32),
+        )
+
+
+class Sink:
+    def __init__(self):
+        self.records = []
+
+    def write(self, rec):
+        self.records.append(rec)
+
+
+def _class_counts(summary):
+    return {
+        cls: cnt for cls, cnt in (summary.get("classes") or {}).items()
+    }
+
+
+def _assert_conserved(summary):
+    for cls, cnt in _class_counts(summary).items():
+        assert (
+            cnt["n_served"] + cnt["n_shed"] + cnt["n_failed"]
+            == cnt["n_requests"]
+        ), (cls, cnt)
+
+
+class TestBatcherQoS:
+    def test_priority_order_under_backlog(self):
+        """10:1 batch:premium backlog admitted before the workers start:
+        premium tickets resolve ahead of the batch wave, batch still
+        gets its floor share — the scheduler bound end to end."""
+        scfg = _scfg(slo_starvation_floor=0.1, queue_depth=64)
+        eng = FakeEngine(scfg)
+        sink = Sink()
+        b = DynamicBatcher(eng, max_batch=1, max_delay_ms=0.0, writer=sink)
+        order = []
+        tickets = []
+        for i in range(40):
+            tickets.append(("batch", b.submit(IMG, slo_class="batch")))
+        for i in range(4):
+            tickets.append(("premium", b.submit(IMG, slo_class="premium")))
+        b.start()
+        for cls, t in tickets:
+            t.result(timeout=10.0)
+        summary = b.summary_record()
+        b.stop()
+        resolves = [r for r in sink.records if r.get("event") == "resolve"]
+        order = [r["slo_class"] for r in resolves]
+        # All 4 premium rode the head of the drain (the floor may cede
+        # a handful of early slots to the backlogged batch lane).
+        assert max(order.index(c) for c in order if c == "premium") < 10
+        _assert_conserved(summary)
+        counts = _class_counts(summary)
+        assert counts["premium"]["n_served"] == 4
+        assert counts["batch"]["n_served"] == 40
+        sched = summary["class_scheduler"]
+        assert sched["n_picks"] >= 44
+        assert sched["picks"]["premium"] >= 4
+        assert summary["n_served"] == 44
+
+    def test_lane_full_sheds_batch_premium_admits(self):
+        scfg = _scfg(slo_classes=(
+            "premium:weight=8,queue_depth=4", "batch:weight=1,queue_depth=2",
+        ))
+        eng = FakeEngine(scfg)
+        sink = Sink()
+        b = DynamicBatcher(eng, writer=sink)  # NOT started: lanes fill
+        b.submit(IMG, slo_class="batch")
+        b.submit(IMG, slo_class="batch")
+        with pytest.raises(QueueFullError) as ei:
+            b.submit(IMG, slo_class="batch")
+        assert ei.value.detail["class_depth"] == {"premium": 0, "batch": 2}
+        b.submit(IMG, slo_class="premium")  # unaffected by batch's flood
+        summary = b.summary_record()
+        b.stop(drain=False)
+        counts = _class_counts(summary)
+        assert counts["batch"]["n_shed"] == 1
+        assert counts["premium"]["n_shed"] == 0
+        shed = [r for r in sink.records if r.get("event") == "shed"]
+        assert shed and shed[0]["slo_class"] == "batch"
+        assert schema.validate_record(shed[0]) == []
+
+    def test_undeclared_class_rejected_before_counters(self):
+        b = DynamicBatcher(FakeEngine(_scfg()))
+        with pytest.raises(ValueError, match="not declared"):
+            b.submit(IMG, slo_class="gold")
+        summary = b.summary_record()
+        b.stop(drain=False)
+        assert summary["n_requests"] == 0
+        assert _class_counts(summary) == {}
+
+    def test_default_class_stamps_unlabelled_submits(self):
+        eng = FakeEngine(_scfg())
+        sink = Sink()
+        with DynamicBatcher(eng, writer=sink) as b:
+            b.submit(IMG).result(timeout=10.0)
+            summary = b.summary_record()
+        assert _class_counts(summary)["standard"]["n_served"] == 1
+        resolve = [r for r in sink.records if r.get("event") == "resolve"]
+        assert resolve and resolve[0]["slo_class"] == "standard"
+
+    def test_per_class_conservation_across_failover(self):
+        scfg = _scfg()
+        bad = FakeEngine(scfg, fail=RuntimeError("boom"), name="bad")
+        good = FakeEngine(scfg, name="good")
+        with DynamicBatcher(
+            engines=[bad, good], max_batch=2, max_delay_ms=5.0,
+            engine_fail_threshold=1,
+        ) as b:
+            tickets = [
+                b.submit(IMG, slo_class=cls)
+                for cls in ("premium", "batch", "premium", "batch")
+            ]
+            for t in tickets:
+                t.result(timeout=10.0)
+            summary = b.summary_record()
+        _assert_conserved(summary)
+        counts = _class_counts(summary)
+        assert counts["premium"]["n_served"] == 2
+        assert counts["batch"]["n_served"] == 2
+        assert counts["premium"]["n_failed"] == 0
+
+    def test_per_class_conservation_across_continuation(self):
+        scfg = _scfg(
+            iters="auto", max_auto_iters=12, exit_quorum=0.5,
+            max_continuations=2, dispatch_retries=0,
+        )
+        eng = TieredFakeEngine(scfg)
+        sink = Sink()
+        with DynamicBatcher(eng, max_batch=4, max_delay_ms=10.0,
+                            writer=sink) as b:
+            tickets = [
+                b.submit(IMG, slo_class=cls)
+                for cls in ("premium", "premium", "batch")
+            ]
+            for t in tickets:
+                t.result(timeout=10.0)
+            summary = b.summary_record()
+        assert summary["n_continued"] >= 1  # the straggler rode a warm hop
+        _assert_conserved(summary)
+        counts = _class_counts(summary)
+        assert counts["premium"]["n_served"] == 2
+        assert counts["batch"]["n_served"] == 1
+        # The continued ticket's terminal kept its admission class.
+        resolves = [r for r in sink.records if r.get("event") == "resolve"]
+        assert sorted(r["slo_class"] for r in resolves) == [
+            "batch", "premium", "premium",
+        ]
+        for r in resolves:
+            assert schema.validate_record(r) == [], r
+
+
+class TestClasslessBitParity:
+    def test_plain_queue_and_no_class_nests(self):
+        eng = FakeEngine(_scfg(slo_classes=None))
+        with DynamicBatcher(eng) as b:
+            assert type(b._q) is queue.Queue  # the PR 18 scheduler
+            b.submit(IMG).result(timeout=10.0)
+            summary = b.summary_record()
+        assert "classes" not in summary
+        assert "class_scheduler" not in summary
+
+    def test_classless_shed_message_is_unchanged(self):
+        eng = FakeEngine(_scfg(slo_classes=None))
+        b = DynamicBatcher(eng, queue_depth=1)
+        b.submit(IMG)
+        with pytest.raises(QueueFullError) as ei:
+            b.submit(IMG)
+        b.stop(drain=False)
+        assert "class" not in str(ei.value)
+        assert "class_depth" not in ei.value.detail
+        assert str(ei.value).startswith("request queue at capacity (1)")
+
+    def test_classless_labels_are_pure_observability(self):
+        """Labels on a classless config count per class in the summary
+        but never reorder the FIFO."""
+        eng = FakeEngine(_scfg(slo_classes=None))
+        sink = Sink()
+        b = DynamicBatcher(eng, max_batch=1, max_delay_ms=0.0, writer=sink)
+        b.submit(IMG, slo_class="batch")
+        b.submit(IMG, slo_class="premium")
+        b.start()
+        summary = None
+        try:
+            while summary is None or summary["n_served"] < 2:
+                summary = b.summary_record()
+        finally:
+            b.stop()
+        counts = _class_counts(b.summary_record())
+        assert counts["batch"]["n_served"] == 1
+        assert counts["premium"]["n_served"] == 1
+        assert "class_scheduler" not in b.summary_record()
+        resolves = [r for r in sink.records if r.get("event") == "resolve"]
+        # FIFO: the batch submit resolved first despite the label.
+        assert [r["slo_class"] for r in resolves] == ["batch", "premium"]
+
+
+# ---------------------------------------------------------------------------
+# class-scoped SLO rules + schema v11
+# ---------------------------------------------------------------------------
+
+
+class TestClassScopedRules:
+    def test_split_slo_rule(self):
+        assert split_slo_rule("p99_ms[premium]") == ("p99_ms", "premium")
+        assert split_slo_rule("p99_ms") == ("p99_ms", None)
+        for bad in ("p99_ms[", "p99_ms[]", "p99_ms[x"):
+            with pytest.raises(ValueError):
+                split_slo_rule(bad)
+
+    def test_parse_slo_rejects_fleet_rules_with_scope(self):
+        assert parse_slo("p99_ms[premium]=40") == ("p99_ms[premium]", 40.0)
+        with pytest.raises(ValueError, match="class scope"):
+            parse_slo("headroom[premium]=0.2")
+
+    def test_monitor_windows_one_class_alone(self):
+        t = [0.0]
+        mon = SLOMonitor(
+            {"p99_ms[premium]": 50.0}, window_s=60.0, clock=lambda: t[0],
+        )
+        for i in range(8):
+            mon.observe({
+                "kind": "serve", "event": "resolve", "latency_ms": 500.0,
+                "slo_class": "batch", "request_id": i,
+            })
+        assert mon.evaluate() == []  # batch pain never arms premium's rule
+        for i in range(8, 16):
+            mon.observe({
+                "kind": "serve", "event": "resolve", "latency_ms": 80.0,
+                "slo_class": "premium", "request_id": i,
+            })
+        (breach,) = mon.evaluate()
+        assert breach["rule"] == "p99_ms[premium]"
+        assert breach["slo_class"] == "premium"
+        assert schema.validate_record(breach) == []
+
+    def test_shed_reclassifies_settle_failed(self):
+        """A shed's settle-"failed" fires first; the richer shed leaf
+        must reclassify the SAME request, not double-count it."""
+        t = [0.0]
+        mon = SLOMonitor(
+            {"shed_rate[batch]": 0.4}, window_s=60.0, clock=lambda: t[0],
+        )
+        mon.observe({"kind": "serve", "event": "settle", "outcome": "served",
+                     "slo_class": "batch", "request_id": 1})
+        mon.observe({"kind": "serve", "event": "settle", "outcome": "failed",
+                     "slo_class": "batch", "request_id": 2})
+        mon.observe({"kind": "serve", "event": "shed",
+                     "slo_class": "batch", "request_id": 2})
+        (breach,) = mon.evaluate()
+        # 1 shed / (1 shed + 1 served) = 0.5 — request 2 counted ONCE.
+        assert breach["observed"] == pytest.approx(0.5)
+
+
+class TestSchemaV11:
+    def _rec(self, event, **kw):
+        return schema.stamp(
+            {"event": event, "request_id": 1, "trace_id": None,
+             "span_id": None, "parent_span": None, **kw},
+            kind="serve",
+        )
+
+    @pytest.mark.parametrize("event", ["admit", "shed", "settle", "resolve"])
+    def test_tenant_scoped_events_require_the_key(self, event):
+        rec = self._rec(event)
+        rec.pop("slo_class", None)
+        assert any("slo_class" in e for e in schema.validate_record(rec))
+        rec["slo_class"] = None  # classless stamps null — fine
+        assert schema.validate_record(rec) == []
+        rec["slo_class"] = "premium"
+        assert schema.validate_record(rec) == []
+
+    def test_workload_records_require_the_key(self):
+        rec = schema.stamp(
+            {"t": 0.0, "signature": "bucket:3x8x8", "outcome": "offered"},
+            kind="workload",
+        )
+        rec.pop("slo_class", None)
+        assert any("slo_class" in e for e in schema.validate_record(rec))
+        rec["slo_class"] = None
+        assert schema.validate_record(rec) == []
+
+    def test_pre_v11_records_are_grandfathered(self):
+        rec = self._rec("admit")
+        rec.pop("slo_class", None)
+        rec["schema_version"] = 10
+        assert schema.validate_record(rec) == []
+
+    def test_untenanted_serve_events_unconstrained(self):
+        rec = self._rec("ladder")
+        rec.pop("slo_class", None)
+        rec["rung"] = "capped_iters"
+        assert schema.validate_record(rec) == []
+
+
+# ---------------------------------------------------------------------------
+# elastic binding + class-weighted regret (stamped-evidence semantics)
+# ---------------------------------------------------------------------------
+
+
+def _evidence(**kw):
+    ev = {
+        "n_engines": 1, "min_engines": 1, "max_engines": 4,
+        "breaches": [], "headroom": 0.5, "low_water": 0.2,
+        "high_water": 0.7, "dwell_s": 1.0, "below_held_s": None,
+        "above_held_s": None, "anticipatory": False,
+        "target_utilization": 0.8, "forecast": None,
+        "lead_time_ms": None, "lead_quantile": None,
+        "fleet_service_rate_rps": None,
+    }
+    ev.update(kw)
+    return ev
+
+
+class TestBindingBreaches:
+    def test_rule_class_parses_hostile_input(self):
+        assert rule_class("p99_ms[premium]") == "premium"
+        assert rule_class("p99_ms") is None
+        assert rule_class("p99_ms[") is None      # malformed: tolerate
+        assert rule_class("p99_ms[]") is None
+        assert rule_class(17) is None
+
+    def test_no_low_classes_passes_breaches_verbatim(self):
+        ev = _evidence(breaches=["p99_ms", "shed_rate[batch]"])
+        assert binding_breaches(ev) == ["p99_ms", "shed_rate[batch]"]
+
+    def test_low_class_breach_does_not_force_scale_out(self):
+        ev = _evidence(
+            breaches=["p99_ms[batch]"], low_classes=["batch"],
+        )
+        assert policy_action(ev) is None  # batch pain spends no hardware
+
+    def test_premium_breach_still_scales_out(self):
+        ev = _evidence(
+            breaches=["p99_ms[premium]"], low_classes=["batch"],
+        )
+        assert binding_breaches(ev) == ["p99_ms[premium]"]
+        assert policy_action(ev) == "scale_out"
+
+    def test_unscoped_breach_is_always_binding(self):
+        ev = _evidence(breaches=["p99_ms"], low_classes=["batch"])
+        assert policy_action(ev) == "scale_out"
+
+    def test_low_class_breach_cannot_veto_scale_in(self):
+        quiet = _evidence(n_engines=2, above_held_s=5.0)
+        assert policy_action(quiet) == "scale_in"
+        batch_pain = _evidence(
+            n_engines=2, above_held_s=5.0,
+            breaches=["shed_rate[batch]"], low_classes=["batch"],
+        )
+        assert policy_action(batch_pain) == "scale_in"
+        premium_pain = _evidence(
+            n_engines=2, above_held_s=5.0,
+            breaches=["p99_ms[premium]"], low_classes=["batch"],
+        )
+        assert policy_action(premium_pain) != "scale_in"
+
+
+class TestWeightedRegret:
+    def _chain(self, failures, *, weights=None, low=("batch",)):
+        ev = _evidence(
+            breaches=["p99_ms[premium]"], low_classes=list(low),
+            lead_time_ms=1000.0,
+        )
+        if weights is not None:
+            ev["class_weights"] = dict(weights)
+        recs = [
+            {"kind": "decision", "schema_version": 11, "t": 1.0,
+             "fleet": "f0", "decision_id": 1, "prev_decision_id": None,
+             "action": "scale_out", "evidence": ev},
+            {"kind": "serve", "event": "scale_out", "fleet": "f0",
+             "decision_id": 1, "t": 1.1, "spawn_ms": 100.0},
+        ]
+        recs += failures
+        return recs
+
+    def test_regret_weighted_prices_failures_by_class(self):
+        recs = self._chain(
+            [
+                {"kind": "serve", "event": "shed", "t": 1.5,
+                 "slo_class": "premium"},
+                {"kind": "serve", "event": "shed", "t": 1.6,
+                 "slo_class": "batch"},
+                {"kind": "serve", "event": "shed", "t": 1.7},  # unclassed
+            ],
+            weights={"premium": 8.0, "standard": 2.0, "batch": 1.0},
+        )
+        rep = audit_records(recs)
+        assert rep["errors"] == []
+        assert rep["regret_total"] == 3
+        assert rep["regret_weighted"] == pytest.approx(8.0 + 1.0 + 1.0)
+        (pd,) = rep["regret_per_decision"]
+        assert pd["regret_weighted"] == pytest.approx(10.0)
+
+    def test_breach_rule_scope_classifies_failures(self):
+        recs = self._chain(
+            [{"kind": "slo_breach", "rule": "p99_ms[premium]", "t": 1.4}],
+            weights={"premium": 8.0},
+        )
+        rep = audit_records(recs)
+        assert rep["regret_weighted"] == pytest.approx(8.0)
+
+    def test_without_weights_weighted_equals_count(self):
+        recs = self._chain(
+            [{"kind": "serve", "event": "shed", "t": 1.5,
+              "slo_class": "premium"}],
+        )
+        rep = audit_records(recs)
+        assert rep["regret_total"] == 1
+        assert rep["regret_weighted"] == pytest.approx(1.0)
+
+    def test_evidence_conservation_replays_class_stance(self):
+        """The stamped bundle is self-contained: the audit replays
+        binding_breaches from evidence alone, so a low-class-only
+        scale-out FAILS conservation."""
+        ev = _evidence(breaches=["p99_ms[batch]"], low_classes=["batch"])
+        recs = [
+            {"kind": "decision", "schema_version": 11, "t": 1.0,
+             "fleet": "f0", "decision_id": 1, "prev_decision_id": None,
+             "action": "scale_out", "evidence": ev},
+            {"kind": "serve", "event": "scale_out", "fleet": "f0",
+             "decision_id": 1, "t": 1.1, "spawn_ms": 10.0},
+        ]
+        rep = audit_records(recs)
+        assert any("replays to" in e for e in rep["errors"])
+
+
+# ---------------------------------------------------------------------------
+# workload class mix + compare rows
+# ---------------------------------------------------------------------------
+
+
+class TestWorkloadClassMix:
+    def test_parse_class_mix(self):
+        from glom_tpu.serve.workload import parse_class_mix
+
+        assert parse_class_mix("premium=0.2,batch=0.5") == {
+            "premium": 0.2, "batch": 0.5,
+        }
+        assert parse_class_mix(None) is None
+        assert parse_class_mix("") is None
+        with pytest.raises(ValueError, match="sum"):
+            parse_class_mix("a=0.7,b=0.6")
+        with pytest.raises(ValueError):
+            parse_class_mix("a=1.5")
+        with pytest.raises(ValueError):
+            parse_class_mix("noequals")
+
+    def test_generate_deals_classes_deterministically(self):
+        from glom_tpu.serve.workload import generate
+
+        mix = {"premium": 0.2, "batch": 0.5}
+        a = generate("flash-crowd", 4.0, seed=7, class_mix=mix)
+        b = generate("flash-crowd", 4.0, seed=7, class_mix=mix)
+        assert a == b  # seeded: the mix never breaks determinism
+        assert all("slo_class" in r for r in a)
+        assert all(schema.validate_record(r) == [] for r in a)
+        dealt = [r["slo_class"] for r in a]
+        n = len(dealt)
+        # Mixed per the fractions (loose: it's a seeded draw), with the
+        # 0.3 remainder unclassed (null).
+        assert 0.05 * n < dealt.count("premium") < 0.45 * n
+        assert 0.30 * n < dealt.count("batch") < 0.70 * n
+        assert dealt.count(None) > 0
+
+    def test_classless_scenario_stamps_null(self):
+        from glom_tpu.serve.workload import generate
+
+        recs = generate("diurnal", 2.0, seed=3)
+        assert recs and all(r["slo_class"] is None for r in recs)
+
+    def test_replay_reoffers_the_recorded_class(self):
+        from glom_tpu.serve.workload import generate, replay
+
+        recs = generate("flash-crowd", 3.0, seed=1,
+                        class_mix={"premium": 0.5})
+        seen = []
+        t = [0.0]
+
+        def clock():
+            return t[0]
+
+        def sleep(dt):
+            t[0] += dt
+
+        replay(recs, lambda rec, i: seen.append(rec.get("slo_class")),
+               clock=clock, sleep=sleep)
+        assert seen == [r["slo_class"] for r in recs]
+        assert "premium" in seen
+
+
+class TestCompareClassRows:
+    SUMMARY = {
+        "kind": "serve", "event": "summary", "config": "tiny",
+        "engines": {},
+        "classes": {
+            "premium": {"n_requests": 10, "n_served": 10, "n_shed": 0,
+                        "n_failed": 0, "n_degraded": 0,
+                        "served_fraction": 1.0},
+            "batch": {"n_requests": 10, "n_served": 3, "n_shed": 7,
+                      "n_failed": 0, "n_degraded": 2,
+                      "served_fraction": 0.3},
+        },
+        "class_scheduler": {
+            "starvation_floor": 0.1, "n_picks": 13, "n_floor_picks": 2,
+            "picks": {"premium": 10, "batch": 3},
+            "lane_full": {"batch": 7},
+        },
+    }
+
+    def test_class_nest_flattens_to_gateable_rows(self):
+        from glom_tpu.telemetry.compare import flatten_engine_metrics
+
+        rows = {r["metric"]: r for r in flatten_engine_metrics(self.SUMMARY)}
+        assert rows["serve_class.batch.n_shed (tiny)"]["value"] == 7.0
+        assert rows["serve_class.batch.served_fraction (tiny)"] == {
+            "metric": "serve_class.batch.served_fraction (tiny)",
+            "value": 0.3, "unit": "fraction", "kind": "bench",
+        }
+        assert rows["serve_class.batch.lane_full_rejects (tiny)"][
+            "value"
+        ] == 7.0
+        assert "serve_class.premium.n_failed (tiny)" in rows
+        # Scheduler pick counters are workload, not quality: never gate.
+        assert not any("picks" in m for m in rows)
+
+    def test_directions(self):
+        from glom_tpu.telemetry.compare import lower_is_better
+
+        assert lower_is_better("serve_class.premium.n_failed (t)", "count")
+        assert lower_is_better("serve_class.premium.n_shed (t)", "count")
+        assert lower_is_better("serve_class.premium.n_degraded (t)", "count")
+        assert lower_is_better(
+            "serve_class.batch.lane_full_rejects (t)", "count"
+        )
+        assert not lower_is_better(
+            "serve_class.batch.served_fraction (t)", "fraction"
+        )

@@ -168,7 +168,10 @@ class TestSchemaV6Contract:
         assert errs and "trace" in errs[0]
 
     def test_null_trace_key_is_explicitly_untraced_and_valid(self):
-        assert schema.validate_record(serve("shed", trace_id=None)) == []
+        # v11: request-scoped events also carry slo_class (null =
+        # classless), so the minimal valid shed stamps both keys.
+        assert schema.validate_record(
+            serve("shed", trace_id=None, slo_class=None)) == []
         assert schema.validate_record(
             serve("dispatch", trace_ids=None)) == []
 
