@@ -45,6 +45,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         "refuses unreviewed entries)",
     )
     ap.add_argument(
+        "--prune-baseline", action="store_true",
+        help="drop baseline entries that no longer fire. DRY RUN by "
+        "default (prints what would be removed); add --apply to rewrite "
+        "the baseline and leave a stamped removal list next to it",
+    )
+    ap.add_argument(
+        "--apply", action="store_true",
+        help="with --prune-baseline: actually rewrite the baseline file",
+    )
+    ap.add_argument(
+        "--cache", metavar="FILE", default=None,
+        help="per-file content-fingerprint cache: files whose import "
+        "closure is unchanged reuse their stored findings (cross-module "
+        "edits invalidate importers; corruption falls back to a full "
+        "pass, loudly)",
+    )
+    ap.add_argument(
         "--select", default=None,
         help="comma-separated checker names to run (default: all)",
     )
@@ -64,14 +81,33 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.select
         else None
     )
+    cache = None
+    if args.cache:
+        from glom_tpu.analysis.cache import AnalysisCache
+
+        cache = AnalysisCache(args.cache)
     warnings: List[str] = []
     try:
-        findings = run(args.paths, select=select, warnings=warnings)
+        findings = run(
+            args.paths, select=select, warnings=warnings, cache=cache
+        )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     for w in warnings:
         print(f"warning: {w}")
+    if cache is not None:
+        print(cache.stats())
+
+    if args.prune_baseline:
+        if select is not None:
+            print(
+                "error: --prune-baseline needs a full run — a partial "
+                "--select cannot judge staleness",
+                file=sys.stderr,
+            )
+            return 2
+        return _prune_baseline(args, findings)
 
     if args.write_baseline:
         baseline_mod.write(findings, args.write_baseline)
@@ -123,6 +159,56 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         print("glom-lint: clean")
     return rc
+
+
+def _prune_baseline(args, findings) -> int:
+    """--prune-baseline: drop suppressions that no longer fire. Dry run
+    unless --apply; --apply rewrites the baseline and writes
+    <baseline>.removed.json — the stamped record of what was dropped and
+    why it was once accepted (the entries keep their reviewed notes)."""
+    import datetime
+    import json
+
+    baseline_path = args.baseline or DEFAULT_BASELINE
+    try:
+        data = baseline_mod.load(baseline_path)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    pruned, removed = baseline_mod.prune(data, findings)
+    if not removed:
+        print(f"{baseline_path}: no stale entries — nothing to prune")
+        return 0
+    for fp in removed:
+        print(f"stale: {fp}")
+    if not args.apply:
+        print(
+            f"dry run: {len(removed)} stale entr"
+            f"{'y' if len(removed) == 1 else 'ies'} in {baseline_path}; "
+            "re-run with --apply to rewrite it"
+        )
+        return 0
+    removal_list = {
+        "pruned_at": datetime.datetime.now(datetime.timezone.utc).isoformat(
+            timespec="seconds"
+        ),
+        "baseline": baseline_path,
+        "removed": {
+            fp: data.get("suppressions", {}).get(fp) for fp in removed
+        },
+    }
+    Path(baseline_path).write_text(
+        json.dumps(pruned, indent=2, sort_keys=True) + "\n"
+    )
+    removal_path = f"{baseline_path}.removed.json"
+    Path(removal_path).write_text(
+        json.dumps(removal_list, indent=2, sort_keys=True) + "\n"
+    )
+    print(
+        f"pruned {len(removed)} entr{'y' if len(removed) == 1 else 'ies'} "
+        f"from {baseline_path}; removal list stamped at {removal_path}"
+    )
+    return 0
 
 
 if __name__ == "__main__":

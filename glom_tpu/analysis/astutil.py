@@ -92,10 +92,14 @@ class FuncInfo:
         while stack:
             node = stack.pop()
             yield node
-            for child in ast.iter_child_nodes(node):
-                if isinstance(child, SCOPE_NODES):
-                    continue
-                stack.append(child)
+            if isinstance(node, SCOPE_NODES):
+                # A nested def/lambda statement is visible, its body is
+                # its own scope — including when the def is a DIRECT
+                # statement of this body (that case used to leak, which
+                # surfaced the moment cross-module reach met the
+                # io_callback host-half idiom in telemetry/counters.py).
+                continue
+            stack.extend(ast.iter_child_nodes(node))
 
 
 class ModuleIndex:

@@ -19,17 +19,29 @@ axis can exist in the mesh without sharding any input):
 
   * a `mesh=` argument whose value (directly or via one local/module
     assignment) contains a literal `MeshConfig(data=..., seq=...)` call
-    — the keyword names ARE the axis names (MeshConfig.axis_names); or,
+    — the keyword names ARE the sharded-axis INTENT (a MeshConfig mesh
+    physically carries all of axis_names, but a collective over an axis
+    the config never sized is dead wire the lint should question); or,
     failing that,
-  * a mesh= argument that is an OPAQUE PARAMETER of the enclosing
-    function, followed back through the intra-module call graph: when
-    every intra-module caller's argument (directly, via one local
-    assignment, or via the caller's OWN parameter one more hop up)
-    attests a MeshConfig, the UNION of those callers' axes is the
-    environment — more specific than the module union, which is what
-    catches a serve-shaped helper in a file that also builds a 'model'-
-    carrying training mesh; one unresolvable caller skips (never
-    guess); or, failing that,
+  * the mesh VALUE followed through the PROJECT-WIDE flow graph
+    (analysis/project.py), any combination of these hops:
+      - an opaque parameter, followed back to every analyzed caller
+        (cross-module, via ProjectGraph.callers_of) — the UNION of the
+        callers' attested axes, with ONE unresolvable caller poisoning
+        the whole attestation (never guess);
+      - a parameter or `self.attr` whose ANNOTATION resolves to
+        MeshConfig — attests the full axis tuple {data, seq, model}
+        (MeshConfig.axis_names is unconditionally all three; only a
+        visible ctor can narrow intent below that);
+      - `self.attr`, chased to the enclosing class's `__init__`
+        assignments (every assignment must attest; union);
+      - a factory call `make_mesh(cfg, ...)` whose callee's matched
+        parameter is annotated MeshConfig — recurses into the argument
+        expression at the call site (the trainer/runtime shape:
+        `self.mesh = make_mesh(mesh_cfg, devices)` with
+        `mesh_cfg: MeshConfig` on the ctor);
+    this is what finally attests the training shard bodies, whose mesh
+    is built two modules away from the shard_map site; or, failing that,
   * the MODULE-WIDE union of every MeshConfig axis keyword in the file
     (a module that only ever builds (data, seq) meshes — the serve mesh
     — never legally runs a 'model' collective);
@@ -37,13 +49,17 @@ axis can exist in the mesh without sharding any input):
     local-variable indirection, `batch_spec = P(DATA_AXIS)`) UNION into
     the environment but never attest it on their own.
 
-A shard_map with no attested environment (an opaque mesh parameter in a
-module that builds no meshes — the training shard bodies, whose mesh
-shapes arrive from config) is SKIPPED — precision stance: this checker
-only fires when it can prove the axis absent. Collectives are checked
-through the body's intra-module call graph, both direct lax.* sites and
-axis names threaded through `*axis*`-named parameters of local helpers
-(the `_psum_wire(x, SEQ_AXIS, k)` idiom).
+A shard_map with no attested environment is SKIPPED — precision stance:
+this checker only fires when it can prove the axis absent. Known residual
+blind spot: a call site the resolver cannot see AT ALL (a function-valued
+variable, method dispatch it cannot type) is missed rather than poisoned,
+so a missed caller with a WIDER mesh could over-flag — every such flag is
+a reviewable claim with file:line, and the pragma/baseline channel is the
+escape hatch. Collectives are checked through the body's
+intra-module call graph, both direct lax.* sites and axis names threaded
+through `*axis*`-named parameters of local helpers (the
+`_psum_wire(x, SEQ_AXIS, k)` idiom). Each site's attestation (source and
+axes) is recorded in ctx.scratch['axis-environment:attested'].
 """
 
 from __future__ import annotations
@@ -63,6 +79,13 @@ from glom_tpu.analysis.core import Checker, Context, Finding, SourceModule
 # MeshConfig keyword names that declare axes (num_slices is a layout
 # knob, not an axis — parallel/mesh.py).
 _MESH_AXIS_KW = {"data", "seq", "model"}
+
+# Mesh-flow hop budget (decremented at EVERY helper transition, so the
+# real trainer chain — site param -> intra caller -> cross-module caller
+# -> self.attr -> __init__ factory -> annotated ctor param — costs nine).
+# Cycles are cut by the `seen` guards; this only bounds pathological
+# non-cyclic chains.
+_FLOW_DEPTH = 16
 
 
 def _local_assignments(fn_node: Optional[ast.AST], tree: ast.Module):
@@ -172,7 +195,7 @@ class AxisEnvironment(Checker):
             if not name or name.split(".")[-1] != "shard_map":
                 continue
             for f in self._check_shard_map(
-                module, node, aliases, consts, module_mesh_axes
+                module, node, aliases, consts, module_mesh_axes, ctx
             ):
                 # A helper reached from several shard_map sites yields
                 # one finding per site — identical claims dedup.
@@ -191,6 +214,7 @@ class AxisEnvironment(Checker):
         aliases: dict,
         consts: Dict[str, str],
         module_mesh_axes: Set[str],
+        ctx: Context,
     ) -> List[Finding]:
         enclosing = enclosing_function(module.parents, call)
         assigns = _local_assignments(enclosing, module.tree)
@@ -202,15 +226,37 @@ class AxisEnvironment(Checker):
             elif kw.arg == "mesh":
                 mesh_arg = kw.value
         attested = _mesh_axes(mesh_arg, assigns)
+        how = "ctor"
         if not attested:
-            # Opaque parameter: follow the INTRA-MODULE callers' mesh
-            # argument back to their MeshConfig — caller-specific axes
-            # beat the module union (a file can build both a (data, seq)
-            # serve mesh and a 'model'-carrying training mesh; the union
-            # would attest the wrong environment for both).
-            attested = self._caller_attested(module, enclosing, mesh_arg)
+            # Opaque mesh value: follow it through the PROJECT flow graph
+            # — callers (cross-module), MeshConfig annotations, __init__
+            # attribute assignments, mesh-factory calls. Flow-specific
+            # axes beat the module union (a file can build both a
+            # (data, seq) serve mesh and a 'model'-carrying training
+            # mesh; the union would attest the wrong environment for
+            # both).
+            finfo = (
+                module.index.info_for(enclosing)
+                if enclosing is not None
+                else None
+            )
+            attested = self._attest_value(
+                self._project(ctx, module), module, finfo, mesh_arg,
+                assigns, _FLOW_DEPTH, set(),
+            )
+            how = "flow"
         if not attested:
             attested = module_mesh_axes
+            how = "module-union"
+        trail = ctx.scratch.setdefault("axis-environment:attested", [])
+        trail.append(
+            (
+                module.relpath,
+                call.lineno,
+                how if attested else "unattested",
+                tuple(sorted(attested)),
+            )
+        )
         if not attested:
             return []  # opaque environment: skip, never guess
         env = attested | spec_env
@@ -228,68 +274,238 @@ class AxisEnvironment(Checker):
                 )
         return findings
 
-    def _caller_attested(
-        self,
-        module: SourceModule,
-        enclosing: Optional[ast.AST],
-        mesh_arg: Optional[ast.AST],
-        depth: int = 3,
+    # -- mesh-flow attestation (project-wide) --------------------------------
+
+    @staticmethod
+    def _project(ctx: Context, module: SourceModule):
+        if ctx.project is not None:
+            return ctx.project
+        from glom_tpu.analysis.project import ProjectGraph
+
+        return ProjectGraph(ctx.modules or [module])
+
+    @staticmethod
+    def _is_meshconfig(tref) -> bool:
+        return (
+            tref is not None
+            and tref.cls is not None
+            and tref.cls.split(":")[-1] == "MeshConfig"
+        )
+
+    def _attest_value(
+        self, project, module, finfo, expr, assigns, depth, seen
+    ) -> Set[str]:
+        """Axes provable for a mesh-valued EXPRESSION in the context of
+        (module, finfo): literal MeshConfig keywords first (intent), then
+        local assignment chasing, MeshConfig-annotated parameters (full
+        axis tuple), caller attestation for opaque parameters,
+        `self.attr` via the enclosing class's __init__, and
+        MeshConfig-annotated factory calls. Empty set = cannot prove."""
+        if expr is None or depth <= 0:
+            return set()
+        got = _mesh_axes(expr, assigns)
+        if got:
+            return got
+        if isinstance(expr, ast.Name):
+            bound = assigns.get(expr.id)
+            if bound is not None:
+                key = ("v", module.relpath, id(bound))
+                if key in seen:
+                    return set()
+                seen.add(key)
+                got = self._attest_value(
+                    project, module, finfo, bound, assigns, depth - 1, seen
+                )
+                if got:
+                    return got
+            if finfo is not None and expr.id in finfo.params:
+                if self._param_is_meshconfig(project, module, finfo, expr.id):
+                    return set(_MESH_AXIS_KW)
+                return self._attest_param(
+                    project, module, finfo, expr.id, depth - 1, seen
+                )
+            return set()
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and finfo is not None
+        ):
+            return self._attest_self_attr(
+                project, module, finfo, expr.attr, depth - 1, seen
+            )
+        if isinstance(expr, ast.Call):
+            return self._attest_factory(
+                project, module, finfo, expr, assigns, depth - 1, seen
+            )
+        return set()
+
+    @staticmethod
+    def _param_is_meshconfig(project, module, finfo, param: str) -> bool:
+        """The parameter's own annotation resolves to MeshConfig — the
+        full axis tuple is then structural (MeshConfig.axis_names is
+        unconditionally ('data', 'seq', 'model')), no ctor needed."""
+        a = getattr(finfo.node, "args", None)
+        if a is None:
+            return False
+        minfo = project.info_of(module)
+        for p in a.posonlyargs + a.args + a.kwonlyargs:
+            if p.arg == param:
+                return AxisEnvironment._is_meshconfig(
+                    project.annotation_type(minfo, p.annotation)
+                )
+        return False
+
+    def _attest_param(
+        self, project, module, finfo, param: str, depth, seen
     ) -> Set[str]:
         """Axes provable by following an opaque mesh PARAMETER back to
-        the intra-module callers that bind it. Attests only when at
-        least one caller is found AND every found caller's argument
-        resolves to a MeshConfig (directly, through one local
-        assignment, or through the caller's own parameter — bounded
-        recursion); any unresolvable caller returns the empty set, the
-        precision stance everywhere in this checker."""
-        if (
-            depth <= 0
-            or enclosing is None
-            or not isinstance(mesh_arg, ast.Name)
-        ):
+        every ANALYZED caller that binds it — cross-module, via the
+        project call graph. Attests only when at least one caller is
+        found AND every found caller's argument attests (through its own
+        flow, bounded recursion); any unresolvable caller returns the
+        empty set, the precision stance everywhere in this checker."""
+        if depth <= 0:
             return set()
-        info = module.index.info_for(enclosing)
-        if info is None or mesh_arg.id not in info.params:
+        key = ("p", module.relpath, finfo.qualname, param)
+        if key in seen:
+            return set()  # recursion never adds evidence
+        seen.add(key)
+        a = getattr(finfo.node, "args", None)
+        if a is None:
             return set()
-        param = mesh_arg.id
-        a = enclosing.args
         pos_names = [p.arg for p in a.posonlyargs + a.args]
         axes: Set[str] = set()
         found = False
-        for caller in module.index.functions.values():
-            if caller.node is enclosing:
+        for cinfo, cfinfo, call in project.callers_of(finfo):
+            if cfinfo is not None and cfinfo.node is finfo.node:
                 continue  # self-recursion never adds evidence
-            for sub in caller.body_nodes():
-                if not isinstance(sub, ast.Call):
-                    continue
-                name = call_name(sub)
-                if not name or "." in name:
-                    continue
-                callee = caller.scope.resolve(name)
-                if callee is None or callee.node is not enclosing:
-                    continue
-                arg_expr = None
-                for kw in sub.keywords:
-                    if kw.arg == param:
-                        arg_expr = kw.value
-                if arg_expr is None and param in pos_names:
-                    idx = pos_names.index(param)
-                    if idx < len(sub.args):
-                        arg_expr = sub.args[idx]
-                if arg_expr is None:
-                    return set()  # splat / default binding: never guess
-                caller_assigns = _local_assignments(
-                    caller.node, module.tree
-                )
-                got = _mesh_axes(arg_expr, caller_assigns)
-                if not got and isinstance(arg_expr, ast.Name):
-                    got = self._caller_attested(
-                        module, caller.node, arg_expr, depth - 1
-                    )
-                if not got:
-                    return set()  # one unattested caller poisons all
-                found = True
-                axes |= got
+            arg_expr = None
+            for kw in call.keywords:
+                if kw.arg == param:
+                    arg_expr = kw.value
+            if arg_expr is None and param in pos_names:
+                idx = pos_names.index(param)
+                if idx < len(call.args) and not any(
+                    isinstance(p, ast.Starred) for p in call.args[: idx + 1]
+                ):
+                    arg_expr = call.args[idx]
+            if arg_expr is None:
+                return set()  # splat / default binding: never guess
+            cmod = cinfo.module
+            cassigns = _local_assignments(
+                cfinfo.node if cfinfo is not None else None, cmod.tree
+            )
+            got = self._attest_value(
+                project, cmod, cfinfo, arg_expr, cassigns, depth - 1, seen
+            )
+            if not got:
+                return set()  # one unattested caller poisons all
+            found = True
+            axes |= got
+        return axes if found else set()
+
+    def _attest_self_attr(
+        self, project, module, finfo, attr: str, depth, seen
+    ) -> Set[str]:
+        """`self.attr` mesh: every `self.attr = ...` assignment in the
+        enclosing class's __init__ must attest (union); a
+        MeshConfig-typed attribute attests the full tuple outright."""
+        cls = project.enclosing_class(module, finfo)
+        if cls is None or depth <= 0:
+            return set()
+        minfo = project.info_of(module)
+        if self._is_meshconfig(project.class_attr_types(minfo, cls).get(attr)):
+            return set(_MESH_AXIS_KW)
+        init = next(
+            (
+                n
+                for n in cls.body
+                if isinstance(n, ast.FunctionDef) and n.name == "__init__"
+            ),
+            None,
+        )
+        init_info = module.index.info_for(init) if init is not None else None
+        if init_info is None:
+            return set()
+        init_assigns = _local_assignments(init, module.tree)
+        values = []
+        for stmt in init_info.body_nodes():
+            if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (
+                stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            )
+            for t in targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                    and t.attr == attr
+                    and stmt.value is not None
+                ):
+                    values.append(stmt.value)
+        if not values:
+            return set()
+        axes: Set[str] = set()
+        for value in values:
+            key = ("v", module.relpath, id(value))
+            if key in seen:
+                return set()
+            seen.add(key)
+            got = self._attest_value(
+                project, module, init_info, value, init_assigns,
+                depth - 1, seen,
+            )
+            if not got:
+                return set()  # one opaque assignment poisons the attr
+            axes |= got
+        return axes
+
+    def _attest_factory(
+        self, project, module, finfo, call: ast.Call, assigns, depth, seen
+    ) -> Set[str]:
+        """A call whose resolvable callee takes a MeshConfig-annotated
+        parameter builds its mesh FROM that config — recurse into the
+        matched argument expression at this call site (the
+        `make_mesh(mesh_cfg, devices)` shape). Every bound
+        MeshConfig-annotated argument must attest; union."""
+        if depth <= 0:
+            return set()
+        hit = project.resolve_call(module, finfo, call)
+        if hit is None:
+            return set()
+        tminfo, tfinfo = hit
+        a = getattr(tfinfo.node, "args", None)
+        if a is None:
+            return set()
+        pos_names = [p.arg for p in a.posonlyargs + a.args]
+        axes: Set[str] = set()
+        found = False
+        for p in a.posonlyargs + a.args + a.kwonlyargs:
+            if not self._is_meshconfig(
+                project.annotation_type(tminfo, p.annotation)
+            ):
+                continue
+            arg_expr = None
+            for kw in call.keywords:
+                if kw.arg == p.arg:
+                    arg_expr = kw.value
+            if arg_expr is None and p.arg in pos_names:
+                idx = pos_names.index(p.arg)
+                if idx < len(call.args) and not any(
+                    isinstance(q, ast.Starred) for q in call.args[: idx + 1]
+                ):
+                    arg_expr = call.args[idx]
+            if arg_expr is None:
+                continue  # defaulted config: no evidence either way
+            got = self._attest_value(
+                project, module, finfo, arg_expr, assigns, depth - 1, seen
+            )
+            if not got:
+                return set()  # an opaque config argument poisons the call
+            found = True
+            axes |= got
         return axes if found else set()
 
     def _reachable(self, module: SourceModule, enclosing, body) -> List:

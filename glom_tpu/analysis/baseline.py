@@ -67,6 +67,24 @@ def apply(
     return new, stale
 
 
+def prune(
+    baseline: dict, findings: List[Finding]
+) -> Tuple[dict, List[str]]:
+    """(pruned_baseline, removed_fingerprints): drop suppressions whose
+    fingerprint no longer occurs in `findings` AT ALL — the stale
+    entries the apply() warnings have been nagging about. Entries with
+    some occurrences keep their full count (count ratcheting is a
+    manual review decision, not an automated one)."""
+    seen = Counter(f.fingerprint for f in findings)
+    supp = baseline.get("suppressions", {})
+    removed = sorted(fp for fp in supp if seen[fp] == 0)
+    out = dict(baseline)
+    out["suppressions"] = {
+        fp: entry for fp, entry in supp.items() if seen[fp] > 0
+    }
+    return out, removed
+
+
 def build(findings: List[Finding], *, reviewed: str = "") -> dict:
     """Baseline dict accepting exactly the given findings. `reviewed` is
     written into every entry; entries with an empty note are rejected at

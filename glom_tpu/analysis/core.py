@@ -124,6 +124,14 @@ class Context:
     """Cross-module facts the checkers share (built once per run)."""
 
     modules: List[SourceModule] = field(default_factory=list)
+    # The whole-program layer (analysis/project.py): import graph,
+    # cross-module symbol/call resolution, the type layer. Built once in
+    # run() over the analyzed set; checkers that compute project-wide
+    # results cache them keyed by id(self) (one Context = one run).
+    project: Optional[object] = None
+    # Scratch channel for project-wide per-checker caches and the
+    # attestation debug trail the tests read.
+    scratch: dict = field(default_factory=dict)
     # Mesh-axis vocabulary: values of module-level *_AXIS string constants
     # across the scanned tree, plus the MeshConfig.axis_names convention.
     axis_vocab: Set[str] = field(default_factory=lambda: {"data", "seq", "model"})
@@ -238,6 +246,8 @@ def run(
     select: Optional[Iterable[str]] = None,
     checkers: Optional[List[Checker]] = None,
     warnings: Optional[List[str]] = None,
+    cache: Optional[object] = None,
+    scratch: Optional[dict] = None,
 ) -> List[Finding]:
     """Run the pass; returns findings NOT suppressed by inline pragmas
     (baseline filtering is the caller's job — see baseline.apply).
@@ -245,9 +255,24 @@ def run(
     unparseable files. When `warnings` is given (and every checker ran —
     a partial --select can't judge), pragmas that suppressed nothing are
     reported into it so fixed-and-forgotten suppressions rot visibly,
-    mirroring the baseline's stale-entry warnings."""
+    mirroring the baseline's stale-entry warnings.
+
+    `cache` is an analysis/cache.py AnalysisCache: every file is still
+    PARSED (the project graph needs the whole analyzed set), but files
+    whose content-fingerprint closure is unchanged reuse their stored
+    findings/warnings instead of re-running the checkers.
+
+    `scratch`, when given, is used as the Context's scratch dict so
+    callers (tests, tooling) can inspect the project-wide evidence the
+    checkers record there — the lock-order acquisition edges, the
+    axis-environment attestation trail."""
+    from glom_tpu.analysis.project import ProjectGraph
+
     modules, findings = load_modules(paths)
     ctx = Context(modules=modules)
+    if scratch is not None:
+        ctx.scratch = scratch
+    ctx.project = ProjectGraph(modules)
     _collect_axis_vocab(modules, ctx)
     active = checkers if checkers is not None else default_checkers()
     if select is not None:
@@ -256,14 +281,26 @@ def run(
         if unknown:
             raise ValueError(f"unknown checkers: {sorted(unknown)}")
         active = [c for c in active if c.name in wanted]
+    if cache is not None:
+        cache.begin(ctx, active, select=select)
     for mod in modules:
+        if cache is not None:
+            hit = cache.lookup(mod)
+            if hit is not None:
+                mod_findings, mod_warnings = hit
+                findings.extend(mod_findings)
+                if warnings is not None:
+                    warnings.extend(mod_warnings)
+                continue
+        mod_findings: List[Finding] = []
+        mod_warnings: List[str] = []
         for checker in active:
             for f in checker.check(mod, ctx):
                 if not mod.suppressed(f):
-                    findings.append(f)
+                    mod_findings.append(f)
         for p in mod.pragmas:
             if not p.reason:
-                findings.append(
+                mod_findings.append(
                     Finding(
                         checker="pragma",
                         path=mod.relpath,
@@ -274,11 +311,18 @@ def run(
                         key="missing-reason",
                     )
                 )
-            elif warnings is not None and select is None and not p.used:
-                warnings.append(
+            elif select is None and not p.used:
+                mod_warnings.append(
                     f"{mod.relpath}:{p.line}: unused pragma "
                     f"ok[{','.join(sorted(p.checkers))}] — the finding it "
                     "suppressed no longer fires; delete it"
                 )
+        findings.extend(mod_findings)
+        if warnings is not None:
+            warnings.extend(mod_warnings)
+        if cache is not None:
+            cache.store(mod, mod_findings, mod_warnings)
+    if cache is not None:
+        cache.finish()
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.checker))
     return findings

@@ -54,12 +54,24 @@ fix is acquiring through the pin API, whose return value this rule
 deliberately does not taint. Compile-time `.buffer()` reads (dtype /
 shape probes that never reach a dispatch) stay clean.
 
-Branch structure is ignored (statement order by line); `*args` splats at
-call sites are skipped (positions unknowable — the runtime copy-guard in
-engine.infer stays the defense there), and cross-MODULE handle flows
-remain out of reach; docs/ANALYSIS.md says so. The seeded acceptance
-pairs are tests/fixtures/donation_memo.py and
-tests/fixtures/alias_pool.py.
+Cross-MODULE handle flow (the project graph + its type layer): handle
+attrs and provider methods are tabled GLOBALLY, keyed by class key
+('module:Class'), and call sites resolve their receiver's type — an
+annotated parameter, a constructor call, a typed `self.attr` from
+`__init__`, a dict-of-handles subscript — so a provider defined in
+serve/engine.py and dispatched from serve/batcher.py is the same
+analysis as the intra-class case. `self` is just a typed receiver of
+the enclosing class, which keeps the old intra-class behavior as the
+degenerate case (and single-file runs unchanged: an unresolvable
+receiver resolves to nothing).
+
+`fn(*args)` at a donating call site is no longer skipped: the splat
+makes donated POSITIONS unknowable, so the site itself is flagged
+(`splat-at-donating-call`) — either unpack explicitly so the pass can
+track the buffers, or pragma the site with the runtime guard that makes
+it safe. Branch structure is still ignored (statement order by line).
+The seeded acceptance pairs are tests/fixtures/donation_memo.py,
+tests/fixtures/alias_pool.py, and tests/fixtures/xmod_donation.py.
 """
 
 from __future__ import annotations
@@ -191,12 +203,45 @@ class DonationSafety(Checker):
     def check(self, module: SourceModule, ctx: Context) -> List[Finding]:
         handles = self._memo_handles(module)
         providers = self._providers(module, handles)
+        xhandles, xproviders = self._project_tables(ctx)
         findings: List[Finding] = []
         for info in module.index.functions.values():
             findings.extend(
-                self._check_function(module, info, handles, providers)
+                self._check_function(
+                    module,
+                    info,
+                    handles,
+                    providers,
+                    project=ctx.project,
+                    xhandles=xhandles,
+                    xproviders=xproviders,
+                )
             )
         return findings
+
+    def _project_tables(self, ctx: Context):
+        """Global (class_key, attr) -> spec and (class_key, method) ->
+        spec tables over every analyzed module, computed once per run —
+        the cross-module half of the memoized-handle analysis."""
+        key = "donation-safety:tables"
+        if key in ctx.scratch:
+            return ctx.scratch[key]
+        xhandles: Dict[Tuple[str, str], object] = {}
+        xproviders: Dict[Tuple[str, str], object] = {}
+        project = ctx.project
+        if project is not None:
+            for mod in ctx.modules:
+                minfo = project.info_of(mod)
+                handles = self._memo_handles(mod)
+                providers = self._providers(mod, handles)
+                for (cls, attr), spec in handles.items():
+                    if cls in minfo.classes:
+                        xhandles[(project.class_key(minfo, cls), attr)] = spec
+                for (cls, meth), spec in providers.items():
+                    if cls in minfo.classes:
+                        xproviders[(project.class_key(minfo, cls), meth)] = spec
+        ctx.scratch[key] = (xhandles, xproviders)
+        return ctx.scratch[key]
 
     def _memo_handles(self, module: SourceModule) -> Dict[Tuple[str, str], object]:
         """(class, attr) -> donation spec for `self.attr` / `self.attr[k]`
@@ -344,28 +389,51 @@ class DonationSafety(Checker):
             scope = scope.parent
         return donating
 
-    def _check_function(
+    def _donating_env(
         self,
         module: SourceModule,
         info: FuncInfo,
-        handles: Optional[Dict[Tuple[str, str], object]] = None,
-        providers: Optional[Dict[Tuple[str, str], object]] = None,
-    ) -> List[Finding]:
-        handles = handles or {}
-        providers = providers or {}
+        handles: Dict[Tuple[str, str], object],
+        providers: Dict[Tuple[str, str], object],
+        project,
+        xhandles: Dict[Tuple[str, str], object],
+        xproviders: Dict[Tuple[str, str], object],
+        seed: Optional[Dict[str, object]] = None,
+    ):
+        """(donating-name map, receiver-type resolver, method class) for
+        one function: jit-bound locals and decorated siblings, plus the
+        memoized-handle taint pass — names bound from a handle-attr load
+        or provider call become donating callables (`fn =
+        self._compile(...)`), tracked in statement order so a rebind to
+        something untainted clears the name (including a seeded one)."""
         cls = _method_class(info)
-        donating = self._donating_names(info)
-        # Memoized-handle taint: names bound from a handle-attr load or a
-        # provider-method call become donating callables too (the
-        # `fn = self._compile(...)` shape), tracked in statement order so
-        # a rebind to something untainted clears the name.
-        if cls is not None and (handles or providers):
+        donating: Dict[str, object] = dict(seed) if seed else {}
+        donating.update(self._donating_names(info))
+        # Typed-receiver resolution (cross-module): the project type
+        # layer maps a receiver expression to a class key, so the global
+        # handle/provider tables apply wherever the object travels.
+        # `self` is seeded as a receiver of the enclosing class (also
+        # visible by closure inside nested defs), which makes the
+        # intra-class case a degenerate typed lookup too.
+        rtype = None
+        if project is not None and (xhandles or xproviders):
+            rtype = project.receiver_resolver(module, info)
+
+        if (
+            donating
+            or (cls is not None and (handles or providers))
+            or rtype is not None
+        ):
             for stmt in _ordered(
                 n for n in info.body_nodes() if isinstance(n, ast.Assign)
             ):
                 spec = self._value_spec(
                     stmt.value, donating, cls, handles, providers
                 )
+                if spec is None:
+                    spec = self._xmod_value_spec(
+                        stmt.value, rtype, xhandles, xproviders
+                    )
                 for t in stmt.targets:
                     if not isinstance(t, ast.Name):
                         continue
@@ -377,7 +445,78 @@ class DonationSafety(Checker):
                         # `fn = self._compile(...)` must not flag
                         # plain_fn's call sites.
                         donating.pop(t.id, None)
-        has_handle_calls = cls is not None and handles
+        return donating, rtype, cls
+
+    @staticmethod
+    def _xmod_value_spec(
+        value: Optional[ast.AST],
+        rtype,
+        xhandles: Dict[Tuple[str, str], object],
+        xproviders: Dict[Tuple[str, str], object],
+    ) -> Optional[object]:
+        """Spec of a value obtained through a TYPED receiver: a provider
+        call (`eng._compile(...)` where `eng` resolves to engine.Engine),
+        or a handle-attr load (`eng._step`, `eng._compiled[sig]`)."""
+        if rtype is None or value is None:
+            return None
+        if isinstance(value, ast.Call) and isinstance(
+            value.func, ast.Attribute
+        ):
+            t = rtype(value.func.value)
+            if t is not None and t.cls is not None:
+                spec = xproviders.get((t.cls, value.func.attr))
+                if spec is not None:
+                    return spec
+        target = value
+        if isinstance(target, ast.Subscript):
+            target = target.value
+        if isinstance(target, ast.Attribute):
+            t = rtype(target.value)
+            if t is not None and t.cls is not None:
+                return xhandles.get((t.cls, target.attr))
+        return None
+
+    def _check_function(
+        self,
+        module: SourceModule,
+        info: FuncInfo,
+        handles: Optional[Dict[Tuple[str, str], object]] = None,
+        providers: Optional[Dict[Tuple[str, str], object]] = None,
+        project=None,
+        xhandles: Optional[Dict[Tuple[str, str], object]] = None,
+        xproviders: Optional[Dict[Tuple[str, str], object]] = None,
+    ) -> List[Finding]:
+        handles = handles or {}
+        providers = providers or {}
+        xhandles = xhandles or {}
+        xproviders = xproviders or {}
+        # Closure capture: a nested def dispatches through names bound in
+        # its ENCLOSING function (the engine's retry `attempt()` calls the
+        # `fn = self._compile(...)` the method bound outside it), so the
+        # donating map is seeded from the enclosing chain, outermost
+        # first; the local statement pass can still clear a seeded name
+        # on rebind.
+        chain: List[FuncInfo] = []
+        scope = info.scope.parent
+        while scope is not None:
+            einfo = module.index.info_for(scope.node)
+            if einfo is not None:
+                chain.append(einfo)
+            scope = scope.parent
+        donating: Dict[str, object] = {}
+        for einfo in reversed(chain):
+            outer, _, _ = self._donating_env(
+                module, einfo, handles, providers, project,
+                xhandles, xproviders, seed=donating,
+            )
+            donating = outer
+        donating, rtype, cls = self._donating_env(
+            module, info, handles, providers, project,
+            xhandles, xproviders, seed=donating,
+        )
+        has_handle_calls = (cls is not None and handles) or (
+            rtype is not None and xhandles
+        )
         if not donating and not has_handle_calls:
             return []
         # events in line order: donations (name killed at line) and uses
@@ -390,6 +529,7 @@ class DonationSafety(Checker):
         # through acquire_read() clears the hazard.
         buffer_lines: Dict[str, set] = {}
         alias_hits: List[Tuple[int, int, str, str]] = []
+        splat_hits: List[Tuple[int, int, str]] = []
         for node in info.body_nodes():
             if isinstance(node, ast.Assign) and (
                 isinstance(node.value, ast.Call)
@@ -413,12 +553,33 @@ class DonationSafety(Checker):
                     if attr is not None and (cls, attr) in handles:
                         spec = handles[(cls, attr)]
                         callee = f"self.{attr}[...]"
+                if spec is None and rtype is not None:
+                    # Typed-receiver dispatch across modules:
+                    # `eng._step(imgs)` / `self.engine._compiled[sig](x)`.
+                    target = node.func
+                    if isinstance(target, ast.Subscript):
+                        target = target.value
+                    if isinstance(target, ast.Attribute):
+                        t = rtype(target.value)
+                        if t is not None and t.cls is not None:
+                            hspec = xhandles.get((t.cls, target.attr))
+                            if hspec is not None:
+                                spec = hspec
+                                callee = dotted(target) or (
+                                    f"<{t.cls}>.{target.attr}"
+                                )
                 if spec is not None:
                     for pos, arg in enumerate(node.args):
                         if isinstance(arg, ast.Name) and (
                             spec == ALL_POSITIONS or pos in spec
                         ):
                             donations.append((node.lineno, arg.id, callee))
+                        elif isinstance(arg, ast.Starred) and (
+                            spec == ALL_POSITIONS or spec
+                        ):
+                            splat_hits.append(
+                                (node.lineno, arg.col_offset, callee)
+                            )
                     for arg in node.args:
                         if (
                             isinstance(arg, ast.Call)
@@ -446,6 +607,24 @@ class DonationSafety(Checker):
                     uses.append((node.lineno, node.col_offset, node))
 
         findings: List[Finding] = []
+        for line, col, callee in splat_hits:
+            findings.append(
+                Finding(
+                    checker=self.name,
+                    path=module.relpath,
+                    line=line,
+                    col=col,
+                    message=(
+                        f"*-splat at donating dispatch {callee}(...) — the "
+                        "donated positions are unknowable statically, so "
+                        "every splatted buffer may be invalidated; unpack "
+                        "the arguments explicitly, or pragma the site with "
+                        "the runtime guard that makes the reuse safe"
+                    ),
+                    symbol=info.qualname,
+                    key="splat-at-donating-call",
+                )
+            )
         for line, col, what, callee in alias_hits:
             if what != "buffer()":
                 if what not in buffer_lines:

@@ -32,17 +32,21 @@ owns TWO OR MORE locks must acquire them in one global order — thread 1
 holding A while waiting on B, thread 2 holding B while waiting on A, is a
 deadlock by construction, and unlike a data race it hangs rather than
 corrupts, so no runtime harness catches it until production does. The
-checker builds the class's lock-acquisition graph — an edge A -> B for
-every site that acquires B while (lexically, or transitively through
-self-method calls) holding A — and flags every edge on a directed cycle.
-The multi-engine DynamicBatcher (serve/batcher.py) carries the codebase's
-first real two-lock pattern (_engine_lock -> _counter_lock, documented at
-the top of that file); this checker is what keeps a future edit from
-quietly adding the reverse nesting. Blind spots, by design: orders across
-DIFFERENT objects' locks (attr names are per-class), and locks handed out
-through non-`with` acquire()/release() pairs. Self-edges (re-acquiring a
-held lock) are not reported — RLock makes them legal and the ctor-type
-distinction is one assignment away from invisible.
+checker builds the PROJECT's lock-acquisition graph over (class, lock)
+nodes — an edge A -> B for every site that acquires B while holding A:
+lexically, transitively through self-method calls, and through TYPED
+receiver calls into other objects (the batcher holding its lock while
+the cache it calls takes its own, which calls into the pool's — the
+codebase's real three-class chain) — and flags every edge on a directed
+cycle at its own acquisition site. The multi-engine DynamicBatcher
+(serve/batcher.py) carries the first real two-lock pattern
+(_engine_lock -> _counter_lock, documented at the top of that file);
+this checker is what keeps a future edit from quietly adding the
+reverse nesting, within a class or across the object graph. Remaining
+blind spots: locks handed out through non-`with` acquire()/release()
+pairs, and receivers the type layer cannot resolve. Self-edges
+(re-acquiring a held lock) are not reported — RLock makes them legal
+and the ctor-type distinction is one assignment away from invisible.
 """
 
 from __future__ import annotations
@@ -336,42 +340,226 @@ class Lockset(Checker):
             walk(stmt, False)
 
 
+class _ClassScan:
+    """One lock-owning class's acquisition facts."""
+
+    def __init__(self, module: SourceModule, cls: ast.ClassDef, ckey: str):
+        self.module = module
+        self.cls_name = cls.name
+        self.ckey = ckey
+        # unit -> [(held frozenset of own lock attrs, lock attr, line)]
+        self.direct: Dict[str, List[Tuple[frozenset, str, int]]] = {}
+        # unit -> [(callee unit, held, line)] for self-method calls
+        self.intra_calls: Dict[str, List[Tuple[str, frozenset, int]]] = {}
+        # unit -> [(callee class key, callee method, held, line)] for
+        # typed-receiver calls into OTHER objects' methods
+        self.ext_calls: Dict[str, List[Tuple[str, str, frozenset, int]]] = {}
+
+
 class LockOrder(Checker):
-    """Directed-cycle detection over a class's lock-acquisition order."""
+    """Directed-cycle detection over the PROJECT's lock-acquisition graph.
+
+    Nodes are (class, lock attribute) pairs across every analyzed module;
+    edges are "acquires B while holding A" — lexically, transitively
+    through self-method calls, and through TYPED receiver calls into
+    other objects (`with self._lock: self.cache.lookup(...)` where
+    lookup takes the cache's own lock adds the cross-OBJECT edge, and the
+    cache's pool calls extend the chain). Single-lock classes
+    participate: one lock cannot conflict with itself, but it can sit in
+    the middle of a batcher -> cache -> pool chain. A cycle anywhere in
+    the composed graph deadlocks the moment two threads interleave, and
+    every edge on one is flagged at its own acquisition site's
+    file:line. Remaining blind spots: locks handed out through
+    non-`with` acquire()/release() pairs, and receivers the type layer
+    cannot resolve (untyped dynamic dispatch). Self-edges (re-acquiring
+    a held lock) are not reported — RLock makes them legal and the
+    ctor-type distinction is one assignment away from invisible.
+    """
 
     name = "lock-order"
     description = (
-        "multi-lock classes acquire their locks in one global order "
+        "locks acquire in one global order across objects "
         "(a cycle in the acquisition graph is a deadlock by construction)"
     )
 
     def check(self, module: SourceModule, ctx: Context) -> List[Finding]:
-        findings: List[Finding] = []
-        for node in module.tree.body:
-            if isinstance(node, ast.ClassDef):
-                findings.extend(self._check_class(module, node))
-        return findings
+        results = self._project_results(ctx)
+        return list(results.get(module.relpath, []))
 
-    def _check_class(
-        self, module: SourceModule, cls: ast.ClassDef
-    ) -> List[Finding]:
-        methods = [n for n in cls.body if isinstance(n, FUNC_NODES)]
-        init = next((m for m in methods if m.name == "__init__"), None)
-        lock_attrs, _ = Lockset()._classify_attrs(init)
-        if len(lock_attrs) < 2:
-            return []  # one lock cannot order-conflict with itself
+    def _project_results(self, ctx: Context) -> Dict[str, List[Finding]]:
+        key = "lock-order:results"
+        if key in ctx.scratch:
+            return ctx.scratch[key]
+        project = ctx.project
+        if project is None:
+            from glom_tpu.analysis.project import ProjectGraph
 
-        # Per method: direct acquisitions (held-set at the acquire, lock,
-        # line), self-calls (callee, held-set at the call, line), and the
-        # set of locks acquired anywhere in the body.
-        direct: Dict[str, List[Tuple[frozenset, str, int]]] = {}
-        calls: Dict[str, List[Tuple[str, frozenset, int]]] = {}
-        acquires: Dict[str, Set[str]] = {}
+            project = ProjectGraph(ctx.modules)
+        scans: Dict[str, _ClassScan] = {}
+        lock_attrs_of: Dict[str, Set[str]] = {}
+        for mod in ctx.modules:
+            minfo = project.info_of(mod)
+            for node in mod.tree.body:
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                methods = [n for n in node.body if isinstance(n, FUNC_NODES)]
+                init = next(
+                    (m for m in methods if m.name == "__init__"), None
+                )
+                locks, _ = Lockset()._classify_attrs(init)
+                if not locks:
+                    continue
+                ckey = project.class_key(minfo, node.name)
+                lock_attrs_of[ckey] = locks
+                scans[ckey] = self._scan_class(
+                    mod, node, ckey, locks, project
+                )
+        # Global fixpoint: GA[(ckey, unit)] = every (class key, lock)
+        # node the unit acquires — directly, through self-calls, or
+        # through typed calls into other classes' methods.
+        ga: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        for ckey, scan in scans.items():
+            units = (
+                set(scan.direct) | set(scan.intra_calls) | set(scan.ext_calls)
+            )
+            for unit in units:
+                ga[(ckey, unit)] = {
+                    (ckey, lock)
+                    for _, lock, _ in scan.direct.get(unit, ())
+                }
+        changed = True
+        while changed:
+            changed = False
+            for ckey, scan in scans.items():
+                for unit, sites in scan.intra_calls.items():
+                    for callee, _, _ in sites:
+                        s = ga.get((ckey, callee))
+                        if s and not s <= ga[(ckey, unit)]:
+                            ga[(ckey, unit)] |= s
+                            changed = True
+                for unit, sites in scan.ext_calls.items():
+                    for dkey, meth, _, _ in sites:
+                        s = ga.get((dkey, meth))
+                        if s and not s <= ga[(ckey, unit)]:
+                            ga[(ckey, unit)] |= s
+                            changed = True
+        # The acquisition graph over (class, lock) nodes, one witness
+        # site per edge (first seen, deterministic scan order).
+        Node = Tuple[str, str]
+        edges: Dict[Tuple[Node, Node], Tuple[str, str, str, int]] = {}
 
-        def scan(fn, unit: str) -> None:
-            direct.setdefault(unit, [])
-            calls.setdefault(unit, [])
-            acquires.setdefault(unit, set())
+        def add_edge(na: Node, nb: Node, scan: _ClassScan, unit: str, line: int) -> None:
+            if na != nb:
+                edges.setdefault(
+                    (na, nb),
+                    (scan.module.relpath, scan.cls_name, unit, line),
+                )
+
+        for ckey, scan in scans.items():
+            for unit, sites in scan.direct.items():
+                for held, lock, line in sites:
+                    for a in sorted(held):
+                        add_edge((ckey, a), (ckey, lock), scan, unit, line)
+            for unit, sites in scan.intra_calls.items():
+                for callee, held, line in sites:
+                    if not held:
+                        continue
+                    for nb in sorted(ga.get((ckey, callee), ())):
+                        for a in sorted(held):
+                            add_edge((ckey, a), nb, scan, unit, line)
+            for unit, sites in scan.ext_calls.items():
+                for dkey, meth, held, line in sites:
+                    if not held:
+                        continue
+                    for nb in sorted(ga.get((dkey, meth), ())):
+                        for a in sorted(held):
+                            add_edge((ckey, a), nb, scan, unit, line)
+
+        adj: Dict[Node, Set[Node]] = {}
+        for na, nb in edges:
+            adj.setdefault(na, set()).add(nb)
+
+        def reaches(src: Node, dst: Node) -> bool:
+            seen, frontier = {src}, [src]
+            while frontier:
+                n = frontier.pop()
+                for nxt in adj.get(n, ()):
+                    if nxt == dst:
+                        return True
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        frontier.append(nxt)
+            return False
+
+        cls_name_of = {ckey: s.cls_name for ckey, s in scans.items()}
+
+        def render(node: Node, home: str) -> str:
+            ckey, attr = node
+            if ckey == home:
+                return attr  # intra-class names (and fingerprints) stay bare
+            return f"{cls_name_of.get(ckey, ckey)}.{attr}"
+
+        results: Dict[str, List[Finding]] = {}
+        for (na, nb), (relpath, cls_name, unit, line) in sorted(
+            edges.items(), key=lambda kv: (kv[1][0], kv[1][3], kv[0])
+        ):
+            if not reaches(nb, na):
+                continue
+            home = na[0]
+            ra, rb = render(na, home), render(nb, home)
+            back = edges.get((nb, na))
+            where = (
+                f"the reverse order is taken in {back[1]}.{back[2]}() at "
+                f"{back[0]}:{back[3]}" if back else
+                "the reverse order is reachable through another edge"
+            )
+            results.setdefault(relpath, []).append(
+                Finding(
+                    checker=self.name,
+                    path=relpath,
+                    line=line,
+                    col=0,
+                    message=(
+                        f"{cls_name} acquires {rb} while holding {ra} "
+                        f"here, but {where} — a lock-order cycle "
+                        "deadlocks the moment two threads interleave"
+                    ),
+                    symbol=f"{cls_name}.{unit}",
+                    key=f"lock-order-{ra}-{rb}",
+                )
+            )
+        # The attested graph, readable node names — what the tests (and
+        # anyone debugging a chain) inspect.
+        ctx.scratch["lock-order:edges"] = {
+            (
+                f"{cls_name_of.get(na[0], na[0])}.{na[1]}",
+                f"{cls_name_of.get(nb[0], nb[0])}.{nb[1]}",
+            ): (w[0], w[3])
+            for (na, nb), w in edges.items()
+        }
+        ctx.scratch[key] = results
+        return results
+
+    def _scan_class(
+        self,
+        module: SourceModule,
+        cls: ast.ClassDef,
+        ckey: str,
+        lock_attrs: Set[str],
+        project,
+    ) -> _ClassScan:
+        scan = _ClassScan(module, cls, ckey)
+
+        def scan_fn(fn, unit: str) -> None:
+            scan.direct.setdefault(unit, [])
+            scan.intra_calls.setdefault(unit, [])
+            scan.ext_calls.setdefault(unit, [])
+            finfo = module.index.info_for(fn)
+            rtype = (
+                project.receiver_resolver(module, finfo)
+                if finfo is not None
+                else None
+            )
 
             def locks_of(with_node: ast.With) -> List[str]:
                 out = []
@@ -388,10 +576,9 @@ class LockOrder(Checker):
                     now = set(held)
                     for lock in locks_of(node):
                         if lock not in now:
-                            direct[unit].append(
+                            scan.direct[unit].append(
                                 (frozenset(now), lock, node.lineno)
                             )
-                            acquires[unit].add(lock)
                             now.add(lock)
                     for child in node.body:
                         walk(child, frozenset(now))
@@ -400,99 +587,33 @@ class LockOrder(Checker):
                     # Nested defs run later under an unknown held-set;
                     # scan them as their own unit reachable from here.
                     nested = f"{unit}.{node.name}"
-                    scan(node, nested)
-                    calls[unit].append((nested, held, node.lineno))
+                    scan_fn(node, nested)
+                    scan.intra_calls[unit].append((nested, held, node.lineno))
                     return
                 if isinstance(node, ast.Call):
                     name = call_name(node) or ""
                     if name.startswith("self.") and name.count(".") == 1:
-                        calls[unit].append(
+                        scan.intra_calls[unit].append(
                             (name.split(".")[1], held, node.lineno)
                         )
+                    elif rtype is not None and isinstance(
+                        node.func, ast.Attribute
+                    ):
+                        # A method call on SOMETHING — resolve the
+                        # receiver's type; an unresolvable receiver
+                        # contributes nothing (precision stance).
+                        t = rtype(node.func.value)
+                        if t is not None and t.cls is not None:
+                            scan.ext_calls[unit].append(
+                                (t.cls, node.func.attr, held, node.lineno)
+                            )
                 for child in ast.iter_child_nodes(node):
                     walk(child, held)
 
             for stmt in fn.body:
                 walk(stmt, frozenset())
 
-        for m in methods:
-            scan(m, m.name)
-
-        # Fixpoint: locks a method acquires TRANSITIVELY through
-        # self-calls (so `with A: self.helper()` where helper takes B
-        # contributes the A -> B edge).
-        changed = True
-        while changed:
-            changed = False
-            for unit, sites in calls.items():
-                for callee, _, _ in sites:
-                    extra = acquires.get(callee, set()) - acquires[unit]
-                    if extra:
-                        acquires[unit] |= extra
-                        changed = True
-
-        # The acquisition graph: held -> acquired, with one witness line
-        # per edge (first seen, deterministic scan order).
-        edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
-
-        def add_edge(a: str, b: str, unit: str, line: int) -> None:
-            if a != b:
-                edges.setdefault((a, b), (unit, line))
-
-        for unit, sites in direct.items():
-            for held, lock, line in sites:
-                for a in sorted(held):
-                    add_edge(a, lock, unit, line)
-        for unit, sites in calls.items():
-            for callee, held, line in sites:
-                if not held:
-                    continue
-                for b in sorted(acquires.get(callee, ())):
-                    for a in sorted(held):
-                        add_edge(a, b, unit, line)
-
-        # Every edge that lies on a directed cycle is a finding: compute
-        # reachability and keep (a, b) where b reaches a.
-        adj: Dict[str, Set[str]] = {}
-        for a, b in edges:
-            adj.setdefault(a, set()).add(b)
-
-        def reaches(src: str, dst: str) -> bool:
-            seen, frontier = {src}, [src]
-            while frontier:
-                n = frontier.pop()
-                for nxt in adj.get(n, ()):
-                    if nxt == dst:
-                        return True
-                    if nxt not in seen:
-                        seen.add(nxt)
-                        frontier.append(nxt)
-            return False
-
-        findings: List[Finding] = []
-        for (a, b), (unit, line) in sorted(
-            edges.items(), key=lambda kv: (kv[1][1], kv[0])
-        ):
-            if reaches(b, a):
-                back = edges.get((b, a))
-                where = (
-                    f"the reverse order is taken in {back[0]}() line "
-                    f"{back[1]}" if back else
-                    "the reverse order is reachable through another edge"
-                )
-                findings.append(
-                    Finding(
-                        checker=self.name,
-                        path=module.relpath,
-                        line=line,
-                        col=0,
-                        message=(
-                            f"{cls.name} acquires {b} while holding {a} "
-                            f"here, but {where} — a lock-order cycle "
-                            "deadlocks the moment two threads interleave"
-                        ),
-                        symbol=f"{cls.name}.{unit}",
-                        key=f"lock-order-{a}-{b}",
-                    )
-                )
-        return findings
+        for m in cls.body:
+            if isinstance(m, FUNC_NODES):
+                scan_fn(m, m.name)
+        return scan

@@ -22,10 +22,18 @@ intra-module call graph reachable from them, and inside that region flags:
   * Python `if`/`while` on values produced by jnp./lax. calls (branching
     on a tracer; `is None` config dispatch is exempt).
 
-Heuristic by design: cross-module calls are not followed (jnp/lax/the
-repo's own kernel helpers are trusted), and branching on raw parameters is
-not flagged (config ints thread through the same signatures as tracers).
-The seeded-violation tests in tests/test_analysis.py pin what IS caught.
+Reachability is WHOLE-PROGRAM (the project graph): a call inside the
+traced region whose name resolves to a function in another ANALYZED
+module — a bare `from utils import helper` name or a dotted
+`counters.record_collective` reference, re-export shims chased — is
+followed into that module, bounded by XMOD_DEPTH module crossings, and
+a violation is reported at the helper's own file:line. Third-party
+namespaces (jnp/lax/np) never resolve in the project graph, so they
+stay trusted exactly as before; lint a single file and the pass is the
+old per-module one. Branching on raw parameters is still not flagged
+(config ints thread through the same signatures as tracers). The
+seeded-violation tests in tests/test_analysis.py pin what IS caught;
+tests/fixtures/xmod_purity.py is the cross-module pair.
 """
 
 from __future__ import annotations
@@ -83,6 +91,19 @@ BANNED_METHODS = {
 }
 METADATA_ATTRS = {"shape", "dtype", "ndim", "size", "aval", "sharding"}
 ARRAY_PREFIXES = ("jnp.", "jax.numpy.", "lax.", "jax.lax.")
+# jnp functions that return host metadata (dtypes, dtype lattice facts),
+# not arrays — a boolean built from them is a legitimate Python branch
+# (the kernel-routing `fold`/`kernel_ok` idiom in kernels/grouped_mlp.py),
+# not a tracer branch.
+METADATA_FUNCS = {
+    "result_type", "promote_types", "issubdtype", "can_cast", "dtype",
+    "iinfo", "finfo", "ndim", "shape", "size",
+}
+
+# Module-boundary crossings the reachability BFS will follow. Every real
+# chain in the repo is 1-2 deep (shard_map body -> telemetry helper);
+# the bound keeps a pathological call web from turning the pass O(repo²).
+XMOD_DEPTH = 4
 
 
 def _unguarded_names(node: ast.AST) -> Set[str]:
@@ -101,16 +122,189 @@ def _unguarded_names(node: ast.AST) -> Set[str]:
     return out
 
 
+def _definite_source_names(node: ast.AST) -> Set[str]:
+    """Names whose VALUE can flow into an assigned target as a tracer:
+    everything outside metadata reads and outside the ARGUMENTS of
+    non-array calls. A host helper handed a tracer returns whatever it
+    returns — in this repo, dtype/shape kernel-routing booleans
+    (`kernel_ok = _supported(params, x, tile_m)`) — not the tracer
+    itself, so definiteness must not launder through it. Tracer METHODS
+    (`x.astype(...)`) keep flowing: the receiver sits in the call's func
+    chain, not its arguments."""
+    out: Set[str] = set()
+
+    def scan(n: ast.AST) -> None:
+        if isinstance(n, ast.Attribute) and n.attr in METADATA_ATTRS:
+            return
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+            return
+        if isinstance(n, ast.Call) and not TracePurity._is_array_call(n):
+            scan(n.func)
+            return
+        for child in ast.iter_child_nodes(n):
+            scan(child)
+
+    scan(node)
+    return out
+
+
 class TracePurity(Checker):
     name = "trace-purity"
     description = "host side effects inside jit/shard_map/while_loop bodies"
 
     def check(self, module: SourceModule, ctx: Context) -> List[Finding]:
-        reached = self._reachable_traced(module)
-        findings: List[Finding] = []
-        for info in reached:
-            findings.extend(self._check_function(module, info))
-        return findings
+        results = self._project_results(ctx)
+        return list(results.get(module.relpath, []))
+
+    def _project_results(
+        self, ctx: Context
+    ) -> Dict[str, List[Finding]]:
+        """Whole-program reachability, computed once per run and sliced
+        per module (findings land in the file that CONTAINS the impure
+        site, which may not be the file that traces it)."""
+        key = "trace-purity:results"
+        if key in ctx.scratch:
+            return ctx.scratch[key]
+        # Worklist over reached functions. Taint is CALL-SITE AWARE: an
+        # entry function's params are all possible tracers (jax owns the
+        # call), but a helper's params are tainted only by the arguments
+        # its reached callers actually pass tainted values into. Without
+        # this, whole-program reach re-breaks the static-config idiom —
+        # `build_local_mask(cfg.num_patches_side, ...)` builds a numpy
+        # mask from plain ints, and all-params-tainted would flag its
+        # np.meshgrid the moment any traced entry reaches it.
+        reached: Dict[int, Tuple[SourceModule, FuncInfo, int]] = {}
+        taint_in: Dict[int, Set[str]] = {}
+        queue: List[int] = []
+
+        def enqueue(
+            mod: SourceModule, info: FuncInfo, depth: int, params: Set[str]
+        ) -> None:
+            fid = id(info.node)
+            if fid not in reached:
+                reached[fid] = (mod, info, depth)
+                taint_in[fid] = set(params)
+                queue.append(fid)
+                return
+            cur_mod, cur_info, cur_depth = reached[fid]
+            changed = False
+            if depth < cur_depth:
+                reached[fid] = (cur_mod, cur_info, depth)
+                changed = True
+            if not params <= taint_in[fid]:
+                taint_in[fid] |= params
+                changed = True
+            if changed:
+                queue.append(fid)
+
+        def all_params(info: FuncInfo) -> Set[str]:
+            return {p for p in info.params if p not in ("self", "cls")}
+
+        for mod in ctx.modules:
+            for info in self._module_entries(mod):
+                enqueue(mod, info, 0, all_params(info))
+        while queue:
+            fid = queue.pop()
+            mod, info, depth = reached[fid]
+            maybe, definite = self._taint(info, taint_in[fid])
+            tainted = maybe | definite
+            for node in info.body_nodes():
+                if not isinstance(node, ast.Call):
+                    continue
+                callee_mod, callee, cdepth = None, None, depth
+                if isinstance(node.func, ast.Name):
+                    callee = info.scope.resolve(node.func.id)
+                    if callee is not None:
+                        callee_mod = mod
+                if callee is None and depth < XMOD_DEPTH and ctx.project is not None:
+                    name = call_name(node)
+                    if name and not name.startswith("self."):
+                        hit = ctx.project.resolve_function(mod, name)
+                        if hit is not None:
+                            callee_mod, callee = hit[0].module, hit[1]
+                            cdepth = depth + 1
+                if callee is not None:
+                    enqueue(
+                        callee_mod,
+                        callee,
+                        cdepth,
+                        self._call_taint(node, callee, tainted),
+                    )
+                # nested traced wrappers inside a traced region: the
+                # wrapped function is a fresh ENTRY (jax calls it), so its
+                # params are all possible tracers.
+                for target in self._traced_callables(node):
+                    rmod, resolved, rdepth = None, None, depth
+                    if isinstance(target, ast.Name):
+                        resolved = info.scope.resolve(target.id)
+                        rmod = mod
+                        if resolved is None and ctx.project is not None:
+                            hit = ctx.project.resolve_function(mod, target.id)
+                            if hit is not None:
+                                rmod, resolved = hit[0].module, hit[1]
+                                rdepth = depth + 1
+                    elif isinstance(target, SCOPE_NODES):
+                        resolved = mod.index.info_for(target)
+                        rmod = mod
+                    if resolved is not None:
+                        enqueue(rmod, resolved, rdepth, all_params(resolved))
+        results: Dict[str, List[Finding]] = {}
+        for fid, (mod, info, _depth) in reached.items():
+            for f in self._check_function(mod, info, ctx, taint_in[fid]):
+                results.setdefault(mod.relpath, []).append(f)
+        ctx.scratch[key] = results
+        return results
+
+    def _call_taint(
+        self, call: ast.Call, callee: FuncInfo, caller_tainted: Set[str]
+    ) -> Set[str]:
+        """Callee parameter names that receive a possibly-tracer value at
+        this call site: arguments referencing a caller-tainted name outside
+        metadata reads, or containing an array-producing jnp/lax call."""
+
+        def arg_tainted(expr: ast.AST) -> bool:
+            if any(
+                isinstance(sub, ast.Call) and self._is_array_call(sub)
+                for sub in ast.walk(expr)
+            ):
+                return True
+            return bool(_unguarded_names(expr) & caller_tainted)
+
+        a = callee.node.args
+        pos = [p.arg for p in a.posonlyargs + a.args]
+        out: Set[str] = set()
+        i = 0
+        for arg in call.args:
+            if isinstance(arg, ast.Starred):
+                if arg_tainted(arg):
+                    # positions are unknowable from here on
+                    out.update(pos[i:])
+                    if a.vararg:
+                        out.add(a.vararg.arg)
+                i = len(pos)
+                continue
+            if arg_tainted(arg):
+                if i < len(pos):
+                    out.add(pos[i])
+                elif a.vararg:
+                    out.add(a.vararg.arg)
+            i += 1
+        kw_capable = set(pos) | {p.arg for p in a.kwonlyargs}
+        for kw in call.keywords:
+            if not arg_tainted(kw.value):
+                continue
+            if kw.arg is None:  # **splat: keys unknowable
+                out.update(kw_capable)
+                if a.kwarg:
+                    out.add(a.kwarg.arg)
+            elif kw.arg in kw_capable:
+                out.add(kw.arg)
+            elif a.kwarg:
+                out.add(a.kwarg.arg)
+        out.discard("self")
+        out.discard("cls")
+        return out
 
     # -- entry discovery + reachability --------------------------------------
 
@@ -131,9 +325,9 @@ class TracePurity(Checker):
                 out.append(kw.value)
         return out
 
-    def _reachable_traced(self, module: SourceModule) -> List[FuncInfo]:
-        """FuncInfos reachable from any traced entry, via intra-module
-        simple-name calls (lexical scope chain)."""
+    def _module_entries(self, module: SourceModule) -> List[FuncInfo]:
+        """TRACED ENTRIES of one module (decorator and call form) — the
+        BFS over what they reach lives in _project_results."""
         entries: List[FuncInfo] = []
 
         def resolve_in(node: ast.AST, scope) -> Optional[FuncInfo]:
@@ -175,36 +369,29 @@ class TracePurity(Checker):
                 resolved = resolve_in(target, scope)
                 if resolved is not None:
                     entries.append(resolved)
-
-        # BFS through intra-module calls
-        reached: Dict[int, FuncInfo] = {}
-        queue = list(entries)
-        while queue:
-            info = queue.pop()
-            if id(info.node) in reached:
-                continue
-            reached[id(info.node)] = info
-            for node in info.body_nodes():
-                if isinstance(node, ast.Call):
-                    callee = None
-                    if isinstance(node.func, ast.Name):
-                        callee = info.scope.resolve(node.func.id)
-                    if callee is not None:
-                        queue.append(callee)
-                    # nested traced wrappers inside a traced region
-                    for target in self._traced_callables(node):
-                        resolved = resolve_in(target, info.scope)
-                        if resolved is not None:
-                            queue.append(resolved)
-        return list(reached.values())
+        return entries
 
     # -- per-function effect scan --------------------------------------------
 
-    def _taint(self, info: FuncInfo) -> Tuple[Set[str], Set[str]]:
+    @staticmethod
+    def _is_array_call(sub: ast.Call) -> bool:
+        name = call_name(sub) or ""
+        if not name.startswith(ARRAY_PREFIXES):
+            return False
+        return name.split(".")[-1] not in METADATA_FUNCS
+
+    def _taint(
+        self, info: FuncInfo, seed_params: Optional[Set[str]] = None
+    ) -> Tuple[Set[str], Set[str]]:
         """(maybe_tracer, definite_tracer) name sets, one forward pass.
-        maybe: parameters and anything derived from them. definite: values
-        produced by jnp./lax. calls (and arithmetic on them)."""
-        maybe = {p for p in info.params if p not in ("self", "cls")}
+        maybe: tainted parameters (all of them by default; the propagated
+        call-site set when the caller supplies one) and anything derived
+        from them. definite: values produced by jnp./lax. calls (and
+        arithmetic on them)."""
+        if seed_params is None:
+            maybe = {p for p in info.params if p not in ("self", "cls")}
+        else:
+            maybe = set(seed_params)
         definite: Set[str] = set()
         for node in info.body_nodes():
             if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
@@ -221,12 +408,13 @@ class TracePurity(Checker):
                 # every shape-derived loop bound reads as a tracer branch.
                 rhs_names = _unguarded_names(value)
                 rhs_calls_array = any(
-                    isinstance(sub, ast.Call)
-                    and (call_name(sub) or "").startswith(ARRAY_PREFIXES)
+                    isinstance(sub, ast.Call) and self._is_array_call(sub)
                     for sub in ast.walk(value)
                 )
                 tainted = bool(rhs_names & maybe) or rhs_calls_array
-                definite_rhs = rhs_calls_array or bool(rhs_names & definite)
+                definite_rhs = rhs_calls_array or bool(
+                    _definite_source_names(value) & definite
+                )
                 for t in targets:
                     for name in names_in(t):
                         if isinstance(name.ctx, ast.Store):
@@ -250,10 +438,24 @@ class TracePurity(Checker):
         return not scan(arg)
 
     def _check_function(
-        self, module: SourceModule, info: FuncInfo
+        self,
+        module: SourceModule,
+        info: FuncInfo,
+        ctx: Optional[Context] = None,
+        tainted_params: Optional[Set[str]] = None,
     ) -> List[Finding]:
         findings: List[Finding] = []
-        maybe, definite = self._taint(info)
+        maybe, definite = self._taint(info, tainted_params)
+
+        def resolve(name: str) -> Optional[FuncInfo]:
+            hit = info.scope.resolve(name)
+            if hit is not None:
+                return hit
+            if ctx is not None and ctx.project is not None:
+                ph = ctx.project.resolve_function(module, name)
+                if ph is not None:
+                    return ph[1]
+            return None
 
         def add(node, message, key):
             findings.append(
@@ -308,7 +510,7 @@ class TracePurity(Checker):
                             break
             elif isinstance(node, (ast.If, ast.While)):
                 test = node.test
-                if self._is_none_check(test):
+                if self._is_none_check(test, resolve):
                     continue
                 if _unguarded_names(test) & definite:
                     add(
@@ -321,13 +523,50 @@ class TracePurity(Checker):
         return findings
 
     @staticmethod
-    def _is_none_check(test: ast.AST) -> bool:
+    def _is_none_check(test: ast.AST, resolve=None) -> bool:
+        """`x is None` config dispatch, possibly spelled through a helper
+        the repo defines (`if not exists(levels):` — utils' one-liner
+        `def exists(x): return x is not None`). The helper is RESOLVED
+        (lexically, then through the project graph) and its body checked,
+        so only genuine none-check wrappers get the exemption."""
         if isinstance(test, ast.Compare) and all(
             isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
         ):
             return True
         if isinstance(test, ast.BoolOp):
-            return all(TracePurity._is_none_check(v) for v in test.values)
+            return all(
+                TracePurity._is_none_check(v, resolve) for v in test.values
+            )
         if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
-            return TracePurity._is_none_check(test.operand)
+            return TracePurity._is_none_check(test.operand, resolve)
+        if (
+            isinstance(test, ast.Call)
+            and isinstance(test.func, ast.Name)
+            and resolve is not None
+        ):
+            helper = resolve(test.func.id)
+            if helper is not None and TracePurity._returns_none_check(helper):
+                return True
         return False
+
+    @staticmethod
+    def _returns_none_check(helper: FuncInfo) -> bool:
+        node = helper.node
+        if isinstance(node, ast.Lambda):
+            body = node.body
+        else:
+            stmts = [
+                s
+                for s in node.body
+                if not (
+                    isinstance(s, ast.Expr)
+                    and isinstance(s.value, ast.Constant)
+                )
+            ]
+            if len(stmts) != 1 or not isinstance(stmts[0], ast.Return):
+                return False
+            body = stmts[0].value
+        return (
+            isinstance(body, ast.Compare)
+            and all(isinstance(op, (ast.Is, ast.IsNot)) for op in body.ops)
+        )

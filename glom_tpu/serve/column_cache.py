@@ -45,7 +45,10 @@ from __future__ import annotations
 import threading
 import time
 from collections import OrderedDict
-from typing import List, NamedTuple, Optional
+from typing import TYPE_CHECKING, Dict, List, NamedTuple, Optional
+
+if TYPE_CHECKING:  # import cycle: paged_columns never imports back
+    from glom_tpu.serve.paged_columns import PagedColumnPool
 
 import numpy as np
 
@@ -128,7 +131,7 @@ class ColumnCache:
         ttl_s: Optional[float] = None,
         writer=None,
         clock=time.monotonic,
-        pools=None,
+        pools: Optional[Dict[str, "PagedColumnPool"]] = None,
     ):
         if budget_bytes < 1:
             raise ValueError(f"budget_bytes {budget_bytes} must be >= 1")

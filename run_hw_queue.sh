@@ -20,11 +20,15 @@ step() {  # step <name> <timeout_s> <cmd...>; returns the command's rc
 }
 
 # 0. Pre-flight: glom-lint (glom_tpu/analysis) over the tree against the
-#    reviewed baseline. Pure-CPU AST pass, seconds — a hardware window
-#    must never start on code with a known collective/schema/lockset
-#    violation (exactly the class of silent mismatch that burns a pod
-#    session before anyone notices the evidence trail is wrong).
-step lint 300 python -m glom_tpu.analysis glom_tpu/ --baseline analysis_baseline.json || {
+#    reviewed baseline. Pure-CPU whole-program AST pass, seconds — a
+#    hardware window must never start on code with a known
+#    collective/schema/lockset violation (exactly the class of silent
+#    mismatch that burns a pod session before anyone notices the
+#    evidence trail is wrong). The fingerprint cache makes repeat queue
+#    runs near-instant; staleness is content-hashed per dependency
+#    closure, so a stale reuse is impossible, not just unlikely.
+step lint 300 python -m glom_tpu.analysis glom_tpu/ --baseline analysis_baseline.json \
+    --cache results/hw_queue/lint_cache.json || {
     log "glom-lint found NEW violations — fix (or review into the baseline) before burning a hardware window"; exit 1; }
 
 # 0b. Gate: is the backend actually up? (bounded — never hangs)

@@ -1,0 +1,48 @@
+"""Seeded fixture pair for donation-safety CROSS-MODULE handle flow
+(glom_tpu/analysis/donation.py + analysis/project.py).
+
+The blind spot this pair pins: the donating compiled handle lives on
+`Engine` in xmod_donation_engine.py, but it is DISPATCHED here, through
+a typed receiver (`eng: Engine`). A single-module pass has no idea
+`eng._step` donates anything. The whole-program pass must:
+
+  * flag `serve_leaky`'s use of `imgs` after the typed-receiver
+    dispatch donated it (handle-attr load across the import boundary);
+  * flag `provider_leaky` the same way when the handle arrives via the
+    provider METHOD (`eng.compile_step()`);
+  * flag `splat_leaky`'s `fn(*args)` dispatch — the donated positions
+    are statically unknowable under a splat, which used to be silently
+    skipped;
+  * leave the clean twins green (donated buffer never read again /
+    only the non-donated position reused).
+
+LINT FIXTURE: parsed, never imported (lint both files together).
+"""
+
+from xmod_donation_engine import Engine
+
+
+def serve_leaky(eng: Engine, params, imgs):
+    out = eng._step(params, imgs)
+    return out, imgs.mean()  # BUG: imgs was donated at position 1
+
+
+def serve_clean(eng: Engine, params, imgs):
+    out = eng._step(params, imgs)
+    return out, params  # position 0 is not donated
+
+
+def provider_leaky(eng: Engine, params, imgs):
+    fn = eng.compile_step()
+    out = fn(params, imgs)
+    return out, imgs.sum()  # BUG: the provider's handle donated imgs
+
+
+def provider_clean(eng: Engine, params, imgs):
+    fn = eng.compile_step()
+    return fn(params, imgs)
+
+
+def splat_leaky(eng: Engine, args):
+    fn = eng.compile_step()
+    return fn(*args)  # BUG: donated positions unknowable under a splat

@@ -1,0 +1,22 @@
+"""Companion provider module for the donation-safety CROSS-MODULE
+fixture pair (tests/fixtures/xmod_donation.py).
+
+`Engine` memoizes a donating compiled handle (`self._step`, donates
+position 1) and exposes a provider method that returns it — the
+serve/engine.py shape. Nothing in THIS module misuses the handle; the
+hazard only exists at the consumer, one import away.
+
+LINT FIXTURE: parsed, never imported.
+"""
+
+import jax
+
+
+class Engine:
+    def __init__(self):
+        self._step = jax.jit(lambda p, x: x * 2, donate_argnums=(1,))
+
+    def compile_step(self):
+        """Provider: returns the donating handle (the `_compile` shape —
+        the taint must survive the return into a typed caller)."""
+        return self._step

@@ -1,0 +1,56 @@
+"""Seeded fixture pair for the lock-order CROSS-OBJECT acquisition
+graph (glom_tpu/analysis/lockset.py LockOrder + analysis/project.py).
+
+The blind spot this pair pins: each class on its own is single-lock and
+perfectly consistent — the deadlock only exists in the CROSS-OBJECT
+order. `Cache.lookup` holds Cache._lock and calls into the typed
+`Pool`, whose `release` takes Pool._lock and calls back into the cache
+(xmod_lock_order_pool.py), taking Cache._lock again:
+
+    Cache._lock -> Pool._lock      (here, lookup)
+    Pool._lock  -> Cache._lock     (pool module, release)
+
+A per-class pass sees no pair of locks in either class. The global
+(class, lock) graph must close the cycle and flag it with the reverse
+edge's file:line. `QuietCache`/`QuietPool` are the clean twins: the
+same typed calls, but no lock is ever held across them.
+
+LINT FIXTURE: parsed, never imported (lint both files together).
+"""
+
+import threading
+
+from xmod_lock_order_pool import Pool, QuietPool
+
+
+class Cache:
+    def __init__(self, pool: Pool):
+        self._lock = threading.Lock()
+        self.pool = pool
+        self.entries = {}
+
+    def evict(self, key):
+        with self._lock:
+            self.entries.pop(key, None)
+
+    def lookup(self, key):
+        with self._lock:
+            # BUG half 1: Cache._lock is held while entering the pool,
+            # which acquires Pool._lock (and then calls back into
+            # evict — the opposite order).
+            self.pool.release(key)
+            return self.entries.get(key)
+
+
+class QuietCache:
+    def __init__(self, pool: QuietPool):
+        self._lock = threading.Lock()
+        self.pool = pool
+        self.entries = {}
+
+    def lookup(self, key):
+        with self._lock:
+            hit = self.entries.get(key)
+        if hit is None:
+            self.pool.release(key)  # lock released first: no edge
+        return hit

@@ -1,0 +1,41 @@
+"""Companion module for the lock-order CROSS-OBJECT fixture pair
+(tests/fixtures/xmod_lock_order.py).
+
+`Pool.release` acquires Pool._lock and then calls back into the typed
+`Cache` (string-annotated through the TYPE_CHECKING shim — the real
+serve modules' import-cycle idiom), closing the cross-module,
+cross-class cycle Cache._lock -> Pool._lock -> Cache._lock.
+`QuietPool` is the clean twin: it takes its own lock and calls nothing.
+
+LINT FIXTURE: parsed, never imported.
+"""
+
+import threading
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # import cycle: the cache module imports this one
+    from xmod_lock_order import Cache
+
+
+class Pool:
+    def __init__(self, cache: "Cache"):
+        self._lock = threading.Lock()
+        self.cache = cache
+        self.rows = {}
+
+    def release(self, key):
+        with self._lock:
+            # BUG half 2: Pool._lock is held while re-entering the
+            # cache, which acquires Cache._lock (see Cache.lookup for
+            # the opposite order).
+            self.cache.evict(key)
+
+
+class QuietPool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.rows = {}
+
+    def release(self, key):
+        with self._lock:
+            self.rows.pop(key, None)

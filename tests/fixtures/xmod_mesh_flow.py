@@ -1,0 +1,62 @@
+"""Seeded fixture pair for axis-environment CROSS-MODULE mesh flow
+(glom_tpu/analysis/axisenv.py + analysis/project.py).
+
+The blind spot this pair pins: this module builds NO mesh — every
+builder takes it as an opaque parameter, and the MeshConfig evidence
+lives in xmod_mesh_flow_runtime.py, one import away. A single-module
+pass has an empty module union and must SKIP all three sites; the
+whole-program pass follows the cross-module caller:
+
+  * `build_leaky`'s only caller passes a MeshConfig(data, seq) serve
+    mesh — its body's psum over MODEL_AXIS is flagged HERE, through
+    the import boundary;
+  * `build_clean` runs the same flow on a declared axis: green;
+  * `build_train`'s mesh traces back to a MeshConfig-ANNOTATED
+    parameter (the trainer/runtime shape), which attests the full
+    {data, seq, model} tuple — its 'model' psum is legal: green.
+
+LINT FIXTURE: parsed, never imported (lint both files together).
+"""
+
+from jax import lax
+
+DATA_AXIS = "data"
+SEQ_AXIS = "seq"
+MODEL_AXIS = "model"
+
+
+def shard_map(fn, mesh=None, in_specs=None, out_specs=None):  # noqa: ARG001
+    return fn
+
+
+def P(*axes):  # noqa: ARG001 — spec stand-in, parsed not executed
+    return axes
+
+
+def build_leaky(mesh):
+    def body(x):
+        # BUG: the only caller ever passes a (data, seq) serve mesh —
+        # this axis exists nowhere in this site's environment.
+        return lax.psum(x, MODEL_AXIS)
+
+    return shard_map(
+        body, mesh=mesh, in_specs=(P(DATA_AXIS),), out_specs=P()
+    )
+
+
+def build_clean(mesh):
+    def body(x):
+        return lax.psum(x, SEQ_AXIS)
+
+    return shard_map(
+        body, mesh=mesh, in_specs=(P(DATA_AXIS),), out_specs=P()
+    )
+
+
+def build_train(mesh):
+    def body(x):
+        return lax.psum(x, MODEL_AXIS)  # legal: annotated-config mesh
+
+    return shard_map(
+        body, mesh=mesh, in_specs=(P(DATA_AXIS),), out_specs=P()
+    )

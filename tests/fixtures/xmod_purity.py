@@ -1,0 +1,31 @@
+"""Seeded fixture pair for trace-purity CROSS-MODULE reachability
+(glom_tpu/analysis/purity.py + analysis/project.py).
+
+The blind spot this pair pins: `step_leaky` is jitted HERE, but the
+host `print` it reaches lives in xmod_purity_util.py — a single-module
+reachability walk ends at the import boundary and misses it. The
+whole-program walk must follow the imported call and flag the print AT
+ITS OWN file:line in the util module. `step_clean` reaches only the
+pure twin and stays green.
+
+LINT FIXTURE: parsed, never imported (lint both files together:
+run([xmod_purity.py, xmod_purity_util.py])).
+"""
+
+import jax
+
+from xmod_purity_util import log_levels, scale
+
+
+def step_leaky(x):
+    # BUG (flagged in xmod_purity_util.py, at the print): the imported
+    # helper host-prints its argument, which is a tracer here.
+    return log_levels(x) * 2
+
+
+def step_clean(x):
+    return scale(x, 2)
+
+
+fast_leaky = jax.jit(step_leaky)
+fast_clean = jax.jit(step_clean)

@@ -1400,3 +1400,343 @@ class TestHandRolledCollectiveTiming:
         )
         assert len(fs) == 1
         assert "hand-rolled" in fs[0].message and fs[0].line == 8
+
+
+# ---------------------------------------------------------------------------
+# whole-program pass: cross-module fixture pairs (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+
+def run_pair(*names, select=None, scratch=None):
+    """Lint a seeded cross-module fixture pair as one analyzed set."""
+    return run(
+        [str(FIXTURES / n) for n in names], select=select, scratch=scratch
+    )
+
+
+class TestCrossModulePairs:
+    def test_purity_reaches_through_import(self):
+        """tests/fixtures/xmod_purity.py: the jit entry lives in one
+        module, the host print one import away — flagged AT the print's
+        own file:line in the util module; the pure twin stays green."""
+        fs = by_checker(
+            run_pair("xmod_purity.py", "xmod_purity_util.py"),
+            "trace-purity",
+        )
+        assert len(fs) == 1, fs
+        assert fs[0].path.endswith("xmod_purity_util.py")
+        assert fs[0].key == "host-print"
+        src_lines = (
+            (FIXTURES / "xmod_purity_util.py").read_text().splitlines()
+        )
+        assert "print(" in src_lines[fs[0].line - 1]
+
+    def test_purity_pair_needs_both_files(self):
+        """The same leaky module linted ALONE is silent — the evidence
+        is unreachable without the companion, which is exactly the
+        blind spot the project graph closes."""
+        assert (
+            by_checker(run_pair("xmod_purity_util.py"), "trace-purity")
+            == []
+        )
+
+    def test_donation_handle_flows_through_typed_receiver(self):
+        """tests/fixtures/xmod_donation.py: the donating handle lives on
+        Engine in the companion module; the typed-receiver dispatches
+        here must taint it — direct handle-attr load, provider-method
+        return, and the *args splat (previously skipped silently)."""
+        fs = by_checker(
+            run_pair("xmod_donation.py", "xmod_donation_engine.py"),
+            "donation-safety",
+        )
+        assert all(f.path.endswith("xmod_donation.py") for f in fs)
+        by_key = sorted(f.key for f in fs)
+        assert by_key == [
+            "splat-at-donating-call",
+            "use-after-donate-imgs",
+            "use-after-donate-imgs",
+        ], fs
+        leaky = sorted(f.symbol for f in fs)
+        assert leaky == ["provider_leaky", "serve_leaky", "splat_leaky"]
+
+    def test_lock_order_cycle_across_classes_and_modules(self):
+        """tests/fixtures/xmod_lock_order.py: each class is single-lock
+        and locally consistent; the deadlock exists only in the global
+        (class, lock) graph. Both halves of the cycle are flagged, each
+        in its OWN module, and the recorded edges name both classes."""
+        scratch = {}
+        fs = by_checker(
+            run_pair(
+                "xmod_lock_order.py",
+                "xmod_lock_order_pool.py",
+                scratch=scratch,
+            ),
+            "lock-order",
+        )
+        assert len(fs) == 2, fs
+        paths = sorted(f.path for f in fs)
+        assert paths[0].endswith("xmod_lock_order.py")
+        assert paths[1].endswith("xmod_lock_order_pool.py")
+        edges = scratch["lock-order:edges"]
+        assert ("Cache._lock", "Pool._lock") in edges
+        assert ("Pool._lock", "Cache._lock") in edges
+        # the clean twins contribute no edges
+        assert not any("Quiet" in a or "Quiet" in b for a, b in edges)
+
+    def test_mesh_flow_attested_through_import(self):
+        """tests/fixtures/xmod_mesh_flow.py: the builder module owns no
+        MeshConfig at all. The serve caller's (data, seq) ctor intent
+        attests the leaky/clean sites through the import boundary; the
+        annotated-MeshConfig train parameter attests the FULL axis
+        tuple, so its 'model' psum is legal."""
+        scratch = {}
+        fs = by_checker(
+            run_pair(
+                "xmod_mesh_flow.py",
+                "xmod_mesh_flow_runtime.py",
+                scratch=scratch,
+            ),
+            "axis-environment",
+        )
+        assert len(fs) == 1, fs
+        assert fs[0].path.endswith("xmod_mesh_flow.py")
+        assert fs[0].key == "axis-env-model"
+        assert fs[0].symbol.startswith("build_leaky")
+        trail = {
+            (row[0].rsplit("/", 1)[-1], row[2], row[3])
+            for row in scratch["axis-environment:attested"]
+        }
+        assert ("xmod_mesh_flow.py", "flow", ("data", "seq")) in trail
+        assert (
+            "xmod_mesh_flow.py",
+            "flow",
+            ("data", "model", "seq"),
+        ) in trail
+        # single-module run: no caller evidence, every site skips
+        solo = {}
+        assert (
+            by_checker(
+                run_pair("xmod_mesh_flow.py", scratch=solo),
+                "axis-environment",
+            )
+            == []
+        )
+        assert all(
+            row[2] == "unattested"
+            for row in solo["axis-environment:attested"]
+        )
+
+    def test_real_repo_project_evidence(self, monkeypatch):
+        """Pins this PR's upgrades against the real tree: the attested
+        cross-object lock edges include the serve cache->pool order, and
+        the training shard_map sites in parallel/manual.py attest the
+        full axis tuple through the runtime's MeshConfig — the sites
+        that were skipped before the project graph existed."""
+        monkeypatch.chdir(REPO)
+        scratch = {}
+        run(["glom_tpu"], scratch=scratch)
+        edges = scratch["lock-order:edges"]
+        assert ("ColumnCache._lock", "PagedColumnPool._lock") in edges
+        path, line = edges[("ColumnCache._lock", "PagedColumnPool._lock")]
+        assert path == "glom_tpu/serve/column_cache.py" and line > 0
+        trail = scratch["axis-environment:attested"]
+        manual = {
+            row[1]: (row[2], row[3])
+            for row in trail
+            if row[0] == "glom_tpu/parallel/manual.py"
+        }
+        assert manual, trail
+        assert all(
+            how == "flow" and axes == ("data", "model", "seq")
+            for how, axes in manual.values()
+        ), manual
+
+
+# ---------------------------------------------------------------------------
+# analysis cache (--cache): fingerprint reuse + cross-module invalidation
+# ---------------------------------------------------------------------------
+
+
+class TestAnalysisCache:
+    UTIL = "def helper(x):\n    print('x', x)\n    return x\n"
+    APP = (
+        "import jax\n"
+        "from util import helper\n"
+        "def step(x):\n"
+        "    return helper(x)\n"
+        "fast = jax.jit(step)\n"
+    )
+    LONE = "def f(x):\n    return x\n"
+
+    def _tree(self, tmp_path):
+        (tmp_path / "util.py").write_text(self.UTIL)
+        (tmp_path / "app.py").write_text(self.APP)
+        (tmp_path / "lone.py").write_text(self.LONE)
+        return [str(tmp_path / n) for n in ("util.py", "app.py", "lone.py")]
+
+    def _cached_run(self, tmp_path, paths):
+        from glom_tpu.analysis.cache import AnalysisCache
+
+        cache = AnalysisCache(str(tmp_path / "cache.json"))
+        findings = run(paths, cache=cache)
+        return cache, findings
+
+    def test_warm_cache_replays_findings(self, tmp_path):
+        paths = self._tree(tmp_path)
+        cache, cold = self._cached_run(tmp_path, paths)
+        assert cache.stats() == "cache: 0/3 files reused (cold)"
+        # the cross-module purity finding is part of what gets stored
+        assert [f.key for f in cold] == ["host-print"]
+        cache, warm = self._cached_run(tmp_path, paths)
+        assert cache.stats() == "cache: 3/3 files reused (warm)"
+        assert [(f.fingerprint, f.line) for f in warm] == [
+            (f.fingerprint, f.line) for f in cold
+        ]
+
+    def test_cross_module_invalidation_both_directions(self, tmp_path):
+        """An import edge couples the PAIR: editing the callee must
+        re-analyze its importers (their findings read its body), and
+        editing the importer must re-analyze the callee (project-wide
+        checkers place findings in the callee that the importer's entry
+        points cause — the fixture's print is exactly that). The
+        unrelated module stays reused either way."""
+        paths = self._tree(tmp_path)
+        self._cached_run(tmp_path, paths)
+        (tmp_path / "util.py").write_text(self.UTIL.replace("'x'", "'y'"))
+        cache, _ = self._cached_run(tmp_path, paths)
+        assert cache.stats() == "cache: 1/3 files reused (mixed)"
+        assert [Path(p).name for p in cache.reused_files] == ["lone.py"]
+        self._cached_run(tmp_path, paths)  # re-warm
+        (tmp_path / "app.py").write_text(
+            self.APP + "def extra(y):\n    return y\n"
+        )
+        cache, findings = self._cached_run(tmp_path, paths)
+        assert cache.stats() == "cache: 1/3 files reused (mixed)"
+        assert [Path(p).name for p in cache.reused_files] == ["lone.py"]
+        assert [f.key for f in findings] == ["host-print"]
+
+    def test_corruption_falls_back_loudly(self, tmp_path, capsys):
+        paths = self._tree(tmp_path)
+        _, cold = self._cached_run(tmp_path, paths)
+        (tmp_path / "cache.json").write_text("{ not json")
+        cache, findings = self._cached_run(tmp_path, paths)
+        err = capsys.readouterr().err
+        assert "unreadable" in err and "FULL pass" in err
+        assert cache.stats() == "cache: 0/3 files reused (cold)"
+        assert [f.fingerprint for f in findings] == [
+            f.fingerprint for f in cold
+        ]
+        # ... and the rewritten cache warms right back up
+        cache, _ = self._cached_run(tmp_path, paths)
+        assert cache.stats() == "cache: 3/3 files reused (warm)"
+
+    def test_select_runs_never_cache(self, tmp_path):
+        from glom_tpu.analysis.cache import AnalysisCache
+
+        paths = self._tree(tmp_path)
+        cache = AnalysisCache(str(tmp_path / "cache.json"))
+        run(paths, cache=cache, select=["trace-purity"])
+        assert "disabled" in cache.stats()
+        assert not (tmp_path / "cache.json").exists()
+
+
+# ---------------------------------------------------------------------------
+# --prune-baseline
+# ---------------------------------------------------------------------------
+
+
+class TestPruneBaseline:
+    def _seed(self, tmp_path):
+        bad = tmp_path / "mod.py"
+        bad.write_text(
+            "from jax import lax\n"
+            "def f(x):\n"
+            "    return lax.psum(x, 'bogus')\n"
+            "def g(x):\n"
+            "    return lax.pmean(x, 'bogus2')\n"
+        )
+        b = tmp_path / "baseline.json"
+        assert main([str(bad), "--write-baseline", str(b)]) == 0
+        data = json.loads(b.read_text())
+        assert len(data["suppressions"]) == 2
+        for entry in data["suppressions"].values():
+            entry["reviewed"] = "seeded test suppression"
+        b.write_text(json.dumps(data))
+        # fix ONE of the two findings -> one stale entry
+        bad.write_text(
+            "from jax import lax\n"
+            "def f(x):\n"
+            "    return lax.psum(x, 'bogus')\n"
+        )
+        return bad, b
+
+    def test_dry_run_default_reports_without_writing(
+        self, tmp_path, capsys
+    ):
+        bad, b = self._seed(tmp_path)
+        before = b.read_text()
+        assert main([str(bad), "--baseline", str(b), "--prune-baseline"]) == 0
+        out = capsys.readouterr().out
+        assert "dry run" in out and "stale:" in out
+        assert b.read_text() == before
+        assert not Path(str(b) + ".removed.json").exists()
+
+    def test_apply_rewrites_and_stamps_removal_list(self, tmp_path, capsys):
+        bad, b = self._seed(tmp_path)
+        assert (
+            main(
+                [
+                    str(bad),
+                    "--baseline",
+                    str(b),
+                    "--prune-baseline",
+                    "--apply",
+                ]
+            )
+            == 0
+        )
+        out = capsys.readouterr().out
+        assert "pruned 1 entry" in out
+        data = json.loads(b.read_text())
+        assert len(data["suppressions"]) == 1
+        removal = json.loads(Path(str(b) + ".removed.json").read_text())
+        assert removal["pruned_at"] and removal["baseline"] == str(b)
+        [(fp, entry)] = removal["removed"].items()
+        assert "bogus2" in fp or "pmean" in entry["message"]
+        assert entry["reviewed"] == "seeded test suppression"
+        # the pruned baseline still gates the remaining finding green
+        assert main([str(bad), "--baseline", str(b)]) == 0
+
+    def test_nothing_stale_is_a_no_op(self, tmp_path, capsys):
+        bad, b = self._seed(tmp_path)
+        assert (
+            main(
+                [str(bad), "--baseline", str(b), "--prune-baseline", "--apply"]
+            )
+            == 0
+        )
+        capsys.readouterr()
+        assert (
+            main(
+                [str(bad), "--baseline", str(b), "--prune-baseline", "--apply"]
+            )
+            == 0
+        )
+        assert "nothing to prune" in capsys.readouterr().out
+
+    def test_partial_select_refuses_to_prune(self, tmp_path, capsys):
+        bad, b = self._seed(tmp_path)
+        assert (
+            main(
+                [
+                    str(bad),
+                    "--baseline",
+                    str(b),
+                    "--select",
+                    "collective-coverage",
+                    "--prune-baseline",
+                ]
+            )
+            == 2
+        )
+        assert "full run" in capsys.readouterr().err
